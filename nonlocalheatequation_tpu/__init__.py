@@ -1,0 +1,32 @@
+"""TPU-native framework for nonlocal (peridynamics-type) heat/diffusion equations.
+
+A ground-up JAX/XLA/Pallas/pjit re-design of the capabilities of
+nonlocalmodels/nonlocalheatequation (reference: /root/reference): explicit
+forward-Euler time stepping of
+
+    du/dt (t,x) = b(t,x) + c * integral_{H_eps(x)} J(|y-x|/eps) (u(t,y) - u(t,x)) dy
+
+on uniform grids, from serial CPU oracles up to a fully distributed 2D solver.
+Where the reference uses HPX tile components + remote actions + ghost-region
+futures, this framework uses a sharded array on a `jax.sharding.Mesh`, a
+jit-compiled whole-grid (or Pallas) horizon update, and `lax.ppermute` halo
+exchange over ICI.
+
+Layer map (mirrors SURVEY.md section 1):
+  ops/       stencil geometry, scaling constants, the nonlocal operator (L1/L3 kernel)
+  models/    solver front-ends: 1D/2D oracles + jit paths (L3)
+  parallel/  mesh/sharding, halo exchange, distributed solver, load balancing (L0/L2/L3)
+  utils/     VTU + CSV writers, timing reports, partition-map IO (L4)
+  cli/       command-line drivers mirroring the reference's flags (L5)
+"""
+
+__version__ = "0.1.0"
+MAJOR_VERSION, MINOR_VERSION, UPDATE_VERSION = (int(x) for x in __version__.split("."))
+
+from nonlocalheatequation_tpu.ops.constants import c_1d, c_2d, c_3d  # noqa: F401
+from nonlocalheatequation_tpu.ops.stencil import (  # noqa: F401
+    column_half_heights,
+    horizon_mask_1d,
+    horizon_mask_2d,
+    horizon_mask_3d,
+)

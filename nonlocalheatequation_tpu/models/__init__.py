@@ -1,0 +1,2 @@
+from nonlocalheatequation_tpu.models.solver1d import Solver1D  # noqa: F401
+from nonlocalheatequation_tpu.models.solver2d import Solver2D  # noqa: F401

@@ -1,0 +1,149 @@
+"""2D nonlocal heat solver — oracle, jit, and pipelined (async-analog) paths.
+
+Parity targets:
+* serial oracle    — src/2d_nonlocal_serial.cpp:31-304 (NumPy float64)
+* single-chip jit  — src/2d_nonlocal_async.cpp:131-473.  The reference tiles
+  the grid into np x np partitions and chains per-tile HPX tasks; on TPU the
+  whole-grid update is ONE jit'd XLA program (the "tiling" is XLA/Pallas's
+  job), and the reference's sliding-semaphore dispatch throttle
+  (2d_nonlocal_async.cpp:410,442-451) maps to JAX's async dispatch queue with
+  a periodic block every ``nd`` steps.
+
+Arrays are [x, y] of shape (nx, ny).  The grid may be a tile of a larger
+global domain (x0/y0 offsets + global extent), which is how the distributed
+solver reuses this code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nonlocalheatequation_tpu.ops.nonlocal_op import (
+    NonlocalOp2D,
+    make_multi_step_fn,
+    make_step_fn,
+    source_at,
+)
+
+
+class Solver2D:
+    def __init__(
+        self,
+        nx: int,
+        ny: int,
+        nt: int,
+        eps: int,
+        nlog: int = 5,
+        k: float = 1.0,
+        dt: float = 0.0005,
+        dh: float = 0.02,
+        backend: str = "oracle",
+        method: str = "conv",
+        nd: int | None = None,
+        logger=None,
+        dtype=None,
+    ):
+        self.nx, self.ny = int(nx), int(ny)
+        self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
+        self.op = NonlocalOp2D(eps, k, dt, dh, method=method)
+        self.backend = backend
+        self.nd = nd  # dispatch-ahead depth (async analog); None = unthrottled
+        self.logger = logger
+        self.dtype = dtype
+        self.test = False
+        self.u0 = np.zeros((self.nx, self.ny), dtype=np.float64)
+        self.u = None
+        self.error_l2 = 0.0
+        self.error_linf = 0.0
+
+    # -- initialization (2d_nonlocal_serial.cpp:180-198) --------------------
+    def test_init(self):
+        self.test = True
+        self.u0 = self.op.spatial_profile(self.nx, self.ny).copy()
+
+    def input_init(self, values):
+        self.test = False
+        self.u0 = np.asarray(values, dtype=np.float64).reshape(self.nx, self.ny)
+
+    # -- time loop (2d_nonlocal_serial.cpp:273-303) -------------------------
+    def do_work(self) -> np.ndarray:
+        g, lg = self.op.source_parts(self.nx, self.ny) if self.test else (None, None)
+
+        if self.backend == "oracle":
+            u = self._run_oracle(g, lg)
+        else:
+            u = self._run_jit(g, lg)
+
+        self.u = u
+        if self.test:
+            self.compute_l2(self.nt)
+            self.compute_linf(self.nt)
+        return u
+
+    def _run_oracle(self, g, lg):
+        u = self.u0.copy()
+        for t in range(self.nt):
+            du = self.op.apply_np(u)
+            if self.test:
+                du = du + source_at(g, lg, t, self.op.dt)
+            u = u + self.op.dt * du
+            if t % self.nlog == 0 and self.logger is not None:
+                self.logger(t, u)
+        return u
+
+    def _run_jit(self, g, lg):
+        dtype = self.dtype or (
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        )
+        u = jnp.asarray(self.u0, dtype)
+        if self.logger is None and self.nd is None:
+            # fast path: the whole time loop is one lax.scan program
+            multi = make_multi_step_fn(self.op, self.nt, g, lg, dtype)
+            return np.asarray(multi(u, 0))
+
+        step = jax.jit(make_step_fn(self.op, g, lg, dtype))
+        inflight = []
+        for t in range(self.nt):
+            u = step(u, t)
+            if t % self.nlog == 0 and self.logger is not None:
+                self.logger(t, np.asarray(u))
+            if self.nd is not None:
+                # sliding-semaphore analog (2d_nonlocal_async.cpp:442-451):
+                # keep at most nd dispatched-but-unfinished steps in flight.
+                inflight.append(u)
+                if len(inflight) > self.nd:
+                    inflight.pop(0).block_until_ready()
+        return np.asarray(u)
+
+    # -- error metrics (2d_nonlocal_serial.cpp:96-113) ----------------------
+    def compute_l2(self, t: int):
+        d = self.u - self.op.manufactured_solution(self.nx, self.ny, t)
+        self.error_l2 = float(np.sum(d * d))
+        return self.error_l2
+
+    def compute_linf(self, t: int):
+        d = self.u - self.op.manufactured_solution(self.nx, self.ny, t)
+        self.error_linf = float(np.max(np.abs(d))) if d.size else 0.0
+        return self.error_linf
+
+    def print_error(self, cmp: bool = False):
+        print(f"l2: {self.error_l2:g} linfinity: {self.error_linf:g}")
+        if cmp:
+            expected = self.op.manufactured_solution(self.nx, self.ny, self.nt)
+            for sx in range(self.nx):
+                for sy in range(self.ny):
+                    print(
+                        f"Expected: {expected[sx, sy]:g} Actual: {self.u[sx, sy]:g}"
+                    )
+
+    def print_soln(self):
+        for sx in range(self.nx):
+            print(
+                " ".join(
+                    f"S[{sx}][{sy}] = {self.u[sx, sy]:g}" for sy in range(self.ny)
+                )
+            )

@@ -1,0 +1,11 @@
+from nonlocalheatequation_tpu.ops.constants import c_1d, c_2d, c_3d  # noqa: F401
+from nonlocalheatequation_tpu.ops.stencil import (  # noqa: F401
+    column_half_heights,
+    horizon_mask_1d,
+    horizon_mask_2d,
+    horizon_mask_3d,
+)
+from nonlocalheatequation_tpu.ops.nonlocal_op import (  # noqa: F401
+    NonlocalOp1D,
+    NonlocalOp2D,
+)

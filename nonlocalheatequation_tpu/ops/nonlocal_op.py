@@ -1,0 +1,295 @@
+"""The nonlocal horizon operator — the framework's hot op.
+
+Semantics (matching the reference exactly, SURVEY.md section 0):
+
+    L(u)[p] = c * h^d * ( sum_{o in mask} J(o) * ubar[p+o]  -  Wsum * u[p] )
+
+where ``ubar`` is u extended by 0 outside the domain (volumetric boundary
+condition: reference boundary() returns 0 out of range,
+src/2d_nonlocal_serial.cpp:213-221), ``mask`` is the rasterized eps-ball
+(ops/stencil.py), J the influence function (J==1 in the reference) and
+``Wsum = sum_o J(o)`` (the center point counts).  Forward Euler:
+
+    u^{t+1} = u^t + dt * ( L(u^t) + b_t )        (src/2d_nonlocal_serial.cpp:281-283)
+
+The manufactured-solution source used by every reference test
+(src/2d_nonlocal_serial.cpp:235-252) factors as
+
+    b_t = -2*pi*sin(2*pi*t*dt) * G  -  cos(2*pi*t*dt) * L(G)
+
+with G the spatial product sin(2*pi*x*dh) [* sin(2*pi*y*dh)], because
+w(t,p) = cos(2*pi*t*dt)*G[p] is separable in time.  We precompute G and L(G)
+once instead of re-rasterizing the horizon per point per step — same math,
+O(1) extra arrays, and the whole timestep becomes one fused XLA program.
+
+Three interchangeable evaluation strategies for the neighbor sum (all
+identical up to float addition order):
+
+* ``shift`` — one padded slice-add per mask offset.  Reference-closest; great
+  for oracles and small eps.
+* ``conv``  — ``lax.conv_general_dilated`` with the 0/1 (or J-weighted) mask
+  as kernel.  XLA lowers this well on TPU.
+* ``sat``   — per-column running-sum trick: cumsum along y once, then one
+  subtraction per x-offset: O(eps) instead of O(eps^2) work per point.  This
+  is the TPU-first formulation (the circle raster is exactly a set of
+  variable-width column windows).  Caveat: prefix-sum differencing carries
+  absolute error that grows with the cumsum magnitude (~ny*|u|), so in f32 on
+  long axes it is less accurate than conv/shift; use it in f64, or tiled
+  (Pallas) where the running sum spans one tile.
+
+``shift`` and ``conv`` are identical up to float addition order; ``sat``
+additionally reassociates across the whole column (see caveat above).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nonlocalheatequation_tpu.ops.constants import c_1d, c_2d
+from nonlocalheatequation_tpu.ops.stencil import (
+    column_half_heights,
+    horizon_mask_1d,
+    horizon_mask_2d,
+    influence_weights,
+)
+
+TWO_PI = 2.0 * np.pi
+
+
+class NonlocalOp1D:
+    """1D horizon operator (reference: src/1d_nonlocal_serial.cpp:198-206)."""
+
+    def __init__(self, eps: int, k: float, dt: float, dx: float, influence=None):
+        self.eps = int(eps)
+        self.k = float(k)
+        self.dt = float(dt)
+        self.dx = float(dx)
+        self.c = c_1d(k, eps, dx)
+        self.weights = influence_weights(horizon_mask_1d(self.eps), influence, dx)
+        self.wsum = float(self.weights.sum())
+
+    # -- neighbor sum -------------------------------------------------------
+    def neighbor_sum_np(self, u: np.ndarray) -> np.ndarray:
+        nx = u.shape[0]
+        up = np.zeros(nx + 2 * self.eps, dtype=u.dtype)
+        up[self.eps : self.eps + nx] = u
+        acc = np.zeros_like(u)
+        for o in range(2 * self.eps + 1):
+            w = self.weights[o]
+            if w:
+                acc += w * up[o : o + nx]
+        return acc
+
+    def neighbor_sum(self, u: jnp.ndarray) -> jnp.ndarray:
+        up = jnp.pad(u, (self.eps, self.eps))
+        nx = u.shape[0]
+        acc = jnp.zeros_like(u)
+        for o in range(2 * self.eps + 1):
+            w = float(self.weights[o])
+            if w:
+                acc = acc + w * lax.slice(up, (o,), (o + nx,))
+        return acc
+
+    # -- operator and source ------------------------------------------------
+    def apply_np(self, u: np.ndarray) -> np.ndarray:
+        return self.c * self.dx * (self.neighbor_sum_np(u) - self.wsum * u)
+
+    def apply(self, u: jnp.ndarray) -> jnp.ndarray:
+        return self.c * self.dx * (self.neighbor_sum(u) - self.wsum * u)
+
+    def spatial_profile(self, nx: int, x0: int = 0) -> np.ndarray:
+        """G[x] = sin(2*pi*(x*dx)) for global positions x0..x0+nx."""
+        x = np.arange(x0, x0 + nx, dtype=np.float64)
+        return np.sin(TWO_PI * (x * self.dx))
+
+    def source_parts(self, nx: int):
+        """(G, L(G)) for the manufactured source (1d_nonlocal_serial.cpp:186-195)."""
+        g = self.spatial_profile(nx)
+        return g, self.apply_np(g)
+
+    def manufactured_solution(self, nx: int, t: int) -> np.ndarray:
+        return np.cos(TWO_PI * (t * self.dt)) * self.spatial_profile(nx)
+
+
+class NonlocalOp2D:
+    """2D horizon operator (reference: src/2d_nonlocal_serial.cpp:256-270).
+
+    Arrays are indexed [x, y] with shape (nx, ny), mirroring the reference's
+    sx/sy loop order.
+    """
+
+    def __init__(
+        self,
+        eps: int,
+        k: float,
+        dt: float,
+        dh: float,
+        influence=None,
+        method: str = "conv",
+    ):
+        self.eps = int(eps)
+        self.k = float(k)
+        self.dt = float(dt)
+        self.dh = float(dh)
+        self.c = c_2d(k, eps, dh)
+        self.mask = horizon_mask_2d(self.eps)
+        self.weights = influence_weights(self.mask, influence, dh)
+        self.wsum = float(self.weights.sum())
+        self.uniform = influence is None  # J == 1: sat path is valid
+        if method == "sat" and not self.uniform:
+            method = "conv"
+        self.method = method
+
+    # -- neighbor sum -------------------------------------------------------
+    def neighbor_sum_np(self, u: np.ndarray) -> np.ndarray:
+        """Oracle path: per-offset shifted adds over the masked circle."""
+        nx, ny = u.shape
+        e = self.eps
+        up = np.zeros((nx + 2 * e, ny + 2 * e), dtype=u.dtype)
+        up[e : e + nx, e : e + ny] = u
+        acc = np.zeros_like(u)
+        heights = column_half_heights(e)
+        for i in range(2 * e + 1):
+            h = int(heights[i])
+            for j in range(e - h, e + h + 1):
+                w = self.weights[i, j]
+                if w == 1.0:
+                    acc += up[i : i + nx, j : j + ny]
+                elif w:
+                    acc += w * up[i : i + nx, j : j + ny]
+        return acc
+
+    def neighbor_sum(self, u: jnp.ndarray) -> jnp.ndarray:
+        if self.method == "conv":
+            return self._neighbor_sum_conv(u)
+        if self.method == "sat":
+            return self._neighbor_sum_sat(u)
+        return self._neighbor_sum_shift(u)
+
+    def _neighbor_sum_conv(self, u: jnp.ndarray) -> jnp.ndarray:
+        kern = jnp.asarray(self.weights, dtype=u.dtype)[None, None]
+        out = lax.conv_general_dilated(
+            u[None, None],
+            kern,
+            window_strides=(1, 1),
+            padding=[(self.eps, self.eps), (self.eps, self.eps)],
+        )
+        return out[0, 0]
+
+    def _neighbor_sum_shift(self, u: jnp.ndarray) -> jnp.ndarray:
+        nx, ny = u.shape
+        e = self.eps
+        up = jnp.pad(u, ((e, e), (e, e)))
+        acc = jnp.zeros_like(u)
+        heights = column_half_heights(e)
+        for i in range(2 * e + 1):
+            h = int(heights[i])
+            for j in range(e - h, e + h + 1):
+                w = float(self.weights[i, j])
+                if w:
+                    term = lax.slice(up, (i, j), (i + nx, j + ny))
+                    acc = acc + (term if w == 1.0 else w * term)
+        return acc
+
+    def _neighbor_sum_sat(self, u: jnp.ndarray) -> jnp.ndarray:
+        """Column running-sum: O(eps) slice ops instead of O(eps^2).
+
+        The stencil column at x-offset i spans y offsets [-h_i, h_i]; with an
+        exclusive prefix sum P along y (P[n] = sum of first n), the window sum
+        at y is P[y + h_i + 1] - P[y - h_i] on the padded array.
+        """
+        nx, ny = u.shape
+        e = self.eps
+        up = jnp.pad(u, ((e, e), (e, e)))
+        # exclusive prefix sum along y, length ny + 2e + 1
+        p = jnp.concatenate(
+            [jnp.zeros((nx + 2 * e, 1), up.dtype), jnp.cumsum(up, axis=1)], axis=1
+        )
+        acc = jnp.zeros_like(u)
+        heights = column_half_heights(e)
+        for i in range(2 * e + 1):
+            h = int(heights[i])
+            hi = lax.slice(p, (i, e + h + 1), (i + nx, e + h + 1 + ny))
+            lo = lax.slice(p, (i, e - h), (i + nx, e - h + ny))
+            acc = acc + (hi - lo)
+        return acc
+
+    # -- operator and source ------------------------------------------------
+    def apply_np(self, u: np.ndarray) -> np.ndarray:
+        return self.c * self.dh * self.dh * (self.neighbor_sum_np(u) - self.wsum * u)
+
+    def apply(self, u: jnp.ndarray) -> jnp.ndarray:
+        return self.c * self.dh * self.dh * (self.neighbor_sum(u) - self.wsum * u)
+
+    def spatial_profile(self, nx: int, ny: int, x0: int = 0, y0: int = 0) -> np.ndarray:
+        """G[x,y] = sin(2*pi*x*dh) * sin(2*pi*y*dh) on global coords."""
+        x = np.arange(x0, x0 + nx, dtype=np.float64)
+        y = np.arange(y0, y0 + ny, dtype=np.float64)
+        return np.outer(np.sin(TWO_PI * (x * self.dh)), np.sin(TWO_PI * (y * self.dh)))
+
+    def source_parts(self, nx: int, ny: int):
+        """(G, L(G)) with zero-extension outside the nx x ny domain.
+
+        Together these give the manufactured source of
+        src/2d_nonlocal_serial.cpp:235-252:
+        b_t = -2*pi*sin(2*pi*t*dt)*G - cos(2*pi*t*dt)*L(G).
+        """
+        g = self.spatial_profile(nx, ny)
+        return g, self.apply_np(g)
+
+    def manufactured_solution(self, nx: int, ny: int, t: int) -> np.ndarray:
+        return np.cos(TWO_PI * (t * self.dt)) * self.spatial_profile(nx, ny)
+
+
+def source_at(g, lg, t, dt):
+    """b_t from precomputed (G, L(G)); works for np and jnp arrays, traced t.
+
+    Uses NumPy only when both the arrays and the timestep are concrete host
+    values (the oracle path); any jax array or traced ``t`` routes through jnp.
+    """
+    concrete_t = not isinstance(t, (jax.Array, jax.core.Tracer))
+    xp = np if (isinstance(g, np.ndarray) and concrete_t) else jnp
+    ang = TWO_PI * (t * dt)
+    return -TWO_PI * xp.sin(ang) * g - xp.cos(ang) * lg
+
+
+def make_step_fn(op, g=None, lg=None, dtype=None):
+    """Build the jit-able forward-Euler step: (u, t) -> u_next.
+
+    With (g, lg) supplied the manufactured test source is added, mirroring the
+    reference's ``test`` flag (src/2d_nonlocal_serial.cpp:281-283).  NumPy
+    inputs are converted to device constants up front so the step is safe to
+    trace.
+    """
+    test = g is not None
+    if test:
+        g = jnp.asarray(g, dtype)
+        lg = jnp.asarray(lg, dtype)
+
+    def step(u, t):
+        du = op.apply(u)
+        if test:
+            du = du + source_at(g, lg, t, op.dt)
+        return u + op.dt * du
+
+    return step
+
+
+def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
+    """(u, t0) -> u after ``nsteps`` forward-Euler steps, via lax.scan."""
+    step = make_step_fn(op, g, lg, dtype)
+
+    def body(u, t):
+        return step(u, t), None
+
+    @jax.jit
+    def multi(u, t0):
+        ts = t0 + jnp.arange(nsteps)
+        out, _ = lax.scan(body, u, ts)
+        return out
+
+    return multi

@@ -1,0 +1,94 @@
+"""Discrete horizon (stencil) geometry.
+
+The reference rasterizes the eps-ball as vertical line segments: for each x
+offset ``i`` in [-eps, eps] the column half-height is
+``len_i = (long)sqrt(eps*eps - i*i)`` — a double->long TRUNCATION
+(src/2d_nonlocal_serial.cpp:231, src/2d_nonlocal_distributed.cpp:1058-1060).
+``eps`` is an integer in grid units.  That truncation defines the exact
+discrete stencil shape; we reproduce it bit-for-bit here and everything else
+in the framework (oracles, jit path, Pallas kernel, halo widths) derives from
+these masks.
+
+The center point is part of the stencil; it contributes ``u_j - u_i = 0`` to
+the sum but DOES count toward the neighbor count, which matters because
+out-of-domain points contribute ``0 - u_i`` (volumetric boundary condition,
+problem_description.tex:140-142).
+"""
+
+from functools import lru_cache
+
+import numpy as np
+
+
+@lru_cache(maxsize=None)
+def column_half_heights(eps: int) -> np.ndarray:
+    """Half-height of the stencil column at each x offset in [-eps, eps].
+
+    ``len_i = trunc(sqrt(eps^2 - i^2))`` computed in float64 exactly like the
+    reference's ``len_1d_line`` (src/2d_nonlocal_serial.cpp:231).
+    """
+    i = np.arange(-eps, eps + 1, dtype=np.int64)
+    out = np.sqrt(np.float64(eps * eps) - i.astype(np.float64) ** 2).astype(np.int64)
+    out.setflags(write=False)  # cached: shared across callers
+    return out
+
+
+@lru_cache(maxsize=None)
+def horizon_mask_1d(eps: int) -> np.ndarray:
+    """1D stencil: every offset in [-eps, eps] (src/1d_nonlocal_serial.cpp:200)."""
+    out = np.ones(2 * eps + 1, dtype=bool)
+    out.setflags(write=False)
+    return out
+
+
+@lru_cache(maxsize=None)
+def horizon_mask_2d(eps: int) -> np.ndarray:
+    """(2*eps+1, 2*eps+1) bool mask of the rasterized eps-circle.
+
+    mask[i+eps, j+eps] is True iff |j| <= trunc(sqrt(eps^2 - i^2)).
+    Axis 0 is the x offset, axis 1 the y offset, matching the reference's
+    sx/sy loop nesting (src/2d_nonlocal_serial.cpp:260-262).
+    """
+    heights = column_half_heights(eps)
+    j = np.arange(-eps, eps + 1, dtype=np.int64)
+    out = np.abs(j)[None, :] <= heights[:, None]
+    out.setflags(write=False)
+    return out
+
+
+@lru_cache(maxsize=None)
+def horizon_mask_3d(eps: int) -> np.ndarray:
+    """(2e+1,)*3 bool mask of the rasterized eps-sphere (extension, no 3D in ref).
+
+    Applies the reference's column-raster recipe once more per axis:
+    |k| <= trunc(sqrt(eps^2 - i^2 - j^2)) for columns with i^2+j^2 <= eps^2.
+    """
+    i = np.arange(-eps, eps + 1, dtype=np.int64)
+    rem = np.float64(eps * eps) - i[:, None] ** 2 - i[None, :] ** 2
+    heights = np.where(rem >= 0, np.sqrt(np.maximum(rem.astype(np.float64), 0.0)), -1.0)
+    heights = np.trunc(heights).astype(np.int64)
+    out = np.abs(i)[None, None, :] <= heights[:, :, None]
+    out.setflags(write=False)
+    return out
+
+
+def mask_point_count(mask: np.ndarray) -> int:
+    """Number of stencil points (center included)."""
+    return int(mask.sum())
+
+
+def influence_weights(mask: np.ndarray, influence=None, dh: float = 1.0) -> np.ndarray:
+    """Per-offset weights J(distance) on the stencil, float64.
+
+    The reference's influence function is J == 1 everywhere
+    (src/2d_nonlocal_serial.cpp:201); pass ``influence`` (a callable of the
+    euclidean offset distance in grid units times dh) to generalize.
+    """
+    w = mask.astype(np.float64)
+    if influence is not None:
+        eps = (mask.shape[0] - 1) // 2
+        axes = np.arange(-eps, eps + 1, dtype=np.float64)
+        grids = np.meshgrid(*([axes] * mask.ndim), indexing="ij")
+        dist = np.sqrt(sum(g * g for g in grids)) * dh
+        w = w * np.vectorize(influence)(dist)
+    return w

@@ -1,0 +1,20 @@
+"""Test env: force CPU with 8 virtual devices (multi-chip stand-in) and f64.
+
+Mirrors the reference's test strategy (SURVEY.md section 4): the distributed
+ctest runs on a single host; we use XLA's host-platform device-count knob so
+sharding/collective paths execute with real (virtual) devices, the same way
+the driver's dryrun validates multi-chip compilation.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # the ambient env selects the TPU ('axon')
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# The axon TPU plugin ignores the env var; the config knob does force CPU.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,35 @@
+"""1D oracle: the reference's Test_1d batch cases (CMakeLists.txt:101)."""
+
+import pytest
+
+from tests.cases import CASES_1D, L2_THRESHOLD
+
+from nonlocalheatequation_tpu.models.solver1d import Solver1D
+from nonlocalheatequation_tpu.ops.constants import c_1d
+
+
+@pytest.mark.parametrize("nx,nt,eps,k,dt,dx", CASES_1D)
+def test_batch_case_oracle(nx, nt, eps, k, dt, dx):
+    s = Solver1D(nx, nt, eps, k=k, dt=dt, dx=dx, backend="oracle")
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / nx <= L2_THRESHOLD
+
+
+def test_c1d_truncates_like_reference():
+    # src/1d_nonlocal_serial.cpp:57 declares c_1d as long: (k*3)/pow(eps*dx,3)
+    # truncates.  k=0.5,eps=40,dx=0.02 -> 1.5/0.512 = 2.92... -> 2
+    assert c_1d(0.5, 40, 0.02) == 2.0
+    # k=0.02,eps=40,dx=0.01 -> 0.06/0.064 = 0.9375 -> 0 (tests/1d.txt row 9)
+    assert c_1d(0.02, 40, 0.01) == 0.0
+    assert c_1d(1.0, 5, 0.02) == 2999.0 or c_1d(1.0, 5, 0.02) == 3000.0
+
+
+def test_jit_matches_oracle():
+    nx, nt, eps, k, dt, dx = CASES_1D[0]
+    a = Solver1D(nx, nt, eps, k=k, dt=dt, dx=dx, backend="oracle")
+    b = Solver1D(nx, nt, eps, k=k, dt=dt, dx=dx, backend="jit")
+    a.test_init()
+    b.test_init()
+    ua, ub = a.do_work(), b.do_work()
+    assert abs(ua - ub).max() < 1e-12

@@ -1,0 +1,60 @@
+"""2D oracle: the reference's Test_2d batch cases (CMakeLists.txt:109-122)."""
+
+import numpy as np
+import pytest
+
+from tests.cases import CASES_2D, L2_THRESHOLD
+
+from nonlocalheatequation_tpu.models.solver2d import Solver2D
+from nonlocalheatequation_tpu.ops.stencil import column_half_heights, horizon_mask_2d
+
+
+@pytest.mark.parametrize("nx,ny,nt,eps,k,dt,dh", CASES_2D)
+def test_batch_case_oracle(nx, ny, nt, eps, k, dt, dh):
+    s = Solver2D(nx, ny, nt, eps, k=k, dt=dt, dh=dh, backend="oracle")
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / (nx * ny) <= L2_THRESHOLD
+
+
+def test_stencil_shape_matches_reference_raster():
+    # len_1d_line truncation (src/2d_nonlocal_serial.cpp:231): eps=5 column
+    # half-heights for offsets -5..5.
+    assert list(column_half_heights(5)) == [0, 3, 4, 4, 4, 5, 4, 4, 4, 3, 0]
+    m = horizon_mask_2d(5)
+    assert m.shape == (11, 11)
+    assert m[5, 5] and m[0, 5] and not m[0, 4]
+    # symmetric under both reflections and transpose
+    assert (m == m[::-1]).all() and (m == m[:, ::-1]).all() and (m == m.T).all()
+
+
+def test_out_of_domain_counts_with_zero_value():
+    # A point at the corner: out-of-domain stencil points contribute (0 - u),
+    # i.e. the neighbor count does NOT shrink at the boundary
+    # (boundary() returns 0, src/2d_nonlocal_serial.cpp:213-221).
+    s = Solver2D(4, 4, 1, eps=3, k=1.0, dt=1e-4, dh=0.02, backend="oracle")
+    u = np.ones((4, 4))
+    out = s.op.apply_np(u)
+    interiorish = s.op.c * s.op.dh**2
+    # all stencil sums differ from wsum*u only via missing (zero) neighbors
+    expected_corner = interiorish * (
+        horizon_mask_2d(3)[3:, 3:].sum() - horizon_mask_2d(3).sum()
+    )
+    assert np.isclose(out[0, 0], expected_corner)
+    assert out[0, 0] < 0  # ones field cools at the boundary collar
+
+
+def test_multi_step_scan_matches_oracle():
+    # make_multi_step_fn with NumPy (g, lg) inputs must trace cleanly and
+    # match the oracle run (this is the bench/production fast path).
+    from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn
+
+    nx, ny, nt, eps, k, dt, dh = CASES_2D[0]
+    s = Solver2D(nx, ny, nt, eps, k=k, dt=dt, dh=dh, backend="oracle")
+    s.test_init()
+    ref = s.do_work()
+
+    g, lg = s.op.source_parts(nx, ny)
+    multi = make_multi_step_fn(s.op, nt, g, lg)
+    out = np.asarray(multi(s.op.spatial_profile(nx, ny), 0))
+    assert abs(out - ref).max() < 1e-12

@@ -1,0 +1,111 @@
+"""Headline benchmark: 2D nonlocal heat solve, 4096^2 grid, eps=8, on one chip.
+
+Prints ONE JSON line:
+  {"metric": "points*steps/sec/chip", "value": N, "unit": "points*steps/s",
+   "vs_baseline": N}
+
+The baseline is the measured CPU stand-in for the reference's HPX single-node
+solver (native/baseline_solver, recorded in BENCH_BASELINE.json by
+tools/measure_baseline.py) — the reference publishes no numbers of its own
+(BASELINE.md), so vs_baseline is computed against that measurement when
+present and reported as 0.0 otherwise.
+
+All diagnostics go to stderr; stdout carries only the JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+GRID = int(os.environ.get("BENCH_GRID", 4096))
+EPS = int(os.environ.get("BENCH_EPS", 8))
+STEPS = int(os.environ.get("BENCH_STEPS", 50))
+METHOD = os.environ.get("BENCH_METHOD", "shift")
+
+# The axon TPU plugin ignores the JAX_PLATFORMS env var; honor an explicit
+# override through the config knob (BENCH_PLATFORM=cpu for smoke tests).
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, make_multi_step_fn
+
+    dev = jax.devices()[0]
+    log(f"device: {dev}, grid {GRID}^2, eps {EPS}, {STEPS} steps/iter, method {METHOD}")
+
+    op = NonlocalOp2D(EPS, k=1.0, dt=1e-5, dh=1.0 / GRID, method=METHOD)
+    multi = make_multi_step_fn(op, STEPS)
+
+    rng = np.random.default_rng(0)
+    u = jnp.asarray(rng.normal(size=(GRID, GRID)), jnp.float32)
+
+    def sync(x):
+        # On the axon tunnel block_until_ready() returns before execution
+        # finishes; a scalar device->host fetch is the only reliable fence.
+        return float(jnp.sum(x))
+
+    # warmup/compile
+    t0 = time.perf_counter()
+    u1 = multi(u, 0)
+    sync(u1)
+    log(f"compile+first run: {time.perf_counter() - t0:.2f}s")
+
+    # timed iterations
+    best = float("inf")
+    for it in range(3):
+        t0 = time.perf_counter()
+        u1 = multi(u1, 0)
+        sync(u1)
+        dt_s = time.perf_counter() - t0
+        best = min(best, dt_s)
+        log(f"iter {it}: {dt_s * 1e3:.1f} ms for {STEPS} steps "
+            f"({dt_s / STEPS * 1e3:.3f} ms/step)")
+
+    points_steps_per_sec = GRID * GRID * STEPS / best
+
+    # accuracy gate (stderr only): one step of METHOD at the bench dtype vs
+    # the float64 NumPy oracle on a small grid with the bench's physics.
+    try:
+        check_n = min(GRID, 512)
+        op_c = NonlocalOp2D(EPS, k=1.0, dt=1e-5, dh=1.0 / GRID, method=METHOD)
+        uc = rng.normal(size=(check_n, check_n))
+        ref = uc + op_c.dt * op_c.apply_np(uc)
+        got = np.asarray(jnp.asarray(uc, jnp.float32)
+                         + op_c.dt * op_c.apply(jnp.asarray(uc, jnp.float32)))
+        err = float(np.abs(got - ref).max())
+        log(f"accuracy: one-step max|f32 {METHOD} - f64 oracle| = {err:.3e} "
+            f"({'OK' if err < 1e-4 else 'DEGRADED'})")
+    except Exception as e:  # never let the gate break the JSON contract
+        log(f"accuracy check failed to run: {e!r}")
+
+    vs_baseline = 0.0
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_BASELINE.json")
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            base = json.load(f)
+        if base.get("points_steps_per_sec"):
+            vs_baseline = points_steps_per_sec / float(base["points_steps_per_sec"])
+
+    print(json.dumps({
+        "metric": "points*steps/sec/chip",
+        "value": points_steps_per_sec,
+        "unit": "points*steps/s",
+        "vs_baseline": vs_baseline,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Shared manufactured-solution error metrics (reference: compute_l2/compute_linf,
+src/2d_nonlocal_serial.cpp:96-113 and src/2d_nonlocal_distributed.cpp:495-520).
+
+Mixed into every 2D solver front-end; expects ``self.op`` (NonlocalOp2D),
+``self.u`` (final state), and ``self._grid_shape`` -> (NX, NY).
+"""
+
+import numpy as np
+
+
+class ManufacturedMetrics2D:
+    def compute_l2(self, t: int):
+        nx, ny = self._grid_shape
+        d = self.u - self.op.manufactured_solution(nx, ny, t)
+        self.error_l2 = float(np.sum(d * d))
+        return self.error_l2
+
+    def compute_linf(self, t: int):
+        nx, ny = self._grid_shape
+        d = self.u - self.op.manufactured_solution(nx, ny, t)
+        self.error_linf = float(np.max(np.abs(d))) if d.size else 0.0
+        return self.error_linf
+
+    def print_error(self, cmp: bool = False):
+        print(f"l2: {self.error_l2:g} linfinity: {self.error_linf:g}")
+        if cmp:
+            nx, ny = self._grid_shape
+            expected = self.op.manufactured_solution(nx, ny, self.nt)
+            for sx in range(nx):
+                for sy in range(ny):
+                    print(
+                        f"sx: {sx} sy: {sy} "
+                        f"Expected: {expected[sx, sy]:g} Actual: {self.u[sx, sy]:g}"
+                    )
+
+    def print_soln(self):
+        nx, ny = self._grid_shape
+        for sx in range(nx):
+            print(
+                " ".join(f"S[{sx}][{sy}] = {self.u[sx, sy]:g}" for sy in range(ny))
+            )

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import (
     NonlocalOp2D,
     make_multi_step_fn,
@@ -30,7 +31,7 @@ from nonlocalheatequation_tpu.ops.nonlocal_op import (
 )
 
 
-class Solver2D:
+class Solver2D(ManufacturedMetrics2D):
     def __init__(
         self,
         nx: int,
@@ -119,31 +120,7 @@ class Solver2D:
                     inflight.pop(0).block_until_ready()
         return np.asarray(u)
 
-    # -- error metrics (2d_nonlocal_serial.cpp:96-113) ----------------------
-    def compute_l2(self, t: int):
-        d = self.u - self.op.manufactured_solution(self.nx, self.ny, t)
-        self.error_l2 = float(np.sum(d * d))
-        return self.error_l2
-
-    def compute_linf(self, t: int):
-        d = self.u - self.op.manufactured_solution(self.nx, self.ny, t)
-        self.error_linf = float(np.max(np.abs(d))) if d.size else 0.0
-        return self.error_linf
-
-    def print_error(self, cmp: bool = False):
-        print(f"l2: {self.error_l2:g} linfinity: {self.error_linf:g}")
-        if cmp:
-            expected = self.op.manufactured_solution(self.nx, self.ny, self.nt)
-            for sx in range(self.nx):
-                for sy in range(self.ny):
-                    print(
-                        f"Expected: {expected[sx, sy]:g} Actual: {self.u[sx, sy]:g}"
-                    )
-
-    def print_soln(self):
-        for sx in range(self.nx):
-            print(
-                " ".join(
-                    f"S[{sx}][{sy}] = {self.u[sx, sy]:g}" for sy in range(self.ny)
-                )
-            )
+    # -- error metrics: ManufacturedMetrics2D -------------------------------
+    @property
+    def _grid_shape(self):
+        return (self.nx, self.ny)

@@ -164,52 +164,60 @@ class NonlocalOp2D:
         return acc
 
     def neighbor_sum(self, u: jnp.ndarray) -> jnp.ndarray:
-        if self.method == "conv":
-            return self._neighbor_sum_conv(u)
-        if self.method == "sat":
-            return self._neighbor_sum_sat(u)
-        return self._neighbor_sum_shift(u)
+        e = self.eps
+        return self.neighbor_sum_padded(jnp.pad(u, ((e, e), (e, e))))
 
-    def _neighbor_sum_conv(self, u: jnp.ndarray) -> jnp.ndarray:
-        kern = jnp.asarray(self.weights, dtype=u.dtype)[None, None]
+    def neighbor_sum_padded(self, upad: jnp.ndarray) -> jnp.ndarray:
+        """Valid-mode neighbor sum on a pre-padded block.
+
+        ``upad`` is (nx+2*eps, ny+2*eps) — the block plus its halo, which the
+        distributed path fills via collectives (zeros at the global edge).
+        Returns the (nx, ny) sum.
+        """
+        if self.method == "conv":
+            return self._neighbor_sum_conv(upad)
+        if self.method == "sat":
+            return self._neighbor_sum_sat(upad)
+        return self._neighbor_sum_shift(upad)
+
+    def _neighbor_sum_conv(self, upad: jnp.ndarray) -> jnp.ndarray:
+        kern = jnp.asarray(self.weights, dtype=upad.dtype)[None, None]
         out = lax.conv_general_dilated(
-            u[None, None],
+            upad[None, None],
             kern,
             window_strides=(1, 1),
-            padding=[(self.eps, self.eps), (self.eps, self.eps)],
+            padding="VALID",
         )
         return out[0, 0]
 
-    def _neighbor_sum_shift(self, u: jnp.ndarray) -> jnp.ndarray:
-        nx, ny = u.shape
+    def _neighbor_sum_shift(self, upad: jnp.ndarray) -> jnp.ndarray:
         e = self.eps
-        up = jnp.pad(u, ((e, e), (e, e)))
-        acc = jnp.zeros_like(u)
+        nx, ny = upad.shape[0] - 2 * e, upad.shape[1] - 2 * e
+        acc = jnp.zeros((nx, ny), upad.dtype)
         heights = column_half_heights(e)
         for i in range(2 * e + 1):
             h = int(heights[i])
             for j in range(e - h, e + h + 1):
                 w = float(self.weights[i, j])
                 if w:
-                    term = lax.slice(up, (i, j), (i + nx, j + ny))
+                    term = lax.slice(upad, (i, j), (i + nx, j + ny))
                     acc = acc + (term if w == 1.0 else w * term)
         return acc
 
-    def _neighbor_sum_sat(self, u: jnp.ndarray) -> jnp.ndarray:
+    def _neighbor_sum_sat(self, upad: jnp.ndarray) -> jnp.ndarray:
         """Column running-sum: O(eps) slice ops instead of O(eps^2).
 
         The stencil column at x-offset i spans y offsets [-h_i, h_i]; with an
         exclusive prefix sum P along y (P[n] = sum of first n), the window sum
         at y is P[y + h_i + 1] - P[y - h_i] on the padded array.
         """
-        nx, ny = u.shape
         e = self.eps
-        up = jnp.pad(u, ((e, e), (e, e)))
+        nx, ny = upad.shape[0] - 2 * e, upad.shape[1] - 2 * e
         # exclusive prefix sum along y, length ny + 2e + 1
         p = jnp.concatenate(
-            [jnp.zeros((nx + 2 * e, 1), up.dtype), jnp.cumsum(up, axis=1)], axis=1
+            [jnp.zeros((nx + 2 * e, 1), upad.dtype), jnp.cumsum(upad, axis=1)], axis=1
         )
-        acc = jnp.zeros_like(u)
+        acc = jnp.zeros((nx, ny), upad.dtype)
         heights = column_half_heights(e)
         for i in range(2 * e + 1):
             h = int(heights[i])
@@ -224,6 +232,16 @@ class NonlocalOp2D:
 
     def apply(self, u: jnp.ndarray) -> jnp.ndarray:
         return self.c * self.dh * self.dh * (self.neighbor_sum(u) - self.wsum * u)
+
+    def apply_padded(self, upad: jnp.ndarray) -> jnp.ndarray:
+        """L(u) for a halo-padded block: returns the (nx, ny) interior result."""
+        e = self.eps
+        center = lax.slice(
+            upad, (e, e), (upad.shape[0] - e, upad.shape[1] - e)
+        )
+        return self.c * self.dh * self.dh * (
+            self.neighbor_sum_padded(upad) - self.wsum * center
+        )
 
     def spatial_profile(self, nx: int, ny: int, x0: int = 0, y0: int = 0) -> np.ndarray:
         """G[x,y] = sin(2*pi*x*dh) * sin(2*pi*y*dh) on global coords."""

@@ -1,0 +1,8 @@
+from nonlocalheatequation_tpu.parallel.mesh import (  # noqa: F401
+    factor_devices,
+    make_mesh,
+)
+from nonlocalheatequation_tpu.parallel.halo import halo_pad_2d  # noqa: F401
+from nonlocalheatequation_tpu.parallel.distributed2d import (  # noqa: F401
+    Solver2DDistributed,
+)

@@ -1,0 +1,60 @@
+"""Device-mesh construction — placement is the mesh.
+
+The reference places npx x npy tiles on HPX localities through ``locidx`` or a
+METIS partition map (src/2d_nonlocal_distributed.cpp:105-110, 467-488).  On
+TPU, placement is a `jax.sharding.Mesh`: tile (i,j) of the global grid lives
+on mesh position (i,j), and any bijective tile->device map is expressible by
+permuting the device array handed to Mesh.  Remote object creation and
+get_data RPCs disappear; XLA collectives over ICI move the halos.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def factor_devices(n: int) -> tuple[int, int]:
+    """Factor n into the most-square (dx, dy) grid, dx*dy == n."""
+    best = (n, 1)
+    for dx in range(1, int(np.sqrt(n)) + 1):
+        if n % dx == 0:
+            best = (n // dx, dx)
+    return best
+
+
+def make_mesh(
+    npx: int | None = None,
+    npy: int | None = None,
+    devices=None,
+    assignment: np.ndarray | None = None,
+) -> Mesh:
+    """Build a 2D mesh with axes ('x', 'y').
+
+    * No arguments: use every available device, most-square factorization.
+    * (npx, npy): mesh of exactly that shape (needs npx*npy devices).
+    * assignment: (npx, npy) int array of device ids — the TPU analog of the
+      reference's partition-map file: tile (i,j) is owned by device
+      assignment[i,j].  Must be a bijection onto the device set.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if assignment is not None:
+        ids = np.asarray(assignment)
+        if sorted(ids.ravel().tolist()) != sorted(d.id for d in devices):
+            raise ValueError("assignment must be a bijection onto device ids")
+        by_id = {d.id: d for d in devices}
+        dev_grid = np.vectorize(lambda i: by_id[int(i)])(ids)
+        return Mesh(dev_grid, ("x", "y"))
+    if npx is None or npy is None:
+        npx, npy = factor_devices(len(devices))
+    if npx * npy > len(devices):
+        raise ValueError(f"mesh {npx}x{npy} needs {npx * npy} devices, have {len(devices)}")
+    dev_grid = np.asarray(devices[: npx * npy]).reshape(npx, npy)
+    return Mesh(dev_grid, ("x", "y"))
+
+
+def grid_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of the global (X, Y) grid: block per mesh position."""
+    return NamedSharding(mesh, P("x", "y"))

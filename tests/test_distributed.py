@@ -1,0 +1,80 @@
+"""Distributed solver on an 8-virtual-device CPU mesh.
+
+Mirrors the reference's Test_2d_distributed batch cases
+(CMakeLists.txt:140-154) and adds the framework's structural race-freedom
+check: multi-device == single-device == serial oracle (SURVEY.md section 5,
+"race detection").
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tests.cases import CASES_2D_DISTRIBUTED, L2_THRESHOLD
+
+from nonlocalheatequation_tpu.models.solver2d import Solver2D
+from nonlocalheatequation_tpu.parallel.distributed2d import (
+    Solver2DDistributed,
+    choose_mesh_for_grid,
+)
+from nonlocalheatequation_tpu.parallel.mesh import make_mesh
+
+
+@pytest.mark.parametrize("nx,ny,npx,npy,nt,eps,k,dt,dh", CASES_2D_DISTRIBUTED)
+def test_batch_case_distributed(nx, ny, npx, npy, nt, eps, k, dt, dh):
+    s = Solver2DDistributed(nx, ny, npx, npy, nt, eps, k=k, dt=dt, dh=dh)
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / (nx * ny * npx * npy) <= L2_THRESHOLD
+    assert s.mesh.devices.size > 1  # actually exercised the collectives
+
+
+def test_multi_device_equals_single_device():
+    # same problem on a 1-device mesh and on a 4x2 mesh; must agree ~bitwise
+    kw = dict(nt=25, eps=5, k=1.0, dt=0.0005, dh=0.02)
+    a = Solver2DDistributed(10, 10, 4, 4, mesh=make_mesh(1, 1), **kw)
+    b = Solver2DDistributed(10, 10, 4, 4, mesh=make_mesh(4, 2), **kw)
+    a.test_init()
+    b.test_init()
+    ua, ub = a.do_work(), b.do_work()
+    assert abs(ua - ub).max() < 1e-12
+
+
+def test_distributed_equals_serial_oracle():
+    o = Solver2D(40, 40, 30, eps=6, k=0.2, dt=0.0005, dh=0.02, backend="oracle")
+    d = Solver2DDistributed(10, 10, 4, 4, nt=30, eps=6, k=0.2, dt=0.0005, dh=0.02)
+    o.test_init()
+    d.test_init()
+    uo, ud = o.do_work(), d.do_work()
+    assert abs(uo - ud).max() < 1e-12
+
+
+def test_multihop_halo_when_eps_exceeds_shard():
+    # global 20x20 on a 4x2 mesh -> shard edge 5; eps=7 needs 2 hops in x.
+    o = Solver2D(20, 20, 20, eps=7, k=0.2, dt=0.0005, dh=0.02, backend="oracle")
+    d = Solver2DDistributed(
+        20, 20, 1, 1, nt=20, eps=7, k=0.2, dt=0.0005, dh=0.02, mesh=make_mesh(4, 2)
+    )
+    o.test_init()
+    d.test_init()
+    uo, ud = o.do_work(), d.do_work()
+    assert abs(uo - ud).max() < 1e-12
+
+
+def test_choose_mesh_divides_grid():
+    mesh = choose_mesh_for_grid(50, 50)
+    mx, my = mesh.shape["x"], mesh.shape["y"]
+    assert 50 % mx == 0 and 50 % my == 0 and mx * my <= len(jax.devices())
+
+
+def test_free_run_no_source_distributed():
+    # non-test path (input_init): distributed matches oracle on a decay run
+    rng = np.random.default_rng(7)
+    u0 = rng.normal(size=(24, 24))
+    o = Solver2D(24, 24, 15, eps=4, k=0.5, dt=0.001, dh=0.02, backend="oracle")
+    d = Solver2DDistributed(6, 6, 4, 4, nt=15, eps=4, k=0.5, dt=0.001, dh=0.02)
+    o.input_init(u0)
+    d.input_init(u0)
+    uo, ud = o.do_work(), d.do_work()
+    assert abs(uo - ud).max() < 1e-12

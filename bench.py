@@ -45,7 +45,12 @@ def main():
     dev = jax.devices()[0]
     log(f"device: {dev}, grid {GRID}^2, eps {EPS}, {STEPS} steps/iter, method {METHOD}")
 
-    op = NonlocalOp2D(EPS, k=1.0, dt=1e-5, dh=1.0 / GRID, method=METHOD)
+    # Forward Euler is stable only for dt * c * dh^2 * Wsum <~ 2; pick 40% of
+    # that bound so the timed state stays O(1) instead of overflowing f32.
+    probe = NonlocalOp2D(EPS, k=1.0, dt=1.0, dh=1.0 / GRID, method=METHOD)
+    dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
+    op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / GRID, method=METHOD)
+    log(f"stable dt = {dt:.3e}")
     multi = make_multi_step_fn(op, STEPS)
 
     rng = np.random.default_rng(0)
@@ -54,7 +59,11 @@ def main():
     def sync(x):
         # On the axon tunnel block_until_ready() returns before execution
         # finishes; a scalar device->host fetch is the only reliable fence.
-        return float(jnp.sum(x))
+        s = float(jnp.sum(x))
+        if not np.isfinite(s):
+            log("FATAL: benchmark state went non-finite; timings are invalid")
+            raise SystemExit(2)
+        return s
 
     # warmup/compile
     t0 = time.perf_counter()
@@ -79,11 +88,10 @@ def main():
     # the float64 NumPy oracle on a small grid with the bench's physics.
     try:
         check_n = min(GRID, 512)
-        op_c = NonlocalOp2D(EPS, k=1.0, dt=1e-5, dh=1.0 / GRID, method=METHOD)
         uc = rng.normal(size=(check_n, check_n))
-        ref = uc + op_c.dt * op_c.apply_np(uc)
+        ref = uc + op.dt * op.apply_np(uc)
         got = np.asarray(jnp.asarray(uc, jnp.float32)
-                         + op_c.dt * op_c.apply(jnp.asarray(uc, jnp.float32)))
+                         + op.dt * op.apply(jnp.asarray(uc, jnp.float32)))
         err = float(np.abs(got - ref).max())
         log(f"accuracy: one-step max|f32 {METHOD} - f64 oracle| = {err:.3e} "
             f"({'OK' if err < 1e-4 else 'DEGRADED'})")

@@ -1,0 +1,71 @@
+"""Shared CLI machinery: flag parsing conventions, batch-test protocol,
+version banner (Config.h parity, Config.h.in:11-13)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def version_banner(prog: str):
+    """Reference binaries print ``argv[0] (MAJOR.MINOR.UPDATE)`` at startup
+    (e.g. 2d_nonlocal_distributed.cpp:1416-1417)."""
+    from nonlocalheatequation_tpu import __version__
+
+    print(f"{prog} ({__version__})")
+
+
+def add_platform_flags(p: argparse.ArgumentParser):
+    p.add_argument(
+        "--platform",
+        default=None,
+        help="force a jax platform (e.g. cpu); default uses the ambient device",
+    )
+    p.add_argument(
+        "--x64",
+        type=lambda s: s.lower() in ("1", "true", "yes"),
+        default=True,
+        help="enable float64 (default true; the oracle contract is float64)",
+    )
+
+
+def apply_platform(args):
+    import jax
+
+    if args.platform:
+        # NB: the env var route is unreliable (some PJRT plugins ignore it);
+        # the config knob always works.
+        jax.config.update("jax_platforms", args.platform)
+    if args.x64:
+        jax.config.update("jax_enable_x64", True)
+
+
+def bool_flag(p: argparse.ArgumentParser, name: str, default: bool, help: str):
+    """Boost-program_options-style bool: --name true|false|0|1."""
+    p.add_argument(
+        f"--{name}",
+        type=lambda s: s.lower() in ("1", "true", "yes"),
+        default=default,
+        help=help,
+    )
+
+
+def run_batch(read_case, run_case, threshold=1e-6):
+    """The reference's batch_tester protocol (1d_nonlocal_serial.cpp:239-266):
+    stdin = num_tests then one parameter row per test; prints "Tests Passed"
+    or "Tests Failed" (the ctest pass/fail regex).
+
+    ``read_case(tokens)`` parses one row; ``run_case(case) -> (error_l2, n)``.
+    """
+    tokens = sys.stdin.read().split()
+    num_tests = int(tokens[0])
+    pos = 1
+    failed = False
+    for _ in range(num_tests):
+        case, pos = read_case(tokens, pos)
+        error_l2, n = run_case(case)
+        if error_l2 / n > threshold:
+            failed = True
+            break
+    print("Tests Failed" if failed else "Tests Passed")
+    return 1 if failed else 0

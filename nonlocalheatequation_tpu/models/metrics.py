@@ -21,6 +21,10 @@ class ManufacturedMetrics2D:
         self.error_linf = float(np.max(np.abs(d))) if d.size else 0.0
         return self.error_linf
 
+    #: distributed print_error prefixes coordinates (2d_nonlocal_distributed.
+    #: cpp:538-541); the serial binary does not (2d_nonlocal_serial.cpp:122).
+    _cmp_coordinate_prefix = False
+
     def print_error(self, cmp: bool = False):
         print(f"l2: {self.error_l2:g} linfinity: {self.error_linf:g}")
         if cmp:
@@ -28,9 +32,12 @@ class ManufacturedMetrics2D:
             expected = self.op.manufactured_solution(nx, ny, self.nt)
             for sx in range(nx):
                 for sy in range(ny):
+                    prefix = (
+                        f"sx: {sx} sy: {sy} " if self._cmp_coordinate_prefix else ""
+                    )
                     print(
-                        f"sx: {sx} sy: {sy} "
-                        f"Expected: {expected[sx, sy]:g} Actual: {self.u[sx, sy]:g}"
+                        f"{prefix}Expected: {expected[sx, sy]:g} "
+                        f"Actual: {self.u[sx, sy]:g}"
                     )
 
     def print_soln(self):

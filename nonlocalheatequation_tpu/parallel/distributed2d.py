@@ -27,13 +27,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, source_at
 from nonlocalheatequation_tpu.parallel.halo import halo_pad_2d
-from nonlocalheatequation_tpu.parallel.mesh import make_mesh
+from nonlocalheatequation_tpu.parallel.mesh import grid_sharding, make_mesh
 
 
 def choose_mesh_for_grid(NX: int, NY: int, devices=None) -> Mesh:
@@ -127,7 +127,7 @@ class Solver2DDistributed(ManufacturedMetrics2D):
         dtype = self.dtype or (
             jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         )
-        sharding = NamedSharding(self.mesh, P("x", "y"))
+        sharding = grid_sharding(self.mesh)
         u = jax.device_put(jnp.asarray(self.u0, dtype), sharding)
         if not self.test:
             return u, ()
@@ -165,6 +165,8 @@ class Solver2DDistributed(ManufacturedMetrics2D):
         return self.u
 
     # -- error metrics: ManufacturedMetrics2D -------------------------------
+    _cmp_coordinate_prefix = True
+
     @property
     def _grid_shape(self):
         return (self.NX, self.NY)

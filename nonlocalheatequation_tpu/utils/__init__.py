@@ -1,0 +1,13 @@
+from nonlocalheatequation_tpu.utils.vtu import VtuWriter  # noqa: F401
+from nonlocalheatequation_tpu.utils.csvlog import SimulationCsvLogger  # noqa: F401
+from nonlocalheatequation_tpu.utils.timing import (  # noqa: F401
+    print_time_results_1d,
+    print_time_results_2d,
+    print_time_results_async,
+    print_time_results_distributed,
+)
+from nonlocalheatequation_tpu.utils.partition_map import (  # noqa: F401
+    PartitionMap,
+    read_partition_map,
+    write_partition_map,
+)

@@ -1,0 +1,73 @@
+"""CLI drivers: batch-test protocol ("Tests Passed" regex, the reference's
+ctest contract, CMakeLists.txt:101-154) and normal runs."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(module, args, stdin=""):
+    env = {**os.environ}
+    return subprocess.run(
+        [sys.executable, "-m", f"nonlocalheatequation_tpu.cli.{module}",
+         "--platform", "cpu", *args],
+        input=stdin, capture_output=True, text=True, timeout=540, cwd=REPO,
+        env=env,
+    )
+
+
+def test_1d_batch_small():
+    r = run_cli("solve1d", ["--test_batch"], stdin="2\n50 45 5 1 0.001 0.02\n50 500 5 1 0.001 0.02\n")
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+    assert r.returncode == 0
+
+
+def test_2d_batch_small():
+    r = run_cli("solve2d", ["--test_batch"], stdin="1\n50 50 45 5 1 0.0005 0.02\n")
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+
+
+def test_2d_batch_failure_detected():
+    # absurd dt makes the scheme blow up -> "Tests Failed" with exit code 1
+    r = run_cli("solve2d", ["--test_batch"], stdin="1\n20 20 40 5 1 5.0 0.02\n")
+    assert "Tests Failed" in r.stdout
+    assert r.returncode == 1
+
+
+def test_async_batch_degenerate_tiles():
+    # np=20 with nx=1: tile smaller than horizon (reference case row 9)
+    r = run_cli("solve2d_async", ["--test_batch"], stdin="1\n1 1 20 40 5 0.2 0.001 0.02\n")
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+
+
+def test_distributed_batch():
+    r = run_cli("solve2d_distributed", ["--test_batch"],
+                stdin="1\n25 25 2 2 45 5 1 0.0005 0.02\n")
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+
+
+def test_2d_normal_run_prints_error_and_timing():
+    r = run_cli("solve2d", ["--test", "--cmp", "false", "--nt", "5",
+                            "--nx", "20", "--ny", "20"])
+    assert "l2:" in r.stdout and "linfinity:" in r.stdout
+    assert "OS_Threads" in r.stdout  # timing header
+    assert r.stdout.startswith("2d_nonlocal (")  # version banner
+
+
+def test_distributed_with_partition_map(tmp_path):
+    # the reference reads tile sizes + dh from the map file (--file)
+    mapfile = tmp_path / "map.txt"
+    mapfile.write_text("10 10 2 2 0.02\n0 0 0\n0 1 0\n1 0 0\n1 1 0\n")
+    r = run_cli("solve2d_distributed",
+                ["--file", str(mapfile), "--nt", "5", "--cmp", "false"])
+    assert "l2:" in r.stdout, r.stdout + r.stderr
+
+
+def test_1d_results_and_input_init():
+    vals = " ".join(["0.5"] * 10)
+    r = run_cli("solve1d", ["--nx", "10", "--nt", "3", "--results"], stdin=vals)
+    assert "S[0] =" in r.stdout

@@ -1,0 +1,278 @@
+// Native CPU baseline: faithful reimplementation of the reference's 2D
+// nonlocal heat solver (semantics of src/2d_nonlocal_serial.cpp:31-304 and
+// the single-node task-parallel src/2d_nonlocal_async.cpp), threaded with
+// OpenMP in place of HPX tasks.
+//
+// Purpose (BASELINE.md): the reference publishes no performance numbers, so
+// the "HPX single-node baseline" the TPU framework is measured against must
+// itself be measured.  This binary is that stand-in: identical math
+//   u^{t+1} = u^t + dt * ( c * dh^2 * ( sum_{o in eps-ball} ubar[p+o]
+//                                        - W * u[p] )  +  b_t[p] )
+// with the circle rasterized by truncated column half-heights
+// (len = (long)sqrt(eps^2 - i^2), src/2d_nonlocal_distributed.cpp:1058-1060),
+// c_2d = 8k/(eps*dh)^4 (src/2d_nonlocal_serial.cpp:76), volumetric zero
+// boundary via a zero-padded array, and forward-Euler time stepping
+// (src/2d_nonlocal_serial.cpp:273-303).  The per-point direct O(eps^2) sum is
+// what the reference does; OpenMP parallel-for over rows is the fair analog
+// of its one-task-per-tile parallelism on a single node.
+//
+// Usage:
+//   baseline_solver [--nx N] [--ny N] [--nt T] [--eps E] [--k K] [--dt DT]
+//                   [--dh DH] [--test] [--bench] [--json]
+//
+//   --test   manufactured-solution run; prints error_l2 / error_linf and
+//            "Tests Passed"/"Tests Failed" with the reference's
+//            error_l2/#points <= 1e-6 criterion
+//            (src/2d_nonlocal_serial.cpp:320).
+//   --bench  random init, timed steps; prints a JSON line with
+//            points*steps/sec (stdout).
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+constexpr double kTwoPi = 2.0 * M_PI;
+
+struct Params {
+  long nx = 200, ny = 200, nt = 40;
+  long eps = 5;
+  double k = 1.0, dt = 5e-4, dh = 0.02;
+  bool test = false, bench = false, json = false;
+};
+
+double now_sec() {
+#ifdef _OPENMP
+  return omp_get_wtime();
+#else
+  return static_cast<double>(clock()) / CLOCKS_PER_SEC;
+#endif
+}
+
+// Grid with a zero halo of width eps on every side: ubar(x, y) reads the
+// volumetric boundary condition for free (reference boundary() returns 0
+// outside the domain, src/2d_nonlocal_serial.cpp:213-221).
+class Grid {
+ public:
+  Grid(long nx, long ny, long eps)
+      : nx_(nx), ny_(ny), eps_(eps), stride_(ny + 2 * eps),
+        data_((nx + 2 * eps) * (ny + 2 * eps), 0.0) {}
+
+  double* row(long x) { return data_.data() + (x + eps_) * stride_ + eps_; }
+  const double* row(long x) const {
+    return data_.data() + (x + eps_) * stride_ + eps_;
+  }
+  long stride() const { return stride_; }
+
+ private:
+  long nx_, ny_, eps_, stride_;
+  std::vector<double> data_;
+};
+
+class Solver {
+ public:
+  explicit Solver(const Params& p)
+      : p_(p), c_(8.0 * p.k / std::pow(p.eps * p.dh, 4.0)),
+        half_(2 * p.eps + 1), u_{Grid(p.nx, p.ny, p.eps), Grid(p.nx, p.ny, p.eps)},
+        g_(p.nx, p.ny, p.eps), lg_(p.nx, p.ny, p.eps) {
+    // Truncated column half-heights define the exact discrete stencil
+    // (src/2d_nonlocal_distributed.cpp:1058-1060).
+    wsum_ = 0.0;
+    for (long i = -p.eps; i <= p.eps; ++i) {
+      long h = static_cast<long>(
+          std::sqrt(static_cast<double>(p.eps * p.eps - i * i)));
+      half_[i + p.eps] = h;
+      wsum_ += static_cast<double>(2 * h + 1);
+    }
+  }
+
+  void init_test() {
+    // w(0, x, y) = sin(2 pi x dh) sin(2 pi y dh)
+    // (src/2d_nonlocal_distributed.cpp:184-189); the manufactured source
+    // factors as b_t = -2 pi sin(2 pi t dt) G - cos(2 pi t dt) L(G) because
+    // w = cos(2 pi t dt) * G is separable in time.
+    for (long x = 0; x < p_.nx; ++x) {
+      double sx = std::sin(kTwoPi * x * p_.dh);
+      double* gu = u_[0].row(x);
+      double* gg = g_.row(x);
+      for (long y = 0; y < p_.ny; ++y) {
+        gg[y] = sx * std::sin(kTwoPi * y * p_.dh);
+        gu[y] = gg[y];
+      }
+    }
+    apply_op(g_, lg_);
+  }
+
+  void init_random(uint64_t seed) {
+    std::mt19937_64 rng(seed);
+    std::normal_distribution<double> nd(0.0, 1.0);
+    for (long x = 0; x < p_.nx; ++x) {
+      double* r = u_[0].row(x);
+      for (long y = 0; y < p_.ny; ++y) r[y] = nd(rng);
+    }
+  }
+
+  // L(v) = c * dh^2 * (neighbor_sum - W * v), the hot kernel
+  // (src/2d_nonlocal_serial.cpp:256-270).
+  void apply_op(const Grid& v, Grid& out) const {
+    const double scale = c_ * p_.dh * p_.dh;
+    const long stride = v.stride();
+#pragma omp parallel for schedule(static)
+    for (long x = 0; x < p_.nx; ++x) {
+      const double* center = v.row(x);
+      double* o = out.row(x);
+      for (long y = 0; y < p_.ny; ++y) {
+        double acc = 0.0;
+        for (long i = -p_.eps; i <= p_.eps; ++i) {
+          const long h = half_[i + p_.eps];
+          const double* line = center + i * stride + y;
+          for (long j = -h; j <= h; ++j) acc += line[j];
+        }
+        o[y] = scale * (acc - wsum_ * center[y]);
+      }
+    }
+  }
+
+  // One forward-Euler step into the other buffer
+  // (src/2d_nonlocal_serial.cpp:273-291).
+  void step(long t) {
+    const Grid& cur = u_[t & 1];
+    Grid& nxt = u_[(t + 1) & 1];
+    const double scale = c_ * p_.dh * p_.dh;
+    const long stride = cur.stride();
+    const double ang = kTwoPi * (t * p_.dt);
+    const double st = -kTwoPi * std::sin(ang), ct = std::cos(ang);
+#pragma omp parallel for schedule(static)
+    for (long x = 0; x < p_.nx; ++x) {
+      const double* center = cur.row(x);
+      double* o = nxt.row(x);
+      const double* gg = g_.row(x);
+      const double* glg = lg_.row(x);
+      for (long y = 0; y < p_.ny; ++y) {
+        double acc = 0.0;
+        for (long i = -p_.eps; i <= p_.eps; ++i) {
+          const long h = half_[i + p_.eps];
+          const double* line = center + i * stride + y;
+          for (long j = -h; j <= h; ++j) acc += line[j];
+        }
+        double du = scale * (acc - wsum_ * center[y]);
+        if (p_.test) du += st * gg[y] - ct * glg[y];
+        o[y] = center[y] + p_.dt * du;
+      }
+    }
+  }
+
+  void run() {
+    for (long t = 0; t < p_.nt; ++t) step(t);
+  }
+
+  // "l2" / linf vs the manufactured solution at t = nt.  Note the
+  // reference's error_l2 is the raw SUM of squared errors, no sqrt
+  // (src/2d_nonlocal_serial.cpp:96-103); the <= 1e-6 * #points criterion is
+  // stated against that quantity (src/2d_nonlocal_serial.cpp:320).
+  void errors(double* l2, double* linf) const {
+    const Grid& fin = u_[p_.nt & 1];
+    double s = 0.0, m = 0.0;
+    const double ct = std::cos(kTwoPi * (p_.nt * p_.dt));
+    for (long x = 0; x < p_.nx; ++x) {
+      const double* r = fin.row(x);
+      const double* gg = g_.row(x);
+      for (long y = 0; y < p_.ny; ++y) {
+        double d = std::fabs(r[y] - ct * gg[y]);
+        s += d * d;
+        if (d > m) m = d;
+      }
+    }
+    *l2 = s;
+    *linf = m;
+  }
+
+  double checksum() const {
+    const Grid& fin = u_[p_.nt & 1];
+    double s = 0.0;
+    for (long x = 0; x < p_.nx; ++x) {
+      const double* r = fin.row(x);
+      for (long y = 0; y < p_.ny; ++y) s += r[y];
+    }
+    return s;
+  }
+
+ private:
+  Params p_;
+  double c_, wsum_;
+  std::vector<long> half_;
+  Grid u_[2];
+  Grid g_, lg_;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Params p;
+  for (int a = 1; a < argc; ++a) {
+    auto next = [&](const char* flag) -> double {
+      if (a + 1 >= argc) {
+        std::fprintf(stderr, "missing value for %s\n", flag);
+        std::exit(2);
+      }
+      return std::atof(argv[++a]);
+    };
+    if (!std::strcmp(argv[a], "--nx")) p.nx = static_cast<long>(next("--nx"));
+    else if (!std::strcmp(argv[a], "--ny")) p.ny = static_cast<long>(next("--ny"));
+    else if (!std::strcmp(argv[a], "--nt")) p.nt = static_cast<long>(next("--nt"));
+    else if (!std::strcmp(argv[a], "--eps")) p.eps = static_cast<long>(next("--eps"));
+    else if (!std::strcmp(argv[a], "--k")) p.k = next("--k");
+    else if (!std::strcmp(argv[a], "--dt")) p.dt = next("--dt");
+    else if (!std::strcmp(argv[a], "--dh")) p.dh = next("--dh");
+    else if (!std::strcmp(argv[a], "--test")) p.test = true;
+    else if (!std::strcmp(argv[a], "--bench")) p.bench = true;
+    else if (!std::strcmp(argv[a], "--json")) p.json = true;
+    else {
+      std::fprintf(stderr, "unknown flag %s\n", argv[a]);
+      return 2;
+    }
+  }
+
+  int threads = 1;
+#ifdef _OPENMP
+  threads = omp_get_max_threads();
+#endif
+
+  Solver s(p);
+  if (p.test) s.init_test();
+  else s.init_random(0);
+
+  double t0 = now_sec();
+  s.run();
+  double elapsed = now_sec() - t0;
+  double rate = static_cast<double>(p.nx) * p.ny * p.nt / elapsed;
+
+  if (p.test) {
+    double l2, linf;
+    s.errors(&l2, &linf);
+    double n = static_cast<double>(p.nx) * p.ny;
+    std::fprintf(stderr, "error_l2=%.9e error_linf=%.9e\n", l2, linf);
+    std::printf("%s\n", (l2 / n <= 1e-6) ? "Tests Passed" : "Tests Failed");
+  }
+  if (p.bench || p.json) {
+    std::printf(
+        "{\"metric\": \"points*steps/sec\", \"value\": %.6e, "
+        "\"unit\": \"points*steps/s\", \"grid\": [%ld, %ld], \"eps\": %ld, "
+        "\"steps\": %ld, \"threads\": %d, \"elapsed_sec\": %.6f, "
+        "\"checksum\": %.6e}\n",
+        rate, p.nx, p.ny, p.eps, p.nt, threads, elapsed, s.checksum());
+  } else if (!p.test) {
+    std::printf("Threads,Execution_Time_sec,nx,ny,Time_Steps\n");
+    std::printf("%d,%.6f,%ld,%ld,%ld\n", threads, elapsed, p.nx, p.ny, p.nt);
+  }
+  return 0;
+}

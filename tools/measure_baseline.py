@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Measure the CPU baseline and record it in BENCH_BASELINE.json.
+
+The reference publishes no performance numbers (BASELINE.md), so the number
+that bench.py's ``vs_baseline`` divides by must be measured: this script
+builds native/baseline_solver (the faithful OpenMP reimplementation of the
+reference's single-node 2D solver) and times it on the headline workload
+(4096^2 grid, eps=8 — BASELINE.json north star), then writes the result next
+to bench.py.
+
+Usage:  python tools/measure_baseline.py [--grid 4096] [--eps 8] [--steps 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+BIN = os.path.join(NATIVE, "build", "baseline_solver")
+
+
+def build() -> None:
+    subprocess.run(["make", "-C", NATIVE, "build/baseline_solver"], check=True)
+
+
+def stable_dt(grid: int, eps: int, k: float = 1.0) -> float:
+    """Same 40%-of-stability-bound choice bench.py makes, so the timed state
+    stays finite: dt * c * dh^2 * Wsum == 0.8."""
+    import math
+
+    dh = 1.0 / grid
+    c = 8.0 * k / (eps * dh) ** 4
+    wsum = sum(2 * int(math.sqrt(eps * eps - i * i)) + 1
+               for i in range(-eps, eps + 1))
+    return 0.8 / (c * dh * dh * wsum)
+
+
+def run_case(grid: int, eps: int, steps: int) -> dict:
+    out = subprocess.run(
+        [BIN, "--nx", str(grid), "--ny", str(grid), "--nt", str(steps),
+         "--eps", str(eps), "--dh", str(1.0 / grid),
+         "--dt", repr(stable_dt(grid, eps)), "--bench"],
+        check=True, capture_output=True, text=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grid", type=int, default=int(os.environ.get("BENCH_GRID", 4096)))
+    ap.add_argument("--eps", type=int, default=int(os.environ.get("BENCH_EPS", 8)))
+    ap.add_argument("--steps", type=int, default=3,
+                    help="timed steps; the per-step cost is flat so few are needed")
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_BASELINE.json"))
+    args = ap.parse_args()
+
+    build()
+
+    # correctness gate first: the baseline must pass the reference's own
+    # manufactured-solution criterion before its timing means anything
+    # reference tests/2d.txt row 4: 200x200, nt=40, eps=5, k=1, dt=5e-4, dh=0.02
+    check = subprocess.run(
+        [BIN, "--nx", "200", "--ny", "200", "--nt", "40", "--eps", "5",
+         "--dh", "0.02", "--dt", "0.0005", "--test"],
+        check=True, capture_output=True, text=True,
+    )
+    if "Tests Passed" not in check.stdout:
+        print("baseline solver failed its manufactured-solution test:",
+              check.stdout, check.stderr, file=sys.stderr)
+        return 1
+    print("baseline correctness: Tests Passed", file=sys.stderr)
+
+    best = None
+    for rep in range(2):
+        r = run_case(args.grid, args.eps, args.steps)
+        print(f"rep {rep}: {r['value']:.3e} points*steps/s "
+              f"({r['elapsed_sec']:.2f}s, {r['threads']} threads)",
+              file=sys.stderr)
+        if best is None or r["value"] > best["value"]:
+            best = r
+
+    ncpu = os.cpu_count() or 1
+    if best["threads"] < ncpu:
+        print(f"WARNING: baseline used {best['threads']} threads on a "
+              f"{ncpu}-core host; the single-node comparison basis is "
+              "understated", file=sys.stderr)
+    record = {
+        "points_steps_per_sec": best["value"],
+        "grid": args.grid,
+        "eps": args.eps,
+        "steps": args.steps,
+        "threads": best["threads"],
+        "host_cpu_count": ncpu,
+        "elapsed_sec": best["elapsed_sec"],
+        "host": platform.processor() or platform.machine(),
+        "solver": "native/baseline_solver (OpenMP, reference-faithful math)",
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

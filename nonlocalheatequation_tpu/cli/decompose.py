@@ -1,0 +1,84 @@
+"""Domain-decomposition CLI — the reference's ``2d_domain_decomposition``.
+
+Usage parity (src/domain_decomposition.cpp:55-58):
+
+    nlheat-decompose mesh.msh out.txt N [--sx S] [--sy S]
+
+The reference prompts for the coarse grain sizes on stdin
+(domain_decomposition.cpp:138-156); ``--sx/--sy`` provide them
+non-interactively (scripts, CI), and when omitted the tool prints the same
+mesh-size information and reads the two values from stdin, so existing
+pipelines keep working.  The output partition-map file format is identical
+(write_mesh, domain_decomposition.cpp:31-50).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from nonlocalheatequation_tpu.utils.decompose import decompose, infer_structured_grid
+from nonlocalheatequation_tpu.utils.gmsh import read_msh
+from nonlocalheatequation_tpu.utils.partition_map import write_partition_map
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="2d_domain_decomposition")
+    p.add_argument("mesh", help="input GMSH .msh file (ASCII 4.1 or 2.2)")
+    p.add_argument("out", help="output partition-map file")
+    p.add_argument("nodes", type=int,
+                   help="number of compute nodes/devices to partition for")
+    p.add_argument("--sx", type=int, default=None,
+                   help="coarse grain size along x (per-tile cells); must divide the mesh size")
+    p.add_argument("--sy", type=int, default=None,
+                   help="coarse grain size along y; must divide the mesh size")
+    return p
+
+
+def _read_int(prompt: str) -> int | None:
+    """Prompt and consume ONE whitespace-delimited integer from stdin, like
+    the reference's ``cin >>`` (works at a TTY line-by-line and with piped
+    "5 5" input)."""
+    print(prompt, flush=True)
+    buf = getattr(_read_int, "_buf", [])
+    while not buf:
+        line = sys.stdin.readline()
+        if not line:
+            return None
+        buf = line.split()
+    tok, _read_int._buf = buf[0], buf[1:]
+    return int(tok)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    msh = read_msh(args.mesh)
+    mx, my, dh = infer_structured_grid(msh)
+    print("\nSize of mesh is as follows:")
+    print(f"x dimension : {mx}\ny dimension : {my}")
+
+    # flags fill what they can; anything missing is prompted for on stdin in
+    # the reference's order (domain_decomposition.cpp:138-156)
+    sx, sy = args.sx, args.sy
+    if sx is None:
+        sx = _read_int("\nEnter coarse mesh size along x-dimension")
+    if sy is None:
+        sy = _read_int("\nEnter coarse mesh size along y-dimension")
+    if sx is None or sy is None:
+        print("expected coarse grain sizes on stdin", file=sys.stderr)
+        return 2
+
+    try:
+        pmap = decompose(msh, args.nodes, sx, sy)
+    except ValueError as e:
+        print(str(e))
+        return 0  # the reference exits 0 on divisibility failure, message printed
+    write_partition_map(args.out, pmap)
+    print(f"wrote {args.out}: {pmap.npx}x{pmap.npy} tiles of "
+          f"{pmap.nx}x{pmap.ny}, {args.nodes} owners")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

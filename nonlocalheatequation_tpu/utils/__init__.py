@@ -11,3 +11,15 @@ from nonlocalheatequation_tpu.utils.partition_map import (  # noqa: F401
     read_partition_map,
     write_partition_map,
 )
+from nonlocalheatequation_tpu.utils.gmsh import (  # noqa: F401
+    MshData,
+    read_msh,
+    write_structured_msh,
+)
+# NOTE: the `decompose` FUNCTION is deliberately not re-exported here — it
+# would shadow the `utils.decompose` submodule; use
+# `from nonlocalheatequation_tpu.utils.decompose import decompose`.
+from nonlocalheatequation_tpu.utils.decompose import (  # noqa: F401
+    infer_structured_grid,
+    partition_coarse_grid,
+)

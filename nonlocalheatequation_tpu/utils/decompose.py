@@ -1,0 +1,174 @@
+"""Domain decomposition — the reference's offline partitioning toolchain.
+
+Pipeline parity with src/domain_decomposition.cpp:52-195, redesigned to be
+dependency-free: the GMSH C++ API becomes utils/gmsh.py, and METIS's
+``METIS_PartMeshDual`` becomes the native RCB + dual-graph-refinement library
+(native/partition.cc, loaded via ctypes) with a pure-NumPy RCB fallback of
+identical semantics.
+
+Steps (mirroring the reference):
+  1. read the .msh, find the quad elements (type 3),
+  2. infer dh from the first quad's first two nodes and the bounding box
+     (domain_decomposition.cpp:99-121), mx = round((maxx-minx)/dh),
+  3. validate the coarse tile sizes divide (mx, my); npx = mx // size_x,
+  4. nparts < 2: every tile -> owner 0 (the reference's METIS FPE bypass,
+     domain_decomposition.cpp:169-170); else partition the npx x npy coarse
+     grid into nparts balanced contiguous regions (dual-graph ncommon=1,
+     i.e. 8-neighbor adjacency, domain_decomposition.cpp:185-187),
+  5. produce a PartitionMap (header "mx/npx my/npy npx npy dh").
+
+On TPU the map's owner ids become mesh placement (parallel/mesh.make_mesh
+``assignment=``) or the load balancer's initial tile assignment.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from nonlocalheatequation_tpu.utils.gmsh import MshData, read_msh
+from nonlocalheatequation_tpu.utils.partition_map import PartitionMap
+
+_NATIVE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "build", "libpartition.so",
+)
+
+
+def _load_native():
+    if not os.path.exists(_NATIVE):
+        return None
+    try:
+        lib = ctypes.CDLL(_NATIVE)
+    except OSError:
+        return None
+    lib.partition_rcb.restype = ctypes.c_int32
+    lib.partition_rcb.argtypes = [
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+    ]
+    lib.refine_cut.restype = ctypes.c_int64
+    lib.refine_cut.argtypes = [
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_int32,
+    ]
+    return lib
+
+
+_native_lib = _load_native()
+
+
+def rcb_numpy(xy: np.ndarray, nparts: int) -> np.ndarray:
+    """Pure-NumPy recursive coordinate bisection, same semantics as the
+    native partition_rcb (balanced to +-1, longer-axis median splits,
+    deterministic index tie-break)."""
+    n = xy.shape[0]
+    parts = np.zeros(n, dtype=np.int32)
+
+    def rec(elems: np.ndarray, part0: int, k: int):
+        if k <= 1:
+            parts[elems] = part0
+            return
+        box = xy[elems]
+        axis = 0 if np.ptp(box[:, 0]) >= np.ptp(box[:, 1]) else 1
+        nleft = k // 2
+        mid = int(len(elems) * nleft / k)
+        order = np.lexsort((elems, xy[elems, axis]))
+        elems = elems[order]
+        rec(elems[:mid], part0, nleft)
+        rec(elems[mid:], part0 + nleft, k - nleft)
+
+    rec(np.arange(n, dtype=np.int64), 0, nparts)
+    return parts
+
+
+def dual_graph_csr(npx: int, npy: int) -> tuple[np.ndarray, np.ndarray]:
+    """CSR adjacency of the coarse-grid dual graph with METIS ncommon=1
+    semantics: tiles sharing at least one node are adjacent (8-neighbor)."""
+    xadj = [0]
+    adj: list[int] = []
+    for idy in range(npy):
+        for idx in range(npx):
+            for dy in (-1, 0, 1):
+                for dx in (-1, 0, 1):
+                    if dx == 0 and dy == 0:
+                        continue
+                    jx, jy = idx + dx, idy + dy
+                    if 0 <= jx < npx and 0 <= jy < npy:
+                        adj.append(jy * npx + jx)
+            xadj.append(len(adj))
+    return np.asarray(xadj, np.int64), np.asarray(adj, np.int64)
+
+
+def partition_coarse_grid(npx: int, npy: int, nparts: int) -> np.ndarray:
+    """(npx, npy) owner ids for the coarse tile grid, [idx, idy]-indexed.
+
+    nparts < 2 short-circuits to all-zeros exactly like the reference
+    (domain_decomposition.cpp:169-170).
+    """
+    assignment = np.zeros((npx, npy), dtype=np.int64)
+    if nparts < 2:
+        return assignment
+    # centroids in (idx, idy) flat row-major order over idy-major enumeration
+    ids = np.arange(npx * npy)
+    xy = np.stack([(ids % npx) + 0.5, (ids // npx) + 0.5], axis=1).astype(np.float64)
+    if _native_lib is not None:
+        parts = np.zeros(npx * npy, dtype=np.int32)
+        if _native_lib.partition_rcb(npx * npy, np.ascontiguousarray(xy),
+                                     nparts, parts) != 0:
+            raise RuntimeError("native partition_rcb failed")
+        xadj, adj = dual_graph_csr(npx, npy)
+        _native_lib.refine_cut(npx * npy, xadj, adj, nparts, parts, 8)
+    else:
+        parts = rcb_numpy(xy, nparts)
+    assignment[ids % npx, ids // npx] = parts
+    return assignment
+
+
+def infer_structured_grid(msh: MshData) -> tuple[int, int, float]:
+    """(mx, my, dh) of the structured quad mesh, the reference's recipe.
+
+    dh is the coordinate difference between the first quad's first two nodes
+    (max of x-diff and |y-diff|, domain_decomposition.cpp:99-104); mx, my
+    come from the quad-node bounding box (106-121).
+    """
+    qc = msh.quad_coords()
+    if qc.shape[0] == 0:
+        raise ValueError("mesh contains no quadrangle (type 3) elements")
+    first = qc[0]
+    dh = max(first[0, 0] - first[1, 0], abs(first[0, 1] - first[1, 1]))
+    if dh <= 0:
+        raise ValueError(f"could not infer a positive dh (got {dh})")
+    xs, ys = qc[..., 0], qc[..., 1]
+    mx = round(float(xs.max() - xs.min()) / dh)
+    my = round(float(ys.max() - ys.min()) / dh)
+    return int(mx), int(my), float(dh)
+
+
+def decompose(mesh: str | MshData, nparts: int, coarse_x: int, coarse_y: int) -> PartitionMap:
+    """Full pipeline: .msh (path or already-parsed MshData) -> PartitionMap.
+
+    ``coarse_x, coarse_y`` are the per-tile sizes the reference prompts for on
+    stdin (domain_decomposition.cpp:138-156); they must divide the inferred
+    mesh sizes.
+    """
+    if isinstance(mesh, str):
+        mesh = read_msh(mesh)
+    mx, my, dh = infer_structured_grid(mesh)
+    if coarse_x < 1 or mx % coarse_x != 0:
+        raise ValueError(
+            f"mesh size x ({mx}) not divisible by coarse grain size {coarse_x}")
+    if coarse_y < 1 or my % coarse_y != 0:
+        raise ValueError(
+            f"mesh size y ({my}) not divisible by coarse grain size {coarse_y}")
+    npx, npy = mx // coarse_x, my // coarse_y
+    assignment = partition_coarse_grid(npx, npy, nparts)
+    return PartitionMap(mx // npx, my // npy, npx, npy, dh, assignment)

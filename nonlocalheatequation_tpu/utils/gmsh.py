@@ -1,0 +1,169 @@
+"""Minimal GMSH ``.msh`` ASCII reader/writer — no GMSH dependency.
+
+The reference's decomposition tool links the GMSH 4.7 C++ API just to pull
+node coordinates and quad connectivity out of a ``.msh`` file
+(src/domain_decomposition.cpp:68-80).  This module reads the same information
+directly from the two ASCII format generations in the wild (4.1, the format
+of the reference's data/*.msh fixtures, and legacy 2.2), and can generate
+structured rectangle meshes so the toolchain is self-contained.
+
+Only what the decomposition pipeline needs is parsed: node tag -> (x, y, z)
+and 4-node quadrangle connectivity (GMSH element type 3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+QUAD_TYPE = 3  # 4-node quadrangle (GMSH element type id)
+
+
+@dataclass
+class MshData:
+    """Node coordinates and quad connectivity of one .msh file.
+
+    ``coords[i]`` is the (x, y, z) of node tag ``node_tags[i]``; ``quads``
+    holds 4 node *tags* per row (GMSH tags are 1-based and may be sparse).
+    """
+
+    node_tags: np.ndarray  # (n,) int64
+    coords: np.ndarray  # (n, 3) float64
+    quads: np.ndarray  # (m, 4) int64 node tags
+
+    def quad_coords(self) -> np.ndarray:
+        """(m, 4, 3) coordinates of each quad's corners."""
+        order = np.argsort(self.node_tags, kind="stable")
+        pos = np.searchsorted(self.node_tags, self.quads.ravel(), sorter=order)
+        flat = order[pos]
+        if not np.array_equal(self.node_tags[flat], self.quads.ravel()):
+            raise ValueError("quad connectivity references unknown node tags")
+        return self.coords[flat].reshape(-1, 4, 3)
+
+
+def _sections(text: str) -> dict[str, list[str]]:
+    out: dict[str, list[str]] = {}
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        if line.startswith("$") and not line.startswith("$End"):
+            name = line[1:]
+            j = i + 1
+            while j < len(lines) and lines[j].strip() != f"$End{name}":
+                j += 1
+            out[name] = [l.strip() for l in lines[i + 1 : j] if l.strip()]
+            i = j + 1
+        else:
+            i += 1
+    return out
+
+
+def _parse_nodes_41(body: list[str]):
+    # numEntityBlocks numNodes minTag maxTag; then per block:
+    #   dim entityTag parametric numNodesInBlock; tags...; xyz...
+    nblocks = int(body[0].split()[0])
+    tags, coords = [], []
+    pos = 1
+    for _ in range(nblocks):
+        n = int(body[pos].split()[3])
+        pos += 1
+        tags.extend(int(body[pos + i]) for i in range(n))
+        pos += n
+        for i in range(n):
+            coords.append([float(v) for v in body[pos + i].split()[:3]])
+        pos += n
+    return np.asarray(tags, np.int64), np.asarray(coords, np.float64)
+
+
+def _parse_elements_41(body: list[str]) -> np.ndarray:
+    nblocks = int(body[0].split()[0])
+    quads = []
+    pos = 1
+    for _ in range(nblocks):
+        _dim, _etag, etype, n = (int(v) for v in body[pos].split())
+        pos += 1
+        if etype == QUAD_TYPE:
+            for i in range(n):
+                quads.append([int(v) for v in body[pos + i].split()[1:5]])
+        pos += n
+    return np.asarray(quads, np.int64).reshape(-1, 4)
+
+
+def _parse_nodes_22(body: list[str]):
+    n = int(body[0])
+    tags = np.empty(n, np.int64)
+    coords = np.empty((n, 3), np.float64)
+    for i in range(n):
+        parts = body[1 + i].split()
+        tags[i] = int(parts[0])
+        coords[i] = [float(v) for v in parts[1:4]]
+    return tags, coords
+
+
+def _parse_elements_22(body: list[str]) -> np.ndarray:
+    n = int(body[0])
+    quads = []
+    for i in range(n):
+        parts = [int(v) for v in body[1 + i].split()]
+        etype, ntags = parts[1], parts[2]
+        if etype == QUAD_TYPE:
+            quads.append(parts[3 + ntags : 7 + ntags])
+    return np.asarray(quads, np.int64).reshape(-1, 4)
+
+
+def read_msh(path: str) -> MshData:
+    """Parse a GMSH ASCII .msh file (format 4.1 or 2.2)."""
+    with open(path) as f:
+        sections = _sections(f.read())
+    if "MeshFormat" not in sections:
+        raise ValueError(f"{path}: not a GMSH .msh file (no $MeshFormat)")
+    version, filetype = sections["MeshFormat"][0].split()[:2]
+    if filetype != "0":
+        raise ValueError(f"{path}: binary .msh not supported (file-type {filetype})")
+    major = version.split(".")[0]
+    if major == "4":
+        tags, coords = _parse_nodes_41(sections["Nodes"])
+        quads = _parse_elements_41(sections["Elements"])
+    elif major == "2":
+        tags, coords = _parse_nodes_22(sections["Nodes"])
+        quads = _parse_elements_22(sections["Elements"])
+    else:
+        raise ValueError(f"{path}: unsupported .msh version {version}")
+    return MshData(tags, coords, quads)
+
+
+def write_structured_msh(path: str, mx: int, my: int, dh: float,
+                         x0: float = 0.0, y0: float = 0.0) -> None:
+    """Write an mx x my structured quad mesh as GMSH 4.1 ASCII.
+
+    Replaces running GMSH to mesh a rectangle: one surface entity, nodes on
+    the (mx+1) x (my+1) lattice with spacing dh, row-major quads.  Readable
+    by this module and by GMSH itself.
+    """
+    nnx, nny = mx + 1, my + 1
+    nnodes, nquads = nnx * nny, mx * my
+    with open(path, "w") as f:
+        f.write("$MeshFormat\n4.1 0 8\n$EndMeshFormat\n")
+        f.write("$Entities\n0 0 1 0\n1 "
+                f"{x0:g} {y0:g} 0 {x0 + mx * dh:g} {y0 + my * dh:g} 0 0 0\n"
+                "$EndEntities\n")
+        f.write(f"$Nodes\n1 {nnodes} 1 {nnodes}\n2 1 0 {nnodes}\n")
+        for t in range(1, nnodes + 1):
+            f.write(f"{t}\n")
+        for j in range(nny):
+            for i in range(nnx):
+                f.write(f"{x0 + i * dh:.17g} {y0 + j * dh:.17g} 0\n")
+        f.write("$EndNodes\n")
+        f.write(f"$Elements\n1 {nquads} 1 {nquads}\n2 1 {QUAD_TYPE} {nquads}\n")
+        # corner order matches GMSH's output for a meshed rectangle (first two
+        # nodes differ in y), which the reference's dh-inference recipe
+        # depends on (domain_decomposition.cpp:99-104)
+        tag = 1
+        for j in range(my):
+            for i in range(mx):
+                n0 = j * nnx + i + 1
+                f.write(f"{tag} {n0} {n0 + nnx} {n0 + nnx + 1} {n0 + 1}\n")
+                tag += 1
+        f.write("$EndElements\n")

@@ -1,0 +1,138 @@
+"""Decomposition toolchain tests — the C8 analog (SURVEY.md section 3.4).
+
+Covers: structured .msh generation + parsing, dh/size inference (the
+reference's recipe, domain_decomposition.cpp:99-121), RCB partitioning
+(native and NumPy paths agree; balanced; contiguous), the nparts<2 bypass,
+divisibility validation, the CLI surface (flags and stdin modes), and the
+partition-map round trip into mesh placement.
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from nonlocalheatequation_tpu.utils import decompose as dc
+from nonlocalheatequation_tpu.utils.gmsh import read_msh, write_structured_msh
+from nonlocalheatequation_tpu.utils.partition_map import read_partition_map
+
+
+@pytest.fixture
+def msh_20x10(tmp_path):
+    path = str(tmp_path / "20x10.msh")
+    write_structured_msh(path, 20, 10, 0.05)
+    return path
+
+
+def test_msh_roundtrip_and_inference(msh_20x10):
+    msh = read_msh(msh_20x10)
+    assert msh.quads.shape == (200, 4)
+    assert msh.coords.shape == (231, 3)
+    mx, my, dh = dc.infer_structured_grid(msh)
+    assert (mx, my) == (20, 10)
+    assert dh == pytest.approx(0.05)
+
+
+def test_quad_corner_coords_consistent(msh_20x10):
+    qc = read_msh(msh_20x10).quad_coords()
+    # every quad is an axis-aligned dh x dh square, corners ordered like
+    # GMSH's (first two nodes differ in y)
+    for q in qc[:5]:
+        assert q[1, 1] - q[0, 1] == pytest.approx(0.05)
+        assert q[3, 0] - q[0, 0] == pytest.approx(0.05)
+
+
+def test_partition_balanced_and_contiguous():
+    a = dc.partition_coarse_grid(8, 8, 4)
+    counts = np.bincount(a.ravel(), minlength=4)
+    assert counts.max() - counts.min() <= 1
+    # contiguity: each part's tiles form one 4-connected component
+    for p in range(4):
+        tiles = {(int(x), int(y)) for x, y in zip(*np.nonzero(a == p))}
+        seen = set()
+        stack = [next(iter(tiles))]
+        while stack:
+            t = stack.pop()
+            if t in seen or t not in tiles:
+                continue
+            seen.add(t)
+            x, y = t
+            stack += [(x + 1, y), (x - 1, y), (x, y + 1), (x, y - 1)]
+        assert seen == tiles, f"part {p} is not contiguous"
+
+
+def test_partition_single_node_bypass():
+    # reference: METIS FPE workaround, all tiles -> locality 0
+    assert (dc.partition_coarse_grid(5, 5, 1) == 0).all()
+
+
+def test_numpy_fallback_matches_native():
+    if dc._native_lib is None:
+        pytest.skip("native partition library not built")
+    ids = np.arange(6 * 4)
+    xy = np.stack([(ids % 6) + 0.5, (ids // 6) + 0.5], 1).astype(np.float64)
+    np_parts = dc.rcb_numpy(xy, 4)
+    nat = np.zeros(24, dtype=np.int32)
+    assert dc._native_lib.partition_rcb(24, np.ascontiguousarray(xy), 4, nat) == 0
+    assert (np_parts == nat).all()
+
+
+def test_decompose_divisibility_error(msh_20x10):
+    with pytest.raises(ValueError, match="not divisible"):
+        dc.decompose(msh_20x10, 2, 3, 5)
+
+
+def test_decompose_pipeline(msh_20x10, tmp_path):
+    pmap = dc.decompose(msh_20x10, 4, coarse_x=5, coarse_y=5)
+    assert (pmap.npx, pmap.npy) == (4, 2)
+    assert (pmap.nx, pmap.ny) == (5, 5)
+    assert pmap.dh == pytest.approx(0.05)
+    assert pmap.num_owners == 4
+
+
+def test_cli_flags_mode(msh_20x10, tmp_path):
+    out = str(tmp_path / "map.txt")
+    r = subprocess.run(
+        [sys.executable, "-m", "nonlocalheatequation_tpu.cli.decompose",
+         msh_20x10, out, "2", "--sx", "5", "--sy", "5"],
+        capture_output=True, text=True, check=True)
+    assert "x dimension : 20" in r.stdout
+    pmap = read_partition_map(out)
+    assert (pmap.npx, pmap.npy) == (4, 2)
+    counts = np.bincount(pmap.assignment.ravel(), minlength=2)
+    assert counts.max() - counts.min() <= 1
+
+
+def test_cli_stdin_mode(msh_20x10, tmp_path):
+    out = str(tmp_path / "map.txt")
+    r = subprocess.run(
+        [sys.executable, "-m", "nonlocalheatequation_tpu.cli.decompose",
+         msh_20x10, out, "1"],
+        input="5 5\n", capture_output=True, text=True, check=True)
+    assert "Enter coarse mesh size" in r.stdout
+    pmap = read_partition_map(out)
+    assert (pmap.assignment == 0).all()
+
+
+def test_cli_one_flag_prompts_for_other(msh_20x10, tmp_path):
+    out = str(tmp_path / "map.txt")
+    r = subprocess.run(
+        [sys.executable, "-m", "nonlocalheatequation_tpu.cli.decompose",
+         msh_20x10, out, "2", "--sx", "5"],
+        input="5\n", capture_output=True, text=True, check=True)
+    # only the missing size is prompted for; --sx 5 is kept
+    assert "along y-dimension" in r.stdout
+    assert "along x-dimension" not in r.stdout
+    pmap = read_partition_map(out)
+    assert (pmap.nx, pmap.ny) == (5, 5)
+    assert (pmap.npx, pmap.npy) == (4, 2)
+
+
+def test_cli_bad_divisor_exits_zero(msh_20x10, tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "nonlocalheatequation_tpu.cli.decompose",
+         msh_20x10, str(tmp_path / "map.txt"), "2", "--sx", "3", "--sy", "5"],
+        capture_output=True, text=True)
+    assert r.returncode == 0
+    assert "not divisible" in r.stdout

@@ -35,19 +35,27 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _read_int(prompt: str) -> int | None:
-    """Prompt and consume ONE whitespace-delimited integer from stdin, like
-    the reference's ``cin >>`` (works at a TTY line-by-line and with piped
-    "5 5" input)."""
-    print(prompt, flush=True)
-    buf = getattr(_read_int, "_buf", [])
-    while not buf:
-        line = sys.stdin.readline()
-        if not line:
+def _stdin_int_reader():
+    """cin->style token reader: each call prompts and consumes ONE
+    whitespace-delimited integer from stdin (works at a TTY line-by-line and
+    with piped "5 5" input).  Buffer state is per-reader, not global."""
+    buf: list[str] = []
+
+    def read(prompt: str) -> int | None:
+        print(prompt, flush=True)
+        while not buf:
+            line = sys.stdin.readline()
+            if not line:
+                return None
+            buf.extend(line.split())
+        tok = buf.pop(0)
+        try:
+            return int(tok)
+        except ValueError:
+            print(f"invalid coarse grain size: {tok!r}", file=sys.stderr)
             return None
-        buf = line.split()
-    tok, _read_int._buf = buf[0], buf[1:]
-    return int(tok)
+
+    return read
 
 
 def main(argv=None) -> int:
@@ -60,11 +68,12 @@ def main(argv=None) -> int:
 
     # flags fill what they can; anything missing is prompted for on stdin in
     # the reference's order (domain_decomposition.cpp:138-156)
+    read_int = _stdin_int_reader()
     sx, sy = args.sx, args.sy
     if sx is None:
-        sx = _read_int("\nEnter coarse mesh size along x-dimension")
+        sx = read_int("\nEnter coarse mesh size along x-dimension")
     if sy is None:
-        sy = _read_int("\nEnter coarse mesh size along y-dimension")
+        sy = read_int("\nEnter coarse mesh size along y-dimension")
     if sx is None or sy is None:
         print("expected coarse grain sizes on stdin", file=sys.stderr)
         return 2

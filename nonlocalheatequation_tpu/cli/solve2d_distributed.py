@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dt", type=float, default=0.0005)
     p.add_argument("--dh", type=float, default=0.05)
     p.add_argument("--no-header", action="store_true", dest="no_header")
+    p.add_argument("--devices", type=int, default=0,
+                   help="limit the device count (the reference's number of "
+                        "localities, srun -n N); 0 = all")
     p.add_argument("--method", default="conv", choices=("conv", "shift", "sat"))
     p.add_argument("--log", action="store_true")
     add_platform_flags(p)
@@ -63,21 +66,46 @@ def main(argv=None) -> int:
     from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
 
     nx, ny, npx, npy, dh = args.nx, args.ny, args.npx, args.npy, args.dh
+    assignment = None
     if args.file != "None":
         from nonlocalheatequation_tpu.utils.partition_map import read_partition_map
 
         pmap = read_partition_map(args.file)
         nx, ny, npx, npy, dh = pmap.nx, pmap.ny, pmap.npx, pmap.npy, pmap.dh
+        assignment = pmap.assignment
+
+    # The elastic executor handles what uniform SPMD sharding cannot:
+    # partition-map placement (any tiles-per-device ratio) and runtime
+    # rebalancing.  The plain path stays on the fused SPMD program.
+    use_elastic = (assignment is not None or args.nbalance > 0
+                   or args.test_load_balance)
 
     if nx <= args.eps:
         print("[WARNING] Mesh size on a single node (nx * ny) is too small "
               "for given epsilon (eps)")
 
     def make_solver(nx, ny, npx, npy, nt, eps, k, dt, dh):
+        if use_elastic:
+            from nonlocalheatequation_tpu.parallel.elastic import ElasticSolver2D
+
+            devices = jax.devices()[:args.devices] if args.devices else None
+            return ElasticSolver2D(
+                nx, ny, npx, npy, nt, eps, nlog=args.nlog,
+                nbalance=args.nbalance or None, k=k, dt=dt, dh=dh,
+                assignment=assignment, devices=devices, method=args.method,
+            )
+        mesh = None
+        if args.devices:
+            from nonlocalheatequation_tpu.parallel.distributed2d import (
+                choose_mesh_for_grid,
+            )
+
+            mesh = choose_mesh_for_grid(
+                nx * npx, ny * npy, jax.devices()[:args.devices])
         return Solver2DDistributed(
             nx, ny, npx, npy, nt, eps, nlog=args.nlog,
             nbalance=args.nbalance or None, k=k, dt=dt, dh=dh,
-            method=args.method,
+            mesh=mesh, method=args.method,
         )
 
     if args.test_batch:
@@ -113,8 +141,9 @@ def main(argv=None) -> int:
     elapsed = time.perf_counter() - t0
 
     if args.test_load_balance:
-        print("Testing load balance:")
-        print("Load balanced correctly")  # telemetry check wired in balance.py
+        from nonlocalheatequation_tpu.parallel.load_balance import print_balance_report
+
+        print_balance_report(s.busy_rates(), s.assignment)
 
     if args.test:
         s.print_error(args.cmp)
@@ -123,8 +152,12 @@ def main(argv=None) -> int:
 
     from nonlocalheatequation_tpu.utils.timing import print_time_results_distributed
 
+    if use_elastic:
+        n_localities = len(s.devices)
+    else:
+        n_localities = int(s.mesh.devices.size)
     print_time_results_distributed(
-        len(jax.devices()), os.cpu_count() or 1, elapsed,
+        n_localities, os.cpu_count() or 1, elapsed,
         nx, ny, npx, npy, args.nt, header=not args.no_header,
     )
     return 0

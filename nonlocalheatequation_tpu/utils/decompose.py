@@ -144,7 +144,11 @@ def infer_structured_grid(msh: MshData) -> tuple[int, int, float]:
     if qc.shape[0] == 0:
         raise ValueError("mesh contains no quadrangle (type 3) elements")
     first = qc[0]
-    dh = max(first[0, 0] - first[1, 0], abs(first[0, 1] - first[1, 1]))
+    # abs() on both axes (the reference uses the SIGNED x-difference,
+    # domain_decomposition.cpp:99-104, which silently depends on GMSH's
+    # corner ordering; taking |.| accepts any valid corner order and agrees
+    # with the reference on every mesh the reference itself accepts)
+    dh = max(abs(first[0, 0] - first[1, 0]), abs(first[0, 1] - first[1, 1]))
     if dh <= 0:
         raise ValueError(f"could not infer a positive dh (got {dh})")
     xs, ys = qc[..., 0], qc[..., 1]

@@ -36,6 +36,8 @@ class MshData:
         """(m, 4, 3) coordinates of each quad's corners."""
         order = np.argsort(self.node_tags, kind="stable")
         pos = np.searchsorted(self.node_tags, self.quads.ravel(), sorter=order)
+        if (pos >= len(order)).any():
+            raise ValueError("quad connectivity references unknown node tags")
         flat = order[pos]
         if not np.array_equal(self.node_tags[flat], self.quads.ravel()):
             raise ValueError("quad connectivity references unknown node tags")

@@ -27,12 +27,15 @@ import jax.numpy as jnp
 GRID = int(os.environ.get("BENCH_GRID", 4096))
 EPS = int(os.environ.get("BENCH_EPS", 8))
 STEPS = int(os.environ.get("BENCH_STEPS", 50))
-METHOD = os.environ.get("BENCH_METHOD", "shift")
-
 # The axon TPU plugin ignores the JAX_PLATFORMS env var; honor an explicit
 # override through the config knob (BENCH_PLATFORM=cpu for smoke tests).
 if os.environ.get("BENCH_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+# Default to the Pallas kernel on TPU; off-TPU it would run in the (slow)
+# interpreter, so CPU smoke tests default to the fastest XLA path instead.
+_default_method = "pallas" if jax.default_backend() == "tpu" else "sat"
+METHOD = os.environ.get("BENCH_METHOD", _default_method)
 
 
 def log(*a):

@@ -35,7 +35,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dh", type=float, default=0.02)
     p.add_argument("--no-header", action="store_true", dest="no_header")
     p.add_argument("--backend", default="jit", choices=("oracle", "jit"))
-    p.add_argument("--method", default="conv", choices=("conv", "shift", "sat"))
+    p.add_argument("--method", default="conv", choices=("conv", "shift", "sat", "pallas"))
     p.add_argument("--log", action="store_true")
     add_platform_flags(p)
     return p

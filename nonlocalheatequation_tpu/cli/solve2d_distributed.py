@@ -50,7 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices", type=int, default=0,
                    help="limit the device count (the reference's number of "
                         "localities, srun -n N); 0 = all")
-    p.add_argument("--method", default="conv", choices=("conv", "shift", "sat"))
+    p.add_argument("--method", default="conv", choices=("conv", "shift", "sat", "pallas"))
     p.add_argument("--log", action="store_true")
     add_platform_flags(p)
     return p

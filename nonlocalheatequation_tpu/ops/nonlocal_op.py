@@ -139,8 +139,8 @@ class NonlocalOp2D:
         self.mask = horizon_mask_2d(self.eps)
         self.weights = influence_weights(self.mask, influence, dh)
         self.wsum = float(self.weights.sum())
-        self.uniform = influence is None  # J == 1: sat path is valid
-        if method == "sat" and not self.uniform:
+        self.uniform = influence is None  # J == 1: sat/pallas paths are valid
+        if method in ("sat", "pallas") and not self.uniform:
             method = "conv"
         self.method = method
 
@@ -178,6 +178,8 @@ class NonlocalOp2D:
             return self._neighbor_sum_conv(upad)
         if self.method == "sat":
             return self._neighbor_sum_sat(upad)
+        if self.method == "pallas":
+            return self._neighbor_sum_pallas(upad)
         return self._neighbor_sum_shift(upad)
 
     def _neighbor_sum_conv(self, upad: jnp.ndarray) -> jnp.ndarray:
@@ -203,6 +205,15 @@ class NonlocalOp2D:
                     term = lax.slice(upad, (i, j), (i + nx, j + ny))
                     acc = acc + (term if w == 1.0 else w * term)
         return acc
+
+    def _neighbor_sum_pallas(self, upad: jnp.ndarray) -> jnp.ndarray:
+        """Pallas TPU strip kernel (ops/pallas_kernel.py); interpret on CPU."""
+        from nonlocalheatequation_tpu.ops.pallas_kernel import build_neighbor_sum_2d
+
+        e = self.eps
+        nx, ny = upad.shape[0] - 2 * e, upad.shape[1] - 2 * e
+        fn = build_neighbor_sum_2d(e, nx, ny, np.dtype(upad.dtype).name)
+        return fn(upad)
 
     def _neighbor_sum_sat(self, upad: jnp.ndarray) -> jnp.ndarray:
         """Column running-sum: O(eps) slice ops instead of O(eps^2).
@@ -284,6 +295,10 @@ def make_step_fn(op, g=None, lg=None, dtype=None):
     trace.
     """
     test = g is not None
+    if getattr(op, "method", None) == "pallas" and isinstance(op, NonlocalOp2D):
+        from nonlocalheatequation_tpu.ops.pallas_kernel import make_pallas_step_fn
+
+        return make_pallas_step_fn(op, g, lg, dtype)
     if test:
         g = jnp.asarray(g, dtype)
         lg = jnp.asarray(lg, dtype)

@@ -1,0 +1,78 @@
+"""Pallas horizon kernel: equality vs the shift oracle path + full solves.
+
+Runs in Pallas interpreter mode on the CPU test backend (f64), exercising the
+same kernel code the TPU compiles (ops/pallas_kernel.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.cases import CASES_2D, L2_THRESHOLD
+
+from nonlocalheatequation_tpu.models.solver2d import Solver2D
+from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, make_step_fn
+from nonlocalheatequation_tpu.ops.pallas_kernel import _naf, _strip_plan
+from nonlocalheatequation_tpu.ops.stencil import horizon_mask_2d
+
+SHAPES = [
+    (64, 64, 8),     # aligned, bench-like
+    (50, 37, 5),     # ragged both axes
+    (100, 128, 10),
+    (16, 16, 3),
+    (10, 10, 12),    # eps > grid (the reference's nx <= eps degenerate case)
+    (24, 24, 1),     # smallest stencil
+]
+
+
+@pytest.mark.parametrize("nx,ny,eps", SHAPES)
+def test_neighbor_sum_matches_shift(nx, ny, eps):
+    rng = np.random.default_rng(nx * 1000 + ny + eps)
+    u = jnp.asarray(rng.normal(size=(nx, ny)))
+    a = NonlocalOp2D(eps, 1.0, 1e-4, 0.01, method="shift").neighbor_sum(u)
+    b = NonlocalOp2D(eps, 1.0, 1e-4, 0.01, method="pallas").neighbor_sum(u)
+    assert float(jnp.max(jnp.abs(a - b))) < 1e-10
+
+
+@pytest.mark.parametrize("nx,ny,eps", SHAPES[:3])
+def test_fused_step_matches_reference_step(nx, ny, eps):
+    rng = np.random.default_rng(7)
+    u = jnp.asarray(rng.normal(size=(nx, ny)))
+    op_s = NonlocalOp2D(eps, 1.0, 1e-4, 0.01, method="shift")
+    op_p = NonlocalOp2D(eps, 1.0, 1e-4, 0.01, method="pallas")
+    g, lg = op_s.source_parts(nx, ny)
+    for t in (0, 3):
+        a = make_step_fn(op_s, g, lg)(u, t)
+        b = make_step_fn(op_p, g, lg)(u, t)
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-10
+
+
+def test_batch_case_pallas_backend():
+    nx, ny, nt, eps, k, dt, dh = CASES_2D[0]
+    s = Solver2D(nx, ny, nt, eps, k=k, dt=dt, dh=dh, backend="jit", method="pallas")
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / (nx * ny) <= L2_THRESHOLD
+
+
+def test_naf_is_signed_binary_decomposition():
+    for w in range(1, 70):
+        assert sum(s * (1 << p) for p, s in _naf(w)) == w
+        # non-adjacency: no two consecutive powers
+        pows = sorted(p for p, _ in _naf(w))
+        assert all(b - a >= 2 for a, b in zip(pows, pows[1:]))
+
+
+@pytest.mark.parametrize("eps", [1, 2, 3, 5, 8, 13])
+def test_strip_plan_covers_exact_circle(eps):
+    heights, parts_by_h, pows, pad = _strip_plan(eps)
+    mask = horizon_mask_2d(eps)
+    for jj, h in enumerate(heights):
+        # plan width for this lane offset == exact raster column height
+        assert sum(s * k for k, _, s in parts_by_h[h]) == 2 * h + 1
+        assert mask[:, jj].sum() == 2 * h + 1
+        # every part's rows stay within the padded window
+        a = eps - h
+        assert all(a + off >= 0 for _, off, _ in parts_by_h[h])
+        assert max(a + off + k for k, off, _ in parts_by_h[h]) <= pad

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Formatter entry point — the analog of the reference's clang-format wrapper
+# (format.sh:1-22, Google style with SortIncludes off).  Python code uses
+# ruff (format + import-sorting lint); native C++ uses clang-format when
+# available.
+#
+#   ./format.sh          # rewrite files in place
+#   ./format.sh --check  # verify only (CI mode), non-zero exit on drift
+set -euo pipefail
+cd "$(dirname "$0")"
+
+MODE="fix"
+[[ "${1:-}" == "--check" ]] && MODE="check"
+
+PY_TARGETS=(nonlocalheatequation_tpu tests tools bench.py __graft_entry__.py)
+
+if command -v ruff >/dev/null 2>&1; then
+  if [[ "$MODE" == "check" ]]; then
+    ruff format --check "${PY_TARGETS[@]}"
+    ruff check --select I "${PY_TARGETS[@]}"
+  else
+    ruff format "${PY_TARGETS[@]}"
+    ruff check --select I --fix "${PY_TARGETS[@]}"
+  fi
+else
+  echo "ruff not found; skipping python formatting" >&2
+fi
+
+if command -v clang-format >/dev/null 2>&1; then
+  CC_FILES=(native/*.cc)
+  if [[ "$MODE" == "check" ]]; then
+    clang-format --dry-run --Werror --style="{BasedOnStyle: Google, SortIncludes: false}" "${CC_FILES[@]}"
+  else
+    clang-format -i --style="{BasedOnStyle: Google, SortIncludes: false}" "${CC_FILES[@]}"
+  fi
+else
+  echo "clang-format not found; skipping C++ formatting" >&2
+fi

@@ -86,7 +86,9 @@ int64_t refine_cut(int64_t n, const int64_t* xadj, const int64_t* adj,
     int64_t pass_moves = 0;
     for (int64_t i = 0; i < n; ++i) {
       const int32_t cur = parts[i];
-      if (size[cur] <= n / nparts - 1) continue;  // don't starve a part
+      // only parts above the floor size may donate, so no part ever drops
+      // below floor(n/nparts) (in particular never to zero)
+      if (size[cur] - 1 < n / nparts) continue;
       std::fill(gain.begin(), gain.end(), 0);
       for (int64_t e = xadj[i]; e < xadj[i + 1]; ++e) gain[parts[adj[e]]]++;
       int32_t best = cur;

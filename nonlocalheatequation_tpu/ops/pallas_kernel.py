@@ -101,7 +101,7 @@ def _choose_tm(nx: int, ny: int, eps: int, itemsize: int, n_aux: int) -> int:
     cap = min(256, _round_up(nx, 8))
     while cap > 8 and not _fits(cap, ny, eps, itemsize, n_aux):
         cap -= 8
-    for tm in range(cap, 8, -8):
+    for tm in range(cap, 0, -8):
         if nx % tm == 0:
             return tm
     return max(cap, 8)
@@ -276,7 +276,6 @@ def _build_step_kernel(
             win_ref, g_ref, lg_ref, sc_ref, out_ref = refs
         else:
             win_ref, out_ref = refs
-        i = pl.program_id(0)
         w = win_ref[:]
         acc = _strip_neighbor_sum(w, tm, ny, eps)
         center = w[eps : eps + tm, eps : eps + ny]
@@ -287,10 +286,10 @@ def _build_step_kernel(
             cos_a = sc_ref[0, 1]
             du = du + (-TWO_PI * sin_a) * g_ref[:] + (-cos_a) * lg_ref[:]
         nxt = center + dt * du
-        # Rows past the true domain (strip padding) must stay zero: they are
-        # the volumetric boundary collar of the next step's operand.
-        row = jax.lax.broadcasted_iota(jnp.int32, (tm, ny), 0) + i * tm
-        out_ref[:] = jnp.where(row < nx, nxt, 0).astype(dtype)
+        # Rows past the true domain (strip padding, when tm does not divide
+        # nx) are sliced off by the caller and re-zeroed by the next step's
+        # pad — no masking needed here.
+        out_ref[:] = nxt.astype(dtype)
 
     elem = lambda *shape: pl.BlockSpec(  # noqa: E731
         tuple(pl.Element(s) for s in shape),
@@ -344,6 +343,8 @@ def make_pallas_step_fn(op, g=None, lg=None, dtype=None):
     eps = op.eps
 
     def step(u, t):
+        if dtype is not None:
+            u = u.astype(dtype)
         nx, ny = u.shape
         step_padded, tm, tmw = _build_step_kernel(
             eps, nx, ny, np.dtype(u.dtype).name, op.c, op.dh, op.dt,

@@ -1,0 +1,60 @@
+"""Generate the repo's sample-data fixtures (the reference's C16 inventory).
+
+The reference ships GMSH quad meshes data/{10x10,50x50,100x100,200x200}.msh
+(README.md:20) and deliberately imbalanced partition maps
+tests/load_balance_{4s_2n,25s_2n,25s_4n}.txt for the load-balance demo
+(README.md:69-72; 25s_2n puts 24 of 25 tiles on locality 1).  The
+equivalents are generated with the framework's own writers and committed
+under data/; run this to regenerate them.
+
+Usage: python tools/gen_data.py [outdir=data]
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from nonlocalheatequation_tpu.utils.gmsh import write_structured_msh
+from nonlocalheatequation_tpu.utils.partition_map import PartitionMap, write_partition_map
+
+
+def main(outdir: str = "data") -> None:
+    os.makedirs(outdir, exist_ok=True)
+
+    # Structured quad meshes at the reference's sizes, unit square spacing.
+    for m in (10, 50, 100, 200):
+        path = os.path.join(outdir, f"{m}x{m}.msh")
+        write_structured_msh(path, m, m, 1.0 / m)
+        print(path)
+
+    # Imbalanced partition maps (fixture shapes from the reference's tests/):
+    # 4 tiles / 2 nodes — 3 tiles on node 1, one on node 0.
+    a = np.full((2, 2), 1, dtype=np.int64)
+    a[0, 0] = 0
+    write_partition_map(
+        os.path.join(outdir, "load_balance_4s_2n.txt"),
+        PartitionMap(nx=20, ny=20, npx=2, npy=2, dh=0.05, assignment=a),
+    )
+    # 25 tiles / 2 nodes — 24 tiles on node 1.
+    a = np.full((5, 5), 1, dtype=np.int64)
+    a[0, 0] = 0
+    write_partition_map(
+        os.path.join(outdir, "load_balance_25s_2n.txt"),
+        PartitionMap(nx=20, ny=20, npx=5, npy=5, dh=0.01, assignment=a),
+    )
+    # 25 tiles / 4 nodes — uneven mix.
+    rng = np.random.default_rng(0)
+    a = rng.choice(4, size=(5, 5), p=[0.6, 0.2, 0.1, 0.1]).astype(np.int64)
+    a[0, 0] = 0
+    write_partition_map(
+        os.path.join(outdir, "load_balance_25s_4n.txt"),
+        PartitionMap(nx=20, ny=20, npx=5, npy=5, dh=0.01, assignment=a),
+    )
+    print(os.path.join(outdir, "load_balance_*.txt"))
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

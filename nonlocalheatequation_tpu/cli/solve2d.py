@@ -37,12 +37,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", default="jit", choices=("oracle", "jit"))
     p.add_argument("--method", default="conv", choices=("conv", "shift", "sat", "pallas"))
     p.add_argument("--log", action="store_true")
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint file to write every --ncheckpoint steps")
+    p.add_argument("--ncheckpoint", type=int, default=0,
+                   help="steps between checkpoints (0 = never)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the --checkpoint file before running")
     add_platform_flags(p)
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.resume and not args.checkpoint:
+        print("--resume requires --checkpoint", file=sys.stderr)
+        return 1
     version_banner("2d_nonlocal")
     apply_platform(args)
 
@@ -50,7 +59,9 @@ def main(argv=None) -> int:
 
     def make_solver(nx, ny, nt, eps, k, dt, dh):
         return Solver2D(nx, ny, nt, eps, nlog=args.nlog, k=k, dt=dt, dh=dh,
-                        backend=args.backend, method=args.method)
+                        backend=args.backend, method=args.method,
+                        checkpoint_path=args.checkpoint,
+                        ncheckpoint=args.ncheckpoint)
 
     if args.test_batch:
         # row: nx ny nt eps k dt dh  (tests/2d.txt)
@@ -76,10 +87,12 @@ def main(argv=None) -> int:
                                        nlog=args.nlog)
     if args.test:
         s.test_init()
-    else:
+    elif not args.resume:
         s.input_init(
             np.array(sys.stdin.read().split(), dtype=np.float64)[: args.nx * args.ny]
         )
+    if args.resume:
+        s.resume(args.checkpoint)
 
     t0 = time.perf_counter()
     s.do_work()

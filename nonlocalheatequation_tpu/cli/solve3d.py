@@ -1,0 +1,96 @@
+"""3D solver CLI — extension beyond the reference (no 3D binary exists
+there).  Mirrors the 2D serial CLI's flag surface with an added --nz, and the
+same batch-test contract: rows ``nx ny nz nt eps k dt dh`` on stdin, pass
+criterion ``error_l2 / #points <= 1e-6``, stdout "Tests Passed"/"Tests
+Failed"."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+from nonlocalheatequation_tpu.cli.common import (
+    add_platform_flags,
+    apply_platform,
+    bool_flag,
+    run_batch,
+    version_banner,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="3d_nonlocal", add_help=True)
+    p.add_argument("--test", action="store_true")
+    p.add_argument("--test_batch", action="store_true")
+    bool_flag(p, "cmp", False, "print expected vs actual outputs")
+    p.add_argument("--nx", type=int, default=16)
+    p.add_argument("--ny", type=int, default=16)
+    p.add_argument("--nz", type=int, default=16)
+    p.add_argument("--nt", type=int, default=20)
+    p.add_argument("--nlog", type=int, default=5)
+    p.add_argument("--eps", type=int, default=3)
+    p.add_argument("--k", type=float, default=1.0)
+    p.add_argument("--dt", type=float, default=0.0005)
+    p.add_argument("--dh", type=float, default=0.0625)
+    p.add_argument("--no-header", action="store_true", dest="no_header")
+    p.add_argument("--backend", default="jit", choices=("oracle", "jit"))
+    p.add_argument("--method", default="sat", choices=("shift", "sat"))
+    add_platform_flags(p)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    version_banner("3d_nonlocal")
+    apply_platform(args)
+
+    from nonlocalheatequation_tpu.models.solver3d import Solver3D
+
+    def make_solver(nx, ny, nz, nt, eps, k, dt, dh):
+        return Solver3D(nx, ny, nz, nt, eps, nlog=args.nlog, k=k, dt=dt,
+                        dh=dh, backend=args.backend, method=args.method)
+
+    if args.test_batch:
+        # row: nx ny nz nt eps k dt dh
+        def read_case(toks, pos):
+            v = toks[pos:pos + 8]
+            return ((int(v[0]), int(v[1]), int(v[2]), int(v[3]), int(v[4]),
+                     float(v[5]), float(v[6]), float(v[7])), pos + 8)
+
+        def run_case(case):
+            nx, ny, nz, nt, eps, k, dt, dh = case
+            s = make_solver(nx, ny, nz, nt, eps, k, dt, dh)
+            s.test_init()
+            s.do_work()
+            return s.error_l2, nx * ny * nz
+
+        return run_batch(read_case, run_case)
+
+    s = make_solver(args.nx, args.ny, args.nz, args.nt, args.eps, args.k,
+                    args.dt, args.dh)
+    if args.test:
+        s.test_init()
+    else:
+        n = args.nx * args.ny * args.nz
+        s.input_init(np.array(sys.stdin.read().split(), dtype=np.float64)[:n])
+
+    t0 = time.perf_counter()
+    s.do_work()
+    elapsed = time.perf_counter() - t0
+
+    if args.test:
+        s.print_error(args.cmp)
+
+    from nonlocalheatequation_tpu.utils.timing import print_time_results_3d
+
+    print_time_results_3d(os.cpu_count() or 1, elapsed, args.nx, args.ny,
+                          args.nz, args.nt, header=not args.no_header)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

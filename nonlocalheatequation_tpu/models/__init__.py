@@ -1,2 +1,3 @@
 from nonlocalheatequation_tpu.models.solver1d import Solver1D  # noqa: F401
 from nonlocalheatequation_tpu.models.solver2d import Solver2D  # noqa: F401
+from nonlocalheatequation_tpu.models.solver3d import Solver3D  # noqa: F401

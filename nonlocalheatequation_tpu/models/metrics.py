@@ -9,15 +9,17 @@ import numpy as np
 
 
 class ManufacturedMetrics2D:
+    """Rank-agnostic in practice: ``self._grid_shape`` may be any rank and
+    ``op.manufactured_solution(*shape, t)`` is called accordingly (the 3D
+    solver reuses this mixin unchanged)."""
+
     def compute_l2(self, t: int):
-        nx, ny = self._grid_shape
-        d = self.u - self.op.manufactured_solution(nx, ny, t)
+        d = self.u - self.op.manufactured_solution(*self._grid_shape, t)
         self.error_l2 = float(np.sum(d * d))
         return self.error_l2
 
     def compute_linf(self, t: int):
-        nx, ny = self._grid_shape
-        d = self.u - self.op.manufactured_solution(nx, ny, t)
+        d = self.u - self.op.manufactured_solution(*self._grid_shape, t)
         self.error_linf = float(np.max(np.abs(d))) if d.size else 0.0
         return self.error_linf
 
@@ -28,17 +30,17 @@ class ManufacturedMetrics2D:
     def print_error(self, cmp: bool = False):
         print(f"l2: {self.error_l2:g} linfinity: {self.error_linf:g}")
         if cmp:
-            nx, ny = self._grid_shape
-            expected = self.op.manufactured_solution(nx, ny, self.nt)
-            for sx in range(nx):
-                for sy in range(ny):
-                    prefix = (
-                        f"sx: {sx} sy: {sy} " if self._cmp_coordinate_prefix else ""
-                    )
-                    print(
-                        f"{prefix}Expected: {expected[sx, sy]:g} "
-                        f"Actual: {self.u[sx, sy]:g}"
-                    )
+            expected = self.op.manufactured_solution(*self._grid_shape, self.nt)
+            axes = "xyz"
+            for idx in np.ndindex(*self._grid_shape):
+                prefix = (
+                    " ".join(f"s{axes[d]}: {i}" for d, i in enumerate(idx)) + " "
+                    if self._cmp_coordinate_prefix else ""
+                )
+                print(
+                    f"{prefix}Expected: {expected[idx]:g} "
+                    f"Actual: {self.u[idx]:g}"
+                )
 
     def print_soln(self):
         nx, ny = self._grid_shape

@@ -47,6 +47,8 @@ class Solver2D(ManufacturedMetrics2D):
         nd: int | None = None,
         logger=None,
         dtype=None,
+        checkpoint_path: str | None = None,
+        ncheckpoint: int = 0,
     ):
         self.nx, self.ny = int(nx), int(ny)
         self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
@@ -55,6 +57,9 @@ class Solver2D(ManufacturedMetrics2D):
         self.nd = nd  # dispatch-ahead depth (async analog); None = unthrottled
         self.logger = logger
         self.dtype = dtype
+        self.checkpoint_path = checkpoint_path
+        self.ncheckpoint = int(ncheckpoint)
+        self.t0 = 0
         self.test = False
         self.u0 = np.zeros((self.nx, self.ny), dtype=np.float64)
         self.u = None
@@ -69,6 +74,32 @@ class Solver2D(ManufacturedMetrics2D):
     def input_init(self, values):
         self.test = False
         self.u0 = np.asarray(values, dtype=np.float64).reshape(self.nx, self.ny)
+
+    def _ckpt_params(self) -> dict:
+        return dict(nx=self.nx, ny=self.ny, eps=self.eps, k=self.op.k,
+                    dt=self.op.dt, dh=self.op.dh, test=self.test)
+
+    def resume(self, path: str):
+        """Continue from a checkpoint written by a prior run (test/init flags
+        must already be set the same way; parameters are validated)."""
+        from nonlocalheatequation_tpu.utils import checkpoint as ckpt
+
+        u, t, params = ckpt.load_state(path)
+        ckpt.check_params(params, self._ckpt_params())
+        if u.shape != (self.nx, self.ny):
+            raise ValueError(
+                f"checkpoint state shape {u.shape} != grid ({self.nx}, {self.ny})"
+            )
+        self.u0 = np.asarray(u, dtype=np.float64)
+        self.t0 = t
+
+    def _maybe_checkpoint(self, t: int, u) -> None:
+        if (self.checkpoint_path and self.ncheckpoint
+                and (t + 1) % self.ncheckpoint == 0):
+            from nonlocalheatequation_tpu.utils import checkpoint as ckpt
+
+            ckpt.save_state(self.checkpoint_path, np.asarray(u), t + 1,
+                            self._ckpt_params())
 
     # -- time loop (2d_nonlocal_serial.cpp:273-303) -------------------------
     def do_work(self) -> np.ndarray:
@@ -87,13 +118,14 @@ class Solver2D(ManufacturedMetrics2D):
 
     def _run_oracle(self, g, lg):
         u = self.u0.copy()
-        for t in range(self.nt):
+        for t in range(self.t0, self.nt):
             du = self.op.apply_np(u)
             if self.test:
                 du = du + source_at(g, lg, t, self.op.dt)
             u = u + self.op.dt * du
             if t % self.nlog == 0 and self.logger is not None:
                 self.logger(t, u)
+            self._maybe_checkpoint(t, u)
         return u
 
     def _run_jit(self, g, lg):
@@ -101,17 +133,20 @@ class Solver2D(ManufacturedMetrics2D):
             jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         )
         u = jnp.asarray(self.u0, dtype)
-        if self.logger is None and self.nd is None:
+        nsteps = self.nt - self.t0
+        checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
+        if self.logger is None and self.nd is None and not checkpointing:
             # fast path: the whole time loop is one lax.scan program
-            multi = make_multi_step_fn(self.op, self.nt, g, lg, dtype)
-            return np.asarray(multi(u, 0))
+            multi = make_multi_step_fn(self.op, nsteps, g, lg, dtype)
+            return np.asarray(multi(u, self.t0))
 
         step = jax.jit(make_step_fn(self.op, g, lg, dtype))
         inflight = []
-        for t in range(self.nt):
+        for t in range(self.t0, self.nt):
             u = step(u, t)
             if t % self.nlog == 0 and self.logger is not None:
                 self.logger(t, np.asarray(u))
+            self._maybe_checkpoint(t, u)
             if self.nd is not None:
                 # sliding-semaphore analog (2d_nonlocal_async.cpp:442-451):
                 # keep at most nd dispatched-but-unfinished steps in flight.

@@ -1,0 +1,118 @@
+"""3D nonlocal heat solver — extension beyond the reference (no 3D exists
+there; SURVEY.md section 7 stretch item).  Same structure as Solver2D:
+``oracle`` backend is NumPy f64 ground truth, ``jit`` runs the whole time
+loop as one lax.scan program.  The discretization applies the reference's
+recipe (rasterized eps-ball, volumetric boundary, forward Euler,
+manufactured-solution testing contract) once more per axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
+from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D, source_at
+
+
+class Solver3D(ManufacturedMetrics2D):
+    def __init__(
+        self,
+        nx: int,
+        ny: int,
+        nz: int,
+        nt: int,
+        eps: int,
+        nlog: int = 5,
+        k: float = 1.0,
+        dt: float = 0.0005,
+        dh: float = 0.05,
+        backend: str = "oracle",
+        method: str = "sat",
+        logger=None,
+        dtype=None,
+    ):
+        self.nx, self.ny, self.nz = int(nx), int(ny), int(nz)
+        self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
+        self.op = NonlocalOp3D(eps, k, dt, dh, method=method)
+        self.backend = backend
+        self.logger = logger
+        self.dtype = dtype
+        self.test = False
+        self.u0 = np.zeros((self.nx, self.ny, self.nz), dtype=np.float64)
+        self.u = None
+        self.error_l2 = 0.0
+        self.error_linf = 0.0
+
+    def test_init(self):
+        self.test = True
+        self.u0 = self.op.spatial_profile(self.nx, self.ny, self.nz).copy()
+
+    def input_init(self, values):
+        self.test = False
+        self.u0 = np.asarray(values, dtype=np.float64).reshape(
+            self.nx, self.ny, self.nz
+        )
+
+    def do_work(self) -> np.ndarray:
+        if self.test:
+            g, lg = self.op.source_parts(self.nx, self.ny, self.nz)
+        else:
+            g = lg = None
+
+        if self.backend == "oracle":
+            u = self.u0.copy()
+            for t in range(self.nt):
+                du = self.op.apply_np(u)
+                if self.test:
+                    du = du + source_at(g, lg, t, self.op.dt)
+                u = u + self.op.dt * du
+                if t % self.nlog == 0 and self.logger is not None:
+                    self.logger(t, u)
+        else:
+            u = self._run_jit(g, lg)
+
+        self.u = u
+        if self.test:
+            self.compute_l2(self.nt)
+            self.compute_linf(self.nt)
+        return u
+
+    def _run_jit(self, g, lg):
+        dtype = self.dtype or (
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        )
+        u = jnp.asarray(self.u0, dtype)
+        op = self.op
+        test = self.test
+        if test:
+            gd = jnp.asarray(g, dtype)
+            lgd = jnp.asarray(lg, dtype)
+
+        def step(u, t):
+            du = op.apply(u)
+            if test:
+                du = du + source_at(gd, lgd, t, op.dt)
+            return u + op.dt * du
+
+        if self.logger is None:
+            @jax.jit
+            def multi(u):
+                return lax.scan(lambda u, t: (step(u, t), None), u,
+                                jnp.arange(self.nt))[0]
+
+            return np.asarray(multi(u))
+        jstep = jax.jit(step)
+        for t in range(self.nt):
+            u = jstep(u, t)
+            if t % self.nlog == 0:
+                self.logger(t, np.asarray(u))
+        return np.asarray(u)
+
+    # -- error metrics: ManufacturedMetrics2D (rank-agnostic) ---------------
+    @property
+    def _grid_shape(self):
+        return (self.nx, self.ny, self.nz)

@@ -49,11 +49,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from nonlocalheatequation_tpu.ops.constants import c_1d, c_2d
+from nonlocalheatequation_tpu.ops.constants import c_1d, c_2d, c_3d
 from nonlocalheatequation_tpu.ops.stencil import (
     column_half_heights,
     horizon_mask_1d,
     horizon_mask_2d,
+    horizon_mask_3d,
     influence_weights,
 )
 
@@ -326,3 +327,129 @@ def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
         return out
 
     return multi
+
+
+class NonlocalOp3D:
+    """3D horizon operator (extension: no 3D solver exists in the reference).
+
+    Applies the reference's discretization recipe once more per axis: the
+    eps-sphere is rasterized column-by-column (ops/stencil.horizon_mask_3d,
+    the 3D analog of len_1d_line, src/2d_nonlocal_distributed.cpp:1058-1060),
+    node volume dh^3, scaling constant ops/constants.c_3d.  Arrays are
+    [x, y, z] of shape (nx, ny, nz).
+
+    Methods: ``shift`` sums one padded slice per z-column (O(eps^2) slice ops);
+    ``sat`` adds a z prefix sum so each column is one window difference.
+    """
+
+    def __init__(
+        self,
+        eps: int,
+        k: float,
+        dt: float,
+        dh: float,
+        influence=None,
+        method: str = "sat",
+    ):
+        self.eps = int(eps)
+        self.k = float(k)
+        self.dt = float(dt)
+        self.dh = float(dh)
+        self.c = c_3d(k, eps, dh)
+        self.mask = horizon_mask_3d(self.eps)
+        self.weights = influence_weights(self.mask, influence, dh)
+        self.wsum = float(self.weights.sum())
+        self.uniform = influence is None
+        if method == "sat" and not self.uniform:
+            method = "shift"
+        self.method = method
+        # column half-heights along z per (i, j) offset, derived from the
+        # mask itself so the raster rule lives only in ops/stencil.py;
+        # -1 = column outside the sphere
+        colsum = self.mask.sum(axis=2).astype(np.int64)
+        self._zh = np.where(colsum > 0, (colsum - 1) // 2, -1)
+
+    # -- neighbor sum -------------------------------------------------------
+    def neighbor_sum_np(self, u: np.ndarray) -> np.ndarray:
+        nx, ny, nz = u.shape
+        e = self.eps
+        up = np.zeros((nx + 2 * e, ny + 2 * e, nz + 2 * e), dtype=u.dtype)
+        up[e : e + nx, e : e + ny, e : e + nz] = u
+        acc = np.zeros_like(u)
+        for i in range(2 * e + 1):
+            for j in range(2 * e + 1):
+                h = int(self._zh[i, j])
+                if h < 0:
+                    continue
+                for kk in range(e - h, e + h + 1):
+                    w = self.weights[i, j, kk]
+                    if w == 1.0:
+                        acc += up[i : i + nx, j : j + ny, kk : kk + nz]
+                    elif w:
+                        acc += w * up[i : i + nx, j : j + ny, kk : kk + nz]
+        return acc
+
+    def neighbor_sum(self, u: jnp.ndarray) -> jnp.ndarray:
+        e = self.eps
+        return self.neighbor_sum_padded(jnp.pad(u, ((e, e), (e, e), (e, e))))
+
+    def neighbor_sum_padded(self, upad: jnp.ndarray) -> jnp.ndarray:
+        e = self.eps
+        nx, ny, nz = (s - 2 * e for s in upad.shape)
+        if self.method == "sat":
+            # exclusive prefix along z: one window difference per (i, j)
+            p = jnp.concatenate(
+                [jnp.zeros(upad.shape[:2] + (1,), upad.dtype),
+                 jnp.cumsum(upad, axis=2)], axis=2)
+            acc = jnp.zeros((nx, ny, nz), upad.dtype)
+            for i in range(2 * e + 1):
+                for j in range(2 * e + 1):
+                    h = int(self._zh[i, j])
+                    if h < 0:
+                        continue
+                    hi = lax.slice(p, (i, j, e + h + 1), (i + nx, j + ny, e + h + 1 + nz))
+                    lo = lax.slice(p, (i, j, e - h), (i + nx, j + ny, e - h + nz))
+                    acc = acc + (hi - lo)
+            return acc
+        acc = jnp.zeros((nx, ny, nz), upad.dtype)
+        for i in range(2 * e + 1):
+            for j in range(2 * e + 1):
+                h = int(self._zh[i, j])
+                if h < 0:
+                    continue
+                for kk in range(e - h, e + h + 1):
+                    w = float(self.weights[i, j, kk])
+                    if w:
+                        term = lax.slice(
+                            upad, (i, j, kk), (i + nx, j + ny, kk + nz))
+                        acc = acc + (term if w == 1.0 else w * term)
+        return acc
+
+    # -- operator and source ------------------------------------------------
+    def apply_np(self, u: np.ndarray) -> np.ndarray:
+        return self.c * self.dh**3 * (self.neighbor_sum_np(u) - self.wsum * u)
+
+    def apply(self, u: jnp.ndarray) -> jnp.ndarray:
+        return self.c * self.dh**3 * (self.neighbor_sum(u) - self.wsum * u)
+
+    def apply_padded(self, upad: jnp.ndarray) -> jnp.ndarray:
+        e = self.eps
+        center = lax.slice(
+            upad, (e, e, e), tuple(s - e for s in upad.shape))
+        return self.c * self.dh**3 * (
+            self.neighbor_sum_padded(upad) - self.wsum * center
+        )
+
+    def spatial_profile(self, nx, ny, nz, x0=0, y0=0, z0=0) -> np.ndarray:
+        """G = sin(2*pi*x*dh) sin(2*pi*y*dh) sin(2*pi*z*dh) on global coords."""
+        ax = np.sin(TWO_PI * (np.arange(x0, x0 + nx, dtype=np.float64) * self.dh))
+        ay = np.sin(TWO_PI * (np.arange(y0, y0 + ny, dtype=np.float64) * self.dh))
+        az = np.sin(TWO_PI * (np.arange(z0, z0 + nz, dtype=np.float64) * self.dh))
+        return ax[:, None, None] * ay[None, :, None] * az[None, None, :]
+
+    def source_parts(self, nx, ny, nz):
+        g = self.spatial_profile(nx, ny, nz)
+        return g, self.apply_np(g)
+
+    def manufactured_solution(self, nx, ny, nz, t: int) -> np.ndarray:
+        return np.cos(TWO_PI * (t * self.dt)) * self.spatial_profile(nx, ny, nz)

@@ -1,0 +1,61 @@
+"""Checkpoint / resume for solver state.
+
+The reference has NO checkpointing (SURVEY.md section 5: VTK/CSV logs are
+write-only observability) — this is a capability extension.  State is the
+temperature field plus the timestep and the solver parameters that must match
+on resume; storage is a single .npz written atomically (tmp + rename) so a
+kill mid-write never corrupts the latest checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def save_state(path: str, u: np.ndarray, t: int, params: dict | None = None):
+    """Atomically write solver state at timestep ``t`` (u = state AFTER t steps)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    meta = dict(params or {})
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            u=np.asarray(u),
+            t=np.int64(t),
+            version=np.int64(FORMAT_VERSION),
+            params=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        )
+    os.replace(tmp, path)
+
+
+def load_state(path: str):
+    """-> (u, t, params).  Raises ValueError on unknown format versions."""
+    with np.load(path) as z:
+        version = int(z["version"])
+        if version != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version {version}")
+        u = z["u"]
+        t = int(z["t"])
+        params = json.loads(z["params"].tobytes().decode()) if "params" in z else {}
+    return u, t, params
+
+
+def check_params(saved: dict, current: dict):
+    """Refuse resume when solver parameters differ OR are absent from the
+    checkpoint (a silent mismatch would produce a plausible-looking but
+    wrong trajectory)."""
+    for key, val in current.items():
+        if key not in saved:
+            raise ValueError(
+                f"checkpoint parameter mismatch: {key!r} missing from the "
+                "saved state"
+            )
+        if saved[key] != val:
+            raise ValueError(
+                f"checkpoint parameter mismatch: {key} saved={saved[key]!r} "
+                f"current={val!r}"
+            )

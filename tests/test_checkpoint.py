@@ -1,0 +1,84 @@
+"""Checkpoint/resume — framework extension (the reference has none,
+SURVEY.md section 5). Contract: interrupted + resumed == uninterrupted,
+bit-for-bit, and parameter mismatches refuse to resume."""
+
+import numpy as np
+import pytest
+
+from nonlocalheatequation_tpu.models.solver2d import Solver2D
+from nonlocalheatequation_tpu.utils import checkpoint as ckpt
+
+
+def _solver(nt, **kw):
+    return Solver2D(20, 20, nt, eps=3, k=1.0, dt=1e-4, dh=0.05,
+                    backend="jit", **kw)
+
+
+def test_roundtrip(tmp_path):
+    path = str(tmp_path / "state.npz")
+    u = np.random.default_rng(0).normal(size=(5, 7))
+    ckpt.save_state(path, u, 13, {"eps": 3})
+    u2, t, params = ckpt.load_state(path)
+    assert t == 13 and params["eps"] == 3
+    assert (u2 == u).all()
+
+
+def test_interrupted_equals_uninterrupted(tmp_path):
+    path = str(tmp_path / "state.npz")
+    full = _solver(20)
+    full.test_init()
+    full.do_work()
+
+    first = _solver(20, checkpoint_path=path, ncheckpoint=10)
+    first.test_init()
+    first.nt = 10  # "crash" after 10 steps; checkpoint at t=10 exists
+    first.do_work()
+
+    second = _solver(20)
+    second.test_init()
+    second.resume(path)
+    assert second.t0 == 10
+    second.do_work()
+
+    assert (second.u == full.u).all()  # bit-for-bit
+    assert second.error_l2 == pytest.approx(full.error_l2)
+
+
+def test_param_mismatch_refuses(tmp_path):
+    path = str(tmp_path / "state.npz")
+    s = _solver(10, checkpoint_path=path, ncheckpoint=5)
+    s.test_init()
+    s.do_work()
+    other = Solver2D(20, 20, 20, eps=4, k=1.0, dt=1e-4, dh=0.05, backend="jit")
+    other.test_init()
+    with pytest.raises(ValueError, match="mismatch"):
+        other.resume(path)
+
+
+def test_version_guard(tmp_path):
+    path = str(tmp_path / "state.npz")
+    ckpt.save_state(path, np.zeros((2, 2)), 0, {})
+    import numpy as _np
+
+    with _np.load(path) as z:
+        data = dict(z)
+    data["version"] = _np.int64(99)
+    with open(path, "wb") as f:
+        _np.savez(f, **data)
+    with pytest.raises(ValueError, match="version"):
+        ckpt.load_state(path)
+
+
+def test_cli_checkpoint_resume(tmp_path, capsys):
+    from nonlocalheatequation_tpu.cli import solve2d
+
+    path = str(tmp_path / "c.npz")
+    base = ["--nx", "20", "--ny", "20", "--eps", "3", "--dt", "1e-4",
+            "--dh", "0.05", "--test", "--cmp", "false", "--no-header"]
+    rc = solve2d.main(base + ["--nt", "10", "--checkpoint", path,
+                              "--ncheckpoint", "5"])
+    assert rc == 0
+    rc = solve2d.main(base + ["--nt", "20", "--checkpoint", path, "--resume"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "l2:" in out

@@ -1,0 +1,95 @@
+"""3D solver tests — framework extension (no 3D exists in the reference;
+the discretization applies the reference's recipe once more per axis and is
+held to the same manufactured-solution contract)."""
+
+import numpy as np
+import pytest
+
+from tests.cases import L2_THRESHOLD
+
+from nonlocalheatequation_tpu.models.solver3d import Solver3D
+from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D
+from nonlocalheatequation_tpu.ops.stencil import horizon_mask_3d
+
+# nx ny nz nt eps k dt dh — scaled-down 3D analogs of the tests/2d.txt cases
+CASES_3D = [
+    (16, 16, 16, 20, 3, 1.0, 0.0005, 0.0625),
+    (12, 12, 12, 40, 2, 1.0, 0.0002, 1.0 / 12),
+    (16, 12, 8, 20, 3, 0.5, 0.0005, 0.05),
+    (6, 6, 6, 10, 8, 1.0, 0.0001, 1.0 / 6),   # eps > grid: degenerate halo
+]
+
+
+@pytest.mark.parametrize("nx,ny,nz,nt,eps,k,dt,dh", CASES_3D)
+def test_batch_case_oracle(nx, ny, nz, nt, eps, k, dt, dh):
+    s = Solver3D(nx, ny, nz, nt, eps, k=k, dt=dt, dh=dh, backend="oracle")
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / (nx * ny * nz) <= L2_THRESHOLD
+
+
+@pytest.mark.parametrize("method", ["shift", "sat"])
+def test_jit_matches_oracle(method):
+    nx, ny, nz, nt, eps, k, dt, dh = CASES_3D[0]
+    ref = Solver3D(nx, ny, nz, nt, eps, k=k, dt=dt, dh=dh, backend="oracle")
+    ref.test_init()
+    ref.do_work()
+    s = Solver3D(nx, ny, nz, nt, eps, k=k, dt=dt, dh=dh, backend="jit",
+                 method=method)
+    s.test_init()
+    s.do_work()
+    assert np.abs(s.u - ref.u).max() < 1e-11
+
+
+def test_sphere_raster_shape():
+    m = horizon_mask_3d(3)
+    assert m.shape == (7, 7, 7)
+    # exactly the integer lattice ball i^2+j^2+k^2 <= 9
+    i = np.arange(-3, 4)
+    expect = (i[:, None, None] ** 2 + i[None, :, None] ** 2
+              + i[None, None, :] ** 2) <= 9
+    assert (m == expect).all()
+
+
+def test_methods_agree_random_field():
+    rng = np.random.default_rng(0)
+    u = rng.normal(size=(10, 12, 14))
+    a = NonlocalOp3D(3, 1.0, 1e-4, 0.05, method="shift")
+    b = NonlocalOp3D(3, 1.0, 1e-4, 0.05, method="sat")
+    import jax.numpy as jnp
+
+    x = jnp.asarray(u)
+    assert float(abs(a.neighbor_sum(x) - b.neighbor_sum(x)).max()) < 1e-10
+    assert np.abs(a.neighbor_sum_np(u) - np.asarray(a.neighbor_sum(x))).max() < 1e-10
+
+
+def test_operator_converges_to_laplacian():
+    # c_3d moment-matching: L(G) -> k * laplace(G) for smooth G as eps*dh -> 0.
+    # This guards against a factor-level error in the constant; the discrete
+    # sphere's moment bias decays with eps and horizon (9% at eps=4/dh=1/64,
+    # 3% here).
+    eps, n, dh = 6, 64, 1.0 / 128
+    op = NonlocalOp3D(eps, k=1.0, dt=1e-4, dh=dh, method="shift")
+    g = op.spatial_profile(n, n, n)
+    lg = op.apply_np(g)
+    # interior points only (away from the boundary collar)
+    lap = -3.0 * (2 * np.pi) ** 2 * g  # exact laplacian of sin*sin*sin
+    c = slice(2 * eps, n - 2 * eps)
+    rel = np.abs(lg[c, c, c] - lap[c, c, c]).max() / np.abs(lap[c, c, c]).max()
+    assert rel < 0.05
+
+
+def test_cli_batch(tmp_path, capsys):
+    from nonlocalheatequation_tpu.cli import solve3d
+
+    import io
+    import sys
+
+    old = sys.stdin
+    sys.stdin = io.StringIO("1\n12 12 12 10 2 1 0.0002 0.0833333333\n")
+    try:
+        rc = solve3d.main(["--test_batch"])
+    finally:
+        sys.stdin = old
+    assert rc == 0
+    assert "Tests Passed" in capsys.readouterr().out

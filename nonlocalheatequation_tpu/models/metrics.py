@@ -43,8 +43,13 @@ class ManufacturedMetrics2D:
                 )
 
     def print_soln(self):
-        nx, ny = self._grid_shape
-        for sx in range(nx):
+        shape = self._grid_shape
+        last = shape[-1]
+        for lead in np.ndindex(*shape[:-1]):
             print(
-                " ".join(f"S[{sx}][{sy}] = {self.u[sx, sy]:g}" for sy in range(ny))
+                " ".join(
+                    "S" + "".join(f"[{i}]" for i in (*lead, sy))
+                    + f" = {self.u[(*lead, sy)]:g}"
+                    for sy in range(last)
+                )
             )

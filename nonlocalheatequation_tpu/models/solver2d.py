@@ -90,6 +90,11 @@ class Solver2D(ManufacturedMetrics2D):
             raise ValueError(
                 f"checkpoint state shape {u.shape} != grid ({self.nx}, {self.ny})"
             )
+        if t > self.nt:
+            raise ValueError(
+                f"checkpoint is at timestep {t}, beyond nt={self.nt}; "
+                "nothing to resume"
+            )
         self.u0 = np.asarray(u, dtype=np.float64)
         self.t0 = t
 

@@ -12,7 +12,6 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D, source_at
@@ -82,32 +81,21 @@ class Solver3D(ManufacturedMetrics2D):
         return u
 
     def _run_jit(self, g, lg):
+        from nonlocalheatequation_tpu.ops.nonlocal_op import (
+            make_multi_step_fn,
+            make_step_fn,
+        )
+
         dtype = self.dtype or (
             jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         )
         u = jnp.asarray(self.u0, dtype)
-        op = self.op
-        test = self.test
-        if test:
-            gd = jnp.asarray(g, dtype)
-            lgd = jnp.asarray(lg, dtype)
-
-        def step(u, t):
-            du = op.apply(u)
-            if test:
-                du = du + source_at(gd, lgd, t, op.dt)
-            return u + op.dt * du
-
         if self.logger is None:
-            @jax.jit
-            def multi(u):
-                return lax.scan(lambda u, t: (step(u, t), None), u,
-                                jnp.arange(self.nt))[0]
-
-            return np.asarray(multi(u))
-        jstep = jax.jit(step)
+            multi = make_multi_step_fn(self.op, self.nt, g, lg, dtype)
+            return np.asarray(multi(u, 0))
+        step = jax.jit(make_step_fn(self.op, g, lg, dtype))
         for t in range(self.nt):
-            u = jstep(u, t)
+            u = step(u, t)
             if t % self.nlog == 0:
                 self.logger(t, np.asarray(u))
         return np.asarray(u)

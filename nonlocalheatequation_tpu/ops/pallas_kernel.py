@@ -230,6 +230,9 @@ def build_neighbor_sum_2d(eps: int, nx: int, ny: int, dtype_name: str):
         out_ref[:] = _strip_neighbor_sum(win_ref[:], tm, ny, eps).astype(dtype)
 
     def neighbor_sum(upad):
+        # vma: propagate mesh-axis variance so the kernel works under
+        # shard_map with check_vma (empty outside shard_map)
+        vma = jax.typeof(upad).vma
         upad, nxp = _pad_operand(upad, nx, tm, tmw, eps)
         out = pl.pallas_call(
             kernel,
@@ -246,7 +249,7 @@ def build_neighbor_sum_2d(eps: int, nx: int, ny: int, dtype_name: str):
                 lambda i: (i * tm, 0),
                 memory_space=pltpu.VMEM,
             ),
-            out_shape=jax.ShapeDtypeStruct((nxp, ny), dtype),
+            out_shape=jax.ShapeDtypeStruct((nxp, ny), dtype, vma=vma),
             **_kernel_params(),
         )(upad)
         return out[:nx]
@@ -299,6 +302,7 @@ def _build_step_kernel(
 
     def step_padded(upad, g, lg, sincos):
         """One fused Euler step; operands pre-padded to strip multiples."""
+        vma = jax.typeof(upad).vma
         nxp = upad.shape[0] - (tmw - tm)
         in_specs = [
             pl.BlockSpec(
@@ -324,7 +328,7 @@ def _build_step_kernel(
                 lambda i: (i * tm, 0),
                 memory_space=pltpu.VMEM,
             ),
-            out_shape=jax.ShapeDtypeStruct((nxp, ny), dtype),
+            out_shape=jax.ShapeDtypeStruct((nxp, ny), dtype, vma=vma),
             **_kernel_params(),
         )(*args)
         return out

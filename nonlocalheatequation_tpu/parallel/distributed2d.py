@@ -121,7 +121,13 @@ class Solver2DDistributed(ManufacturedMetrics2D):
                 return u_blk + op.dt * op.apply_padded(upad)
 
             in_specs = (spec, P())
-        return shard_map(local_step, mesh=mesh, in_specs=in_specs, out_specs=spec)
+        # check_vma=False only for the Pallas path: its interpreter mode (the
+        # CPU test path) internally carries mixed varying/unvarying values and
+        # trips the vma checker — JAX's own error message prescribes this
+        # workaround; semantics are unchanged.  Other methods keep the
+        # checker's trace-time protection.
+        return shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                         out_specs=spec, check_vma=op.method != "pallas")
 
     def _device_state(self):
         dtype = self.dtype or (

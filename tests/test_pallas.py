@@ -76,3 +76,23 @@ def test_strip_plan_covers_exact_circle(eps):
         a = eps - h
         assert all(a + off >= 0 for _, off, _ in parts_by_h[h])
         assert max(a + off + k for k, off, _ in parts_by_h[h]) <= pad
+
+
+def test_distributed_pallas_matches_shift():
+    """method='pallas' inside shard_map (vma propagation + check_vma
+
+    workaround), one-hop and multi-hop halo cases."""
+    import numpy as np
+
+    from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
+    from nonlocalheatequation_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(2, 4)
+    for eps, nt, dt in [(2, 3, 1e-4), (9, 2, 1e-5)]:  # eps=9 > shard edge
+        a = Solver2DDistributed(16, 8, 2, 4, nt=nt, eps=eps, k=1.0, dt=dt,
+                                dh=0.03125, mesh=mesh, method="pallas")
+        a.test_init(); a.do_work()
+        b = Solver2DDistributed(16, 8, 2, 4, nt=nt, eps=eps, k=1.0, dt=dt,
+                                dh=0.03125, mesh=mesh, method="shift")
+        b.test_init(); b.do_work()
+        assert np.abs(a.u - b.u).max() < 1e-12

@@ -10,49 +10,155 @@ tools/measure_baseline.py) — the reference publishes no numbers of its own
 (BASELINE.md), so vs_baseline is computed against that measurement when
 present and reported as 0.0 otherwise.
 
-All diagnostics go to stderr; stdout carries only the JSON line.
+All diagnostics go to stderr; stdout carries only the JSON line.  The JSON
+contract is unconditional: any failure (TPU init hang/crash included) still
+produces a one-line JSON with an "error" field instead of a traceback — the
+reference's ctest discipline (CMakeLists.txt:101-154) treats a check that
+cannot run as a failed check, not a missing one.
 """
 
 import json
 import os
 import sys
+import threading
 import time
+import traceback
 
 import numpy as np
-
-import jax
-import jax.numpy as jnp
 
 
 GRID = int(os.environ.get("BENCH_GRID", 4096))
 EPS = int(os.environ.get("BENCH_EPS", 8))
 STEPS = int(os.environ.get("BENCH_STEPS", 50))
-# The axon TPU plugin ignores the JAX_PLATFORMS env var; honor an explicit
-# override through the config knob (BENCH_PLATFORM=cpu for smoke tests).
-if os.environ.get("BENCH_PLATFORM"):
-    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
-
-# Default to the Pallas kernel on TPU; off-TPU it would run in the (slow)
-# interpreter, so CPU smoke tests default to the fastest XLA path instead.
-_default_method = "pallas" if jax.default_backend() == "tpu" else "sat"
-METHOD = os.environ.get("BENCH_METHOD", _default_method)
+# Emit the error JSON *before* any outer driver timeout can SIGKILL us: a
+# wedged TPU init hangs inside the plugin where no Python except clause runs.
+WATCHDOG_S = float(os.environ.get("BENCH_WATCHDOG_S", 480))
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def main():
+_emit_once = threading.Lock()
+_emitted = False
+
+
+def emit(value, vs_baseline, error=None):
+    """Print the JSON line once; returns True if this call was the one."""
+    global _emitted
+    with _emit_once:
+        if _emitted:
+            return False
+        rec = {
+            "metric": "points*steps/sec/chip",
+            "value": value,
+            "unit": "points*steps/s",
+            "vs_baseline": vs_baseline,
+        }
+        if error is not None:
+            rec["error"] = error
+        # print under the lock: the watchdog must not observe _emitted=True
+        # (and exit) before the line is actually flushed
+        print(json.dumps(rec), flush=True)
+        _emitted = True
+    return True
+
+
+def start_watchdog():
+    done = threading.Event()
+
+    def guard():
+        if not done.wait(WATCHDOG_S):
+            log(f"WATCHDOG: no result after {WATCHDOG_S:.0f}s "
+                "(backend init or execution wedged)")
+            wrote = emit(0.0, 0.0, error=f"watchdog timeout after {WATCHDOG_S:.0f}s")
+            sys.stdout.flush()
+            # If a valid result already went out (e.g. the stderr-only
+            # accuracy gate wedged after the measurement), exit clean.
+            os._exit(3 if wrote else 0)
+
+    threading.Thread(target=guard, daemon=True).start()
+    return done
+
+
+def acquire_device(jax, retries=3, backoff_s=5.0):
+    """First device of the default backend, with retry-with-backoff.
+
+    Under axon the tunneled TPU can be transiently unavailable (e.g. wedged
+    by a previous client); jax caches a *failed* backend init, so retries
+    clear the cache between attempts.
+    """
+    last = None
+    for attempt in range(retries):
+        try:
+            return jax.devices()[0]
+        except Exception as e:  # noqa: BLE001 — init errors vary by plugin
+            last = e
+            log(f"device acquisition attempt {attempt + 1}/{retries} failed: {e!r}")
+            # jax caches a FAILED backend init; without clearing it every
+            # retry re-reads the same error.  The API moved over jax
+            # versions, so try the known homes in order.
+            cleared = False
+            for clear in (
+                lambda: jax.extend.backend.clear_backends(),
+                lambda: jax.clear_backends(),
+            ):
+                try:
+                    clear()
+                    cleared = True
+                    break
+                except AttributeError:
+                    continue
+                except Exception as ce:
+                    log(f"clear_backends raised: {ce!r}")
+                    break
+            if not cleared:
+                log("no usable clear_backends API; retrying anyway")
+            time.sleep(backoff_s * (attempt + 1))
+    raise RuntimeError(f"could not acquire a device after {retries} attempts: {last!r}")
+
+
+def read_baseline(points_steps_per_sec):
+    try:
+        base_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json"
+        )
+        if os.path.exists(base_path):
+            with open(base_path) as f:
+                base = json.load(f)
+            if base.get("points_steps_per_sec"):
+                return points_steps_per_sec / float(base["points_steps_per_sec"])
+    except Exception as e:  # a bad side-channel file must not void the result
+        log(f"baseline read failed ({e!r}); reporting vs_baseline=0.0")
+    return 0.0
+
+
+def run_bench():
+    # Backend selection happens HERE, inside main flow, so an init failure is
+    # catchable and reportable (round 1 crashed at import scope instead).
+    # The axon TPU plugin ignores the JAX_PLATFORMS env var; honor an explicit
+    # override through the config knob (BENCH_PLATFORM=cpu for smoke tests).
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+
     from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, make_multi_step_fn
 
-    dev = jax.devices()[0]
-    log(f"device: {dev}, grid {GRID}^2, eps {EPS}, {STEPS} steps/iter, method {METHOD}")
+    dev = acquire_device(jax)
+    backend = jax.default_backend()
+    # Default to the Pallas kernel on TPU; off-TPU it would run in the (slow)
+    # interpreter, so CPU smoke tests default to the fastest XLA path instead.
+    method = os.environ.get("BENCH_METHOD", "pallas" if backend == "tpu" else "sat")
+    log(f"device: {dev}, grid {GRID}^2, eps {EPS}, {STEPS} steps/iter, method {method}")
 
     # Forward Euler is stable only for dt * c * dh^2 * Wsum <~ 2; pick 40% of
     # that bound so the timed state stays O(1) instead of overflowing f32.
-    probe = NonlocalOp2D(EPS, k=1.0, dt=1.0, dh=1.0 / GRID, method=METHOD)
+    probe = NonlocalOp2D(EPS, k=1.0, dt=1.0, dh=1.0 / GRID, method=method)
     dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
-    op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / GRID, method=METHOD)
+    op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / GRID, method=method)
     log(f"stable dt = {dt:.3e}")
     multi = make_multi_step_fn(op, STEPS)
 
@@ -64,8 +170,7 @@ def main():
         # finishes; a scalar device->host fetch is the only reliable fence.
         s = float(jnp.sum(x))
         if not np.isfinite(s):
-            log("FATAL: benchmark state went non-finite; timings are invalid")
-            raise SystemExit(2)
+            raise RuntimeError("benchmark state went non-finite; timings invalid")
         return s
 
     # warmup/compile
@@ -86,36 +191,49 @@ def main():
             f"({dt_s / STEPS * 1e3:.3f} ms/step)")
 
     points_steps_per_sec = GRID * GRID * STEPS / best
+    # Emit the measured result BEFORE the accuracy gate: the gate is
+    # stderr-only diagnostics, and a device hang inside it must not turn a
+    # valid measurement into a watchdog error (emit() is once-only).
+    emit(points_steps_per_sec, read_baseline(points_steps_per_sec))
 
-    # accuracy gate (stderr only): one step of METHOD at the bench dtype vs
-    # the float64 NumPy oracle on a small grid with the bench's physics.
+    # accuracy gate (stderr only): multi-step L2 of the bench method at the
+    # bench dtype vs the float64 NumPy oracle on a small grid with the bench's
+    # physics — the reference's contract is L2/N <= 1e-6 at t=nt
+    # (2d_nonlocal_distributed.cpp:1346).
     try:
         check_n = min(GRID, 512)
+        nsteps = min(STEPS, 50)
         uc = rng.normal(size=(check_n, check_n))
-        ref = uc + op.dt * op.apply_np(uc)
-        got = np.asarray(jnp.asarray(uc, jnp.float32)
-                         + op.dt * op.apply(jnp.asarray(uc, jnp.float32)))
-        err = float(np.abs(got - ref).max())
-        log(f"accuracy: one-step max|f32 {METHOD} - f64 oracle| = {err:.3e} "
-            f"({'OK' if err < 1e-4 else 'DEGRADED'})")
+        ref = uc.copy()
+        for _ in range(nsteps):
+            ref = ref + op.dt * op.apply_np(ref)
+        got = jnp.asarray(uc, jnp.float32)
+        for _ in range(nsteps):
+            got = got + op.dt * op.apply(got)
+        got = np.asarray(got)
+        l2_per_n = float(np.sum((got - ref) ** 2)) / (check_n * check_n)
+        ok = l2_per_n <= 1e-6
+        log(f"accuracy: {nsteps}-step L2/N (f32 {method} vs f64 oracle) = "
+            f"{l2_per_n:.3e} ({'OK' if ok else 'DEGRADED'})")
+        if not ok:
+            log("WARNING: bench dtype does not hold the 1e-6 contract at this "
+                "config; see tests/test_accuracy_contract.py for the gated path")
     except Exception as e:  # never let the gate break the JSON contract
         log(f"accuracy check failed to run: {e!r}")
 
-    vs_baseline = 0.0
-    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "BENCH_BASELINE.json")
-    if os.path.exists(base_path):
-        with open(base_path) as f:
-            base = json.load(f)
-        if base.get("points_steps_per_sec"):
-            vs_baseline = points_steps_per_sec / float(base["points_steps_per_sec"])
 
-    print(json.dumps({
-        "metric": "points*steps/sec/chip",
-        "value": points_steps_per_sec,
-        "unit": "points*steps/s",
-        "vs_baseline": vs_baseline,
-    }))
+def main():
+    done = start_watchdog()
+    try:
+        run_bench()
+    except BaseException as e:  # noqa: BLE001 — the JSON line must always appear
+        log(traceback.format_exc())
+        emit(0.0, 0.0, error=f"{type(e).__name__}: {e}")
+        # A check that can't run is a FAILED check (ctest discipline,
+        # CMakeLists.txt:101-154): nonzero rc, but the JSON line is out.
+        sys.exit(1)
+    finally:
+        done.set()
 
 
 if __name__ == "__main__":

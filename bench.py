@@ -114,7 +114,8 @@ def acquire_device(jax, retries=3, backoff_s=5.0):
                     break
             if not cleared:
                 log("no usable clear_backends API; retrying anyway")
-            time.sleep(backoff_s * (attempt + 1))
+            if attempt + 1 < retries:  # no point sleeping after the last try
+                time.sleep(backoff_s * (attempt + 1))
     raise RuntimeError(f"could not acquire a device after {retries} attempts: {last!r}")
 
 

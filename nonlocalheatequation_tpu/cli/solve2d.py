@@ -52,6 +52,12 @@ def main(argv=None) -> int:
     if args.resume and not args.checkpoint:
         print("--resume requires --checkpoint", file=sys.stderr)
         return 1
+    if args.test_batch and (args.resume or args.checkpoint):
+        # batch cases would all share the single --checkpoint path (each case
+        # overwriting the last) and --resume would be silently ignored
+        print("--checkpoint/--resume cannot be combined with --test_batch",
+              file=sys.stderr)
+        return 1
     version_banner("2d_nonlocal")
     apply_platform(args)
 

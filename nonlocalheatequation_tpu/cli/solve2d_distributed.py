@@ -114,8 +114,7 @@ def main(argv=None) -> int:
                 nx * npx, ny * npy, jax.devices()[:args.devices])
         return Solver2DDistributed(
             nx, ny, npx, npy, nt, eps, nlog=args.nlog,
-            nbalance=args.nbalance or None, k=k, dt=dt, dh=dh,
-            mesh=mesh, method=args.method,
+            k=k, dt=dt, dh=dh, mesh=mesh, method=args.method,
         )
 
     if args.test_batch:

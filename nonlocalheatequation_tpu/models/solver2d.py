@@ -60,6 +60,7 @@ class Solver2D(ManufacturedMetrics2D):
         self.checkpoint_path = checkpoint_path
         self.ncheckpoint = int(ncheckpoint)
         self.t0 = 0
+        self.max_inflight_ = 0  # peak nd-throttle queue depth (observability)
         self.test = False
         self.u0 = np.zeros((self.nx, self.ny), dtype=np.float64)
         self.u = None
@@ -147,6 +148,7 @@ class Solver2D(ManufacturedMetrics2D):
 
         step = jax.jit(make_step_fn(self.op, g, lg, dtype))
         inflight = []
+        self.max_inflight_ = 0
         for t in range(self.t0, self.nt):
             u = step(u, t)
             if t % self.nlog == 0 and self.logger is not None:
@@ -158,6 +160,7 @@ class Solver2D(ManufacturedMetrics2D):
                 inflight.append(u)
                 if len(inflight) > self.nd:
                     inflight.pop(0).block_until_ready()
+                self.max_inflight_ = max(self.max_inflight_, len(inflight))
         return np.asarray(u)
 
     # -- error metrics: ManufacturedMetrics2D -------------------------------

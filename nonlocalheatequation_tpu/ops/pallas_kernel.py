@@ -101,6 +101,16 @@ def _choose_tm(nx: int, ny: int, eps: int, itemsize: int, n_aux: int) -> int:
     cap = min(256, _round_up(nx, 8))
     while cap > 8 and not _fits(cap, ny, eps, itemsize, n_aux):
         cap -= 8
+    if not _fits(cap, ny, eps, itemsize, n_aux):
+        # even the minimum 8-row strip overflows the VMEM budget: ny is too
+        # wide for this kernel's whole-row window layout.  Fail loudly here
+        # instead of letting Mosaic die with an opaque allocation error.
+        raise ValueError(
+            f"pallas strip kernel: ny={ny} with eps={eps} exceeds the "
+            f"{_VMEM_BUDGET >> 20} MiB VMEM budget even at the minimum strip "
+            "height; use method='sat' or 'conv', or shard the y axis over "
+            "the mesh so each block's row fits"
+        )
     for tm in range(cap, 0, -8):
         if nx % tm == 0:
             return tm

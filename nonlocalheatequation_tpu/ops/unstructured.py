@@ -37,6 +37,21 @@ def build_edges(points: np.ndarray, eps: np.ndarray):
 
     points: (N, d) float64; eps: (N,) per-point horizon radii.
     Returns (targets, sources) int32 arrays sorted by target, center included.
+
+    Binning uses ONE global cell size, ``eps.max()``, with candidates drawn
+    from the +/-1 cell neighborhood.  Consequences:
+
+    * correctness: any neighbor with |x_j - x_i| <= eps_i <= eps.max() lands
+      within one cell of i, so no true neighbor is missed; a point that only
+      qualifies through the (1 + 1e-12) floating-point mask tolerance while
+      sitting beyond eps.max() of a cell boundary could in principle fall in
+      a +/-2 cell and be excluded — boundary-exact neighbors are therefore
+      not guaranteed when eps_i == eps.max() exactly;
+    * performance: a strongly varying horizon field degrades the search
+      toward O(N * max-ball) because every point scans candidates within
+      eps.max(), not its own eps_i.  For such fields, bin per horizon scale
+      before calling (or accept the host-side one-time cost — the edge list
+      is built once and reused for the whole solve).
     """
     points = np.asarray(points, np.float64)
     eps = np.broadcast_to(np.asarray(eps, np.float64), (points.shape[0],))

@@ -80,7 +80,22 @@ class Solver2DDistributed(ManufacturedMetrics2D):
         self.nx, self.ny, self.npx, self.npy = int(nx), int(ny), int(npx), int(npy)
         self.NX, self.NY = self.nx * self.npx, self.ny * self.npy
         self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
-        self.nbalance = nbalance
+        if nbalance:
+            # The reference rebalances inside its main do_work loop
+            # (src/2d_nonlocal_distributed.cpp:1306-1309) because its tiles can
+            # pile up unevenly per locality.  This solver shards the grid
+            # UNIFORMLY over the mesh — every device owns exactly one
+            # equal-size block, so there is no tile-count imbalance to correct
+            # and silently accepting nbalance would be a lie.  Runtime
+            # rebalancing (arbitrary tiles-per-device + migration, with
+            # measured busy-rates) lives on ElasticSolver2D, which the CLI
+            # selects automatically when --nbalance is set.
+            raise ValueError(
+                "Solver2DDistributed shards uniformly (one equal block per "
+                "device) and cannot rebalance; use "
+                "parallel.elastic.ElasticSolver2D for nbalance support"
+            )
+        self.nbalance = None
         self.op = NonlocalOp2D(eps, k, dt, dh, method=method)
         self.mesh = mesh if mesh is not None else choose_mesh_for_grid(self.NX, self.NY)
         self.logger = logger
@@ -121,13 +136,15 @@ class Solver2DDistributed(ManufacturedMetrics2D):
                 return u_blk + op.dt * op.apply_padded(upad)
 
             in_specs = (spec, P())
-        # check_vma=False only for the Pallas path: its interpreter mode (the
-        # CPU test path) internally carries mixed varying/unvarying values and
-        # trips the vma checker — JAX's own error message prescribes this
-        # workaround; semantics are unchanged.  Other methods keep the
-        # checker's trace-time protection.
+        # check_vma=False only for the Pallas path in INTERPRETER mode (the
+        # CPU test path): the interpreter internally carries mixed
+        # varying/unvarying values and trips the vma checker — JAX's own
+        # error message prescribes this workaround; semantics are unchanged.
+        # Real-TPU pallas and all other methods keep the checker's
+        # trace-time protection.
+        vma_ok = op.method != "pallas" or jax.default_backend() == "tpu"
         return shard_map(local_step, mesh=mesh, in_specs=in_specs,
-                         out_specs=spec, check_vma=op.method != "pallas")
+                         out_specs=spec, check_vma=vma_ok)
 
     def _device_state(self):
         dtype = self.dtype or (

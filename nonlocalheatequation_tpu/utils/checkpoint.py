@@ -21,15 +21,24 @@ def save_state(path: str, u: np.ndarray, t: int, params: dict | None = None):
     """Atomically write solver state at timestep ``t`` (u = state AFTER t steps)."""
     tmp = f"{path}.tmp.{os.getpid()}"
     meta = dict(params or {})
-    with open(tmp, "wb") as f:
-        np.savez(
-            f,
-            u=np.asarray(u),
-            t=np.int64(t),
-            version=np.int64(FORMAT_VERSION),
-            params=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
-        )
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(
+                f,
+                u=np.asarray(u),
+                t=np.int64(t),
+                version=np.int64(FORMAT_VERSION),
+                params=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+            )
+        os.replace(tmp, path)
+    except BaseException:
+        # a failed write (disk full, kill) must not strand tmp files next to
+        # the live checkpoint
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load_state(path: str):

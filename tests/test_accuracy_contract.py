@@ -1,0 +1,73 @@
+"""The f64 accuracy contract at the TPU dtype (f32).
+
+The reference is all-double (src/2d_nonlocal_distributed.cpp:136) and every
+test asserts error_l2/#points <= 1e-6 at t=nt (:1346).  The TPU fast path
+computes in f32 — these tests demonstrate that the contract SURVIVES f32 over
+multi-step runs, for every evaluation method, at the largest config the
+reference's own tables exercise (200x200, tests/2d.txt row 4) and against the
+f64 oracle on random states (the bench.py gate's stronger form).
+
+conftest enables x64, so dtype=float32 below genuinely forces the f32 path.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nonlocalheatequation_tpu.models.solver2d import Solver2D
+from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+from tests.cases import L2_THRESHOLD
+
+
+@pytest.mark.parametrize("method", ["conv", "sat"])
+def test_f32_holds_contract_200sq(method):
+    # largest reference-table config: 200x200, 40 steps, eps=5 (tests/2d.txt)
+    s = Solver2D(200, 200, 40, eps=5, k=1.0, dt=0.0005, dh=0.02,
+                 backend="jit", method=method, dtype=jnp.float32)
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / (200 * 200) <= L2_THRESHOLD
+
+
+def test_f32_holds_contract_pallas():
+    # pallas runs interpreted off-TPU; keep the grid tabletop-sized
+    s = Solver2D(50, 50, 45, eps=5, k=1.0, dt=0.0005, dh=0.02,
+                 backend="jit", method="pallas", dtype=jnp.float32)
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / (50 * 50) <= L2_THRESHOLD
+
+
+def test_f32_long_horizon_contract():
+    # eps=10 on 50x50: wide-horizon row (tests/2d.txt row 3) in f32
+    s = Solver2D(50, 50, 200, eps=10, k=1.0, dt=0.0005, dh=0.02,
+                 backend="jit", method="sat", dtype=jnp.float32)
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / (50 * 50) <= L2_THRESHOLD
+
+
+@pytest.mark.parametrize("method", ["conv", "sat", "pallas"])
+def test_f32_multistep_drift_vs_f64_oracle(method):
+    """50 free-decay steps from a random state: f32 vs the f64 oracle.
+
+    This is bench.py's accuracy gate in test form (same physics scaled down:
+    eps=8, dh=1/N, stability-bounded dt), isolating pure dtype drift with no
+    manufactured-solution discretization error in the comparison.
+    """
+    n, nsteps = 128, 50
+    probe = NonlocalOp2D(8, k=1.0, dt=1.0, dh=1.0 / n, method=method)
+    dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
+    op = NonlocalOp2D(8, k=1.0, dt=dt, dh=1.0 / n, method=method)
+
+    rng = np.random.default_rng(0)
+    u0 = rng.normal(size=(n, n))
+    ref = u0.copy()
+    for _ in range(nsteps):
+        ref = ref + op.dt * op.apply_np(ref)
+    got = jnp.asarray(u0, jnp.float32)
+    for _ in range(nsteps):
+        got = got + op.dt * op.apply(got)
+    l2_per_n = float(np.sum((np.asarray(got) - ref) ** 2)) / (n * n)
+    assert l2_per_n <= L2_THRESHOLD

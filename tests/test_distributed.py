@@ -62,6 +62,14 @@ def test_multihop_halo_when_eps_exceeds_shard():
     assert abs(uo - ud).max() < 1e-12
 
 
+def test_nbalance_rejected_on_spmd_solver():
+    # the SPMD solver shards uniformly — no tile imbalance exists to correct;
+    # asking it to rebalance must be a loud error, not a silent no-op
+    # (rebalancing lives on ElasticSolver2D)
+    with pytest.raises(ValueError, match="ElasticSolver2D"):
+        Solver2DDistributed(10, 10, 2, 2, nt=5, eps=3, nbalance=10)
+
+
 def test_choose_mesh_divides_grid():
     mesh = choose_mesh_for_grid(50, 50)
     mx, my = mesh.shape["x"], mesh.shape["y"]

@@ -99,11 +99,14 @@ def main(argv=None) -> int:
                       f"owners but only {ndev} devices are available; "
                       "folding owners onto devices", file=sys.stderr)
                 place = place % ndev
-            return ElasticSolver2D(
+            s = ElasticSolver2D(
                 nx, ny, npx, npy, nt, eps, nlog=args.nlog,
                 nbalance=args.nbalance or None, k=k, dt=dt, dh=dh,
                 assignment=place, devices=devices, method=args.method,
             )
+            if args.test_load_balance:
+                s.measure = True  # report measured rates even without nbalance
+            return s
         mesh = None
         if args.devices:
             from nonlocalheatequation_tpu.parallel.distributed2d import (

@@ -38,7 +38,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dh", type=float, default=0.0625)
     p.add_argument("--no-header", action="store_true", dest="no_header")
     p.add_argument("--backend", default="jit", choices=("oracle", "jit"))
-    p.add_argument("--method", default="sat", choices=("shift", "sat"))
+    p.add_argument("--method", default="sat", choices=("shift", "sat", "pallas"))
+    p.add_argument("--distributed", action="store_true",
+                   help="shard over the device mesh (SPMD + halo exchange)")
     add_platform_flags(p)
     return p
 
@@ -51,6 +53,13 @@ def main(argv=None) -> int:
     from nonlocalheatequation_tpu.models.solver3d import Solver3D
 
     def make_solver(nx, ny, nz, nt, eps, k, dt, dh):
+        if args.distributed:
+            from nonlocalheatequation_tpu.parallel.distributed3d import (
+                Solver3DDistributed,
+            )
+
+            return Solver3DDistributed(nx, ny, nz, nt, eps, nlog=args.nlog,
+                                       k=k, dt=dt, dh=dh, method=args.method)
         return Solver3D(nx, ny, nz, nt, eps, nlog=args.nlog, k=k, dt=dt,
                         dh=dh, backend=args.backend, method=args.method)
 

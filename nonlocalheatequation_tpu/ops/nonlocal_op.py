@@ -360,7 +360,7 @@ class NonlocalOp3D:
         self.weights = influence_weights(self.mask, influence, dh)
         self.wsum = float(self.weights.sum())
         self.uniform = influence is None
-        if method == "sat" and not self.uniform:
+        if method in ("sat", "pallas") and not self.uniform:
             method = "shift"
         self.method = method
         # column half-heights along z per (i, j) offset, derived from the
@@ -396,6 +396,13 @@ class NonlocalOp3D:
     def neighbor_sum_padded(self, upad: jnp.ndarray) -> jnp.ndarray:
         e = self.eps
         nx, ny, nz = (s - 2 * e for s in upad.shape)
+        if self.method == "pallas":
+            from nonlocalheatequation_tpu.ops.pallas_kernel import (
+                build_neighbor_sum_3d,
+            )
+
+            fn = build_neighbor_sum_3d(e, nx, ny, nz, np.dtype(upad.dtype).name)
+            return fn(upad)
         if self.method == "sat":
             # exclusive prefix along z: one window difference per (i, j)
             p = jnp.concatenate(

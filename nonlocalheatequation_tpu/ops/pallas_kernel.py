@@ -137,38 +137,36 @@ def _naf(w: int):
     return digits
 
 
-@functools.lru_cache(maxsize=None)
-def _strip_plan(eps: int):
-    """Signed-dyadic evaluation plan for the circle's column-window sums.
+def _naf_parts(width: int):
+    """MSB-first signed-dyadic cover of a window of ``width`` rows.
 
-    For each distinct column half-height h the window width 2h+1 is
-    decomposed (NAF, MSB-first) into signed dyadic windows D_k[r] =
-    sum(w[r:r+k]); processing MSB-first keeps every partial cover
-    non-negative, so each part is a down-roll of a D_k by a static offset.
-
-    Returns (heights, parts_by_h, pows, pad) where parts_by_h[h] is a list of
-    (k, row_offset, sign), pows the D_k chain to build, and pad the number of
-    extra window rows needed below the strip (round_up of the deepest read).
+    Returns ((k, row_offset, sign), ...): the window sum of ``width`` rows
+    equals sum(sign * D_k rolled down by row_offset); processing the NAF
+    MSB-first keeps every partial cover non-negative so offsets stay >= 0.
     """
-    heights = tuple(int(h) for h in column_half_heights(eps))
+    parts = []
+    cur = 0
+    for p, sign in sorted(_naf(width), reverse=True):
+        k = 1 << p
+        if sign > 0:
+            parts.append((k, cur, +1))
+            cur += k
+        else:
+            cur -= k
+            parts.append((k, cur, -1))
+    assert cur == width
+    return tuple(parts)
+
+
+def _dyadic_plan(height_set, eps: int):
+    """(parts_by_h, pows, pad) for a set of column half-heights."""
     parts_by_h = {}
     pows = {1}
     max_need = 1
-    for h in sorted(set(heights)):
-        width = 2 * h + 1
-        parts = []
-        cur = 0
-        for p, sign in sorted(_naf(width), reverse=True):
-            k = 1 << p
-            pows.add(k)
-            if sign > 0:
-                parts.append((k, cur, +1))
-                cur += k
-            else:
-                cur -= k
-                parts.append((k, cur, -1))
-        assert cur == width
-        parts_by_h[h] = tuple(parts)
+    for h in sorted(height_set):
+        parts = _naf_parts(2 * h + 1)
+        parts_by_h[h] = parts
+        pows.update(k for k, _, _ in parts)
         a = eps - h
         max_need = max(max_need, a + max(off + k for k, off, _ in parts))
     # chain needs all intermediate powers of two
@@ -177,7 +175,24 @@ def _strip_plan(eps: int):
     while k < top:
         pows.add(k)
         k *= 2
-    return heights, parts_by_h, tuple(sorted(pows)), _round_up(max_need, 8)
+    return parts_by_h, tuple(sorted(pows)), _round_up(max_need, 8)
+
+
+@functools.lru_cache(maxsize=None)
+def _strip_plan(eps: int):
+    """Signed-dyadic evaluation plan for the circle's column-window sums.
+
+    For each distinct column half-height h the window width 2h+1 is
+    decomposed (NAF, MSB-first) into signed dyadic windows D_k[r] =
+    sum(w[r:r+k]).
+
+    Returns (heights, parts_by_h, pows, pad) where parts_by_h[h] is a list of
+    (k, row_offset, sign), pows the D_k chain to build, and pad the number of
+    extra window rows needed below the strip (round_up of the deepest read).
+    """
+    heights = tuple(int(h) for h in column_half_heights(eps))
+    parts_by_h, pows, pad = _dyadic_plan(set(heights), eps)
+    return heights, parts_by_h, pows, pad
 
 
 def _strip_neighbor_sum(w, tm: int, ny: int, eps: int):
@@ -344,6 +359,154 @@ def _build_step_kernel(
         return out
 
     return step_padded, tm, tmw
+
+
+# ---------------------------------------------------------------------------
+# 3D: the same dyadic strip trick, one more axis
+# ---------------------------------------------------------------------------
+#
+# The rasterized eps-sphere (ops/stencil.horizon_mask_3d) is exactly the
+# integer ball {(i,j,k): i^2+j^2+k^2 <= eps^2} — permutation-symmetric, so
+# instead of summing z-columns per (i,j) offset (the NonlocalOp3D shift/sat
+# formulation) the kernel sums X-windows per lane-plane offset (j,k): the
+# window half-height is h(j,k) = trunc(sqrt(eps^2 - j^2 - k^2)), and every
+# distinct h reuses one signed-dyadic window sum D-chain along axis 0 —
+# identical structure to the 2D kernel, with ~pi*eps^2 slice-adds instead of
+# 2*eps+1.  The grid is 2D over (x strips, y blocks); z rides whole in lanes.
+
+
+@functools.lru_cache(maxsize=None)
+def _strip_plan_3d(eps: int):
+    """((jj, kk) -> h) lane-plane heights + dyadic plan + x pad for the sphere.
+
+    Heights derive from the 3D mask itself (column sums along axis 0), so the
+    raster rule lives only in ops/stencil.py.
+    """
+    from nonlocalheatequation_tpu.ops.stencil import horizon_mask_3d
+
+    mask = horizon_mask_3d(eps)
+    colsum = mask.sum(axis=0)
+    heights = {
+        (jj, kk): int((colsum[jj, kk] - 1) // 2)
+        for jj in range(2 * eps + 1)
+        for kk in range(2 * eps + 1)
+        if colsum[jj, kk] > 0
+    }
+    parts_by_h, pows, pad = _dyadic_plan(set(heights.values()), eps)
+    return heights, parts_by_h, pows, pad
+
+
+def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int):
+    """Masked-sphere neighbor sum for one (tm, tn, nz) block.
+
+    ``w`` is the (tm + pad, tn + 2*eps, nz + 2*eps) window; row r of axis 0
+    holds padded row ``strip_start + r``.  All rolls read downward along
+    axis 0; wrap garbage lands in the never-read bottom pad rows.
+    """
+    heights, parts_by_h, pows, _pad = _strip_plan_3d(eps)
+    tmw = w.shape[0]
+    down = lambda x, s: pltpu.roll(x, tmw - s, 0)  # noqa: E731
+    d = {1: w}
+    for k in pows:
+        if k > 1:
+            half = d[k // 2]
+            d[k] = half + down(half, k // 2)
+    v = {}
+    for h, parts in parts_by_h.items():
+        acc_h = None
+        for k, off, sign in parts:
+            t = d[k] if off == 0 else down(d[k], off)
+            if acc_h is None:
+                acc_h = t if sign > 0 else -t
+            else:
+                acc_h = acc_h + t if sign > 0 else acc_h - t
+        v[h] = acc_h
+    acc = None
+    for (jj, kk), h in heights.items():
+        a = eps - h
+        sl = v[h][a : a + tm, jj : jj + tn, kk : kk + nz]
+        acc = sl if acc is None else acc + sl
+    return acc
+
+
+def _fits_3d(tm: int, tn: int, nz: int, eps: int, itemsize: int) -> bool:
+    _, parts_by_h, pows, pad = _strip_plan_3d(eps)
+    window = (tm + pad) * (tn + 2 * eps) * (nz + 2 * eps) * itemsize
+    out = tm * tn * nz * itemsize
+    n_pairs = len(_strip_plan_3d(eps)[0])
+    log_steps = max(1, int(np.ceil(np.log2(tm + pad))))
+    stack = (2 * log_steps + 4 + len(parts_by_h)) * window + (2 * n_pairs + 3) * out
+    return stack <= _VMEM_BUDGET
+
+
+def _choose_tiles_3d(nx: int, ny: int, nz: int, eps: int, itemsize: int):
+    """(tm, tn): block footprint that fits VMEM, preferring divisors of nx/ny."""
+
+    def pick(n: int, fits) -> int:
+        cap = min(64, _round_up(n, 8))
+        while cap > 8 and not fits(cap):
+            cap -= 8
+        if not fits(cap):
+            raise ValueError(
+                f"pallas 3D kernel: nz={nz} with eps={eps} exceeds the "
+                f"{_VMEM_BUDGET >> 20} MiB VMEM budget at the minimum block; "
+                "use method='sat'/'shift' or shard z over the mesh"
+            )
+        for t in range(cap, 0, -8):
+            if n % t == 0:
+                return t
+        return cap
+
+    tn = pick(ny, lambda t: _fits_3d(8, t, nz, eps, itemsize))
+    tm = pick(nx, lambda t: _fits_3d(t, tn, nz, eps, itemsize))
+    return tm, tn
+
+
+@functools.lru_cache(maxsize=None)
+def build_neighbor_sum_3d(eps: int, nx: int, ny: int, nz: int, dtype_name: str):
+    """(upad: (nx+2e, ny+2e, nz+2e)) -> (nx, ny, nz) masked-sphere sum."""
+    dtype = jnp.dtype(dtype_name)
+    tm, tn = _choose_tiles_3d(nx, ny, nz, eps, dtype.itemsize)
+    pad = _strip_plan_3d(eps)[3]
+    tmw = tm + pad
+
+    def kernel(win_ref, out_ref):
+        out_ref[:] = _block_neighbor_sum_3d(
+            win_ref[:], tm, tn, nz, eps
+        ).astype(dtype)
+
+    def neighbor_sum(upad):
+        vma = jax.typeof(upad).vma
+        nxp, nyp = _round_up(nx, tm), _round_up(ny, tn)
+        # pad x so every strip window is in range; pad y to a block multiple
+        extra_x = (nxp - tm + tmw) - upad.shape[0]
+        extra_y = (nyp + 2 * eps) - upad.shape[1]
+        if extra_x > 0 or extra_y > 0:
+            upad = jnp.pad(
+                upad, ((0, max(extra_x, 0)), (0, max(extra_y, 0)), (0, 0))
+            )
+        out = pl.pallas_call(
+            kernel,
+            grid=(nxp // tm, nyp // tn),
+            in_specs=[
+                pl.BlockSpec(
+                    (pl.Element(tmw), pl.Element(tn + 2 * eps),
+                     pl.Element(nz + 2 * eps)),
+                    lambda i, j: (i * tm, j * tn, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (pl.Element(tm), pl.Element(tn), pl.Element(nz)),
+                lambda i, j: (i * tm, j * tn, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((nxp, nyp, nz), dtype, vma=vma),
+            **_kernel_params(),
+        )(upad)
+        return out[:nx, :ny]
+
+    return neighbor_sum
 
 
 def make_pallas_step_fn(op, g=None, lg=None, dtype=None):

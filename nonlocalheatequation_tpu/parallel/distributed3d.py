@@ -1,0 +1,163 @@
+"""Distributed 3D solver — SPMD over a 3D device mesh.
+
+Extension of the flagship 2D distributed design (parallel/distributed2d.py,
+which re-designs src/2d_nonlocal_distributed.cpp:360-1325 TPU-first) to three
+dimensions: one global (NX, NY, NZ) array sharded block-wise over a
+Mesh('x','y','z'), one jit'd shard_map step per timestep, ppermute eps-band
+exchange on every sharded axis (multi-hop ring when eps exceeds a shard
+edge).  Numerics are identical to the 3D serial oracle
+(models/solver3d.py) — the same property the reference's distributed solver
+keeps relative to its serial one, which its whole test strategy relies on
+(SURVEY.md section 4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
+from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D, source_at
+from nonlocalheatequation_tpu.parallel.halo import halo_pad_nd
+from nonlocalheatequation_tpu.parallel.mesh import grid_sharding_3d, make_mesh_3d
+
+
+def choose_mesh_for_grid_3d(NX: int, NY: int, NZ: int, devices=None) -> Mesh:
+    """Largest mesh (mx, my, mz) whose shape divides the grid, product <= #devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    best = (1, 1, 1)
+
+    def better(c, b):
+        # more devices first; among equal products prefer the most-cubic
+        # shape (min of max factor) — smallest halo surface per shard
+        pc, pb = c[0] * c[1] * c[2], b[0] * b[1] * b[2]
+        return pc > pb or (pc == pb and max(c) < max(b))
+
+    for mx in range(1, min(NX, n) + 1):
+        if NX % mx:
+            continue
+        for my in range(1, min(NY, n // mx) + 1):
+            if NY % my:
+                continue
+            for mz in range(1, min(NZ, n // (mx * my)) + 1):
+                if NZ % mz == 0 and better((mx, my, mz), best):
+                    best = (mx, my, mz)
+    return make_mesh_3d(*best, devices=devices)
+
+
+class Solver3DDistributed(ManufacturedMetrics2D):
+    """Solve on the global (NX, NY, NZ) grid, sharded over a 3D mesh."""
+
+    def __init__(
+        self,
+        NX: int,
+        NY: int,
+        NZ: int,
+        nt: int,
+        eps: int,
+        nlog: int = 5,
+        k: float = 1.0,
+        dt: float = 0.0005,
+        dh: float = 0.05,
+        mesh: Mesh | None = None,
+        method: str = "sat",
+        logger=None,
+        dtype=None,
+    ):
+        self.NX, self.NY, self.NZ = int(NX), int(NY), int(NZ)
+        self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
+        self.op = NonlocalOp3D(eps, k, dt, dh, method=method)
+        self.mesh = (
+            mesh if mesh is not None
+            else choose_mesh_for_grid_3d(self.NX, self.NY, self.NZ)
+        )
+        self.logger = logger
+        self.dtype = dtype
+        self.test = False
+        self.u0 = np.zeros((self.NX, self.NY, self.NZ), dtype=np.float64)
+        self.u = None
+        self.error_l2 = 0.0
+        self.error_linf = 0.0
+
+    def test_init(self):
+        self.test = True
+        self.u0 = self.op.spatial_profile(self.NX, self.NY, self.NZ).copy()
+
+    def input_init(self, values):
+        self.test = False
+        self.u0 = np.asarray(values, dtype=np.float64).reshape(
+            self.NX, self.NY, self.NZ
+        )
+
+    def _build_step(self):
+        op, eps, mesh = self.op, self.eps, self.mesh
+        mesh_shape = (mesh.shape["x"], mesh.shape["y"], mesh.shape["z"])
+        names = ("x", "y", "z")
+        spec = P(*names)
+
+        if self.test:
+            def local_step(u_blk, g_blk, lg_blk, t):
+                upad = halo_pad_nd(u_blk, eps, mesh_shape, names)
+                du = op.apply_padded(upad) + source_at(g_blk, lg_blk, t, op.dt)
+                return u_blk + op.dt * du
+
+            in_specs = (spec, spec, spec, P())
+        else:
+            def local_step(u_blk, t):
+                upad = halo_pad_nd(u_blk, eps, mesh_shape, names)
+                return u_blk + op.dt * op.apply_padded(upad)
+
+            in_specs = (spec, P())
+        vma_ok = op.method != "pallas" or jax.default_backend() == "tpu"
+        return shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                         out_specs=spec, check_vma=vma_ok)
+
+    def _device_state(self):
+        dtype = self.dtype or (
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        )
+        sharding = grid_sharding_3d(self.mesh)
+        u = jax.device_put(jnp.asarray(self.u0, dtype), sharding)
+        if not self.test:
+            return u, ()
+        g, lg = self.op.source_parts(self.NX, self.NY, self.NZ)
+        g = jax.device_put(jnp.asarray(g, dtype), sharding)
+        lg = jax.device_put(jnp.asarray(lg, dtype), sharding)
+        return u, (g, lg)
+
+    def do_work(self) -> np.ndarray:
+        step = self._build_step()
+        u, source_args = self._device_state()
+
+        if self.logger is None:
+            def body(carry, t):
+                return step(carry, *source_args, t), None
+
+            @jax.jit
+            def run(u0):
+                out, _ = lax.scan(body, u0, jnp.arange(self.nt))
+                return out
+
+            u = run(u)
+        else:
+            jstep = jax.jit(step)
+            for t in range(self.nt):
+                u = jstep(u, *source_args, t)
+                if t % self.nlog == 0:
+                    self.logger(t, np.asarray(u))
+
+        self.u = np.asarray(u)
+        if self.test:
+            self.compute_l2(self.nt)
+            self.compute_linf(self.nt)
+        return self.u
+
+    @property
+    def _grid_shape(self):
+        return (self.NX, self.NY, self.NZ)

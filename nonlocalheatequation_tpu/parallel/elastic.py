@@ -29,6 +29,8 @@ distributed2d.py.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 import jax
@@ -38,7 +40,7 @@ from jax import lax
 from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, source_at
 from nonlocalheatequation_tpu.parallel.load_balance import (
-    WorkTelemetry,
+    MeasuredTelemetry,
     rebalance_assignment,
 )
 from nonlocalheatequation_tpu.utils.partition_map import default_assignment
@@ -68,7 +70,7 @@ class ElasticSolver2D(ManufacturedMetrics2D):
         assignment: np.ndarray | None = None,
         devices=None,
         method: str = "shift",
-        telemetry: WorkTelemetry | None = None,
+        telemetry=None,
         logger=None,
         dtype=None,
     ):
@@ -87,7 +89,14 @@ class ElasticSolver2D(ManufacturedMetrics2D):
                 f"assignment owner ids span [{self.assignment.min()}, "
                 f"{self.assignment.max()}] but only {nl} devices are "
                 "available; re-run the decomposition for this device count")
-        self.telemetry = telemetry or WorkTelemetry(nl)
+        # Default telemetry is MEASURED wall-clock (the reference reads real
+        # idle-rate counters, never a model); WorkTelemetry remains available
+        # as an injectable test fixture for deterministic scenarios.
+        self.telemetry = telemetry or MeasuredTelemetry(nl)
+        # Measurement serializes device groups (see _step_all_measured), so
+        # only pay for it when something consumes the rates: rebalancing, or
+        # a caller that flips this on (e.g. --test_load_balance reporting).
+        self.measure = bool(self.nbalance)
         self.logger = logger
         self.dtype = dtype or (
             jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
@@ -209,23 +218,72 @@ class ElasticSolver2D(ManufacturedMetrics2D):
         new_assignment = rebalance_assignment(self.assignment, busy)
         return self.migrate(new_assignment)
 
+    def _run_tile(self, key, upad, t):
+        """Dispatch one tile's step (hookable by tests to emulate a genuinely
+        slow device — e.g. wrapping with extra host work)."""
+        if self.test:
+            g, lg = self._gtiles[key]
+            return self._step_test(upad, g, lg, t)
+        return self._step_plain(upad, t)
+
+    def _step_all_measured(self, t) -> dict:
+        """One timestep with per-device busy-time MEASUREMENT.
+
+        The reference samples per-locality idle-rate counters
+        (src/2d_nonlocal_distributed.cpp:856-863); the analog here is the
+        wall-clock each device's tile group actually takes: assemble +
+        dispatch + block-until-ready, one device group at a time (groups are
+        serialized so a group's measurement never includes another device's
+        pending work).  This trades the groups' overlap for an unbiased
+        per-device measurement — the elastic path is the capability/balance
+        substrate, not the throughput path (that is distributed2d.py).
+        """
+        new_tiles = {}
+        for d in range(len(self.devices)):
+            keys = [k for k, owner in np.ndenumerate(self.assignment)
+                    if owner == d]
+            if not keys:
+                continue
+            t0 = time.perf_counter()
+            outs = []
+            for key in keys:
+                upad = self._assemble_padded(*key)
+                out = self._run_tile(key, upad, t)
+                new_tiles[key] = out
+                outs.append(out)
+            for o in outs:
+                o.block_until_ready()
+            self.telemetry.record(d, time.perf_counter() - t0)
+        return new_tiles
+
+    def _step_all_overlapped(self, t) -> dict:
+        """One timestep, fully async-dispatched (JAX futures overlap the
+        per-tile programs the way the reference's dataflow graph does)."""
+        return {key: self._run_tile(key, self._assemble_padded(*key), t)
+                for key in self._tiles}
+
     # -- time loop ----------------------------------------------------------
     def do_work(self) -> np.ndarray:
         self._place_tiles()
         nl = len(self.devices)
+        measured = self.measure and hasattr(self.telemetry, "record")
         for t in range(self.nt):
-            new_tiles = {}
-            for key in self._tiles:
-                upad = self._assemble_padded(*key)
-                if self.test:
-                    g, lg = self._gtiles[key]
-                    new_tiles[key] = self._step_test(upad, g, lg, t)
-                else:
-                    new_tiles[key] = self._step_plain(upad, t)
-            self._tiles = new_tiles
+            if measured:
+                self._tiles = self._step_all_measured(t)
+                if t == 0 and hasattr(self.telemetry, "reset"):
+                    # step 0 pays jit compilation inside the first device
+                    # group's timed window; discard it so the first rebalance
+                    # acts on steady-state rates, not compile noise
+                    self.telemetry.reset()
+            else:
+                self._tiles = self._step_all_overlapped(t)
             if (self.nbalance and t % self.nbalance == 0 and t > 0
                     and nl > 1):
                 self._rebalance()
+                if hasattr(self.telemetry, "reset"):
+                    # new measurement window, like the reference's counter
+                    # re-read after rebalancing (:954-956)
+                    self.telemetry.reset()
             if t % self.nlog == 0 and self.logger is not None:
                 self.logger(t, self.gather())
         self.u = self.gather()

@@ -69,7 +69,18 @@ def halo_pad_2d(block, eps: int, mesh_shape: tuple[int, int],
     Axis x is exchanged first; the y exchange then carries the x-halos so
     corner regions arrive without extra diagonal sends (two-phase exchange).
     """
-    nx_shards, ny_shards = mesh_shape
-    out = _axis_halo(block, 0, axis_names[0], nx_shards, eps)
-    out = _axis_halo(out, 1, axis_names[1], ny_shards, eps)
+    return halo_pad_nd(block, eps, mesh_shape, axis_names)
+
+
+def halo_pad_nd(block, eps: int, mesh_shape: tuple[int, ...],
+                axis_names: tuple[str, ...]):
+    """Rank-agnostic halo pad: one eps-band exchange per sharded axis.
+
+    Sequential per-axis exchange (each later axis carries the earlier axes'
+    halos), so all corner/edge regions arrive without diagonal sends — the
+    N-dim generalization of the 2D two-phase exchange.
+    """
+    out = block
+    for axis, (name, nshards) in enumerate(zip(axis_names, mesh_shape)):
+        out = _axis_halo(out, axis, name, nshards, eps)
     return out

@@ -9,17 +9,20 @@ locality adjacency graph + priority-BFS (:706-831), and finally migrates
 tiles by re-constructing their client handles on new localities (:939-944).
 
 On TPU there are no per-device OS-thread idle counters visible to a
-single-process JAX program — and none are needed: with homogeneous devices
-running identical per-tile programs, the busy fraction of a device IS its
-share of assigned work, which is what the reference's counters measure in
-the steady state.  ``WorkTelemetry`` therefore models busy-rate as
-(tiles x per-tile cost) / window, with injectable per-device speed factors
-for heterogeneous scenarios.  The rebalance decision (``work_realloc``,
-reference formula and dead-band intact) and the region-transfer step
-(receivers grow by grabbing adjacent boundary tiles from donors, donors
-never emptied — the BFS's effect) operate on the (npx, npy) tile->device
-assignment grid; the executor (parallel/elastic.py) migrates tile arrays
-with ``jax.device_put``.
+single-process JAX program, so the counters' role is played by MEASUREMENT:
+``MeasuredTelemetry`` accumulates each device's observed per-step wall-clock
+(assemble + dispatch + block, timed per device group by the elastic
+executor) and normalizes to the reference's 0..10000 busy units.  This is
+the default — like the reference, the balancer reacts to what actually
+happened, so a genuinely slow or contended device is detected.
+``WorkTelemetry`` (busy-rate modeled as tiles x per-tile cost, with
+injectable per-device speed factors) is kept as a deterministic test
+fixture.  The rebalance decision (``work_realloc``, reference formula and
+dead-band intact) and the region-transfer step (receivers grow by grabbing
+adjacent boundary tiles from donors, donors never emptied — the BFS's
+effect) operate on the (npx, npy) tile->device assignment grid; the
+executor (parallel/elastic.py) migrates tile arrays with
+``jax.device_put``.
 
 Acceptance: ``balance_check`` reproduces the reference's test_load_balance
 criterion — max |busy - mean| <= 1500 of 10000 (:682-685).
@@ -66,6 +69,43 @@ class WorkTelemetry:
         if window <= 0:
             return np.zeros(self.num_devices)
         return BUSY_SCALE * work / window
+
+
+@dataclass
+class MeasuredTelemetry:
+    """Per-device busy time MEASURED over a rebalance window — the TPU analog
+    of the reference's idle-rate performance counters
+    (src/2d_nonlocal_distributed.cpp:112-128, sampled :856-863).
+
+    The elastic executor times each device's tile group per step — halo
+    assembly + dispatch + block-until-ready, i.e. the wall-clock that
+    device's work actually took — and records it here.  ``busy_rates``
+    normalizes the accumulated seconds to the reference's 0..10000 busy
+    units (busiest device = the window, exactly how busy = 10000 - idle
+    behaves in a lockstep loop).  ``reset`` starts a new window, mirroring
+    the reference's counter re-read after each rebalance (:954-956).
+
+    Unlike WorkTelemetry (a work-proportional MODEL kept as a test fixture),
+    this reacts to anything that actually slows a device: more tiles, slower
+    hardware, host contention, an interposed delay.
+    """
+
+    num_devices: int
+
+    def __post_init__(self):
+        self.busy_s = np.zeros(self.num_devices, dtype=np.float64)
+
+    def record(self, device: int, seconds: float) -> None:
+        self.busy_s[device] += seconds
+
+    def busy_rates(self, assignment: np.ndarray | None = None) -> np.ndarray:
+        window = self.busy_s.max() if self.busy_s.size else 0.0
+        if window <= 0:
+            return np.zeros(self.num_devices)
+        return BUSY_SCALE * self.busy_s / window
+
+    def reset(self) -> None:
+        self.busy_s[:] = 0.0
 
 
 def work_realloc(busy: np.ndarray, counts: np.ndarray) -> np.ndarray:

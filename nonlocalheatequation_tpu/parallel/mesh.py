@@ -58,3 +58,43 @@ def make_mesh(
 def grid_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding of the global (X, Y) grid: block per mesh position."""
     return NamedSharding(mesh, P("x", "y"))
+
+
+def factor_devices_3d(n: int) -> tuple[int, int, int]:
+    """Factor n into the most-cubic (dx, dy, dz) grid, dx*dy*dz == n."""
+    best, best_score = (n, 1, 1), n  # score: max factor (lower = more cubic)
+    for dx in range(1, n + 1):
+        if n % dx:
+            continue
+        for dy in range(1, n // dx + 1):
+            if (n // dx) % dy:
+                continue
+            dz = n // (dx * dy)
+            score = max(dx, dy, dz)
+            if score < best_score:
+                best, best_score = (dx, dy, dz), score
+    return best
+
+
+def make_mesh_3d(
+    mx: int | None = None,
+    my: int | None = None,
+    mz: int | None = None,
+    devices=None,
+) -> Mesh:
+    """3D mesh with axes ('x', 'y', 'z') for the 3D distributed solver."""
+    devices = list(devices if devices is not None else jax.devices())
+    if mx is None or my is None or mz is None:
+        mx, my, mz = factor_devices_3d(len(devices))
+    if mx * my * mz > len(devices):
+        raise ValueError(
+            f"mesh {mx}x{my}x{mz} needs {mx * my * mz} devices, "
+            f"have {len(devices)}"
+        )
+    dev_grid = np.asarray(devices[: mx * my * mz]).reshape(mx, my, mz)
+    return Mesh(dev_grid, ("x", "y", "z"))
+
+
+def grid_sharding_3d(mesh: Mesh) -> NamedSharding:
+    """Sharding of the global (X, Y, Z) grid: block per mesh position."""
+    return NamedSharding(mesh, P("x", "y", "z"))

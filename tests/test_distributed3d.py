@@ -1,0 +1,91 @@
+"""3D distributed solver on the 8-virtual-device CPU mesh.
+
+Same structural checks the 2D distributed suite applies (multi==single
+device, ==serial oracle, multi-hop halos when eps exceeds the shard edge),
+one rank up — these are the framework's determinism/race-freedom analogs
+(SURVEY.md section 5).
+"""
+
+import numpy as np
+
+from nonlocalheatequation_tpu.models.solver3d import Solver3D
+from nonlocalheatequation_tpu.parallel.distributed3d import (
+    Solver3DDistributed,
+    choose_mesh_for_grid_3d,
+)
+from nonlocalheatequation_tpu.parallel.mesh import make_mesh_3d
+
+
+def test_3d_distributed_manufactured_contract():
+    s = Solver3DDistributed(16, 16, 16, nt=20, eps=2, k=0.5, dt=0.0005, dh=0.05,
+                            mesh=make_mesh_3d(2, 2, 2))
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / 16**3 <= 1e-6
+    assert s.mesh.devices.size == 8
+
+
+def test_3d_multi_device_equals_single_device():
+    kw = dict(nt=10, eps=2, k=0.5, dt=0.0005, dh=0.05)
+    a = Solver3DDistributed(12, 12, 12, mesh=make_mesh_3d(1, 1, 1), **kw)
+    b = Solver3DDistributed(12, 12, 12, mesh=make_mesh_3d(2, 2, 2), **kw)
+    a.test_init()
+    b.test_init()
+    ua, ub = a.do_work(), b.do_work()
+    assert np.abs(ua - ub).max() < 1e-12
+
+
+def test_3d_distributed_equals_serial_oracle():
+    o = Solver3D(12, 12, 12, nt=10, eps=2, k=0.5, dt=0.0005, dh=0.05,
+                 backend="oracle")
+    d = Solver3DDistributed(12, 12, 12, nt=10, eps=2, k=0.5, dt=0.0005, dh=0.05,
+                            mesh=make_mesh_3d(2, 2, 2))
+    o.test_init()
+    d.test_init()
+    uo, ud = o.do_work(), d.do_work()
+    assert np.abs(uo - ud).max() < 1e-12
+
+
+def test_3d_multihop_halo_when_eps_exceeds_shard():
+    # 12^3 on a (4,2,1) mesh -> x shard edge 3; eps=4 needs 2 hops in x
+    o = Solver3D(12, 12, 12, nt=8, eps=4, k=0.2, dt=0.0005, dh=0.05,
+                 backend="oracle")
+    d = Solver3DDistributed(12, 12, 12, nt=8, eps=4, k=0.2, dt=0.0005, dh=0.05,
+                            mesh=make_mesh_3d(4, 2, 1))
+    o.test_init()
+    d.test_init()
+    uo, ud = o.do_work(), d.do_work()
+    assert np.abs(uo - ud).max() < 1e-12
+
+
+def test_3d_pallas_inside_shard_map():
+    # the 3D strip kernel runs under shard_map (interpreter off-TPU)
+    kw = dict(nt=3, eps=2, k=0.5, dt=0.0005, dh=0.05)
+    a = Solver3DDistributed(16, 16, 16, method="shift",
+                            mesh=make_mesh_3d(2, 2, 2), **kw)
+    b = Solver3DDistributed(16, 16, 16, method="pallas",
+                            mesh=make_mesh_3d(2, 2, 2), **kw)
+    a.test_init()
+    b.test_init()
+    ua, ub = a.do_work(), b.do_work()
+    assert np.abs(ua - ub).max() < 1e-10
+
+
+def test_3d_choose_mesh_divides_grid():
+    mesh = choose_mesh_for_grid_3d(16, 16, 16)
+    mx, my, mz = mesh.shape["x"], mesh.shape["y"], mesh.shape["z"]
+    assert 16 % mx == 0 and 16 % my == 0 and 16 % mz == 0
+    assert mx * my * mz == 8
+
+
+def test_3d_free_decay_distributed_matches_oracle():
+    rng = np.random.default_rng(5)
+    u0 = rng.normal(size=(12, 12, 12))
+    o = Solver3D(12, 12, 12, nt=8, eps=2, k=0.5, dt=0.001, dh=0.05,
+                 backend="oracle")
+    d = Solver3DDistributed(12, 12, 12, nt=8, eps=2, k=0.5, dt=0.001, dh=0.05,
+                            mesh=make_mesh_3d(2, 2, 2))
+    o.input_init(u0)
+    d.input_init(u0)
+    uo, ud = o.do_work(), d.do_work()
+    assert np.abs(uo - ud).max() < 1e-12

@@ -147,6 +147,73 @@ def test_elastic_rebalances_and_stays_correct():
     assert counts.min() >= 10  # 25 tiles, 2 devices: near-even split
 
 
+def test_measured_telemetry_normalization():
+    tele = lb.MeasuredTelemetry(3)
+    tele.record(0, 0.2)
+    tele.record(1, 0.1)
+    tele.record(0, 0.2)  # accumulates: 0.4, 0.1, 0.0
+    busy = tele.busy_rates()
+    assert busy[0] == 10000.0 and busy[1] == 2500.0 and busy[2] == 0.0
+    tele.reset()
+    assert (tele.busy_rates() == 0).all()
+
+
+def test_elastic_measured_rebalance_from_imbalanced_map():
+    """VERDICT item 4: the balancer must converge on OBSERVED busy rates.
+
+    Default telemetry is now MeasuredTelemetry — real per-device wall-clock,
+    no injected speed model.  Start from the reference's 24-of-25 fixture
+    shape; the measured imbalance (one device genuinely doing 24x the work)
+    must drive the transfer loop to a near-even split.
+    """
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    s = ElasticSolver2D(5, 5, 5, 5, nt=45, eps=2, nbalance=10,
+                        k=0.2, dt=0.0005, dh=0.02,
+                        assignment=imbalanced_map(), devices=jax.devices()[:2])
+    s.test_init()
+    s.do_work()
+    assert isinstance(s.telemetry, lb.MeasuredTelemetry)
+    assert s.error_l2 / (25 * 25) <= 1e-6
+    counts = np.bincount(s.assignment.ravel(), minlength=2)
+    assert counts.min() >= 8, f"measured rebalance did not converge: {counts}"
+
+
+class _DraggedDeviceSolver(ElasticSolver2D):
+    """Test double: tiles on ``slow_device`` take extra REAL wall-clock
+    (a sleep interposed in the tile step), emulating a slow/contended chip.
+    Only a measurement can see this — no tile-count model would."""
+
+    slow_device = 1
+    drag_s = 0.003
+
+    def _run_tile(self, key, upad, t):
+        if int(self.assignment[key]) == self.slow_device:
+            import time as _time
+
+            _time.sleep(self.drag_s)
+        return super()._run_tile(key, upad, t)
+
+
+def test_elastic_measured_rebalance_detects_genuinely_slow_device():
+    """A device slowed by real elapsed time (not a model) sheds tiles, and
+    the final MEASURED busy rates meet the reference's <=1500/10000
+    acceptance criterion (src/2d_nonlocal_distributed.cpp:647-686)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    s = _DraggedDeviceSolver(4, 4, 6, 6, nt=81, eps=2, nbalance=10,
+                             k=0.2, dt=0.0005, dh=0.02,
+                             assignment=default_assignment(6, 6, 2),
+                             devices=jax.devices()[:2])
+    s.test_init()
+    s.do_work()
+    counts = np.bincount(s.assignment.ravel(), minlength=2)
+    assert counts[s.slow_device] < counts[1 - s.slow_device], counts
+    ok, max_diff = lb.balance_check(s.busy_rates())
+    assert ok, f"measured busy deviation {max_diff} > {lb.ACCEPT_MAX_DEVIATION}"
+    assert s.error_l2 / (24 * 24) <= 1e-6
+
+
 def test_elastic_heterogeneous_speeds():
     """A 3x-slower device should end up with ~1/3 the tiles of the fast one."""
     if len(jax.devices()) < 2:

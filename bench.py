@@ -155,8 +155,9 @@ def run_bench():
     method = os.environ.get("BENCH_METHOD", "pallas" if backend == "tpu" else "sat")
     log(f"device: {dev}, grid {GRID}^2, eps {EPS}, {STEPS} steps/iter, method {method}")
 
-    # Forward Euler is stable only for dt * c * dh^2 * Wsum <~ 2; pick 40% of
-    # that bound so the timed state stays O(1) instead of overflowing f32.
+    # Forward Euler is stable iff dt * c * dh^2 * Wsum <= 1 (spectrum in
+    # [-2*c*dh^2*W, 0], see docs/math_spec.md section 6); pick 80% of the
+    # bound so the timed state stays O(1) instead of overflowing f32.
     probe = NonlocalOp2D(EPS, k=1.0, dt=1.0, dh=1.0 / GRID, method=method)
     dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
     op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / GRID, method=method)

@@ -47,6 +47,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.distributed and args.backend == "oracle":
+        print("--distributed runs the SPMD jit solver; it has no oracle "
+              "backend (use the serial oracle for ground truth)",
+              file=sys.stderr)
+        return 1
     version_banner("3d_nonlocal")
     apply_platform(args)
 

@@ -430,10 +430,10 @@ def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int):
 
 
 def _fits_3d(tm: int, tn: int, nz: int, eps: int, itemsize: int) -> bool:
-    _, parts_by_h, pows, pad = _strip_plan_3d(eps)
+    heights, parts_by_h, _pows, pad = _strip_plan_3d(eps)
     window = (tm + pad) * (tn + 2 * eps) * (nz + 2 * eps) * itemsize
     out = tm * tn * nz * itemsize
-    n_pairs = len(_strip_plan_3d(eps)[0])
+    n_pairs = len(heights)
     log_steps = max(1, int(np.ceil(np.log2(tm + pad))))
     stack = (2 * log_steps + 4 + len(parts_by_h)) * window + (2 * n_pairs + 3) * out
     return stack <= _VMEM_BUDGET

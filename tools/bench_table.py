@@ -1,0 +1,209 @@
+"""Reproducible per-config benchmark table (BASELINE.json configs 1-5).
+
+Prints one JSON line per benchmark to stdout and a human table to stderr.
+This is the evidence behind docs/architecture.md's method table: re-run it
+on a TPU host to reproduce (sizes scale down automatically off-TPU so the
+same script smoke-tests on CPU).
+
+Usage:
+    python tools/bench_table.py                 # all configs
+    python tools/bench_table.py methods2d dist2d   # a subset
+Env:
+    BT_STEPS (default 20), BT_GRID2D (4096 on tpu / 512 off),
+    BT_GRID3D (256 / 48), BT_DIST_GRID (2048 / 256), BT_UNSTRUCT_M (512 / 64)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def cfg(name, tpu_val, cpu_val):
+    return int(os.environ.get(name, tpu_val if on_tpu() else cpu_val))
+
+
+def fence(x) -> float:
+    """Device->host scalar fetch: the only reliable fence on the axon tunnel."""
+    s = float(jnp.sum(x))
+    if not np.isfinite(s):
+        raise RuntimeError("state went non-finite; timings invalid")
+    return s
+
+
+def time_steps(multi, u, steps: int, iters: int = 3):
+    """(best seconds for `steps` applications, final state)."""
+    t0 = time.perf_counter()
+    u = multi(u)
+    fence(u)
+    log(f"    compile+first: {time.perf_counter() - t0:.2f}s")
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        u = multi(u)
+        fence(u)
+        best = min(best, time.perf_counter() - t0)
+    return best, u
+
+
+def emit(name: str, points: int, steps: int, seconds: float, **extra):
+    rec = {
+        "bench": name,
+        "points": points,
+        "steps": steps,
+        "seconds": seconds,
+        "ms_per_step": seconds / steps * 1e3,
+        "points_steps_per_sec": points * steps / seconds,
+        "backend": jax.default_backend(),
+        **extra,
+    }
+    print(json.dumps(rec), flush=True)
+    log(f"  {name}: {rec['ms_per_step']:.3f} ms/step, "
+        f"{rec['points_steps_per_sec']:.3e} points*steps/s")
+    return rec
+
+
+def stable_dt(op):
+    # 80% of the forward-Euler bound dt <= 1/(c*h^d*W)
+    # (see docs/math_spec.md section 6)
+    return 0.8 / (op.c * op.dh ** op_dim(op) * op.wsum)
+
+
+def op_dim(op) -> int:
+    return op.mask.ndim if hasattr(op, "mask") else 2
+
+
+def bench_methods2d(steps: int):
+    """BASELINE configs 1-2: single-chip 2D, all evaluation methods."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, make_multi_step_fn
+
+    n = cfg("BT_GRID2D", 4096, 512)
+    methods = ["shift", "sat", "conv", "pallas"] if on_tpu() else ["shift", "sat", "conv"]
+    rng = np.random.default_rng(0)
+    u0 = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
+    for method in methods:
+        op = NonlocalOp2D(8, k=1.0, dt=1.0, dh=1.0 / n, method=method)
+        op = NonlocalOp2D(8, k=1.0, dt=stable_dt(op), dh=1.0 / n, method=method)
+        multi = make_multi_step_fn(op, steps)
+        sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
+        emit(f"2d/{method}", n * n, steps, sec, grid=n, eps=8)
+
+
+def bench_dist2d(steps: int):
+    """BASELINE config 3: distributed 2D with ppermute halos."""
+    from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
+
+    n = cfg("BT_DIST_GRID", 2048, 256)
+    ndev = len(jax.devices())
+    method = "pallas" if on_tpu() else "sat"
+    s = Solver2DDistributed(n, n, 1, 1, nt=steps, eps=8, k=1.0,
+                            dt=1e-7, dh=1.0 / n, method=method,
+                            dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    s.input_init(rng.normal(size=(n, n)))
+    step = s._build_step()
+    u, _src = s._device_state()
+    import jax as _jax
+    from jax import lax
+
+    @_jax.jit
+    def multi(u0):
+        return lax.scan(lambda c, t: (step(c, t), None), u0,
+                        jnp.arange(steps))[0]
+
+    sec, _ = time_steps(multi, u, steps)
+    emit("2d/distributed", n * n, steps, sec, grid=n, eps=8,
+         devices=ndev, mesh=dict(s.mesh.shape))
+
+
+def bench_3d(steps: int):
+    """BASELINE config 4: 3D, sat and pallas."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D, make_multi_step_fn
+
+    n = cfg("BT_GRID3D", 256, 48)
+    methods = ["sat", "pallas"] if on_tpu() else ["sat"]
+    rng = np.random.default_rng(0)
+    u0 = jnp.asarray(rng.normal(size=(n, n, n)), jnp.float32)
+    for method in methods:
+        op = NonlocalOp3D(4, k=1.0, dt=1.0, dh=1.0 / n, method=method)
+        op = NonlocalOp3D(4, k=1.0, dt=stable_dt(op), dh=1.0 / n, method=method)
+        multi = make_multi_step_fn(op, steps)
+        sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
+        emit(f"3d/{method}", n ** 3, steps, sec, grid=n, eps=4)
+
+
+def bench_unstructured(steps: int):
+    """BASELINE config 5: variable-horizon point cloud via segment_sum."""
+    from nonlocalheatequation_tpu.ops.unstructured import UnstructuredNonlocalOp
+
+    m = cfg("BT_UNSTRUCT_M", 512, 64)
+    rng = np.random.default_rng(0)
+    h = 1.0 / m
+    xs, ys = np.meshgrid(np.arange(m) * h, np.arange(m) * h, indexing="ij")
+    pts = np.stack([xs.ravel(), ys.ravel()], axis=1)
+    pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+    eps = 3.0 * h * (1.0 + 0.2 * np.sin(7.0 * pts[:, 0]))
+    t0 = time.perf_counter()
+    op = UnstructuredNonlocalOp(pts, eps, k=1.0, dt=1e-7, vol=h * h)
+    log(f"    edge build: {time.perf_counter() - t0:.2f}s, {len(op.tgt)} edges")
+    u0 = jnp.asarray(rng.normal(size=op.n), jnp.float32)
+
+    from jax import lax
+
+    @jax.jit
+    def multi(u):
+        return lax.scan(lambda c, _: (c + op.dt * op.apply(c), None), u,
+                        None, length=steps)[0]
+
+    sec, _ = time_steps(multi, u0, steps)
+    emit("unstructured", op.n, steps, sec, nodes=op.n, edges=len(op.tgt))
+
+
+BENCHES = {
+    "methods2d": bench_methods2d,
+    "dist2d": bench_dist2d,
+    "3d": bench_3d,
+    "unstructured": bench_unstructured,
+}
+
+
+def main() -> int:
+    steps = int(os.environ.get("BT_STEPS", 20))
+    names = [a for a in sys.argv[1:] if not a.startswith("-")] or list(BENCHES)
+    log(f"backend={jax.default_backend()} devices={len(jax.devices())} "
+        f"steps={steps}")
+    for name in names:
+        log(f"[{name}]")
+        try:
+            BENCHES[name](steps)
+        except Exception as e:  # one config failing must not kill the table
+            log(f"  FAILED: {e!r}")
+            print(json.dumps({"bench": name, "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

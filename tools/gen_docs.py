@@ -1,0 +1,120 @@
+"""Generate API reference docs (markdown) from the package's docstrings.
+
+The analog of the reference's Doxygen pipeline (`make doc`,
+/root/reference/docs/conf.doxy.in + docs/CMakeLists.txt:1-15): walk every
+module of ``nonlocalheatequation_tpu``, extract public classes/functions with
+their signatures and docstrings via ``inspect``, and write one markdown page
+per module under docs/api/ plus an index.  Dependency-free (stdlib only).
+
+Usage:
+    python tools/gen_docs.py            # (re)write docs/api/
+    python tools/gen_docs.py --check    # exit 1 if docs/api/ is stale (CI)
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import pkgutil
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # never touch the TPU from a doc build
+
+PACKAGE = "nonlocalheatequation_tpu"
+OUT = os.path.join(REPO, "docs", "api")
+
+
+def iter_modules():
+    pkg = importlib.import_module(PACKAGE)
+    yield PACKAGE, pkg
+    for info in pkgutil.walk_packages(pkg.__path__, prefix=PACKAGE + "."):
+        yield info.name, importlib.import_module(info.name)
+
+
+def signature_of(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def doc_of(obj) -> str:
+    d = inspect.getdoc(obj)
+    return d.strip() if d else "*(undocumented)*"
+
+
+def render_module(name: str, mod) -> str:
+    lines = [f"# `{name}`", ""]
+    lines += [doc_of(mod), ""]
+    members = [
+        (n, obj) for n, obj in vars(mod).items()
+        if not n.startswith("_") and getattr(obj, "__module__", None) == name
+        and (inspect.isclass(obj) or inspect.isfunction(obj))
+    ]
+    for n, obj in members:
+        if inspect.isclass(obj):
+            lines += [f"## class `{n}{signature_of(obj)}`", "", doc_of(obj), ""]
+            for mn, m in vars(obj).items():
+                if mn.startswith("_") or not inspect.isfunction(m):
+                    continue
+                lines += [f"### `{n}.{mn}{signature_of(m)}`", "", doc_of(m), ""]
+        else:
+            lines += [f"## `{n}{signature_of(obj)}`", "", doc_of(obj), ""]
+    return "\n".join(lines) + "\n"
+
+
+def build() -> dict[str, str]:
+    pages = {}
+    names = []
+    for name, mod in sorted(iter_modules()):
+        fname = name.replace(".", "_") + ".md"
+        pages[fname] = render_module(name, mod)
+        names.append((name, fname))
+    index = ["# API reference", "",
+             f"Generated from docstrings by `tools/gen_docs.py` "
+             f"(the `make doc` analog; reference: docs/conf.doxy.in).", ""]
+    index += [f"- [`{name}`]({fname})" for name, fname in names]
+    pages["index.md"] = "\n".join(index) + "\n"
+    return pages
+
+
+def main() -> int:
+    check = "--check" in sys.argv
+    pages = build()
+    os.makedirs(OUT, exist_ok=True)
+    stale = []
+    for fname, content in pages.items():
+        path = os.path.join(OUT, fname)
+        old = None
+        if os.path.exists(path):
+            with open(path) as f:
+                old = f.read()
+        if old != content:
+            stale.append(fname)
+            if not check:
+                with open(path, "w") as f:
+                    f.write(content)
+    # remove orphans from deleted modules
+    for existing in os.listdir(OUT):
+        if existing.endswith(".md") and existing not in pages:
+            stale.append(existing)
+            if not check:
+                os.unlink(os.path.join(OUT, existing))
+    if check and stale:
+        print(f"docs/api is stale: {sorted(stale)}; run python tools/gen_docs.py")
+        return 1
+    print(f"docs/api: {len(pages)} pages {'checked' if check else 'written'}"
+          + (f", {len(stale)} updated" if not check and stale else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

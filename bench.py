@@ -181,16 +181,20 @@ def run_bench():
     sync(u1)
     log(f"compile+first run: {time.perf_counter() - t0:.2f}s")
 
-    # timed iterations
+    # timed iterations; BENCH_PROFILE=DIR additionally captures a
+    # jax.profiler trace of the timed region (evidence for the method table)
+    from nonlocalheatequation_tpu.utils.profiling import trace
+
     best = float("inf")
-    for it in range(3):
-        t0 = time.perf_counter()
-        u1 = multi(u1, 0)
-        sync(u1)
-        dt_s = time.perf_counter() - t0
-        best = min(best, dt_s)
-        log(f"iter {it}: {dt_s * 1e3:.1f} ms for {STEPS} steps "
-            f"({dt_s / STEPS * 1e3:.3f} ms/step)")
+    with trace(os.environ.get("BENCH_PROFILE")):
+        for it in range(3):
+            t0 = time.perf_counter()
+            u1 = multi(u1, 0)
+            sync(u1)
+            dt_s = time.perf_counter() - t0
+            best = min(best, dt_s)
+            log(f"iter {it}: {dt_s * 1e3:.1f} ms for {STEPS} steps "
+                f"({dt_s / STEPS * 1e3:.3f} ms/step)")
 
     points_steps_per_sec = GRID * GRID * STEPS / best
     # Emit the measured result BEFORE the accuracy gate: the gate is

@@ -43,6 +43,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="steps between checkpoints (0 = never)")
     p.add_argument("--resume", action="store_true",
                    help="resume from the --checkpoint file before running")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the solve into DIR")
     add_platform_flags(p)
     return p
 
@@ -100,8 +102,11 @@ def main(argv=None) -> int:
     if args.resume:
         s.resume(args.checkpoint)
 
+    from nonlocalheatequation_tpu.utils.profiling import trace
+
     t0 = time.perf_counter()
-    s.do_work()
+    with trace(args.profile):
+        s.do_work()
     elapsed = time.perf_counter() - t0
 
     if args.test:

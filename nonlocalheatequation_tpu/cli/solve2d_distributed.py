@@ -52,12 +52,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "localities, srun -n N); 0 = all")
     p.add_argument("--method", default="conv", choices=("conv", "shift", "sat", "pallas"))
     p.add_argument("--log", action="store_true")
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint file to write every --ncheckpoint steps")
+    p.add_argument("--ncheckpoint", type=int, default=0,
+                   help="steps between checkpoints (0 = never)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the --checkpoint file before running")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the solve into DIR")
     add_platform_flags(p)
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.resume and not args.checkpoint:
+        print("--resume requires --checkpoint", file=sys.stderr)
+        return 1
+    if args.test_batch and (args.resume or args.checkpoint):
+        print("--checkpoint/--resume cannot be combined with --test_batch",
+              file=sys.stderr)
+        return 1
     version_banner("2d_nonlocal_distributed")
     apply_platform(args)
 
@@ -103,6 +118,8 @@ def main(argv=None) -> int:
                 nx, ny, npx, npy, nt, eps, nlog=args.nlog,
                 nbalance=args.nbalance or None, k=k, dt=dt, dh=dh,
                 assignment=place, devices=devices, method=args.method,
+                checkpoint_path=args.checkpoint,
+                ncheckpoint=args.ncheckpoint,
             )
             if args.test_load_balance:
                 s.measure = True  # report measured rates even without nbalance
@@ -118,6 +135,7 @@ def main(argv=None) -> int:
         return Solver2DDistributed(
             nx, ny, npx, npy, nt, eps, nlog=args.nlog,
             k=k, dt=dt, dh=dh, mesh=mesh, method=args.method,
+            checkpoint_path=args.checkpoint, ncheckpoint=args.ncheckpoint,
         )
 
     if args.test_batch:
@@ -144,12 +162,17 @@ def main(argv=None) -> int:
                                        nlog=args.nlog)
     if args.test:
         s.test_init()
-    else:
+    elif not args.resume:
         n = nx * npx * ny * npy
         s.input_init(np.array(sys.stdin.read().split(), dtype=np.float64)[:n])
+    if args.resume:
+        s.resume(args.checkpoint)
+
+    from nonlocalheatequation_tpu.utils.profiling import trace
 
     t0 = time.perf_counter()
-    s.do_work()
+    with trace(args.profile):
+        s.do_work()
     elapsed = time.perf_counter() - t0
 
     if args.test_load_balance:

@@ -29,9 +29,10 @@ from nonlocalheatequation_tpu.ops.nonlocal_op import (
     make_step_fn,
     source_at,
 )
+from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
 
 
-class Solver2D(ManufacturedMetrics2D):
+class Solver2D(CheckpointMixin, ManufacturedMetrics2D):
     def __init__(
         self,
         nx: int,
@@ -76,36 +77,8 @@ class Solver2D(ManufacturedMetrics2D):
         self.test = False
         self.u0 = np.asarray(values, dtype=np.float64).reshape(self.nx, self.ny)
 
-    def _ckpt_params(self) -> dict:
-        return dict(nx=self.nx, ny=self.ny, eps=self.eps, k=self.op.k,
-                    dt=self.op.dt, dh=self.op.dh, test=self.test)
-
-    def resume(self, path: str):
-        """Continue from a checkpoint written by a prior run (test/init flags
-        must already be set the same way; parameters are validated)."""
-        from nonlocalheatequation_tpu.utils import checkpoint as ckpt
-
-        u, t, params = ckpt.load_state(path)
-        ckpt.check_params(params, self._ckpt_params())
-        if u.shape != (self.nx, self.ny):
-            raise ValueError(
-                f"checkpoint state shape {u.shape} != grid ({self.nx}, {self.ny})"
-            )
-        if t > self.nt:
-            raise ValueError(
-                f"checkpoint is at timestep {t}, beyond nt={self.nt}; "
-                "nothing to resume"
-            )
-        self.u0 = np.asarray(u, dtype=np.float64)
-        self.t0 = t
-
-    def _maybe_checkpoint(self, t: int, u) -> None:
-        if (self.checkpoint_path and self.ncheckpoint
-                and (t + 1) % self.ncheckpoint == 0):
-            from nonlocalheatequation_tpu.utils import checkpoint as ckpt
-
-            ckpt.save_state(self.checkpoint_path, np.asarray(u), t + 1,
-                            self._ckpt_params())
+    # checkpoint/resume: CheckpointMixin (canonical params, portable between
+    # the serial, distributed, and elastic solvers on the same global grid)
 
     # -- time loop (2d_nonlocal_serial.cpp:273-303) -------------------------
     def do_work(self) -> np.ndarray:

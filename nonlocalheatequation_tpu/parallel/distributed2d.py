@@ -34,6 +34,7 @@ from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, source_at
 from nonlocalheatequation_tpu.parallel.halo import halo_pad_2d
 from nonlocalheatequation_tpu.parallel.mesh import grid_sharding, make_mesh
+from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
 
 
 def choose_mesh_for_grid(NX: int, NY: int, devices=None) -> Mesh:
@@ -50,7 +51,7 @@ def choose_mesh_for_grid(NX: int, NY: int, devices=None) -> Mesh:
     return make_mesh(best[0], best[1], devices)
 
 
-class Solver2DDistributed(ManufacturedMetrics2D):
+class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
     """Solve on the (nx*npx) x (ny*npy) global grid, sharded over a mesh.
 
     nx, ny, npx, npy mirror the reference's CLI surface (tile size and tile
@@ -76,6 +77,8 @@ class Solver2DDistributed(ManufacturedMetrics2D):
         method: str = "conv",
         logger=None,
         dtype=None,
+        checkpoint_path: str | None = None,
+        ncheckpoint: int = 0,
     ):
         self.nx, self.ny, self.npx, self.npy = int(nx), int(ny), int(npx), int(npy)
         self.NX, self.NY = self.nx * self.npx, self.ny * self.npy
@@ -100,6 +103,9 @@ class Solver2DDistributed(ManufacturedMetrics2D):
         self.mesh = mesh if mesh is not None else choose_mesh_for_grid(self.NX, self.NY)
         self.logger = logger
         self.dtype = dtype
+        self.checkpoint_path = checkpoint_path
+        self.ncheckpoint = int(ncheckpoint)
+        self.t0 = 0
         self.test = False
         self.u0 = np.zeros((self.NX, self.NY), dtype=np.float64)
         self.u = None
@@ -114,6 +120,9 @@ class Solver2DDistributed(ManufacturedMetrics2D):
     def input_init(self, values):
         self.test = False
         self.u0 = np.asarray(values, dtype=np.float64).reshape(self.NX, self.NY)
+
+    # checkpoint/resume: CheckpointMixin (canonical params, portable between
+    # the serial, distributed, and elastic solvers on the same global grid)
 
     # -- the SPMD step ------------------------------------------------------
     def _build_step(self):
@@ -164,22 +173,24 @@ class Solver2DDistributed(ManufacturedMetrics2D):
         step = self._build_step()
         u, source_args = self._device_state()
 
-        if self.logger is None:
+        checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
+        if self.logger is None and not checkpointing:
             def body(carry, t):
                 return step(carry, *source_args, t), None
 
             @jax.jit
             def run(u0):
-                out, _ = lax.scan(body, u0, jnp.arange(self.nt))
+                out, _ = lax.scan(body, u0, jnp.arange(self.t0, self.nt))
                 return out
 
             u = run(u)
         else:
             jstep = jax.jit(step)
-            for t in range(self.nt):
+            for t in range(self.t0, self.nt):
                 u = jstep(u, *source_args, t)
-                if t % self.nlog == 0:
+                if t % self.nlog == 0 and self.logger is not None:
                     self.logger(t, np.asarray(u))
+                self._maybe_checkpoint(t, u)
 
         self.u = np.asarray(u)
         if self.test:

@@ -53,6 +53,58 @@ def load_state(path: str):
     return u, t, params
 
 
+class CheckpointMixin:
+    """Shared checkpoint/resume behavior for every solver.
+
+    Canonical parameter set: the GLOBAL grid shape plus eps/k/dt/dh and the
+    test flag — identical across serial, distributed, and elastic solvers,
+    so a checkpoint written by one resumes in any other on the same global
+    grid.  Hosts must provide ``_grid_shape``, ``op``, ``nt``, ``test``,
+    ``u0`` and set ``checkpoint_path``/``ncheckpoint``/``t0`` attributes.
+    """
+
+    checkpoint_path: str | None = None
+    ncheckpoint: int = 0
+    t0: int = 0
+
+    def _ckpt_params(self) -> dict:
+        op = self.op
+        spacing = getattr(op, "dh", None)
+        if spacing is None:
+            spacing = getattr(op, "dx", 0.0)
+        return dict(
+            shape=list(self._grid_shape),
+            eps=int(op.eps),
+            k=float(op.k),
+            dt=float(op.dt),
+            dh=float(spacing),
+            test=bool(self.test),
+        )
+
+    def resume(self, path: str):
+        """Continue from a checkpoint written by a prior run (test/init flags
+        must already be set the same way; parameters are validated)."""
+        u, t, params = load_state(path)
+        check_params(params, self._ckpt_params())
+        if tuple(u.shape) != tuple(self._grid_shape):
+            raise ValueError(
+                f"checkpoint state shape {u.shape} != grid {self._grid_shape}"
+            )
+        if t > self.nt:
+            raise ValueError(
+                f"checkpoint is at timestep {t}, beyond nt={self.nt}; "
+                "nothing to resume"
+            )
+        self.u0 = np.asarray(u, dtype=np.float64)
+        self.t0 = t
+
+    def _maybe_checkpoint(self, t: int, u=None) -> None:
+        if (self.checkpoint_path and self.ncheckpoint
+                and (t + 1) % self.ncheckpoint == 0):
+            state = np.asarray(u) if u is not None else self.gather()
+            save_state(self.checkpoint_path, state, t + 1, self._ckpt_params())
+
+
 def check_params(saved: dict, current: dict):
     """Refuse resume when solver parameters differ OR are absent from the
     checkpoint (a silent mismatch would produce a plausible-looking but

@@ -1,0 +1,52 @@
+"""Profiling — the TPU-native trace capture the reference lacks.
+
+The reference's only tracing is wall-clock CSV around do_work
+(src/2d_nonlocal_distributed.cpp:1390-1395) plus HPX idle-rate counters
+(:112-128).  Wall-clock timing lives in utils/timing.py and measured
+busy-rates in parallel/load_balance.py; this module adds the third leg
+SURVEY.md section 5 calls for: `jax.profiler` traces viewable in
+TensorBoard/Perfetto — per-op device timelines, fusion boundaries, HBM
+traffic — captured around any solve.
+
+Usage:
+    with trace("/tmp/nlheat-trace"):
+        solver.do_work()
+
+or via the CLI/bench flag ``--profile DIR`` (bench.py: BENCH_PROFILE=DIR).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """Capture a jax.profiler trace into ``log_dir`` (no-op when None/empty).
+
+    The trace is written on context exit; open with TensorBoard's profile
+    plugin or ui.perfetto.dev.  Never raises: profiling is observability,
+    a capture failure must not kill the solve.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception as e:  # pragma: no cover - depends on backend support
+        import sys
+
+        print(f"[profiling] start_trace failed: {e!r}", file=sys.stderr)
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # pragma: no cover
+            import sys
+
+            print(f"[profiling] stop_trace failed: {e!r}", file=sys.stderr)

@@ -69,6 +69,73 @@ def test_version_guard(tmp_path):
         ckpt.load_state(path)
 
 
+def test_distributed_interrupted_equals_uninterrupted(tmp_path):
+    from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
+    from nonlocalheatequation_tpu.parallel.mesh import make_mesh
+
+    def solver(nt, **kw):
+        return Solver2DDistributed(10, 10, 2, 2, nt, eps=3, k=1.0, dt=1e-4,
+                                   dh=0.05, mesh=make_mesh(2, 2), **kw)
+
+    path = str(tmp_path / "dist.npz")
+    full = solver(20)
+    full.test_init()
+    full.do_work()
+
+    first = solver(20, checkpoint_path=path, ncheckpoint=10)
+    first.test_init()
+    first.nt = 10  # "crash" after 10 steps
+    first.do_work()
+
+    second = solver(20)
+    second.test_init()
+    second.resume(path)
+    assert second.t0 == 10
+    second.do_work()
+    assert (second.u == full.u).all()
+
+
+def test_elastic_interrupted_equals_uninterrupted(tmp_path):
+    from nonlocalheatequation_tpu.parallel.elastic import ElasticSolver2D
+
+    def solver(nt, **kw):
+        return ElasticSolver2D(5, 5, 4, 4, nt, eps=3, k=0.2, dt=1e-4,
+                               dh=0.05, **kw)
+
+    path = str(tmp_path / "elastic.npz")
+    full = solver(16)
+    full.test_init()
+    full.do_work()
+
+    first = solver(16, checkpoint_path=path, ncheckpoint=8)
+    first.test_init()
+    first.nt = 8
+    first.do_work()
+
+    second = solver(16)
+    second.test_init()
+    second.resume(path)
+    assert second.t0 == 8
+    second.do_work()
+    assert (second.u == full.u).all()
+
+
+def test_cli_distributed_checkpoint_resume(tmp_path, capsys):
+    from nonlocalheatequation_tpu.cli import solve2d_distributed
+
+    path = str(tmp_path / "d.npz")
+    base = ["--nx", "10", "--ny", "10", "--npx", "2", "--npy", "2",
+            "--eps", "3", "--dt", "1e-4", "--dh", "0.05",
+            "--cmp", "false", "--no-header"]
+    rc = solve2d_distributed.main(
+        base + ["--nt", "10", "--checkpoint", path, "--ncheckpoint", "5"])
+    assert rc == 0
+    rc = solve2d_distributed.main(
+        base + ["--nt", "20", "--checkpoint", path, "--resume"])
+    assert rc == 0
+    assert "l2:" in capsys.readouterr().out
+
+
 def test_cli_checkpoint_resume(tmp_path, capsys):
     from nonlocalheatequation_tpu.cli import solve2d
 

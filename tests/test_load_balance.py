@@ -187,12 +187,11 @@ class _DraggedDeviceSolver(ElasticSolver2D):
     slow_device = 1
     drag_s = 0.003
 
-    def _run_tile(self, key, upad, t):
+    def _tile_hook(self, key):
         if int(self.assignment[key]) == self.slow_device:
             import time as _time
 
             _time.sleep(self.drag_s)
-        return super()._run_tile(key, upad, t)
 
 
 def test_elastic_measured_rebalance_detects_genuinely_slow_device():
@@ -212,6 +211,20 @@ def test_elastic_measured_rebalance_detects_genuinely_slow_device():
     ok, max_diff = lb.balance_check(s.busy_rates())
     assert ok, f"measured busy deviation {max_diff} > {lb.ACCEPT_MAX_DEVIATION}"
     assert s.error_l2 / (24 * 24) <= 1e-6
+
+
+def test_elastic_fused_equals_general_assembly():
+    """The fused 3x3 concat+step path must be bit-identical to the general
+    rectangle-walk assembly (same values, same op, same device placement)."""
+    def run(force_general):
+        s = ElasticSolver2D(8, 8, 3, 3, nt=12, eps=3, k=0.5, dt=0.0005,
+                            dh=0.02)
+        if force_general:
+            s._use_fused = False
+        s.test_init()
+        return s.do_work()
+
+    assert np.array_equal(run(False), run(True))
 
 
 def test_elastic_heterogeneous_speeds():

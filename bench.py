@@ -284,9 +284,14 @@ def run_measure_child(force_method=None):
     # generous first-event window: child has to import jax + init the backend
     phase_budget = min(PROBE_TIMEOUT_S, remaining())
     while True:
-        ev = reader.next_event(min(phase_budget, remaining()))
+        # while we have NOTHING, spend up to 10s of the MARGIN_S emit margin
+        # as grace past the global deadline — a first rung seconds from
+        # landing beats a guaranteed 0.0 (the watchdog still fires 5s later)
+        grace = 10.0 if harvested == 0 else 0.0
+        ev = reader.next_event(min(phase_budget, remaining() + grace))
         if ev is None:
-            why = "global deadline" if remaining() <= 0 else "phase timeout"
+            why = ("global deadline" if remaining() + grace <= 0
+                   else "phase timeout")
             log(f"measure child silent past budget ({why}); killing")
             kill(proc)
             return harvested, False
@@ -352,6 +357,17 @@ def main():
             method = os.environ.get("BENCH_METHOD") or None
             if method != "sat" and remaining() > 60:
                 log("no rung completed; retrying once with method=sat forced")
+                harvested, clean = run_measure_child(force_method="sat")
+        if harvested == 0 and not cpu_fallback:
+            # a TPU that answers jax.devices() but wedges under real work is
+            # as dead as one that never answers: same CPU fallback
+            allow_cpu = os.environ.get("BENCH_ALLOW_CPU_FALLBACK", "1") == "1"
+            if (allow_cpu and os.environ.get("BENCH_PLATFORM") != "cpu"
+                    and remaining() > 45):
+                log("TPU answered the probe but produced no rung; "
+                    "measuring on CPU so the artifact is labeled, not 0.0")
+                os.environ["BENCH_PLATFORM"] = "cpu"
+                BEST.update_meta(cpu_fallback=True)
                 harvested, clean = run_measure_child(force_method="sat")
 
         wrote, had = BEST.emit_now(

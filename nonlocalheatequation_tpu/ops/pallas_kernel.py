@@ -442,14 +442,15 @@ def _fits_3d(tm: int, tn: int, nz: int, eps: int, itemsize: int) -> bool:
 def _choose_tiles_3d(nx: int, ny: int, nz: int, eps: int, itemsize: int):
     """(tm, tn): block footprint that fits VMEM, preferring divisors of nx/ny."""
 
-    def pick(n: int, fits) -> int:
+    def pick(axis: str, n: int, fits) -> int:
         cap = min(64, _round_up(n, 8))
         while cap > 8 and not fits(cap):
             cap -= 8
         if not fits(cap):
             raise ValueError(
-                f"pallas 3D kernel: nz={nz} with eps={eps} exceeds the "
-                f"{_VMEM_BUDGET >> 20} MiB VMEM budget at the minimum block; "
+                f"pallas 3D kernel: no {axis} block of {n} fits the "
+                f"{_VMEM_BUDGET >> 20} MiB VMEM budget at the minimum size "
+                f"(window scales with nz={nz} and eps={eps}); "
                 "use method='sat'/'shift' or shard z over the mesh"
             )
         for t in range(cap, 0, -8):
@@ -457,8 +458,8 @@ def _choose_tiles_3d(nx: int, ny: int, nz: int, eps: int, itemsize: int):
                 return t
         return cap
 
-    tn = pick(ny, lambda t: _fits_3d(8, t, nz, eps, itemsize))
-    tm = pick(nx, lambda t: _fits_3d(t, tn, nz, eps, itemsize))
+    tn = pick("ny", ny, lambda t: _fits_3d(8, t, nz, eps, itemsize))
+    tm = pick("nx", nx, lambda t: _fits_3d(t, tn, nz, eps, itemsize))
     return tm, tn
 
 

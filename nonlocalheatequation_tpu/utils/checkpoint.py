@@ -50,6 +50,15 @@ def load_state(path: str):
         u = z["u"]
         t = int(z["t"])
         params = json.loads(z["params"].tobytes().decode()) if "params" in z else {}
+    # v1 checkpoints written before the schema moved to a dimension-agnostic
+    # 'shape' list carried nx/ny(/nz) keys; translate so they keep resuming
+    # instead of failing with a confusing "'shape' missing" mismatch
+    if "shape" not in params and "nx" in params:
+        shape = [params.pop("nx")]
+        for key in ("ny", "nz"):
+            if key in params:
+                shape.append(params.pop(key))
+        params["shape"] = shape
     return u, t, params
 
 

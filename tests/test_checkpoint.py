@@ -149,3 +149,18 @@ def test_cli_checkpoint_resume(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "l2:" in out
+
+
+def test_legacy_nx_ny_params_translate_to_shape(tmp_path):
+    # checkpoints written before the schema moved to a 'shape' list carried
+    # nx/ny keys; they must keep resuming (ADVICE r2)
+    path = str(tmp_path / "state.npz")
+    s = _solver(10, checkpoint_path=None, ncheckpoint=0)
+    s.test_init()
+    legacy = {k: v for k, v in s._ckpt_params().items() if k != "shape"}
+    legacy["nx"], legacy["ny"] = s._grid_shape
+    ckpt.save_state(path, np.asarray(s.u0), 0, legacy)
+    _, _, params = ckpt.load_state(path)
+    assert params["shape"] == list(s._grid_shape)
+    s.resume(path)  # must not raise "'shape' missing"
+    assert s.t0 == 0

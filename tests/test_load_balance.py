@@ -244,3 +244,52 @@ def test_elastic_heterogeneous_speeds():
     ok, max_diff = lb.balance_check(s.busy_rates())
     assert ok, f"max busy deviation {max_diff}"
     assert s.error_l2 / (24 * 24) <= 1e-6
+
+
+def test_windowed_measurement_overlaps_nonwindow_steps():
+    """VERDICT r2 #5: with nbalance set, only the measure_window steps
+    feeding each rebalance are measured (serialized); all other steps take
+    the fully overlapped dispatch path."""
+    calls = {"measured": 0, "overlapped": 0}
+
+    class Probe(ElasticSolver2D):
+        def _step_all_measured(self, t):
+            calls["measured"] += 1
+            return super()._step_all_measured(t)
+
+        def _step_all_overlapped(self, t):
+            calls["overlapped"] += 1
+            return super()._step_all_overlapped(t)
+
+    s = Probe(4, 4, 4, 4, nt=20, eps=2, nbalance=10, measure_window=3,
+              k=0.2, dt=0.0005, dh=0.02)
+    s.test_init()
+    s.do_work()
+    # windows (nbalance=10, W=3): {8,9,10} and {18,19} within t<20
+    assert calls["measured"] == 5, calls
+    assert calls["overlapped"] == 15, calls
+    assert s.error_l2 / (16 * 16) <= 1e-6
+
+
+def test_batched_dispatch_one_call_per_device_per_step():
+    """VERDICT r2 #7: the overlapped fused path dispatches ONE batched jit
+    call per device per step, not one per tile."""
+    calls = {"batched": 0, "tile": 0}
+
+    class Probe(ElasticSolver2D):
+        def _step_device_batched(self, d, t):
+            calls["batched"] += 1
+            return super()._step_device_batched(d, t)
+
+        def _step_tile(self, key, t):
+            calls["tile"] += 1
+            return super()._step_tile(key, t)
+
+    ndev = min(2, len(jax.devices()))
+    s = Probe(4, 4, 4, 4, nt=10, eps=2, k=0.2, dt=0.0005, dh=0.02,
+              devices=jax.devices()[:ndev])
+    s.test_init()
+    s.do_work()
+    assert calls["tile"] == 0, calls  # no per-tile dispatch on this path
+    assert calls["batched"] == 10 * ndev, calls
+    assert s.error_l2 / (16 * 16) <= 1e-6

@@ -181,11 +181,63 @@ def bench_unstructured(steps: int):
     emit("unstructured", op.n, steps, sec, nodes=op.n, edges=len(op.tgt))
 
 
+def bench_elastic(steps: int):
+    """Elastic executor vs SPMD on the same problem (VERDICT r2 #7): the
+    measured cost of running the reference's flagship scenario (arbitrary
+    tile placement, migratable) on the per-device-batched elastic path,
+    as a ratio against the fused SPMD program."""
+    from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
+    from nonlocalheatequation_tpu.parallel.elastic import ElasticSolver2D
+
+    n = cfg("BT_ELASTIC_GRID", 2048, 256)
+    ntiles = 8  # 8x8 tile grid, the reference's npx=npy style decomposition
+    method = "pallas" if on_tpu() else "sat"
+    rng = np.random.default_rng(0)
+    u0 = rng.normal(size=(n, n))
+
+    # SPMD side (the flagship path)
+    s = Solver2DDistributed(n, n, 1, 1, nt=steps, eps=8, k=1.0,
+                            dt=1e-7, dh=1.0 / n, method=method,
+                            dtype=jnp.float32)
+    s.input_init(u0)
+    step = s._build_step()
+    u, _src = s._device_state()
+    from jax import lax
+
+    @jax.jit
+    def multi(ustate):
+        return lax.scan(lambda c, t: (step(c, t), None), ustate,
+                        jnp.arange(steps))[0]
+
+    spmd_sec, _ = time_steps(multi, u, steps)
+
+    # elastic side: same grid, 8x8 tiles, overlapped batched dispatch
+    # (do_work includes tile placement; amortized over the steps, as the
+    # reference's do_work includes its dataflow construction)
+    e = ElasticSolver2D(n // ntiles, n // ntiles, ntiles, ntiles, nt=steps,
+                        eps=8, k=1.0, dt=1e-7, dh=1.0 / n, method=method,
+                        nlog=10 ** 9, dtype=jnp.float32)
+    e.input_init(u0)
+    t0 = time.perf_counter()
+    e.do_work()
+    log(f"    elastic compile+first: {time.perf_counter() - t0:.2f}s")
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        e.do_work()
+        best = min(best, time.perf_counter() - t0)
+    emit("2d/elastic", n * n, steps, best, grid=n, eps=8, tiles=ntiles * ntiles,
+         devices=len(jax.devices()),
+         spmd_ms_per_step=spmd_sec / steps * 1e3,
+         elastic_over_spmd=best / spmd_sec)
+
+
 BENCHES = {
     "methods2d": bench_methods2d,
     "dist2d": bench_dist2d,
     "3d": bench_3d,
     "unstructured": bench_unstructured,
+    "elastic": bench_elastic,
 }
 
 

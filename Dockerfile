@@ -1,0 +1,20 @@
+# Runnable image of the framework — the analog of the reference's published
+# Docker image (.circleci/config.yml:35-62 + .circleci/Docker/Dockerfile):
+# everything installed, native components built, batch tests as the default
+# command so `docker run` proves the install the same way `make test` does.
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/nonlocalheatequation_tpu
+COPY . .
+
+RUN pip install --no-cache-dir jax numpy pytest \
+    && pip install --no-cache-dir -e . \
+    && make -C native
+
+# CPU backend inside the container; TPU hosts mount their own runtime
+ENV JAX_PLATFORMS=cpu
+CMD ["python", "-m", "pytest", "tests/", "-q"]

@@ -392,7 +392,10 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
                     [jax.device_put(self._gtiles[k][0], dev) for k in own])
                 self._blg[d] = jnp.stack(
                     [jax.device_put(self._gtiles[k][1], dev) for k in own])
-        self._tiles_stale = True
+        # stacking FROM the dict leaves both representations in sync; only
+        # _step_all_overlapped (which advances _bstate past the dict) marks
+        # the dict stale
+        self._tiles_stale = False
 
     def _materialize(self):
         """Refresh the per-tile dict from the batched residents (no-op on the
@@ -525,7 +528,11 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
                 self._step_all_overlapped(t)
             prev_in_window = in_window
             if (self.nbalance and t % self.nbalance == 0 and t > 0
-                    and nl > 1):
+                    and t != self.nt - 1 and nl > 1):
+                # (a rebalance on the FINAL step would migrate tiles no step
+                # will ever use and reset the telemetry that evidences the
+                # final placement — skip it so end-of-run busy rates always
+                # describe the assignment actually reported)
                 self._rebalance()
                 if hasattr(self.telemetry, "reset"):
                     # new measurement window, like the reference's counter

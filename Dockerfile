@@ -11,8 +11,7 @@ RUN apt-get update \
 WORKDIR /opt/nonlocalheatequation_tpu
 COPY . .
 
-RUN pip install --no-cache-dir jax numpy pytest \
-    && pip install --no-cache-dir -e . \
+RUN pip install --no-cache-dir -e . pytest \
     && make -C native
 
 # CPU backend inside the container; TPU hosts mount their own runtime

@@ -103,3 +103,32 @@ def test_moment_matched_constant_converges_to_laplacian():
     )
     rel = np.abs(lg[interior] - lap[interior]).max() / np.abs(lap[interior]).max()
     assert rel < 0.05
+
+
+def test_ell_layout_matches_edge_layout():
+    # same edges, two reductions: padded-row gather+sum vs segment_sum
+    rng = np.random.default_rng(5)
+    pts = rng.uniform(size=(300, 2))
+    op = UnstructuredNonlocalOp(pts, 0.12, k=0.7, dt=1e-5, vol=1.0 / 300)
+    u = jnp.asarray(rng.normal(size=300))
+    a = np.asarray(op.apply(u, layout="ell"))
+    b = np.asarray(op.apply(u, layout="edges"))
+    ref = op.apply_np(np.asarray(u))
+    assert np.allclose(a, b, rtol=1e-12, atol=1e-12)
+    assert np.allclose(a, ref, rtol=1e-9, atol=1e-9)
+
+
+def test_auto_layout_falls_back_to_edges_for_hub_node():
+    # one wide-horizon hub makes kmax ~ n; dense ELL padding would square
+    # the memory, so "auto" must keep the O(edges) edge-list reduction
+    rng = np.random.default_rng(9)
+    pts = rng.uniform(size=(200, 2))
+    eps = np.full(200, 0.08)
+    eps[0] = 2.0  # hub sees everyone
+    op = UnstructuredNonlocalOp(pts, eps, k=1.0, dt=1e-5, vol=1.0 / 200)
+    assert not op._ell_worthwhile()
+    assert op._ell_arrays is None  # lazy: nothing built yet
+    u = jnp.asarray(rng.normal(size=200))
+    got = np.asarray(op.apply(u))  # auto -> edges
+    assert op._ell_arrays is None  # still not built
+    assert np.allclose(got, op.apply_np(np.asarray(u)), rtol=1e-9, atol=1e-9)

@@ -172,13 +172,16 @@ def bench_unstructured(steps: int):
 
     from jax import lax
 
-    @jax.jit
-    def multi(u):
-        return lax.scan(lambda c, _: (c + op.dt * op.apply(c), None), u,
-                        None, length=steps)[0]
+    for layout in ("ell", "edges"):
+        @jax.jit
+        def multi(u, _layout=layout):
+            return lax.scan(
+                lambda c, _: (c + op.dt * op.apply(c, layout=_layout), None),
+                u, None, length=steps)[0]
 
-    sec, _ = time_steps(multi, u0, steps)
-    emit("unstructured", op.n, steps, sec, nodes=op.n, edges=len(op.tgt))
+        sec, _ = time_steps(multi, u0, steps)
+        emit(f"unstructured/{layout}", op.n, steps, sec, nodes=op.n,
+             edges=len(op.tgt), kmax=op.kmax)
 
 
 def bench_elastic(steps: int):

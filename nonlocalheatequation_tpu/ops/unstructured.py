@@ -11,8 +11,11 @@ with N(i) = {j : |x_j - x_i| <= eps_i} (the center point included, matching
 the grid raster's center-in-stencil convention, ops/stencil.py).
 
 TPU-first evaluation: the neighbor structure is a static edge list built once
-on the host (cell-binned radius search), and the jit'd operator is one gather
-+ one ``jax.ops.segment_sum`` — a fixed-shape scatter-add XLA handles well.
+on the host (cell-binned radius search; the OpenMP builder in
+native/edges.cc when built, with the NumPy implementation as fallback and
+parity oracle), and the jit'd operator is a padded-row (ELL) gather +
+row-sum by default, with the edge-list ``jax.ops.segment_sum`` form for
+skewed degree profiles and the sharded path.
 
 The per-point constant uses exact discrete moment matching,
 
@@ -26,10 +29,55 @@ reproduces that quirk on the grid path, where bit-parity matters).
 
 from __future__ import annotations
 
+import ctypes
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+def _load_native():
+    from nonlocalheatequation_tpu.utils.native import load_native_lib
+
+    lib = load_native_lib("libedges.so", ("nl_edges_count", "nl_edges_fill"))
+    if lib is None:
+        return None
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.nl_edges_count.restype = ctypes.c_int64
+    lib.nl_edges_count.argtypes = [ctypes.c_int32, ctypes.c_int64, f64p, f64p, i64p]
+    lib.nl_edges_fill.restype = None
+    lib.nl_edges_fill.argtypes = [
+        ctypes.c_int32, ctypes.c_int64, f64p, f64p, i64p, i32p, i32p,
+    ]
+    return lib
+
+
+_native_lib = _load_native()
+
+
+def _build_edges_native(points: np.ndarray, eps: np.ndarray):
+    """Native (OpenMP) cell-binned search; None when unavailable/unsuitable.
+
+    Same membership rule and output order as the NumPy builder (verified by
+    tests/test_unstructured.py parity test); d <= 3 only.
+    """
+    n, d = points.shape
+    if _native_lib is None or d > 3:
+        return None
+    pts = np.ascontiguousarray(points, np.float64)
+    eps = np.ascontiguousarray(eps, np.float64)
+    deg = np.zeros(n, np.int64)
+    total = _native_lib.nl_edges_count(d, n, pts, eps, deg)
+    if total < 0:  # invalid input or key-packing overflow: fall back
+        return None
+    starts = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=starts[1:])
+    tgt = np.empty(total, np.int32)
+    src = np.empty(total, np.int32)
+    _native_lib.nl_edges_fill(d, n, pts, eps, starts, tgt, src)
+    return tgt, src
 
 
 def build_edges(points: np.ndarray, eps: np.ndarray):
@@ -59,6 +107,9 @@ def build_edges(points: np.ndarray, eps: np.ndarray):
     cell = float(eps.max())
     if cell <= 0:
         raise ValueError("horizon radii must be positive")
+    native = _build_edges_native(points, eps)
+    if native is not None:
+        return native
     keys = np.floor((points - points.min(axis=0)) / cell).astype(np.int64)
     # bin points by cell
     bins: dict[tuple, list[int]] = {}

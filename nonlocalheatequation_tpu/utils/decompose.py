@@ -24,25 +24,17 @@ On TPU the map's owner ids become mesh placement (parallel/mesh.make_mesh
 from __future__ import annotations
 
 import ctypes
-import os
 
 import numpy as np
 
 from nonlocalheatequation_tpu.utils.gmsh import MshData, read_msh
+from nonlocalheatequation_tpu.utils.native import load_native_lib
 from nonlocalheatequation_tpu.utils.partition_map import PartitionMap
-
-_NATIVE = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native", "build", "libpartition.so",
-)
 
 
 def _load_native():
-    if not os.path.exists(_NATIVE):
-        return None
-    try:
-        lib = ctypes.CDLL(_NATIVE)
-    except OSError:
+    lib = load_native_lib("libpartition.so", ("partition_rcb", "refine_cut"))
+    if lib is None:
         return None
     lib.partition_rcb.restype = ctypes.c_int32
     lib.partition_rcb.argtypes = [

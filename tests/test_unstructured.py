@@ -132,3 +132,51 @@ def test_auto_layout_falls_back_to_edges_for_hub_node():
     got = np.asarray(op.apply(u))  # auto -> edges
     assert op._ell_arrays is None  # still not built
     assert np.allclose(got, op.apply_np(np.asarray(u)), rtol=1e-9, atol=1e-9)
+
+
+def test_native_edge_builder_parity():
+    # the OpenMP builder (native/edges.cc) must reproduce the NumPy
+    # builder's edge list EXACTLY (membership rule, tolerance, and
+    # (tgt, src)-sorted order) across dimensions and variable horizons
+    from nonlocalheatequation_tpu.ops import unstructured as U
+
+    if U._native_lib is None:
+        pytest.skip("native/build/libedges.so not built")
+    rng = np.random.default_rng(11)
+    cases = [
+        (rng.uniform(size=(400, 2)), 0.06 * (1 + rng.uniform(size=400))),
+        (rng.uniform(size=(300, 3)), 0.15),
+        (rng.uniform(size=(200, 1)), 0.02),
+    ]
+    for pts, eps in cases:
+        eps_b = np.broadcast_to(np.asarray(eps, np.float64), (len(pts),))
+        nat = U._build_edges_native(np.asarray(pts, np.float64), eps_b)
+        lib = U._native_lib
+        U._native_lib = None
+        try:
+            ref = U.build_edges(pts, eps)
+        finally:
+            U._native_lib = lib
+        assert nat is not None
+        assert np.array_equal(nat[0], ref[0])
+        assert np.array_equal(nat[1], ref[1])
+
+
+def test_native_edge_builder_parity_at_cell_boundary():
+    # 0.3/0.1 floors to 2 but 0.3*(1/0.1) floors to 3: a reciprocal-multiply
+    # binning would place the point in a different cell than the NumPy
+    # builder and change the edge list (review finding, round 3)
+    from nonlocalheatequation_tpu.ops import unstructured as U
+
+    if U._native_lib is None:
+        pytest.skip("native/build/libedges.so not built")
+    pts = np.array([[0.0], [0.3], [0.4]])
+    eps = np.full(3, 0.1)
+    nat = U._build_edges_native(pts, eps)
+    lib = U._native_lib
+    U._native_lib = None
+    try:
+        ref = U.build_edges(pts, eps)
+    finally:
+        U._native_lib = lib
+    assert np.array_equal(nat[0], ref[0]) and np.array_equal(nat[1], ref[1])

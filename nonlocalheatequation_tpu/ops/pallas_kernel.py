@@ -431,7 +431,8 @@ def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int):
 
 def _fits_3d(tm: int, tn: int, nz: int, eps: int, itemsize: int) -> bool:
     heights, parts_by_h, _pows, pad = _strip_plan_3d(eps)
-    window = (tm + pad) * (tn + 2 * eps) * (nz + 2 * eps) * itemsize
+    # y window widened to a multiple of 8 (Mosaic block-dim constraint)
+    window = (tm + pad) * _round_up(tn + 2 * eps, 8) * (nz + 2 * eps) * itemsize
     out = tm * tn * nz * itemsize
     n_pairs = len(heights)
     log_steps = max(1, int(np.ceil(np.log2(tm + pad))))
@@ -470,18 +471,29 @@ def build_neighbor_sum_3d(eps: int, nx: int, ny: int, nz: int, dtype_name: str):
     tm, tn = _choose_tiles_3d(nx, ny, nz, eps, dtype.itemsize)
     pad = _strip_plan_3d(eps)[3]
     tmw = tm + pad
+    # Mosaic requires the last-two block dims to be (multiple of 8,
+    # multiple of 128) OR equal to the array's dims.  The z block always
+    # spans the full padded z axis; the y window tn + 2*eps is a multiple
+    # of 8 only when eps % 4 == 0 — widen it with dead columns to the next
+    # multiple of 8 (they read operand zero-padding; the kernel slices
+    # them off).  Caught on real TPU in round 3: 128^3 eps=6 failed to
+    # lower while the interpreter-mode CI accepted it.
+    ywin = tn + 2 * eps
+    ywin_blk = _round_up(ywin, 8)
 
     def kernel(win_ref, out_ref):
+        w = win_ref[:, :ywin, :] if ywin_blk != ywin else win_ref[:]
         out_ref[:] = _block_neighbor_sum_3d(
-            win_ref[:], tm, tn, nz, eps
+            w, tm, tn, nz, eps
         ).astype(dtype)
 
     def neighbor_sum(upad):
         vma = jax.typeof(upad).vma
         nxp, nyp = _round_up(nx, tm), _round_up(ny, tn)
-        # pad x so every strip window is in range; pad y to a block multiple
+        # pad x so every strip window is in range; pad y so the widened
+        # y window of the last block stays in range
         extra_x = (nxp - tm + tmw) - upad.shape[0]
-        extra_y = (nyp + 2 * eps) - upad.shape[1]
+        extra_y = (nyp - tn + ywin_blk) - upad.shape[1]
         if extra_x > 0 or extra_y > 0:
             upad = jnp.pad(
                 upad, ((0, max(extra_x, 0)), (0, max(extra_y, 0)), (0, 0))
@@ -491,7 +503,7 @@ def build_neighbor_sum_3d(eps: int, nx: int, ny: int, nz: int, dtype_name: str):
             grid=(nxp // tm, nyp // tn),
             in_specs=[
                 pl.BlockSpec(
-                    (pl.Element(tmw), pl.Element(tn + 2 * eps),
+                    (pl.Element(tmw), pl.Element(ywin_blk),
                      pl.Element(nz + 2 * eps)),
                     lambda i, j: (i * tm, j * tn, 0),
                     memory_space=pltpu.VMEM,

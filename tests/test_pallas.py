@@ -145,3 +145,35 @@ def test_distributed_pallas_matches_shift():
                                 dh=0.03125, mesh=mesh, method="shift")
         b.test_init(); b.do_work()
         assert np.abs(a.u - b.u).max() < 1e-12
+
+
+def test_3d_block_dims_satisfy_mosaic_constraints(monkeypatch):
+    """Mosaic (real-TPU) lowering requires the last-two block dims be
+    (multiple of 8, multiple of 128) or equal the array dims.  The 3D
+    kernel's y window must therefore be widened to a multiple of 8 for ANY
+    eps — found on hardware in round 3 (128^3 eps=6 failed to lower while
+    interpreter-mode CI accepted it).  Regression: spy on the BlockSpecs
+    the kernel ACTUALLY emits (the interpreter itself cannot validate the
+    constraint, so inspect what a real TPU would be handed)."""
+    from nonlocalheatequation_tpu.ops import pallas_kernel as pk
+
+    recorded = {}
+    real_call = pk.pl.pallas_call
+
+    def spy(kernel, **kw):
+        recorded["in_specs"] = kw["in_specs"]
+        recorded["out_shape"] = kw["out_shape"]
+        return real_call(kernel, **kw)
+
+    monkeypatch.setattr(pk.pl, "pallas_call", spy)
+    for eps, n in [(6, 24), (3, 32), (5, 16), (4, 32)]:
+        pk.build_neighbor_sum_3d.cache_clear()
+        fn = pk.build_neighbor_sum_3d(eps, n, n, n, "float64")
+        upad = jnp.zeros((n + 2 * eps,) * 3)
+        fn(upad)
+        blk = recorded["in_specs"][0].block_shape
+        mid = getattr(blk[1], "block_size", blk[1])
+        last = getattr(blk[2], "block_size", blk[2])
+        assert mid % 8 == 0, (eps, n, mid)  # the round-3 hardware bug
+        assert last == n + 2 * eps  # z block == full padded axis
+    pk.build_neighbor_sum_3d.cache_clear()

@@ -441,10 +441,18 @@ def _fits_3d(tm: int, tn: int, nz: int, eps: int, itemsize: int) -> bool:
 
 
 def _choose_tiles_3d(nx: int, ny: int, nz: int, eps: int, itemsize: int):
-    """(tm, tn): block footprint that fits VMEM, preferring divisors of nx/ny."""
+    """(tm, tn): block footprint that fits VMEM, preferring divisors of nx/ny.
 
-    def pick(axis: str, n: int, fits) -> int:
-        cap = min(64, _round_up(n, 8))
+    Small blocks win on hardware: sweeping tm/tn on a v5e (round 3, post
+    lowering-fix) put (8, 16) ahead of or equal to every larger choice at
+    256^3 eps=4 (-7%), 192^3 eps=3 (~even), and 128^3 eps=6 (-1%, with
+    (8, 8) another 13% better there but worse at 192^3) — the z axis
+    already provides the long lane dimension, so growing the block only
+    adds VMEM pressure without improving utilization.  Caps: tm 8, tn 16.
+    """
+
+    def pick(axis: str, n: int, fits, cap_max: int) -> int:
+        cap = min(cap_max, _round_up(n, 8))
         while cap > 8 and not fits(cap):
             cap -= 8
         if not fits(cap):
@@ -459,8 +467,8 @@ def _choose_tiles_3d(nx: int, ny: int, nz: int, eps: int, itemsize: int):
                 return t
         return cap
 
-    tn = pick("ny", ny, lambda t: _fits_3d(8, t, nz, eps, itemsize))
-    tm = pick("nx", nx, lambda t: _fits_3d(t, tn, nz, eps, itemsize))
+    tn = pick("ny", ny, lambda t: _fits_3d(8, t, nz, eps, itemsize), 16)
+    tm = pick("nx", nx, lambda t: _fits_3d(t, tn, nz, eps, itemsize), 8)
     return tm, tn
 
 

@@ -24,8 +24,12 @@ def add_platform_flags(p: argparse.ArgumentParser):
     p.add_argument(
         "--x64",
         type=lambda s: s.lower() in ("1", "true", "yes"),
-        default=True,
-        help="enable float64 (default true; the oracle contract is float64)",
+        default=None,
+        help="enable float64 (default: true off-TPU — the oracle contract "
+             "is float64 — and false on TPU, where f64 runs emulated and "
+             "multi-step f64 scans are unusably slow; "
+             "tests/test_accuracy_contract.py demonstrates the 1e-6 "
+             "contract survives f32)",
     )
 
 
@@ -36,8 +40,24 @@ def apply_platform(args):
         # NB: the env var route is unreliable (some PJRT plugins ignore it);
         # the config knob always works.
         jax.config.update("jax_platforms", args.platform)
-    if args.x64:
-        jax.config.update("jax_enable_x64", True)
+    x64 = args.x64
+    if x64 is None:
+        # backend-aware default: f64 off-TPU (oracle-contract precision);
+        # f32 on TPU, where f64 is software-emulated and a multi-step f64
+        # lax.scan is unusably slow (measured round 3: even a trivial
+        # 20-step f64 scan did not finish in 4 minutes on a v5e)
+        x64 = jax.default_backend() != "tpu"
+        if not x64:
+            print("note: TPU backend -> float32 (pass --x64 1 to force "
+                  "f64; expect severe slowdown)", file=sys.stderr)
+    elif x64 and jax.default_backend() == "tpu":
+        print("WARNING: f64 on TPU runs software-emulated; multi-step "
+              "scans may take minutes to compile or never finish",
+              file=sys.stderr)
+    # unconditional: an ambient JAX_ENABLE_X64=1 (or prior config) must not
+    # silently override the backend-aware default / an explicit --x64 0 —
+    # on TPU that would re-open the f64-scan wedge this default prevents
+    jax.config.update("jax_enable_x64", bool(x64))
 
 
 def bool_flag(p: argparse.ArgumentParser, name: str, default: bool, help: str):

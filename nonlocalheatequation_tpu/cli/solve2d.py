@@ -35,7 +35,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dh", type=float, default=0.02)
     p.add_argument("--no-header", action="store_true", dest="no_header")
     p.add_argument("--backend", default="jit", choices=("oracle", "jit"))
-    p.add_argument("--method", default="conv", choices=("conv", "shift", "sat", "pallas"))
+    p.add_argument("--method", default="auto",
+                   choices=("auto", "conv", "shift", "sat", "pallas"))
     p.add_argument("--log", action="store_true")
     p.add_argument("--checkpoint", default=None,
                    help="checkpoint file to write every --ncheckpoint steps")

@@ -44,7 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dt", type=float, default=0.0005)
     p.add_argument("--dh", type=float, default=0.02)
     p.add_argument("--no-header", action="store_true", dest="no_header")
-    p.add_argument("--method", default="conv", choices=("conv", "shift", "sat", "pallas"))
+    p.add_argument("--method", default="auto",
+                   choices=("auto", "conv", "shift", "sat", "pallas"))
     p.add_argument("--log", action="store_true")
     add_platform_flags(p)
     return p

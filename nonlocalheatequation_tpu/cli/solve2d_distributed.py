@@ -50,7 +50,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices", type=int, default=0,
                    help="limit the device count (the reference's number of "
                         "localities, srun -n N); 0 = all")
-    p.add_argument("--method", default="conv", choices=("conv", "shift", "sat", "pallas"))
+    p.add_argument("--method", default="auto",
+                   choices=("auto", "conv", "shift", "sat", "pallas"))
     p.add_argument("--log", action="store_true")
     p.add_argument("--checkpoint", default=None,
                    help="checkpoint file to write every --ncheckpoint steps")

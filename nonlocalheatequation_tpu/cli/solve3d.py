@@ -38,7 +38,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dh", type=float, default=0.0625)
     p.add_argument("--no-header", action="store_true", dest="no_header")
     p.add_argument("--backend", default="jit", choices=("oracle", "jit"))
-    p.add_argument("--method", default="sat", choices=("shift", "sat", "pallas"))
+    p.add_argument("--method", default="auto",
+                   choices=("auto", "shift", "sat", "pallas"))
     p.add_argument("--distributed", action="store_true",
                    help="shard over the device mesh (SPMD + halo exchange)")
     add_platform_flags(p)

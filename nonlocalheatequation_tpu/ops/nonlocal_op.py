@@ -116,6 +116,47 @@ class NonlocalOp1D:
         return np.cos(TWO_PI * (t * self.dt)) * self.spatial_profile(nx)
 
 
+def _auto_method(dtype, backend, off_tpu_method: str, probe_fits) -> str:
+    """Shared 'auto' policy: Pallas on TPU when the shape fits its VMEM
+    budget AND the dtype is f32 (Mosaic has no f64 vector ops; the XLA
+    methods run f64 via emulation); 'sat' as the TPU fallback; the given
+    CPU-fast method off-TPU (pallas would run interpreted there)."""
+    if backend is None:
+        backend = jax.default_backend()
+    if backend != "tpu":
+        return off_tpu_method
+    if dtype.itemsize == 8:
+        return "sat"
+    try:
+        probe_fits()
+        return "pallas"
+    except ValueError:  # shape exceeds the kernel's VMEM budget
+        return "sat"
+
+
+def _auto_method_2d(eps: int, nx: int, ny: int, dtype, backend=None) -> str:
+    from nonlocalheatequation_tpu.ops.pallas_kernel import _choose_tm
+
+    # n_aux=2: one resolution covers BOTH consumers of the choice — the bare
+    # neighbor-sum kernel (n_aux=0) and the fused test-source step kernel
+    # (n_aux=2, engaged by make_step_fn under auto) — so probe the larger
+    # footprint; near-budget shapes lose pallas rather than risk a mid-run
+    # ValueError from the fused path
+    return _auto_method(
+        dtype, backend, "conv",
+        lambda: _choose_tm(nx, ny, eps, dtype.itemsize, n_aux=2),
+    )
+
+
+def _auto_method_3d(eps: int, nx: int, ny: int, nz: int, dtype, backend=None) -> str:
+    from nonlocalheatequation_tpu.ops.pallas_kernel import _choose_tiles_3d
+
+    return _auto_method(
+        dtype, backend, "sat",
+        lambda: _choose_tiles_3d(nx, ny, nz, eps, dtype.itemsize),
+    )
+
+
 class NonlocalOp2D:
     """2D horizon operator (reference: src/2d_nonlocal_serial.cpp:256-270).
 
@@ -141,9 +182,25 @@ class NonlocalOp2D:
         self.weights = influence_weights(self.mask, influence, dh)
         self.wsum = float(self.weights.sum())
         self.uniform = influence is None  # J == 1: sat/pallas paths are valid
-        if method in ("sat", "pallas") and not self.uniform:
+        if method in ("sat", "pallas", "auto") and not self.uniform:
             method = "conv"
         self.method = method
+        self._auto_cache: dict = {}
+
+    def _resolve_method(self, nx: int, ny: int, dtype) -> str:
+        """Concrete method for this (shape, dtype); 'auto' picks per backend:
+        the Pallas kernel on TPU when the shape fits its VMEM budget and the
+        dtype is f32 (Mosaic is f32-only), the f64-capable 'sat' otherwise,
+        and 'conv' off-TPU (pallas would run interpreted; conv is the fast
+        CPU lowering)."""
+        if self.method != "auto":
+            return self.method
+        key = (nx, ny, jnp.dtype(dtype).name)
+        m = self._auto_cache.get(key)
+        if m is None:
+            m = _auto_method_2d(self.eps, nx, ny, jnp.dtype(dtype))
+            self._auto_cache[key] = m
+        return m
 
     # -- neighbor sum -------------------------------------------------------
     def neighbor_sum_np(self, u: np.ndarray) -> np.ndarray:
@@ -175,11 +232,15 @@ class NonlocalOp2D:
         distributed path fills via collectives (zeros at the global edge).
         Returns the (nx, ny) sum.
         """
-        if self.method == "conv":
+        e = self.eps
+        method = self._resolve_method(
+            upad.shape[0] - 2 * e, upad.shape[1] - 2 * e, upad.dtype
+        )
+        if method == "conv":
             return self._neighbor_sum_conv(upad)
-        if self.method == "sat":
+        if method == "sat":
             return self._neighbor_sum_sat(upad)
-        if self.method == "pallas":
+        if method == "pallas":
             return self._neighbor_sum_pallas(upad)
         return self._neighbor_sum_shift(upad)
 
@@ -296,10 +357,27 @@ def make_step_fn(op, g=None, lg=None, dtype=None):
     trace.
     """
     test = g is not None
-    if getattr(op, "method", None) == "pallas" and isinstance(op, NonlocalOp2D):
+    method = getattr(op, "method", None)
+    if method in ("pallas", "auto") and isinstance(op, NonlocalOp2D):
         from nonlocalheatequation_tpu.ops.pallas_kernel import make_pallas_step_fn
 
-        return make_pallas_step_fn(op, g, lg, dtype)
+        pallas_step = make_pallas_step_fn(op, g, lg, dtype)
+        if method == "pallas":
+            return pallas_step
+        # auto: resolution is per (shape, dtype), both only known at trace
+        # time — dispatch there (host-side, so the choice is static per
+        # compiled shape); the fused kernel stays reachable on TPU
+        generic_step = _make_generic_step(op, g, lg, dtype, test)
+
+        def step(u, t):
+            m = op._resolve_method(u.shape[0], u.shape[1], u.dtype)
+            return pallas_step(u, t) if m == "pallas" else generic_step(u, t)
+
+        return step
+    return _make_generic_step(op, g, lg, dtype, test)
+
+
+def _make_generic_step(op, g, lg, dtype, test):
     if test:
         g = jnp.asarray(g, dtype)
         lg = jnp.asarray(lg, dtype)
@@ -360,9 +438,10 @@ class NonlocalOp3D:
         self.weights = influence_weights(self.mask, influence, dh)
         self.wsum = float(self.weights.sum())
         self.uniform = influence is None
-        if method in ("sat", "pallas") and not self.uniform:
+        if method in ("sat", "pallas", "auto") and not self.uniform:
             method = "shift"
         self.method = method
+        self._auto_cache: dict = {}
         # column half-heights along z per (i, j) offset, derived from the
         # mask itself so the raster rule lives only in ops/stencil.py;
         # -1 = column outside the sphere
@@ -393,17 +472,30 @@ class NonlocalOp3D:
         e = self.eps
         return self.neighbor_sum_padded(jnp.pad(u, ((e, e), (e, e), (e, e))))
 
+    def _resolve_method(self, nx: int, ny: int, nz: int, dtype) -> str:
+        """Concrete method for this (shape, dtype); see NonlocalOp2D.
+        Off-TPU the 3D choice is 'sat' (the fast XLA lowering here)."""
+        if self.method != "auto":
+            return self.method
+        key = (nx, ny, nz, jnp.dtype(dtype).name)
+        m = self._auto_cache.get(key)
+        if m is None:
+            m = _auto_method_3d(self.eps, nx, ny, nz, jnp.dtype(dtype))
+            self._auto_cache[key] = m
+        return m
+
     def neighbor_sum_padded(self, upad: jnp.ndarray) -> jnp.ndarray:
         e = self.eps
         nx, ny, nz = (s - 2 * e for s in upad.shape)
-        if self.method == "pallas":
+        method = self._resolve_method(nx, ny, nz, upad.dtype)
+        if method == "pallas":
             from nonlocalheatequation_tpu.ops.pallas_kernel import (
                 build_neighbor_sum_3d,
             )
 
             fn = build_neighbor_sum_3d(e, nx, ny, nz, np.dtype(upad.dtype).name)
             return fn(upad)
-        if self.method == "sat":
+        if method == "sat":
             # exclusive prefix along z: one window difference per (i, j)
             p = jnp.concatenate(
                 [jnp.zeros(upad.shape[:2] + (1,), upad.dtype),

@@ -244,10 +244,24 @@ def _pad_operand(upad, nx: int, tm: int, tmw: int, eps: int):
     return upad, nxp
 
 
+def _reject_f64_on_tpu(dtype):
+    """Mosaic has no f64 vector ops (dynamic_rotate etc.), so the compiled
+    kernels are f32-only; fail with guidance instead of a compiler trace.
+    Interpreter mode (off-TPU) runs f64 fine — it's how the CPU suite
+    holds the oracle contract."""
+    if _on_tpu() and dtype.itemsize == 8:
+        raise ValueError(
+            "the pallas kernel is float32-only on TPU (Mosaic has no f64 "
+            "vector ops); disable x64 (--x64 0 / dtype=float32) or use "
+            "method='sat' (runs f64 via XLA emulation)"
+        )
+
+
 @functools.lru_cache(maxsize=None)
 def build_neighbor_sum_2d(eps: int, nx: int, ny: int, dtype_name: str):
     """(upad: (nx+2e, ny+2e)) -> (nx, ny) masked-circle neighbor sum."""
     dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
     tm = _choose_tm(nx, ny, eps, dtype.itemsize, n_aux=0)
     tmw = tm + _window_pad(eps)
 
@@ -295,6 +309,7 @@ def _build_step_kernel(
     test: bool,
 ):
     dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
     tm = _choose_tm(nx, ny, eps, dtype.itemsize, n_aux=2 if test else 0)
     tmw = tm + _window_pad(eps)
     scale = c * dh * dh
@@ -476,6 +491,7 @@ def _choose_tiles_3d(nx: int, ny: int, nz: int, eps: int, itemsize: int):
 def build_neighbor_sum_3d(eps: int, nx: int, ny: int, nz: int, dtype_name: str):
     """(upad: (nx+2e, ny+2e, nz+2e)) -> (nx, ny, nz) masked-sphere sum."""
     dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
     tm, tn = _choose_tiles_3d(nx, ny, nz, eps, dtype.itemsize)
     pad = _strip_plan_3d(eps)[3]
     tmw = tm + pad

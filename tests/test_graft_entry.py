@@ -1,0 +1,64 @@
+"""Driver entry-point contracts (__graft_entry__.py).
+
+entry() must never initialize the real backend in-process: probes run in
+killable subprocesses and example args are NumPy, so a wedged chip (which
+hangs jax.devices() with no exception — the round-1/2 artifact killer)
+cannot hang the driver's compile-check inside entry() itself.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+import jax
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_entry_returns_numpy_args_and_jits_on_cpu(monkeypatch):
+    # short probe budget: the ambient backend may be a wedged TPU; the
+    # contract under test is "entry() returns promptly with jittable parts"
+    monkeypatch.setenv("GRAFT_PALLAS_PROBE_S", "5")
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__ as ge
+
+        t0 = time.time()
+        fn, args = ge.entry()
+        took = time.time() - t0
+        assert took < 60, f"entry() took {took:.0f}s with a 5s probe budget"
+        assert isinstance(args[0], np.ndarray)  # no backend init in entry()
+        out = jax.jit(fn)(*args)  # conftest pins this process to CPU
+        assert out.shape == (512, 512)
+    finally:
+        sys.path.remove(REPO)
+
+
+def test_dryrun_multichip_subprocess_isolation():
+    # dryrun must not disturb the caller's JAX config (ADVICE r2); cheap to
+    # check from a child so this test doesn't depend on conftest state
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import jax\n"
+        "before = jax.config.jax_platforms\n"
+        "import __graft_entry__ as ge\n"
+        "ge.dryrun_multichip(4)\n"
+        "assert jax.config.jax_platforms == before, 'caller config mutated'\n"
+        "print('ok')\n" % REPO
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "ok" in proc.stdout
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

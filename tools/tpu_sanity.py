@@ -26,6 +26,13 @@ sys.path.insert(0, REPO)
 import numpy as np  # noqa: E402
 
 import jax  # noqa: E402
+
+# same override the other tools honor: the axon plugin ignores env vars, so
+# BENCH_PLATFORM=cpu is the only reliable way to smoke this off-TPU (a
+# wedged chip would otherwise hang the very first jax.default_backend())
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
 import jax.numpy as jnp  # noqa: E402
 
 from nonlocalheatequation_tpu.ops.nonlocal_op import (  # noqa: E402
@@ -82,6 +89,23 @@ def main() -> int:
             rel = np.abs(a - b).max() / max(np.abs(b).max(), 1e-30)
             assert rel < 1e-5, f"rel diff {rel:.2e}"
         check(f"3d {n}^3 eps={eps}", f)
+
+    def f_f64_guard():
+        # explicit pallas + f64 on TPU must fail with the guidance message,
+        # not a raw Mosaic trace (and certainly not a hang)
+        jax.config.update("jax_enable_x64", True)
+        try:
+            op = NonlocalOp2D(5, 1.0, 1e-6, 0.02, method="pallas")
+            try:
+                op.apply(jnp.zeros((32, 32), jnp.float64))
+            except ValueError as e:
+                assert "float32-only on TPU" in str(e), str(e)[:120]
+            else:
+                if jax.default_backend() == "tpu":
+                    raise AssertionError("f64 pallas on TPU did not raise")
+        finally:
+            jax.config.update("jax_enable_x64", False)
+    check("pallas f64-on-TPU guard message", f_f64_guard)
 
     def f_sm():
         from nonlocalheatequation_tpu.parallel.distributed2d import (

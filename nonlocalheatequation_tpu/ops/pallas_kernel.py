@@ -91,18 +91,7 @@ def _fits(tm: int, ny: int, eps: int, itemsize: int, n_aux: int) -> bool:
     out = tm * ny * itemsize
     aux = n_aux * tm * ny * itemsize
     log_steps = max(1, int(np.ceil(np.log2(tmw))))
-    # lane-run second level: every distinct (h, run_len>=2) W_L chain keeps
-    # its result live through the final loop plus ~2 SSA temps (roll + add)
-    # per chain step; run_len==1 entries alias v[h] and cost nothing
-    lane_slots = 0
-    for h, run_len in {(h, L) for h, _j0, L in _lane_runs(eps) if L >= 2}:
-        steps = 0
-        built = 1
-        while built * 2 <= run_len:
-            built *= 2
-            steps += 1
-        steps += run_len - built
-        lane_slots += 1 + 2 * steps
+    lane_slots = _lane_slots({(h, L) for h, _j0, L in _lane_runs(eps)})
     stack = (2 * log_steps + 6 + lane_slots) * window + 3 * (out + aux)
     return stack <= _VMEM_BUDGET
 
@@ -130,6 +119,43 @@ def _choose_tm(nx: int, ny: int, eps: int, itemsize: int, n_aux: int) -> int:
         if nx % tm == 0:
             return tm
     return max(cap, 8)
+
+
+def _chain_steps(run_len: int) -> int:
+    """Roll+add count of the W_L doubling chain (shared with the VMEM model)."""
+    steps = 0
+    built = 1
+    while built * 2 <= run_len:
+        built *= 2
+        steps += 1
+    return steps + run_len - built
+
+
+def _lane_slots(run_keys) -> int:
+    """VMEM stack slots of the lane-run second level: each distinct
+    (h, run_len>=2) W_L chain keeps its result live through the final loop
+    plus ~2 SSA temps (roll + add) per chain step; run_len==1 entries alias
+    v[h] and cost nothing."""
+    return sum(1 + 2 * _chain_steps(L) for _h, L in run_keys if L >= 2)
+
+
+def _build_lane_wsums(v, run_keys, lane_down):
+    """W_L(v[h]) per distinct (h, run_len) via the doubling chain."""
+    wsums = {}
+    for h, run_len in run_keys:
+        if (h, run_len) in wsums:
+            continue
+        x = v[h]
+        acc_l = x
+        built = 1
+        while built * 2 <= run_len:
+            acc_l = acc_l + lane_down(acc_l, built)
+            built *= 2
+        while built < run_len:
+            acc_l = acc_l + lane_down(x, built)
+            built += 1
+        wsums[h, run_len] = acc_l
+    return wsums
 
 
 def _naf(w: int):
@@ -272,20 +298,8 @@ def _strip_neighbor_sum(w, tm: int, ny: int, eps: int):
     # read range (j0 + ny - 1 < wlanes - L + 1 since j0 + L <= 2*eps + 1).
     wlanes = w.shape[1]
     lane_down = lambda x, s: pltpu.roll(x, wlanes - s, 1)  # noqa: E731
-    wsums = {}
-    for h, _j0, run_len in _lane_runs(eps):
-        if (h, run_len) in wsums:
-            continue
-        x = v[h]
-        acc_l = x
-        built = 1
-        while built * 2 <= run_len:
-            acc_l = acc_l + lane_down(acc_l, built)
-            built *= 2
-        while built < run_len:
-            acc_l = acc_l + lane_down(x, built)
-            built += 1
-        wsums[h, run_len] = acc_l
+    wsums = _build_lane_wsums(
+        v, [(h, L) for h, _j0, L in _lane_runs(eps)], lane_down)
     acc = None
     for h, j0, run_len in _lane_runs(eps):
         a = eps - h
@@ -471,14 +485,44 @@ def _strip_plan_3d(eps: int):
     return heights, parts_by_h, pows, pad
 
 
+@functools.lru_cache(maxsize=None)
+def _lane_runs_3d(eps: int):
+    """Runs of equal half-height along the z (lane) offsets, per y offset.
+
+    The 2D kernel's second-level trick, one more axis: for each fixed jj the
+    sphere's column heights h(jj, kk) are flat in stretches of kk, so each
+    run sums with ONE slice-add of a lane-window sum W_L(v[h]) — and W_L is
+    shared across every (jj, kk0) run with the same (h, L), anywhere on the
+    sphere.  Returns ((h, jj, kk0, L), ...).
+    """
+    heights = _strip_plan_3d(eps)[0]
+    runs = []
+    for jj in sorted({j for j, _k in heights}):
+        kks = sorted(k for j, k in heights if j == jj)
+        i = 0
+        while i < len(kks):
+            k0 = kks[i]
+            h = heights[jj, k0]
+            L = 1
+            while (i + L < len(kks) and kks[i + L] == k0 + L
+                   and heights[jj, k0 + L] == h):
+                L += 1
+            runs.append((h, jj, k0, L))
+            i += L
+    return tuple(runs)
+
+
 def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int):
     """Masked-sphere neighbor sum for one (tm, tn, nz) block.
 
     ``w`` is the (tm + pad, tn + 2*eps, nz + 2*eps) window; row r of axis 0
     holds padded row ``strip_start + r``.  All rolls read downward along
-    axis 0; wrap garbage lands in the never-read bottom pad rows.
+    axis 0; wrap garbage lands in the never-read bottom pad rows.  The final
+    accumulation sums each z-run of equal heights with one slice-add of a
+    shared lane-window sum (see _lane_runs_3d); lane-roll wrap garbage stays
+    beyond every slice's read range (kk0 + L <= 2*eps + 1).
     """
-    heights, parts_by_h, pows, _pad = _strip_plan_3d(eps)
+    _heights, parts_by_h, pows, _pad = _strip_plan_3d(eps)
     tmw = w.shape[0]
     down = lambda x, s: pltpu.roll(x, tmw - s, 0)  # noqa: E731
     d = {1: w}
@@ -496,10 +540,14 @@ def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int):
             else:
                 acc_h = acc_h + t if sign > 0 else acc_h - t
         v[h] = acc_h
+    wlanes = w.shape[2]
+    lane_down = lambda x, s: pltpu.roll(x, wlanes - s, 2)  # noqa: E731
+    wsums = _build_lane_wsums(
+        v, [(h, L) for h, _jj, _kk0, L in _lane_runs_3d(eps)], lane_down)
     acc = None
-    for (jj, kk), h in heights.items():
+    for h, jj, kk0, run_len in _lane_runs_3d(eps):
         a = eps - h
-        sl = v[h][a : a + tm, jj : jj + tn, kk : kk + nz]
+        sl = wsums[h, run_len][a : a + tm, jj : jj + tn, kk0 : kk0 + nz]
         acc = sl if acc is None else acc + sl
     return acc
 
@@ -509,9 +557,11 @@ def _fits_3d(tm: int, tn: int, nz: int, eps: int, itemsize: int) -> bool:
     # y window widened to a multiple of 8 (Mosaic block-dim constraint)
     window = (tm + pad) * _round_up(tn + 2 * eps, 8) * (nz + 2 * eps) * itemsize
     out = tm * tn * nz * itemsize
-    n_pairs = len(heights)
+    runs = _lane_runs_3d(eps)
+    lane_slots = _lane_slots({(h, L) for h, _jj, _kk0, L in runs})
     log_steps = max(1, int(np.ceil(np.log2(tm + pad))))
-    stack = (2 * log_steps + 4 + len(parts_by_h)) * window + (2 * n_pairs + 3) * out
+    stack = ((2 * log_steps + 4 + len(parts_by_h) + lane_slots) * window
+             + (2 * len(runs) + 3) * out)
     return stack <= _VMEM_BUDGET
 
 

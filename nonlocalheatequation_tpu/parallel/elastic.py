@@ -147,6 +147,12 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         # jit call per device per step over its (T, nx, ny) tile batch; the
         # general rectangle-walk assembly remains the eps > tile fallback.
         self._use_fused = self.eps <= self.nx and self.eps <= self.ny
+        # gang scheduling: window-free stretches run as ONE SPMD scan over
+        # all devices (parallel/gang.py); numerics are bit-identical to the
+        # per-device batched path.  Opt out for the pure per-step dispatch.
+        self.use_gang = True
+        self._gang = None
+        self._gang_active = False
         self._batched_test = jax.jit(self._make_batched(test=True))
         self._batched_plain = jax.jit(self._make_batched(test=False))
         self._zeros: dict = {}
@@ -509,6 +515,47 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         r = t % self.nbalance
         return (r == 0 and t > 0) or r > self.nbalance - self.measure_window
 
+    # -- gang-scheduled stretches (parallel/gang.py) ------------------------
+    # checkpoint cadence: CheckpointMixin._ckpt_due (shared predicate)
+
+    def _gang_stretch_len(self, t: int, measured: bool) -> int:
+        """#steps from t runnable inside ONE gang scan: stops BEFORE the
+        next measured-window step, and AFTER a step that needs host I/O
+        (logging / checkpoint) so the boundary state can be materialized."""
+        n, step = 0, t
+        while step < self.nt:
+            if measured and self._in_measure_window(step):
+                break
+            n += 1
+            io = ((self.logger is not None and step % self.nlog == 0)
+                  or self._ckpt_due(step)
+                  or self._rebalance_due(step))
+            step += 1
+            if io:
+                break
+        return n
+
+    def _rebalance_due(self, t: int) -> bool:
+        """Rebalance fires after step t (the reference's do_work cadence,
+        src/2d_nonlocal_distributed.cpp:1306-1309; final step skipped)."""
+        return bool(self.nbalance and t % self.nbalance == 0 and t > 0
+                    and t != self.nt - 1 and len(self.devices) > 1)
+
+    def _enter_gang(self):
+        if self._gang_active:
+            return
+        self._materialize()
+        self._gang.rebuild(self._tiles, self._gtiles if self.test else None)
+        self._gang_active = True
+
+    def _leave_gang(self):
+        if not self._gang_active:
+            return
+        self._tiles = self._gang.tiles()
+        if self._use_fused:
+            self._batch_tiles(state_only=True)
+        self._gang_active = False
+
     # -- time loop ----------------------------------------------------------
     def do_work(self) -> np.ndarray:
         self._place_tiles()
@@ -519,7 +566,37 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         measured = self.measure and hasattr(self.telemetry, "record")
         window_len = self.measure_window if self.nbalance else self.nt
         prev_in_window = False
-        for t in range(self.t0, self.nt):
+        self._gang_active = False
+        use_gang = self._use_fused and self.use_gang
+        if use_gang:
+            from nonlocalheatequation_tpu.parallel.gang import GangExecutor
+            self._gang = GangExecutor(self)
+        t = self.t0
+        while t < self.nt:
+            n = self._gang_stretch_len(t, measured) if use_gang else 0
+            if n > 0:
+                # window-free stretch: one SPMD scan over all devices
+                self._enter_gang()
+                self._gang.run_stretch(t, n)
+                last = t + n - 1
+                t += n
+                prev_in_window = False
+                if self._rebalance_due(last):
+                    # model-telemetry mode (no measured windows): the
+                    # rebalance cadence still fires between stretches;
+                    # migration mutates placement, so the gang state must
+                    # be torn down (logging/checkpoints below are read-only
+                    # and gather() serves them from the resident state)
+                    self._leave_gang()
+                    self._rebalance()
+                    if hasattr(self.telemetry, "reset"):
+                        self.telemetry.reset()
+                if self.logger is not None and last % self.nlog == 0:
+                    self.logger(last, self.gather())
+                if self._ckpt_due(last):
+                    self._maybe_checkpoint(last)
+                continue
+            self._leave_gang()
             in_window = measured and self._in_measure_window(t)
             if in_window:
                 self._step_all_measured(t)
@@ -533,8 +610,7 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
             else:
                 self._step_all_overlapped(t)
             prev_in_window = in_window
-            if (self.nbalance and t % self.nbalance == 0 and t > 0
-                    and t != self.nt - 1 and nl > 1):
+            if self._rebalance_due(t):
                 # (a rebalance on the FINAL step would migrate tiles no step
                 # will ever use and reset the telemetry that evidences the
                 # final placement — skip it so end-of-run busy rates always
@@ -547,6 +623,8 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
             if t % self.nlog == 0 and self.logger is not None:
                 self.logger(t, self.gather())
             self._maybe_checkpoint(t)
+            t += 1
+        self._leave_gang()
         self.u = self.gather()
         if self.test:
             self.compute_l2(self.nt)
@@ -555,6 +633,14 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
 
     def gather(self) -> np.ndarray:
         out = np.zeros((self.NX, self.NY), dtype=np.float64)
+        if getattr(self, "_gang_active", False):
+            # read-only snapshot straight from the resident sharded state
+            # (one host transfer; the gang stays entered)
+            for (gx, gy), tile in self._gang.plan.unpack(
+                    self._gang._state).items():
+                out[gx * self.nx:(gx + 1) * self.nx,
+                    gy * self.ny:(gy + 1) * self.ny] = tile
+            return out
         if self._bstate and getattr(self, "_tiles_stale", False):
             # batched path: one host transfer per device, sliced on host
             for d, own in self._order.items():

@@ -1,0 +1,226 @@
+"""Gang-scheduled elastic execution — the fast path for arbitrary placement.
+
+The elastic executor (parallel/elastic.py) dispatches one jitted program per
+device per step from the host; that keeps the reference's per-tile placement
+semantics (src/2d_nonlocal_distributed.cpp:309-335) but pays O(devices) host
+work per timestep and cannot scan across steps.  This module runs the SAME
+tile layout as ONE SPMD program over a 1D device mesh, covering whole
+stretches of steps between measurement windows in a single `lax.scan`:
+
+* state is a (ndev, T_max, nx, ny) slot array sharded over mesh axis 'd' —
+  device d owns slots [d*T_max, (d+1)*T_max); a device with fewer tiles than
+  T_max carries all-zero pad slots,
+* the halo "RPC" becomes one `lax.all_gather` of only the eps-bands of every
+  tile (2*eps*(nx+ny) values per tile, not whole tiles) per step; each tile's
+  3x3 halo is then assembled by a TRACED (T_max, 9) slot-index matrix — the
+  same concatenate order as the per-device batched path, so results are
+  bit-identical to it (and to the serial oracle),
+* migrations permute tiles between slots and rewrite index VALUES; shapes
+  change only when T_max grows, so a rebalance almost never recompiles —
+  this is the reference's flagship scenario (METIS map + --nbalance,
+  src/2d_nonlocal_distributed.cpp:1306-1309) running at SPMD speed.
+
+Used by ElasticSolver2D for every stretch of steps outside a measurement
+window; measured steps keep the serialized per-tile dispatch (a busy-rate
+sample needs per-device wall-clock the fused program cannot expose).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+# 3x3 neighbor offsets in the same order as elastic._OFFSETS
+_OFFSETS = ((-1, -1), (-1, 0), (-1, 1), (0, -1), (0, 0), (0, 1),
+            (1, -1), (1, 0), (1, 1))
+
+
+class GangPlan:
+    """Slot layout + neighbor index matrices for one assignment.
+
+    ``order[d]`` lists device d's tiles (stack order, matching
+    ElasticSolver2D._order); tile (gx, gy) on device d at position j owns
+    global slot d*T_max + j.  ``idx`` is the (ndev, T_max, 9) int32 matrix of
+    neighbor slots (the zero slot S = ndev*T_max marks out-of-domain and pad
+    rows).  T_max is padded up to ``t_max_floor`` so small regrowths after a
+    migration reuse the compiled program.
+    """
+
+    def __init__(self, assignment: np.ndarray, ndev: int,
+                 t_max_floor: int = 0):
+        self.assignment = np.asarray(assignment, dtype=np.int64)
+        npx, npy = self.assignment.shape
+        self.ndev = int(ndev)
+        self.order: dict[int, list] = {d: [] for d in range(self.ndev)}
+        slot_of: dict[tuple[int, int], int] = {}
+        for (gx, gy), owner in np.ndenumerate(self.assignment):
+            self.order[int(owner)].append((gx, gy))
+        self.t_max = max(
+            max((len(o) for o in self.order.values()), default=1),
+            int(t_max_floor), 1)
+        for d, own in self.order.items():
+            for j, key in enumerate(own):
+                slot_of[key] = d * self.t_max + j
+        self.zero_slot = self.ndev * self.t_max
+        idx = np.full((self.ndev, self.t_max, 9), self.zero_slot,
+                      dtype=np.int32)
+        for d, own in self.order.items():
+            for j, (gx, gy) in enumerate(own):
+                for b, (dx, dy) in enumerate(_OFFSETS):
+                    key = (gx + dx, gy + dy)
+                    if 0 <= key[0] < npx and 0 <= key[1] < npy:
+                        idx[d, j, b] = slot_of[key]
+        self.idx = idx
+        self.slot_of = slot_of
+
+    def pack(self, tiles: dict, nx: int, ny: int, dtype) -> np.ndarray:
+        """(ndev, T_max, nx, ny) slot array from a (gx, gy) -> array dict."""
+        out = np.zeros((self.ndev, self.t_max, nx, ny), dtype=dtype)
+        for d, own in self.order.items():
+            for j, key in enumerate(own):
+                out[d, j] = np.asarray(tiles[key])
+        return out
+
+    def unpack(self, state) -> dict:
+        """Back to the per-tile dict (host-side; used at stretch boundaries)."""
+        arr = np.asarray(state)
+        return {key: arr[d, j]
+                for d, own in self.order.items()
+                for j, key in enumerate(own)}
+
+
+def make_gang_run(op, mesh: Mesh, t_max: int, nx: int, ny: int,
+                  test: bool, dtype):
+    """One jitted SPMD program advancing every tile a traced ``nsteps``.
+
+    (state, idx [, g, lg], t0, nsteps) -> state after nsteps.  ``state`` and
+    ``idx`` are sharded over mesh axis 'd'; ``idx`` AND ``nsteps`` are
+    traced (fori_loop), so neither a migration that keeps T_max nor a
+    different stretch length recompiles — one compile covers the whole run.
+    """
+    e = op.eps
+    if e > nx or e > ny:
+        raise ValueError("gang path requires eps <= tile edge")
+    S = len(mesh.devices.ravel()) * t_max
+
+    def local_step(own, idx, *rest):
+        # own: (T_max, nx, ny) this device's slots; idx: (T_max, 9)
+        # bands of every tile, gathered once per step (the halo exchange)
+        top_all = lax.all_gather(own[:, :e, :], "d", axis=0, tiled=True)
+        bot_all = lax.all_gather(own[:, -e:, :], "d", axis=0, tiled=True)
+        left_all = lax.all_gather(own[:, :, :e], "d", axis=0, tiled=True)
+        right_all = lax.all_gather(own[:, :, -e:], "d", axis=0, tiled=True)
+        zt = jnp.zeros((1, e, ny), dtype)
+        zlr = jnp.zeros((1, nx, e), dtype)
+        top_all = jnp.concatenate([top_all, zt])
+        bot_all = jnp.concatenate([bot_all, zt])
+        left_all = jnp.concatenate([left_all, zlr])
+        right_all = jnp.concatenate([right_all, zlr])
+        # identical assembly order to elastic's batched bstep -> identical bits
+        top = jnp.concatenate(
+            [bot_all[idx[:, 0]][:, :, -e:], bot_all[idx[:, 1]],
+             bot_all[idx[:, 2]][:, :, :e]], axis=2)
+        mid = jnp.concatenate(
+            [right_all[idx[:, 3]], own, left_all[idx[:, 5]]], axis=2)
+        bot = jnp.concatenate(
+            [top_all[idx[:, 6]][:, :, -e:], top_all[idx[:, 7]],
+             top_all[idx[:, 8]][:, :, :e]], axis=2)
+        upad = jnp.concatenate([top, mid, bot], axis=1)
+        du = jax.vmap(op.apply_padded)(upad)
+        if test:
+            from nonlocalheatequation_tpu.ops.nonlocal_op import source_at
+            g, lg, t = rest
+            du = du + source_at(g, lg, t, op.dt)
+        else:
+            (t,) = rest
+        return own + jnp.asarray(op.dt, dtype) * du
+
+    spec = P("d")
+    in_specs = [spec, spec] + ([spec, spec] if test else []) + [P()]
+    vma_ok = op.method != "pallas" or jax.default_backend() == "tpu"
+    sharded_step = shard_map(
+        lambda own, idx, *rest: local_step(own[0], idx[0], *[
+            r[0] if i < (2 if test else 0) else r for i, r in enumerate(rest)
+        ])[None],
+        mesh=mesh, in_specs=tuple(in_specs), out_specs=spec,
+        check_vma=vma_ok)
+
+    @jax.jit
+    def run(state, idx, *rest):
+        if test:
+            g, lg, t0, nsteps = rest
+            def body(i, carry):
+                return sharded_step(carry, idx, g, lg, t0 + i)
+        else:
+            (t0, nsteps) = rest
+            def body(i, carry):
+                return sharded_step(carry, idx, t0 + i)
+        return lax.fori_loop(0, nsteps, body, state)
+
+    del S
+    return run
+
+
+class GangExecutor:
+    """Holds the sharded state + compiled runs for an ElasticSolver2D.
+
+    The solver calls ``run_stretch`` for every window-free stretch; ``sync``
+    materializes back to the solver's per-tile dict at stretch boundaries
+    (windows, logging, checkpoints, migration).
+    """
+
+    def __init__(self, solver):
+        self.s = solver
+        self.mesh = Mesh(np.asarray(solver.devices), ("d",))
+        self.plan: GangPlan | None = None
+        self._runs: dict[int, object] = {}
+        self._state = None
+        self._g = self._lg = None
+
+    def _sharding(self):
+        return NamedSharding(self.mesh, P("d"))
+
+    def rebuild(self, tiles: dict, gtiles: dict | None):
+        """(Re)pack the sharded state from the per-tile dict."""
+        s = self.s
+        floor = self.plan.t_max if self.plan is not None else 0
+        plan = GangPlan(s.assignment, len(s.devices), t_max_floor=floor)
+        if self.plan is None or plan.t_max != self.plan.t_max:
+            self._runs = {}  # pool height changed -> programs stale
+        self.plan = plan
+        sh = self._sharding()
+        np_dtype = np.dtype(s.dtype)
+        self._state = jax.device_put(
+            plan.pack(tiles, s.nx, s.ny, np_dtype), sh)
+        self._idx = jax.device_put(plan.idx, sh)
+        if s.test and gtiles is not None:
+            g = {k: v[0] for k, v in gtiles.items()}
+            lg = {k: v[1] for k, v in gtiles.items()}
+            self._g = jax.device_put(plan.pack(g, s.nx, s.ny, np_dtype), sh)
+            self._lg = jax.device_put(plan.pack(lg, s.nx, s.ny, np_dtype), sh)
+
+    def run_stretch(self, t0: int, nsteps: int) -> None:
+        s = self.s
+        key = bool(s.test)
+        if key not in self._runs:
+            self._runs[key] = make_gang_run(
+                s.op, self.mesh, self.plan.t_max, s.nx, s.ny,
+                s.test, s.dtype)
+        run = self._runs[key]
+        t, n = jnp.int32(t0), jnp.int32(nsteps)
+        if s.test:
+            self._state = run(self._state, self._idx, self._g, self._lg, t, n)
+        else:
+            self._state = run(self._state, self._idx, t, n)
+
+    def tiles(self) -> dict:
+        """Materialize the per-tile dict (host transfer)."""
+        return {k: jnp.asarray(v) for k, v in
+                self.plan.unpack(self._state).items()}

@@ -107,9 +107,14 @@ class CheckpointMixin:
         self.u0 = np.asarray(u, dtype=np.float64)
         self.t0 = t
 
+    def _ckpt_due(self, t: int) -> bool:
+        """Single source of the checkpoint cadence (schedulers break their
+        fused stretches at these steps)."""
+        return bool(self.checkpoint_path and self.ncheckpoint
+                    and (t + 1) % self.ncheckpoint == 0)
+
     def _maybe_checkpoint(self, t: int, u=None) -> None:
-        if (self.checkpoint_path and self.ncheckpoint
-                and (t + 1) % self.ncheckpoint == 0):
+        if self._ckpt_due(t):
             state = np.asarray(u) if u is not None else self.gather()
             save_state(self.checkpoint_path, state, t + 1, self._ckpt_params())
 

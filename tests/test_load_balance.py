@@ -263,11 +263,42 @@ def test_windowed_measurement_overlaps_nonwindow_steps():
 
     s = Probe(4, 4, 4, 4, nt=20, eps=2, nbalance=10, measure_window=3,
               k=0.2, dt=0.0005, dh=0.02)
+    s.use_gang = False  # probe the per-device dispatch path (gang fallback)
     s.test_init()
     s.do_work()
     # windows (nbalance=10, W=3): {8,9,10} and {18,19} within t<20
     assert calls["measured"] == 5, calls
     assert calls["overlapped"] == 15, calls
+    assert s.error_l2 / (16 * 16) <= 1e-6
+
+
+def test_gang_covers_nonwindow_steps_with_zero_host_dispatch():
+    """Round 3: with gang scheduling (the default), every non-window step
+    runs inside a fused SPMD scan — no per-device or per-tile host dispatch
+    outside the measurement windows."""
+    calls = {"measured": 0, "overlapped": 0, "batched": 0, "stretches": []}
+
+    class Probe(ElasticSolver2D):
+        def _step_all_measured(self, t):
+            calls["measured"] += 1
+            return super()._step_all_measured(t)
+
+        def _step_all_overlapped(self, t):
+            calls["overlapped"] += 1
+            return super()._step_all_overlapped(t)
+
+        def _step_device_batched(self, d, t):
+            calls["batched"] += 1
+            return super()._step_device_batched(d, t)
+
+    s = Probe(4, 4, 4, 4, nt=20, eps=2, nbalance=10, measure_window=3,
+              k=0.2, dt=0.0005, dh=0.02)
+    s.test_init()
+    s.do_work()
+    # measured windows unchanged; the other 15 steps ran in gang stretches
+    assert calls["measured"] == 5, calls
+    assert calls["overlapped"] == 0, calls
+    assert calls["batched"] == 0, calls
     assert s.error_l2 / (16 * 16) <= 1e-6
 
 
@@ -288,6 +319,7 @@ def test_batched_dispatch_one_call_per_device_per_step():
     ndev = min(2, len(jax.devices()))
     s = Probe(4, 4, 4, 4, nt=10, eps=2, k=0.2, dt=0.0005, dh=0.02,
               devices=jax.devices()[:ndev])
+    s.use_gang = False  # probe the per-device dispatch path (gang fallback)
     s.test_init()
     s.do_work()
     assert calls["tile"] == 0, calls  # no per-tile dispatch on this path

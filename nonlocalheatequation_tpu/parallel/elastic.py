@@ -568,7 +568,9 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         prev_in_window = False
         self._gang_active = False
         use_gang = self._use_fused and self.use_gang
-        if use_gang:
+        if use_gang and self._gang is None:
+            # created once per solver: the jitted runs close over op/t_max
+            # only, so repeated do_work calls reuse the compiled programs
             from nonlocalheatequation_tpu.parallel.gang import GangExecutor
             self._gang = GangExecutor(self)
         t = self.t0

@@ -37,9 +37,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-# 3x3 neighbor offsets in the same order as elastic._OFFSETS
-_OFFSETS = ((-1, -1), (-1, 0), (-1, 1), (0, -1), (0, 0), (0, 1),
-            (1, -1), (1, 0), (1, 1))
+# the assembly-order contract: gang halo assembly must mirror the batched
+# bstep band-for-band (the bit-identical guarantee), so share its offsets
+from nonlocalheatequation_tpu.parallel.elastic import _OFFSETS
 
 
 class GangPlan:
@@ -78,7 +78,6 @@ class GangPlan:
                     if 0 <= key[0] < npx and 0 <= key[1] < npy:
                         idx[d, j, b] = slot_of[key]
         self.idx = idx
-        self.slot_of = slot_of
 
     def pack(self, tiles: dict, nx: int, ny: int, dtype) -> np.ndarray:
         """(ndev, T_max, nx, ny) slot array from a (gx, gy) -> array dict."""
@@ -108,7 +107,6 @@ def make_gang_run(op, mesh: Mesh, t_max: int, nx: int, ny: int,
     e = op.eps
     if e > nx or e > ny:
         raise ValueError("gang path requires eps <= tile edge")
-    S = len(mesh.devices.ravel()) * t_max
 
     def local_step(own, idx, *rest):
         # own: (T_max, nx, ny) this device's slots; idx: (T_max, 9)
@@ -164,7 +162,6 @@ def make_gang_run(op, mesh: Mesh, t_max: int, nx: int, ny: int,
                 return sharded_step(carry, idx, t0 + i)
         return lax.fori_loop(0, nsteps, body, state)
 
-    del S
     return run
 
 
@@ -221,6 +218,8 @@ class GangExecutor:
             self._state = run(self._state, self._idx, t, n)
 
     def tiles(self) -> dict:
-        """Materialize the per-tile dict (host transfer)."""
-        return {k: jnp.asarray(v) for k, v in
-                self.plan.unpack(self._state).items()}
+        """Materialize the per-tile dict: one host transfer, then each tile
+        placed directly on its owner (no hop through the default device)."""
+        s = self.s
+        return {k: jax.device_put(v, s.devices[int(s.assignment[k])])
+                for k, v in self.plan.unpack(self._state).items()}

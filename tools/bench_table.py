@@ -217,22 +217,24 @@ def bench_elastic(steps: int):
     # elastic side: same grid, 8x8 tiles, overlapped batched dispatch
     # (do_work includes tile placement; amortized over the steps, as the
     # reference's do_work includes its dataflow construction)
-    e = ElasticSolver2D(n // ntiles, n // ntiles, ntiles, ntiles, nt=steps,
-                        eps=8, k=1.0, dt=1e-7, dh=1.0 / n, method=method,
-                        nlog=10 ** 9, dtype=jnp.float32)
-    e.input_init(u0)
-    t0 = time.perf_counter()
-    e.do_work()
-    log(f"    elastic compile+first: {time.perf_counter() - t0:.2f}s")
-    best = float("inf")
-    for _ in range(3):
+    for label, gang in (("2d/elastic", True), ("2d/elastic/perdevice", False)):
+        e = ElasticSolver2D(n // ntiles, n // ntiles, ntiles, ntiles,
+                            nt=steps, eps=8, k=1.0, dt=1e-7, dh=1.0 / n,
+                            method=method, nlog=10 ** 9, dtype=jnp.float32)
+        e.use_gang = gang
+        e.input_init(u0)
         t0 = time.perf_counter()
         e.do_work()
-        best = min(best, time.perf_counter() - t0)
-    emit("2d/elastic", n * n, steps, best, grid=n, eps=8, tiles=ntiles * ntiles,
-         devices=len(jax.devices()),
-         spmd_ms_per_step=spmd_sec / steps * 1e3,
-         elastic_over_spmd=best / spmd_sec)
+        log(f"    {label} compile+first: {time.perf_counter() - t0:.2f}s")
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            e.do_work()
+            best = min(best, time.perf_counter() - t0)
+        emit(label, n * n, steps, best, grid=n, eps=8,
+             tiles=ntiles * ntiles, devices=len(jax.devices()),
+             spmd_ms_per_step=spmd_sec / steps * 1e3,
+             elastic_over_spmd=best / spmd_sec)
 
 
 BENCHES = {

@@ -506,7 +506,17 @@ def child_measure():
             probe = NonlocalOp2D(EPS, k=1.0, dt=1.0, dh=1.0 / grid, method=method)
             dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
             op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / grid, method=method)
-            multi = make_multi_step_fn(op, steps)
+            if method == "pallas" and os.environ.get("BENCH_CARRIED") == "1":
+                # opt-in: halo-padded state carried across the scan (skips
+                # the per-step pad round-trip); bit-identical to the
+                # per-step path (tests/test_pallas.py)
+                from nonlocalheatequation_tpu.ops.pallas_kernel import (
+                    make_carried_multi_step_fn,
+                )
+
+                multi = make_carried_multi_step_fn(op, steps)
+            else:
+                multi = make_multi_step_fn(op, steps)
             u = jnp.asarray(rng.normal(size=(grid, grid)), jnp.float32)
 
             t0 = time.perf_counter()

@@ -46,6 +46,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -258,12 +259,14 @@ def _lane_runs(eps: int):
     return tuple(runs)
 
 
-def _strip_neighbor_sum(w, tm: int, ny: int, eps: int):
+def _strip_neighbor_sum(w, tm: int, ny: int, eps: int, row0: int | None = None):
     """Masked-circle neighbor sum for one strip.
 
     ``w`` is the (tm + pad, ny + 2*eps) window whose row r holds padded row
     ``strip_start + r``; returns the (tm, ny) sum over the eps-ball centered
-    at each of the strip's points.
+    at each of the strip's points.  ``row0`` is the window row holding the
+    strip's first center (default eps; the carried-frame kernel passes its
+    dead-band offset D).
 
     All rolls are downward (row r reads rows >= r), so wrap-around garbage
     lands only in the bottom ``pad`` rows, which are never read — no masking
@@ -300,9 +303,11 @@ def _strip_neighbor_sum(w, tm: int, ny: int, eps: int):
     lane_down = lambda x, s: pltpu.roll(x, wlanes - s, 1)  # noqa: E731
     wsums = _build_lane_wsums(
         v, [(h, L) for h, _j0, L in _lane_runs(eps)], lane_down)
+    if row0 is None:
+        row0 = eps
     acc = None
     for h, j0, run_len in _lane_runs(eps):
-        a = eps - h
+        a = row0 - h
         sl = wsums[h, run_len][a : a + tm, j0 : j0 + ny]
         acc = sl if acc is None else acc + sl
     return acc
@@ -654,6 +659,107 @@ def build_neighbor_sum_3d(eps: int, nx: int, ny: int, nz: int, dtype_name: str):
         return out[:nx, :ny]
 
     return neighbor_sum
+
+
+@functools.lru_cache(maxsize=None)
+def _build_carried_kernel(eps: int, nx: int, ny: int, dtype_name: str,
+                          c: float, dh: float, dt: float, wsum: float):
+    """Multi-step kernel that CARRIES the halo-padded state across steps.
+
+    The per-step path pays a `jnp.pad` round-trip (read + write the whole
+    grid) every step just to re-glue the zero halo.  Here the state lives in
+    a (Rc, ny+2*eps) frame — a dead band of D = round_up(eps, 8) rows, the
+    eps halo, the real rows, and the chain pad — and every step is one
+    pallas_call that reads windows of buffer A and writes (aliased, in
+    place) into buffer B; ping-ponging (A, B) avoids the in-place stencil
+    hazard.  Halo rows/lanes are re-zeroed by an iota mask in-kernel;
+    unwritten regions keep their (zero) contents through the aliased donate.
+    Out-block row offsets use the (i*(tm//8) + D//8)*8 form because
+    Mosaic's divisibility prover rejects the equivalent i*tm + D.
+
+    Numerics are IDENTICAL to the per-step kernel (same plan, same
+    summation order); only the frame bookkeeping differs.  Production
+    (source-free) path only — the timed bench rungs.
+    """
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    tm = _choose_tm(nx, ny, eps, dtype.itemsize, n_aux=0)
+    D = _round_up(eps, 8)
+    tmw = tm + _round_up((D - eps) + _window_pad(eps), 8)
+    Lc = ny + 2 * eps
+    G = -(-(nx + 2 * eps) // tm)  # out rows [D, D+G*tm) cover halo+real
+    Rc = max(D + G * tm, (G - 1) * tm + tmw)
+    scale = c * dh * dh
+
+    def kernel(win_ref, dst_ref, out_ref):
+        del dst_ref  # alias target; present only to pin the output buffer
+        w = win_ref[:]
+        acc = _strip_neighbor_sum(w, tm, ny, eps, row0=D)
+        center = w[D : D + tm, eps : eps + ny]
+        du = scale * (acc - wsum * center)
+        nxt = center + dt * du
+        i = pl.program_id(0)
+        rows = D + i * tm + jax.lax.broadcasted_iota(jnp.int32, (tm, ny), 0)
+        ok = (rows >= D + eps) & (rows < D + eps + nx)
+        out_ref[:, eps : eps + ny] = jnp.where(ok, nxt, 0).astype(dtype)
+        out_ref[:, :eps] = jnp.zeros((tm, eps), dtype)
+        out_ref[:, eps + ny :] = jnp.zeros((tm, eps), dtype)
+
+    def step(A, B):
+        return pl.pallas_call(
+            kernel,
+            grid=(G,),
+            in_specs=[
+                pl.BlockSpec(
+                    (pl.Element(tmw), pl.Element(Lc)),
+                    lambda i: (i * tm, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(
+                (pl.Element(tm), pl.Element(Lc)),
+                lambda i: ((i * (tm // 8) + D // 8) * 8, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((Rc, Lc), dtype),
+            input_output_aliases={1: 0},
+            **_kernel_params(),
+        )(A, B)
+
+    return step, Rc, Lc, D
+
+
+def make_carried_multi_step_fn(op, nsteps: int, dtype=None):
+    """(u, t0) -> u after ``nsteps`` steps, state carried in padded form.
+
+    Drop-in for ops.nonlocal_op.make_multi_step_fn on the production
+    (source-free) path when op.method == 'pallas'; see
+    _build_carried_kernel.  The t0 argument is accepted for signature
+    parity (the uniform-J production step is time-independent).
+    """
+    eps = op.eps
+
+    @jax.jit
+    def multi(u, t0):
+        del t0
+        dt_ = dtype or u.dtype
+        nx, ny = u.shape
+        step, Rc, Lc, D = _build_carried_kernel(
+            eps, nx, ny, jnp.dtype(dt_).name, op.c, op.dh, op.dt, op.wsum)
+        C0 = (jnp.zeros((Rc, Lc), dt_)
+              .at[D + eps : D + eps + nx, eps : eps + ny]
+              .set(u.astype(dt_)))
+        C1 = jnp.zeros((Rc, Lc), dt_)
+
+        def body(carry, _):
+            A, B = carry
+            return (step(A, B), A), None
+
+        (A, _B), _ = lax.scan(body, (C0, C1), None, length=nsteps)
+        return A[D + eps : D + eps + nx, eps : eps + ny]
+
+    return multi
 
 
 def make_pallas_step_fn(op, g=None, lg=None, dtype=None):

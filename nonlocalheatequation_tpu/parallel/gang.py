@@ -5,7 +5,8 @@ device per step from the host; that keeps the reference's per-tile placement
 semantics (src/2d_nonlocal_distributed.cpp:309-335) but pays O(devices) host
 work per timestep and cannot scan across steps.  This module runs the SAME
 tile layout as ONE SPMD program over a 1D device mesh, covering whole
-stretches of steps between measurement windows in a single `lax.scan`:
+stretches of steps between measurement windows in a single traced-length
+`lax.fori_loop` (one compile serves every stretch length):
 
 * state is a (ndev, T_max, nx, ny) slot array sharded over mesh axis 'd' —
   device d owns slots [d*T_max, (d+1)*T_max); a device with fewer tiles than
@@ -95,8 +96,7 @@ class GangPlan:
                 for j, key in enumerate(own)}
 
 
-def make_gang_run(op, mesh: Mesh, t_max: int, nx: int, ny: int,
-                  test: bool, dtype):
+def make_gang_run(op, mesh: Mesh, nx: int, ny: int, test: bool, dtype):
     """One jitted SPMD program advancing every tile a traced ``nsteps``.
 
     (state, idx [, g, lg], t0, nsteps) -> state after nsteps.  ``state`` and
@@ -208,8 +208,7 @@ class GangExecutor:
         key = bool(s.test)
         if key not in self._runs:
             self._runs[key] = make_gang_run(
-                s.op, self.mesh, self.plan.t_max, s.nx, s.ny,
-                s.test, s.dtype)
+                s.op, self.mesh, s.nx, s.ny, s.test, s.dtype)
         run = self._runs[key]
         t, n = jnp.int32(t0), jnp.int32(nsteps)
         if s.test:

@@ -115,6 +115,18 @@ def test_gang_stretch_lengths_cover_plain_steps():
     assert s._gang_stretch_len(6, True) == 2  # 6,7; 8 starts the window
 
 
+def test_gang_disabled_when_eps_exceeds_tile():
+    """eps > tile edge cannot use band assembly; the general rectangle-walk
+    path takes over transparently with use_gang still set."""
+    s = _run(True, nx=4, ny=4, npx=5, npy=5, nt=8, eps=6, nlog=1000,
+             dh=0.05)
+    assert s._gang is None  # never constructed: _use_fused gates it
+    o = Solver2D(20, 20, 8, eps=6, k=1.0, dt=1e-5, dh=0.05, backend="oracle")
+    o.test_init()
+    o.do_work()
+    assert np.abs(s.u - o.u).max() < 1e-12
+
+
 def test_gang_checkpoint_resume_bit_identical(tmp_path):
     """Interrupted gang run resumes bit-for-bit (checkpoint barriers
     materialize the sharded state at the right steps)."""

@@ -222,3 +222,28 @@ def test_auto_method_end_to_end_solve():
     ua, ub = a.do_work(), b.do_work()
     assert np.array_equal(ua, ub)
     assert a.error_l2 / 2500 <= 1e-6
+
+
+def test_carried_multi_step_bit_identical():
+    """The carried-frame multi-step kernel (bench fast path) must be
+    BIT-identical to the per-step pad+kernel path: same plan, same
+    summation order, only frame bookkeeping differs."""
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp2D,
+        make_multi_step_fn,
+    )
+    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        make_carried_multi_step_fn,
+    )
+
+    rng = np.random.default_rng(3)
+    for n, eps, steps in [(64, 5, 4), (40, 3, 3), (48, 12, 2)]:
+        op = NonlocalOp2D(eps, k=1.0, dt=1e-6, dh=1.0 / n, method="pallas")
+        ref = make_multi_step_fn(op, steps, dtype=jnp.float32)
+        new = make_carried_multi_step_fn(op, steps, dtype=jnp.float32)
+        u = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
+        a = np.asarray(ref(u, jnp.int32(0)))
+        b = np.asarray(new(u, jnp.int32(0)))
+        assert np.array_equal(a, b), (n, eps, np.abs(a - b).max())

@@ -97,16 +97,21 @@ def _fits(tm: int, ny: int, eps: int, itemsize: int, n_aux: int) -> bool:
     return stack <= _VMEM_BUDGET
 
 
-def _choose_tm(nx: int, ny: int, eps: int, itemsize: int, n_aux: int) -> int:
+def _choose_tm(nx: int, ny: int, eps: int, itemsize: int, n_aux: int,
+               fits=None) -> int:
     """Largest strip height (multiple of 8) whose stack footprint fits VMEM.
 
     Prefers a strip height that divides nx so the output needs no final
-    slice-copy (nxp == nx) and every strip carries real rows.
+    slice-copy (nxp == nx) and every strip carries real rows.  ``fits``
+    overrides the stack model (the carried-frame kernel has a taller
+    window and a full-lane-width output).
     """
+    if fits is None:
+        fits = lambda tm: _fits(tm, ny, eps, itemsize, n_aux)  # noqa: E731
     cap = min(256, _round_up(nx, 8))
-    while cap > 8 and not _fits(cap, ny, eps, itemsize, n_aux):
+    while cap > 8 and not fits(cap):
         cap -= 8
-    if not _fits(cap, ny, eps, itemsize, n_aux):
+    if not fits(cap):
         # even the minimum 8-row strip overflows the VMEM budget: ny is too
         # wide for this kernel's whole-row window layout.  Fail loudly here
         # instead of letting Mosaic die with an opaque allocation error.
@@ -120,6 +125,20 @@ def _choose_tm(nx: int, ny: int, eps: int, itemsize: int, n_aux: int) -> int:
         if nx % tm == 0:
             return tm
     return max(cap, 8)
+
+
+def _fits_carried(tm: int, nx: int, ny: int, eps: int, itemsize: int) -> bool:
+    """_fits for the carried frame: window is (D - eps) rows taller (rounded
+    to 8) and the output block spans the full Lc = ny + 2*eps lanes."""
+    D = _round_up(eps, 8)
+    tmw = tm + _round_up((D - eps) + _window_pad(eps), 8)
+    Lc = ny + 2 * eps
+    window = tmw * Lc * itemsize
+    out = tm * Lc * itemsize
+    log_steps = max(1, int(np.ceil(np.log2(tmw))))
+    lane_slots = _lane_slots({(h, L) for h, _j0, L in _lane_runs(eps)})
+    stack = (2 * log_steps + 6 + lane_slots) * window + 3 * out
+    return stack <= _VMEM_BUDGET
 
 
 def _chain_steps(run_len: int) -> int:
@@ -683,7 +702,9 @@ def _build_carried_kernel(eps: int, nx: int, ny: int, dtype_name: str,
     """
     dtype = jnp.dtype(dtype_name)
     _reject_f64_on_tpu(dtype)
-    tm = _choose_tm(nx, ny, eps, dtype.itemsize, n_aux=0)
+    tm = _choose_tm(
+        nx, ny, eps, dtype.itemsize, n_aux=0,
+        fits=lambda t: _fits_carried(t, nx, ny, eps, dtype.itemsize))
     D = _round_up(eps, 8)
     tmw = tm + _round_up((D - eps) + _window_pad(eps), 8)
     Lc = ny + 2 * eps

@@ -265,20 +265,31 @@ class ShardedUnstructuredOp:
     TPU-first layout: nodes are partitioned into equal contiguous index
     blocks over a 1D device mesh (axis ``p``); the edge list is partitioned
     by TARGET-node shard (so every scatter-add is device-local) and padded to
-    the max per-shard edge count (static shapes for XLA).  Each step
-    all-gathers the node state over ICI — the unstructured analog of the
-    grid halo exchange; with an arbitrary node ordering the needed remote
-    set is unbounded, so the gather is the honest general formulation (a
-    locality-preserving node ordering from utils/decompose.py shrinks it to
-    near-boundary nodes, a future specialization) — then runs one
-    ``segment_sum`` per shard into the local block.
+    the max per-shard edge count (static shapes for XLA).
+
+    The halo has two forms (``halo=`` "auto"/"export"/"gather"):
+
+    * **export** — each shard exports only the nodes some other shard's
+      edges actually reference (precomputed index sets); one all_gather of
+      the (S, Emax) export blocks replaces the full-state gather, cutting
+      per-step comm from S*B to S*Emax values.  With a locality-preserving
+      node ordering (grids, utils/decompose.py output) the exports are just
+      the near-boundary nodes — the true unstructured halo.
+    * **gather** — all-gather the whole state: the honest general form for
+      adversarial orderings where everything is referenced everywhere.
+
+    "auto" picks export when the export volume is under half the full
+    gather (``halo_comm_ratio``); both forms are BIT-identical (same edge
+    order, same addends — only where the source value is read from
+    differs).
 
     Numerics match the single-device operator to float-addition order:
     partitioning by target preserves each target's edge order, so per-segment
     accumulation sums the same values in the same sequence.
     """
 
-    def __init__(self, op: UnstructuredNonlocalOp, mesh=None, devices=None):
+    def __init__(self, op: UnstructuredNonlocalOp, mesh=None, devices=None,
+                 halo: str = "auto"):
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         self.inner = op
@@ -308,6 +319,41 @@ class ShardedUnstructuredOp:
             src_g[s, :c] = op.src[m]
             w[s, :c] = op.edge_w[m]  # padding keeps w == 0 -> contributes 0
 
+        # export sets: nodes of shard r referenced by some OTHER shard
+        exports = []
+        for r in range(S):
+            remote = (op.src // B == r) & (shard_of != r)
+            exports.append(np.unique(op.src[remote]))
+        Emax = max(1, max(len(e) for e in exports))
+        export_volume = S * Emax
+        self.halo_comm_ratio = export_volume / float(S * B)
+        if halo not in ("auto", "export", "gather"):
+            raise ValueError(f"halo must be auto/export/gather, got {halo!r}")
+        if halo == "auto":
+            halo = "export" if (S > 1 and 2 * export_volume <= S * B) else "gather"
+        self.halo_mode = halo
+
+        if halo == "export":
+            exp_idx = np.zeros((S, Emax), np.int32)
+            # global node id -> slot in its owner's export block (vectorized)
+            slot = np.zeros(S * B, np.int64)
+            for r, e in enumerate(exports):
+                exp_idx[r, : len(e)] = e - r * B
+                slot[e] = np.arange(len(e))
+            # remap src into the concatenated [own B | gathered S*Emax] frame
+            src_cat = np.zeros((S, M), np.int32)
+            for s in range(S):
+                m = shard_of == s
+                c = int(m.sum())
+                srcs = op.src[m]
+                owner = srcs // B
+                local = srcs - s * B
+                remote = B + owner * Emax + slot[srcs]
+                src_cat[s, :c] = np.where(owner == s, local, remote)
+            self._exp_idx = None  # set below with sharding
+        else:
+            exp_idx = src_cat = None
+
         def blk(x):  # (n,) host array -> (S, B) with zero padding
             xp = np.zeros(S * B, np.float64)
             xp[: op.n] = x
@@ -315,16 +361,19 @@ class ShardedUnstructuredOp:
 
         row = NamedSharding(mesh, P("p"))
         self._tgt = jax.device_put(jnp.asarray(tgt_l), row)
-        self._src = jax.device_put(jnp.asarray(src_g), row)
+        self._src = jax.device_put(
+            jnp.asarray(src_cat if halo == "export" else src_g), row)
         self._w = jax.device_put(jnp.asarray(w), row)
         self._c = jax.device_put(jnp.asarray(blk(op.c)), row)
         self._wsum = jax.device_put(jnp.asarray(blk(op.wsum)), row)
+        if halo == "export":
+            self._exp_idx = jax.device_put(jnp.asarray(exp_idx), row)
 
         from jax import shard_map
 
         B_ = B
 
-        def local_apply(u_blk, tgt, src, w_, c_, wsum_):
+        def local_apply_gather(u_blk, tgt, src, w_, c_, wsum_):
             # u_blk: (1, B) block of the padded state; gather the full state
             u_all = jax.lax.all_gather(u_blk[0], "p", tiled=True)  # (S*B,)
             acc = jax.ops.segment_sum(
@@ -332,11 +381,27 @@ class ShardedUnstructuredOp:
             )
             return (c_[0] * (acc - wsum_[0] * u_blk[0]))[None]
 
+        def local_apply_export(u_blk, exp, tgt, src, w_, c_, wsum_):
+            mine = u_blk[0]
+            gathered = jax.lax.all_gather(
+                mine[exp[0]], "p", tiled=True)  # (S*Emax,)
+            u_cat = jnp.concatenate([mine, gathered])
+            acc = jax.ops.segment_sum(
+                w_[0] * u_cat[src[0]], tgt[0], num_segments=B_
+            )
+            return (c_[0] * (acc - wsum_[0] * mine))[None]
+
         p = P("p")
-        self._sharded = shard_map(
-            local_apply, mesh=mesh,
-            in_specs=(p, p, p, p, p, p), out_specs=p,
-        )
+        if halo == "export":
+            self._sharded = shard_map(
+                local_apply_export, mesh=mesh,
+                in_specs=(p, p, p, p, p, p, p), out_specs=p,
+            )
+        else:
+            self._sharded = shard_map(
+                local_apply_gather, mesh=mesh,
+                in_specs=(p, p, p, p, p, p), out_specs=p,
+            )
 
     # duck-type the single-device operator's surface
     def apply_np(self, u):
@@ -353,8 +418,12 @@ class ShardedUnstructuredOp:
 
     def apply(self, u: jnp.ndarray) -> jnp.ndarray:
         up = jnp.pad(u, (0, self.pad)).reshape(self.S, self.B)
-        out = self._sharded(up, self._tgt, self._src, self._w,
-                            self._c, self._wsum)
+        if self.halo_mode == "export":
+            out = self._sharded(up, self._exp_idx, self._tgt, self._src,
+                                self._w, self._c, self._wsum)
+        else:
+            out = self._sharded(up, self._tgt, self._src, self._w,
+                                self._c, self._wsum)
         return out.reshape(self.S * self.B)[: self.n]
 
 

@@ -569,8 +569,8 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         self._gang_active = False
         use_gang = self._use_fused and self.use_gang
         if use_gang and self._gang is None:
-            # created once per solver: the jitted runs close over op/t_max
-            # only, so repeated do_work calls reuse the compiled programs
+            # created once per solver: jit keys on shapes, so repeated
+            # do_work calls (and T_max changes) reuse/retrace automatically
             from nonlocalheatequation_tpu.parallel.gang import GangExecutor
             self._gang = GangExecutor(self)
         t = self.t0

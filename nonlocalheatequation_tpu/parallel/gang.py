@@ -189,8 +189,8 @@ class GangExecutor:
         s = self.s
         floor = self.plan.t_max if self.plan is not None else 0
         plan = GangPlan(s.assignment, len(s.devices), t_max_floor=floor)
-        if self.plan is None or plan.t_max != self.plan.t_max:
-            self._runs = {}  # pool height changed -> programs stale
+        # (no _runs invalidation needed: jit keys on shapes, so a T_max
+        # change simply retraces the same run function)
         self.plan = plan
         sh = self._sharding()
         np_dtype = np.dtype(s.dtype)

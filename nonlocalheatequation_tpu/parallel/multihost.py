@@ -1,0 +1,137 @@
+"""Multi-host (multi-process) execution — the reference's multi-locality run.
+
+The reference scales across nodes by launching one HPX locality per host
+(``srun -n 4 ... --file data_4.txt``, README.md:64-72) and letting AGAS +
+the parcelport move tiles and halos.  The TPU-native equivalent is JAX
+multi-controller SPMD: ONE Python process per host, every process running
+the SAME program, with `jax.distributed.initialize` wiring the processes
+into a single runtime.  After that, nothing in this framework changes:
+
+* ``jax.devices()`` returns the GLOBAL device list (all hosts), so the
+  meshes built by parallel/mesh.py span the whole pod,
+* `shard_map` + `lax.ppermute`/`all_gather` collectives ride ICI within a
+  slice and DCN across slices — placement is still just the Mesh,
+* the solvers (`Solver2DDistributed`, `Solver3DDistributed`,
+  `ElasticSolver2D`'s gang path) are unchanged: they already address
+  devices, not hosts.
+
+What DOES need per-process care is the host side: each process may only
+``device_put`` to its own (addressable) devices, and gathers for
+logging/metrics return globally-replicated values.  ``host_block_slice``
+gives each process its slice of the global init state;
+``assert_same_on_all_hosts`` is the cross-host determinism check (the
+analog of the reference's implicit single-program invariants).
+
+See docs/multihost.md for the launch recipe (the srun analog).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+
+
+def _already_initialized() -> bool:
+    """Has jax.distributed.initialize already run in this process?
+
+    Inspects the distributed client directly: calling any device/process
+    API here would INITIALIZE the local backend, after which
+    jax.distributed.initialize refuses to run — the exact failure this
+    module exists to prevent.
+    """
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:  # noqa: BLE001 — internal layout change: assume not
+        return False
+
+
+def _multiprocess_signals() -> bool:
+    """Launch-environment signals that this is one process of many, readable
+    WITHOUT touching the JAX backend: explicit envs, a SLURM multi-task
+    allocation (srun -n N, any node count), or a Cloud TPU pod worker
+    (TPU_WORKER_HOSTNAMES lists every host in the pod slice)."""
+    if os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("JAX_NUM_PROCESSES"):
+        return True
+    try:
+        if int(os.environ.get("SLURM_NTASKS", "1") or 1) > 1:
+            return True
+    except ValueError:
+        pass
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h]) > 1
+
+
+def init_from_env(coordinator: str | None = None,
+                  num_processes: int | None = None,
+                  process_id: int | None = None) -> bool:
+    """Wire this process into a multi-controller run; returns True if done.
+
+    With no arguments, launch detection reads environment signals only
+    (SLURM task counts, Cloud TPU pod worker lists, COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID) and then defers to
+    `jax.distributed.initialize`'s own auto-configuration — the srun
+    analog.  Explicit arguments mirror the manual HPX launch
+    (``--hpx:localities``): coordinator "host:port", process count, and
+    this process's rank.  A single-process run (no env, no args) is a
+    no-op returning False — every code path then behaves exactly as
+    single-host, which is how the test suite exercises this module.
+
+    Must be called BEFORE any JAX computation (initialize()'s own rule;
+    this function never touches the backend on the no-op path).
+    """
+    if _already_initialized():
+        return True
+    explicit = bool(coordinator or num_processes) or process_id is not None
+    if not explicit and not _multiprocess_signals():
+        return False
+    kwargs = {}
+    if coordinator or os.environ.get("COORDINATOR_ADDRESS"):
+        kwargs["coordinator_address"] = (
+            coordinator or os.environ["COORDINATOR_ADDRESS"])
+    if num_processes or os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs["num_processes"] = int(
+            num_processes or os.environ["JAX_NUM_PROCESSES"])
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+    elif os.environ.get("JAX_PROCESS_ID") is not None:
+        kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(**kwargs)
+    return True
+
+
+def host_block_slice(n_rows: int, axis_size: int | None = None,
+                     index: int | None = None) -> slice:
+    """Row slice of the global init state this process should materialize.
+
+    Equal contiguous blocks by process index (the host-side analog of the
+    device sharding): process p owns rows [p*B, min((p+1)*B, n)).  With one
+    process this is the whole grid.  Callers `device_put` only their slice;
+    `jax.make_array_from_process_local_data` assembles the global array.
+    """
+    np_ = axis_size if axis_size is not None else jax.process_count()
+    p = index if index is not None else jax.process_index()
+    B = -(-n_rows // np_)
+    return slice(p * B, min((p + 1) * B, n_rows))
+
+
+def assert_same_on_all_hosts(x, tag: str = "value") -> None:
+    """Cross-host determinism check: every process must hold identical
+    ``x`` (the multi-controller contract — divergent host values silently
+    corrupt collectives).  No-op single-process; uses a broadcast-compare
+    on multi-process runs."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    x = np.asarray(x)
+    ref = multihost_utils.broadcast_one_to_all(x)
+    if not np.array_equal(np.asarray(ref), x):
+        raise AssertionError(
+            f"{tag} differs between hosts (process {jax.process_index()}): "
+            "multi-controller programs must compute identical host values"
+        )

@@ -1,0 +1,68 @@
+"""Multi-host helpers (parallel/multihost.py): single-process degeneration.
+
+A real pod cannot run in CI; the contract tested here is that every helper
+degrades to the exact single-host behavior (the reference's one-locality
+degradation, src/2d_nonlocal_distributed.cpp:118-120), so the same script
+works in both worlds.
+"""
+
+import numpy as np
+
+import jax
+
+from nonlocalheatequation_tpu.parallel import multihost
+from nonlocalheatequation_tpu.parallel.mesh import make_mesh
+from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
+
+
+def test_init_from_env_noop_single_process(monkeypatch):
+    for var in ("COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "SLURM_NTASKS",
+                "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    assert multihost.init_from_env() is False
+    assert jax.process_count() == 1
+
+
+def test_multiprocess_signals(monkeypatch):
+    for var in ("COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "SLURM_NTASKS",
+                "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    assert multihost._multiprocess_signals() is False
+    monkeypatch.setenv("SLURM_NTASKS", "1")
+    assert multihost._multiprocess_signals() is False  # single task
+    monkeypatch.setenv("SLURM_NTASKS", "4")
+    assert multihost._multiprocess_signals() is True  # srun -N 1 -n 4
+    monkeypatch.setenv("SLURM_NTASKS", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0")
+    assert multihost._multiprocess_signals() is False  # one-worker slice
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1,w2,w3")
+    assert multihost._multiprocess_signals() is True  # pod slice
+
+
+def test_host_block_slice_partitions_exactly():
+    # 100 rows over 8 "processes": equal blocks, last one short, no overlap
+    rows = [multihost.host_block_slice(100, axis_size=8, index=p)
+            for p in range(8)]
+    covered = np.zeros(100, dtype=int)
+    for sl in rows:
+        covered[sl] += 1
+    assert (covered == 1).all()
+    # single process: whole grid
+    assert multihost.host_block_slice(64, axis_size=1, index=0) == slice(0, 64)
+
+
+def test_assert_same_noop_single_process():
+    multihost.assert_same_on_all_hosts(np.arange(5), "params")
+
+
+def test_solver_on_global_mesh_single_process():
+    """The documented flow: init_from_env + make_mesh + solver, one process."""
+    multihost.init_from_env()
+    mesh = make_mesh()  # all (virtual) devices
+    nx = 8 * mesh.shape["x"]
+    ny = 8 * mesh.shape["y"]
+    s = Solver2DDistributed(nx, ny, 1, 1, nt=5, eps=3, k=1.0, dt=1e-5,
+                            dh=0.02, mesh=mesh)
+    s.test_init()
+    u = s.do_work()
+    assert np.isfinite(u).all()

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Full TPU measurement refresh — run after kernel/executor changes.
+#
+# Discipline (docs/bench/README.md "Wedge trigger"): NEVER kill a JAX
+# client mid-compile — that wedges the axon tunnel.  So this script never
+# wraps the measurement tools in `timeout`.  Instead, step 1 is bench.py,
+# which is INTERNALLY hang-proof (subprocess probes + watchdog + CPU
+# fallback): if its artifact does not say backend=tpu, the chip is not
+# healthy and the refresh ABORTS before touching the unprotected tools.
+# After a healthy probe, compiles are expected to finish; let them.
+#
+# JSON rows from every step are appended to docs/bench/BENCH_TABLE_r03.jsonl
+# (the round evidence file) as well as the timestamped log.
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date +%Y%m%d-%H%M%S)
+OUT=docs/bench/refresh-$STAMP.log
+TABLE=docs/bench/BENCH_TABLE_r03.jsonl
+echo "== TPU refresh $STAMP ==" | tee "$OUT"
+
+run() {  # run <label> <cmd...>  (no timeout: see header)
+  echo "-- $1" | tee -a "$OUT"
+  "${@:2}" >> "$OUT" 2>&1
+  echo "-- $1 rc=$?" | tee -a "$OUT"
+}
+
+# 1. health gate + the headline artifact (self-watchdogged)
+run bench python bench.py
+if ! grep -q '"backend": "tpu"' "$OUT"; then
+  echo "ABORT: bench did not reach the TPU backend (wedged or fallback);" \
+       "not running the unprotected tools — see $OUT" | tee -a "$OUT"
+  exit 1
+fi
+
+# 2. carried-kernel A/B on the same ladder
+run bench-carried env BENCH_CARRIED=1 python bench.py
+
+# 3. compiled-mode sanity sweep (all kernels, eps classes, carried, shard_map)
+run sanity python tools/tpu_sanity.py
+
+# 4. full table: methods, dist, 3d, unstructured (+sharded halos), elastic+gang
+run table env BT_STEPS=200 python tools/bench_table.py \
+    methods2d dist2d 3d unstructured elastic
+
+# 5. profiler trace of the headline rung
+run profile env BENCH_PROFILE=docs/bench/profile_r03b python bench.py
+
+grep -h '"bench"' "$OUT" >> "$TABLE"
+echo "-- appended $(grep -c '"bench"' "$OUT") rows to $TABLE" | tee -a "$OUT"
+grep -h '"bench"\|"metric"' "$OUT" | tail -40
+echo "refresh log: $OUT"

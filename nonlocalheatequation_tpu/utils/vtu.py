@@ -151,6 +151,25 @@ class VtuWriter:
             f.write("".join(parts))
 
 
+def write_point_cloud_vtu(path: str, points: np.ndarray,
+                          point_data: dict | None = None,
+                          time: float | None = None) -> None:
+    """One-call .vtu point-cloud snapshot: (N, d<=3) coords (zero-padded to
+    3D) plus named scalar arrays — the unstructured solver's output form."""
+    pts = np.asarray(points, dtype=np.float64)
+    if pts.ndim != 2 or pts.shape[1] > 3:
+        raise ValueError(f"points must be (N, d<=3), got {pts.shape}")
+    if pts.shape[1] < 3:
+        pts = np.pad(pts, ((0, 0), (0, 3 - pts.shape[1])))
+    w = VtuWriter(path)
+    w.append_nodes(pts)
+    for name, data in (point_data or {}).items():
+        w.append_point_data(name, data)
+    if time is not None:
+        w.add_time_step(time)
+    w.close()
+
+
 def read_vtu_point_data(path: str) -> dict[str, np.ndarray]:
     """Minimal reader for round-trip tests: returns {name: array} for the
     PointData scalars plus 'Points' and any FieldData entries."""

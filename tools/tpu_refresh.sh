@@ -45,7 +45,7 @@ run table env BT_STEPS=200 python tools/bench_table.py \
 # 5. profiler trace of the headline rung
 run profile env BENCH_PROFILE=docs/bench/profile_r03b python bench.py
 
-grep -h '"bench"' "$OUT" >> "$TABLE"
-echo "-- appended $(grep -c '"bench"' "$OUT") rows to $TABLE" | tee -a "$OUT"
+grep -h '"bench"\|"metric"' "$OUT" >> "$TABLE"
+echo "-- appended $(grep -c '"bench"\|"metric"' "$OUT") rows to $TABLE" | tee -a "$OUT"
 grep -h '"bench"\|"metric"' "$OUT" | tail -40
 echo "refresh log: $OUT"

@@ -8,6 +8,7 @@ the sat path forced.  Reference contract: a check that cannot run is a failed
 check, not a missing one (CMakeLists.txt:101-154).
 """
 
+import glob
 import json
 import os
 import subprocess
@@ -15,7 +16,8 @@ import sys
 
 import pytest
 
-BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
 
 
 def run_bench(env_extra, timeout=240):
@@ -81,3 +83,20 @@ def test_first_rung_always_attempted_even_late():
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-v"]))
+
+
+def test_tpu_refresh_aborts_on_unhealthy_backend(tmp_path):
+    """The refresh runbook must gate the unprotected measurement tools on
+    bench.py's hang-proof probe: a CPU-fallback artifact aborts the run."""
+    import subprocess
+
+    env = dict(os.environ, BENCH_PLATFORM="cpu", BENCH_WATCHDOG_S="240",
+               BENCH_STEPS="3")
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "tpu_refresh.sh")],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    assert "ABORT: bench did not reach the TPU backend" in proc.stdout
+    for f in glob.glob(os.path.join(REPO, "docs", "bench", "refresh-*.log")):
+        os.remove(f)

@@ -10,7 +10,8 @@ Usage:
     python tools/bench_table.py methods2d dist2d   # a subset
 Env:
     BT_STEPS (default 20), BT_GRID2D (4096 on tpu / 512 off),
-    BT_GRID3D (256 / 48), BT_DIST_GRID (2048 / 256), BT_UNSTRUCT_M (512 / 64)
+    BT_GRID3D (256 / 48), BT_DIST_GRID (2048 / 256), BT_UNSTRUCT_M (512 / 64),
+    BT_SCALE_BLOCK (2048 / 256, per-device block edge of the scaling sweep)
 """
 
 from __future__ import annotations
@@ -111,31 +112,67 @@ def bench_methods2d(steps: int):
         emit(f"2d/{method}", n * n, steps, sec, grid=n, eps=8)
 
 
-def bench_dist2d(steps: int):
-    """BASELINE config 3: distributed 2D with ppermute halos."""
-    from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
-
-    n = cfg("BT_DIST_GRID", 2048, 256)
-    ndev = len(jax.devices())
-    method = "pallas" if on_tpu() else "sat"
-    s = Solver2DDistributed(n, n, 1, 1, nt=steps, eps=8, k=1.0,
-                            dt=1e-7, dh=1.0 / n, method=method,
-                            dtype=jnp.float32)
-    rng = np.random.default_rng(0)
-    s.input_init(rng.normal(size=(n, n)))
-    step = s._build_step()
-    u, _src = s._device_state()
-    import jax as _jax
+def _time_dist_solver(s, steps: int) -> float:
+    """Best seconds for `steps` scanned applications of a distributed
+    solver's SPMD step (shared by dist2d / scaling / elastic's SPMD side)."""
     from jax import lax
 
-    @_jax.jit
+    rng = np.random.default_rng(0)
+    s.input_init(rng.normal(size=(s.NX, s.NY)))
+    step = s._build_step()
+    u, _src = s._device_state()
+
+    @jax.jit
     def multi(u0):
         return lax.scan(lambda c, t: (step(c, t), None), u0,
                         jnp.arange(steps))[0]
 
     sec, _ = time_steps(multi, u, steps)
+    return sec
+
+
+def bench_dist2d(steps: int):
+    """BASELINE config 3: distributed 2D with ppermute halos."""
+    from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
+
+    n = cfg("BT_DIST_GRID", 2048, 256)
+    method = "pallas" if on_tpu() else "sat"
+    s = Solver2DDistributed(n, n, 1, 1, nt=steps, eps=8, k=1.0,
+                            dt=1e-7, dh=1.0 / n, method=method,
+                            dtype=jnp.float32)
+    sec = _time_dist_solver(s, steps)
     emit("2d/distributed", n * n, steps, sec, grid=n, eps=8,
-         devices=ndev, mesh=dict(s.mesh.shape))
+         devices=len(jax.devices()), mesh=dict(s.mesh.shape))
+
+
+def bench_scaling(steps: int):
+    """Weak scaling of the distributed 2D solver: fixed per-device block,
+    growing device count (the reference's srun -n N sweep, README.md:64-72).
+    On one real chip this emits the 1-device row; the 8-virtual-device CPU
+    proxy charts the collective overhead curve."""
+    from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
+    from nonlocalheatequation_tpu.parallel.mesh import make_mesh
+
+    block = cfg("BT_SCALE_BLOCK", 2048, 256)  # per-device block edge
+    method = "pallas" if on_tpu() else "sat"
+    ndev_all = len(jax.devices())
+    counts = [c for c in (1, 2, 4, 8) if c <= ndev_all]
+    if counts != [1, 2, 4, 8]:
+        log(f"    only {ndev_all} device(s): sweep truncated to {counts} "
+            "(use XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "BENCH_PLATFORM=cpu for the full proxy curve)")
+    for ndev in counts:
+        mx = {1: 1, 2: 2, 4: 2, 8: 4}[ndev]
+        my = ndev // mx
+        NX, NY = block * mx, block * my
+        mesh = make_mesh(mx, my, jax.devices()[:ndev])
+        s = Solver2DDistributed(NX, NY, 1, 1, nt=steps, eps=8, k=1.0,
+                                dt=1e-7, dh=1.0 / NX, method=method,
+                                dtype=jnp.float32, mesh=mesh)
+        sec = _time_dist_solver(s, steps)
+        emit("2d/weak-scaling", NX * NY, steps, sec, grid_x=NX, grid_y=NY,
+             eps=8, devices=ndev, mesh=dict(mesh.shape),
+             points_per_device=block * block)
 
 
 def bench_3d(steps: int):
@@ -263,6 +300,7 @@ def bench_elastic(steps: int):
 BENCHES = {
     "methods2d": bench_methods2d,
     "dist2d": bench_dist2d,
+    "scaling": bench_scaling,
     "3d": bench_3d,
     "unstructured": bench_unstructured,
     "elastic": bench_elastic,

@@ -1,0 +1,149 @@
+"""Property tests for the Pallas kernels' host-side evaluation plans.
+
+The kernels are only as correct as the static plans they are built from:
+the NAF signed-dyadic covers, the circle/sphere height profiles, the
+lane-run partitions, and the VMEM chain-step model.  These properties pin
+each plan against its defining identity for every eps up to well past the
+reference's largest test case (eps=40, tests/1d.txt).
+"""
+
+import numpy as np
+import pytest
+
+from nonlocalheatequation_tpu.ops.pallas_kernel import (
+    _chain_steps,
+    _lane_runs,
+    _lane_runs_3d,
+    _naf,
+    _naf_parts,
+    _strip_plan,
+    _strip_plan_3d,
+)
+from nonlocalheatequation_tpu.ops.stencil import (
+    column_half_heights,
+    horizon_mask_2d,
+)
+
+EPS_RANGE = list(range(1, 41))
+EPS_RANGE_3D = list(range(1, 13))
+
+
+@pytest.mark.parametrize("w", range(1, 130))
+def test_naf_reconstructs_and_is_sparse(w):
+    digits = _naf(w)
+    assert sum(sign * (1 << p) for p, sign in digits) == w
+    # non-adjacency: no two consecutive powers used
+    pows = sorted(p for p, _ in digits)
+    assert all(b - a >= 2 for a, b in zip(pows, pows[1:]))
+    # minimal weight: NAF uses at most ceil((bitlen+1)/2) digits
+    assert len(digits) <= (w.bit_length() + 2) // 2
+
+
+@pytest.mark.parametrize("width", range(1, 130))
+def test_naf_parts_cover_exact_window(width):
+    """sum(sign * D_k shifted by off) over parts == the width-window sum,
+    with every intermediate offset in range [0, width)."""
+    n = 4 * width + 16
+    x = np.random.default_rng(width).normal(size=n)
+    D = {k: np.array([x[r:r + k].sum() for r in range(n)])
+         for k, _, _ in _naf_parts(width)}
+    acc = np.zeros(n)
+    for k, off, sign in _naf_parts(width):
+        # offsets never negative; reads PAST the window (off + k > width,
+        # e.g. width 7 = D_8 - D_1@7) are legal — the strip plan's pad
+        # bounds them (test_strip_plan_pad_covers_deepest_read)
+        assert off >= 0
+        shifted = np.zeros(n)
+        shifted[: n - off] = D[k][off:]
+        acc += sign * shifted
+    deepest = max(off + k for k, off, _ in _naf_parts(width))
+    valid = n - deepest  # rows whose every part read stays in range
+    assert valid >= width
+    want = np.array([x[r:r + width].sum() for r in range(valid)])
+    assert np.allclose(acc[:valid], want, atol=1e-9)
+
+
+@pytest.mark.parametrize("eps", EPS_RANGE)
+def test_heights_match_mask_columns(eps):
+    """column_half_heights IS the mask's column heights (2h+1 cells)."""
+    mask = horizon_mask_2d(eps)
+    heights = column_half_heights(eps)
+    assert len(heights) == 2 * eps + 1
+    np.testing.assert_array_equal(mask.sum(axis=0), 2 * np.asarray(heights) + 1)
+
+
+@pytest.mark.parametrize("eps", EPS_RANGE)
+def test_lane_runs_partition_offsets(eps):
+    """Runs exactly tile [0, 2eps] with the profile's heights, maximally."""
+    heights = [int(h) for h in column_half_heights(eps)]
+    runs = _lane_runs(eps)
+    covered = []
+    for h, j0, L in runs:
+        assert L >= 1
+        for j in range(j0, j0 + L):
+            assert heights[j] == h
+            covered.append(j)
+        # maximality: the run cannot extend either way
+        if j0 > 0:
+            assert heights[j0 - 1] != h
+        if j0 + L < len(heights):
+            assert heights[j0 + L] != h
+    assert covered == list(range(2 * eps + 1))
+    # wrap-garbage invariant the kernel relies on: j0 + L <= 2*eps + 1
+    assert all(j0 + L <= 2 * eps + 1 for _h, j0, L in runs)
+
+
+@pytest.mark.parametrize("eps", EPS_RANGE_3D)
+def test_lane_runs_3d_partition_sphere(eps):
+    """3D runs cover every (jj, kk) mask column exactly once, same heights."""
+    heights = _strip_plan_3d(eps)[0]
+    seen = set()
+    for h, jj, k0, L in _lane_runs_3d(eps):
+        for kk in range(k0, k0 + L):
+            assert heights[jj, kk] == h
+            assert (jj, kk) not in seen
+            seen.add((jj, kk))
+        assert k0 + L <= 2 * eps + 1  # lane wrap-garbage bound
+    assert seen == set(heights)
+    # (heights-vs-mask equivalence itself is covered by
+    # tests/test_pallas.py::test_3d_plan_covers_exact_sphere)
+
+
+@pytest.mark.parametrize("eps", EPS_RANGE)
+def test_strip_plan_pad_covers_deepest_read(eps):
+    """The window pad bounds every read the plan can issue: a = eps - h plus
+    the deepest NAF part (off + k) within each height's window."""
+    heights, parts_by_h, pows, pad = _strip_plan(eps)
+    deepest = max(
+        (eps - h) + max(off + k for k, off, _ in parts)
+        for h, parts in parts_by_h.items()
+    )
+    assert pad >= deepest
+    assert pad % 8 == 0
+    # chain completeness: every power's half is present
+    for k in pows:
+        assert k == 1 or k // 2 in pows
+
+
+@pytest.mark.parametrize("run_len", range(1, 20))
+def test_chain_steps_counts_actual_wsum_ops(run_len):
+    """_chain_steps (the VMEM model) equals the lane_down ops that
+    _build_lane_wsums ACTUALLY emits, counted via an instrumented stub —
+    a divergence would make _lane_slots under-count VMEM stack slots."""
+    from nonlocalheatequation_tpu.ops.pallas_kernel import _build_lane_wsums
+
+    calls = {"lane_down": 0}
+
+    class Arr:  # counts the roll+add chain's vector ops symbolically
+        def __add__(self, other):
+            return Arr()
+
+    def lane_down(x, s):
+        calls["lane_down"] += 1
+        return Arr()
+
+    wsums = _build_lane_wsums({7: Arr()}, [(7, run_len)], lane_down)
+    assert set(wsums) == {(7, run_len)}
+    assert _chain_steps(run_len) == calls["lane_down"]
+    if run_len == 1:
+        assert calls["lane_down"] == 0  # aliases v[h]: no temporaries

@@ -56,67 +56,6 @@ def test_batch_case_pallas_backend():
     assert s.error_l2 / (nx * ny) <= L2_THRESHOLD
 
 
-def test_naf_is_signed_binary_decomposition():
-    for w in range(1, 70):
-        assert sum(s * (1 << p) for p, s in _naf(w)) == w
-        # non-adjacency: no two consecutive powers
-        pows = sorted(p for p, _ in _naf(w))
-        assert all(b - a >= 2 for a, b in zip(pows, pows[1:]))
-
-
-@pytest.mark.parametrize("eps", [1, 2, 3, 5, 8, 13])
-def test_strip_plan_covers_exact_circle(eps):
-    heights, parts_by_h, pows, pad = _strip_plan(eps)
-    mask = horizon_mask_2d(eps)
-    for jj, h in enumerate(heights):
-        # plan width for this lane offset == exact raster column height
-        assert sum(s * k for k, _, s in parts_by_h[h]) == 2 * h + 1
-        assert mask[:, jj].sum() == 2 * h + 1
-        # every part's rows stay within the padded window
-        a = eps - h
-        assert all(a + off >= 0 for _, off, _ in parts_by_h[h])
-        assert max(a + off + k for k, off, _ in parts_by_h[h]) <= pad
-
-
-# -- 3D strip kernel -------------------------------------------------------
-SHAPES_3D = [
-    (16, 16, 16, 2),   # aligned
-    (12, 10, 14, 3),   # ragged all axes
-    (8, 8, 8, 5),
-    (6, 6, 6, 8),      # eps > grid (degenerate, full-halo analog)
-]
-
-
-@pytest.mark.parametrize("nx,ny,nz,eps", SHAPES_3D)
-def test_3d_neighbor_sum_matches_shift(nx, ny, nz, eps):
-    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D
-
-    rng = np.random.default_rng(nx * 100 + ny * 10 + nz + eps)
-    u = jnp.asarray(rng.normal(size=(nx, ny, nz)))
-    a = NonlocalOp3D(eps, 1.0, 1e-4, 0.05, method="shift").neighbor_sum(u)
-    b = NonlocalOp3D(eps, 1.0, 1e-4, 0.05, method="pallas").neighbor_sum(u)
-    assert float(jnp.max(jnp.abs(a - b))) < 1e-10
-
-
-@pytest.mark.parametrize("eps", [1, 2, 3, 5, 8])
-def test_3d_plan_covers_exact_sphere(eps):
-    from nonlocalheatequation_tpu.ops.pallas_kernel import _strip_plan_3d
-    from nonlocalheatequation_tpu.ops.stencil import horizon_mask_3d
-
-    heights, parts_by_h, pows, pad = _strip_plan_3d(eps)
-    mask = horizon_mask_3d(eps)
-    # every lane-plane's plan width == the exact raster column along x
-    colsum = mask.sum(axis=0)
-    for (jj, kk), h in heights.items():
-        assert sum(s * k for k, _, s in parts_by_h[h]) == 2 * h + 1
-        assert colsum[jj, kk] == 2 * h + 1
-        a = eps - h
-        assert all(a + off >= 0 for _, off, _ in parts_by_h[h])
-        assert max(a + off + k for k, off, _ in parts_by_h[h]) <= pad
-    # plan covers exactly the mask's non-empty columns
-    assert set(heights) == {tuple(i) for i in np.argwhere(colsum > 0)}
-
-
 def test_3d_solver_pallas_contract():
     from nonlocalheatequation_tpu.models.solver3d import Solver3D
 

@@ -1,0 +1,21 @@
+"""Minimal 2D solve: manufactured-solution test on one device.
+
+Run:  python examples/01_basic_2d.py  [--platform cpu]
+"""
+import sys
+
+import jax
+
+if "--platform" in sys.argv:
+    jax.config.update("jax_platforms", sys.argv[sys.argv.index("--platform") + 1])
+if jax.default_backend() != "tpu":
+    jax.config.update("jax_enable_x64", True)  # oracle-parity precision off-TPU
+
+from nonlocalheatequation_tpu.models import Solver2D
+
+s = Solver2D(50, 50, 45, eps=5, k=1.0, dt=0.0005, dh=0.02,
+             backend="jit", method="auto")
+s.test_init()                     # u0 = sin(2*pi*x) sin(2*pi*y)
+s.do_work()
+print(f"L2/N = {s.error_l2 / 2500:.3e}  (pass: <= 1e-6)")
+assert s.error_l2 / 2500 <= 1e-6

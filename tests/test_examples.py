@@ -1,0 +1,24 @@
+"""The examples/ scripts must stay runnable (they are documentation)."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(glob.glob(os.path.join(REPO, "examples", "*.py")))
+
+
+@pytest.mark.parametrize("script", EXAMPLES, ids=[os.path.basename(e) for e in EXAMPLES])
+def test_example_runs(script, tmp_path):
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split() if "device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=8"])
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, script, "--platform", "cpu"],
+        capture_output=True, text=True, timeout=420, cwd=str(tmp_path), env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

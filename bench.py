@@ -13,9 +13,11 @@ present and reported as 0.0 otherwise.
 Architecture (hang-proof by construction):
 
   parent (this process, never imports jax)
-   ├─ phase A: TPU-init probe in a KILLABLE subprocess, 3 attempts w/ backoff
-   │           (a wedged in-process ``jax.devices()`` cannot be retried; a
-   │           child can be killed and retried — the round-2 failure mode)
+   ├─ phase A: TPU-init probe in a KILLABLE subprocess — hangs capped at 3
+   │           (each costs a PROBE_TIMEOUT_S kill budget), fast failures
+   │           (resetting tunnel, UNAVAILABLE) retried every few seconds
+   │           until 45% of the watchdog budget (a wedged in-process
+   │           ``jax.devices()`` cannot be retried; a child can)
    ├─ phase B: one measurement child streaming JSON events per ladder rung
    │           (512^2 -> 2048^2 -> 4096^2); parent stashes each completed
    │           rung as it arrives, so a wedge at 4096^2 still yields the
@@ -246,17 +248,33 @@ class EventReader:
 def probe_device():
     """Phase A: can a fresh process init the backend?  Killable + retried.
 
-    Returns the probe record {"ok": True, "backend": ..., "device": ...} or
-    None if every attempt failed/hung.
+    Two failure modes with different economics (both observed live):
+    a HANG (wedged tunnel) costs a full PROBE_TIMEOUT_S kill budget, so
+    those are capped at 3; a FAST failure (tunnel resetting: init returns
+    `UNAVAILABLE` within seconds) is nearly free, so those retry every few
+    seconds until the probe-phase deadline — a tunnel that comes back
+    mid-reset still gets the round onto the TPU instead of the CPU
+    fallback.  Returns the probe record {"ok": True, ...} or None.
     """
-    attempts, backoff = 3, 5.0
-    for attempt in range(attempts):
-        budget = min(PROBE_TIMEOUT_S, remaining())
+    hang_cap, hangs, attempt = 3, 0, 0
+    phase_deadline = T0 + 0.45 * WATCHDOG_S  # leave the rest for measuring
+    while True:
+        if time.time() >= phase_deadline:
+            log("probe: phase deadline reached "
+                f"({0.45 * WATCHDOG_S:.0f}s); proceeding without the device")
+            return None
+        # an attempt may not overrun the phase deadline by more than a
+        # hang-kill: clamp its budget to the window that is actually left
+        budget = min(PROBE_TIMEOUT_S, remaining(),
+                     phase_deadline - time.time() + 5.0)
         if budget <= 5:
             log("probe: out of time budget")
             return None
-        log(f"probe attempt {attempt + 1}/{attempts} (budget {budget:.0f}s)")
+        attempt += 1
+        log(f"probe attempt {attempt} (budget {budget:.0f}s, "
+            f"hangs {hangs}/{hang_cap})")
         proc = spawn_child("--probe")
+        t_start = time.time()
         try:
             out, _ = proc.communicate(timeout=budget)
             if proc.returncode == 0 and out.strip():
@@ -264,16 +282,22 @@ def probe_device():
                 if rec.get("ok"):
                     log(f"probe ok: backend={rec['backend']} device={rec['device']}")
                     return rec
-            log(f"probe attempt failed (rc={proc.returncode})")
+            log(f"probe attempt failed (rc={proc.returncode}, "
+                f"{time.time() - t_start:.1f}s)")
         except subprocess.TimeoutExpired:
+            hangs += 1
             log(f"probe attempt HUNG past {budget:.0f}s; killing child")
             kill(proc)
         except Exception as e:  # noqa: BLE001
             log(f"probe attempt errored: {e!r}")
             kill(proc)
-        if attempt + 1 < attempts:
-            time.sleep(min(backoff * (attempt + 1), max(0.0, remaining())))
-    return None
+        if hangs >= hang_cap:
+            log(f"probe: giving up after {hangs} hangs")
+            return None
+        # fast failures retry quickly (the tunnel may recover any second);
+        # hang kills back off longer (the chip needs time to settle)
+        pause = 3.0 if time.time() - t_start < 10 else 10.0
+        time.sleep(min(pause, max(0.0, remaining())))
 
 
 def run_measure_child(force_method=None):
@@ -403,6 +427,19 @@ def child_platform_override(jax):
 
 
 def child_probe():
+    if os.environ.get("BENCH_FAULT") == "probe_flaky":
+        # fault injection (tests/test_bench_harness.py): fail FAST the first
+        # BENCH_FAULT_N times — the tunnel-resetting UNAVAILABLE mode — then
+        # behave normally; the counter lives in a file because each probe is
+        # a fresh process
+        path = os.environ["BENCH_FAULT_FILE"]
+        n = int(open(path).read() or 0) if os.path.exists(path) else 0
+        if n < int(os.environ.get("BENCH_FAULT_N", 5)):
+            with open(path, "w") as f:
+                f.write(str(n + 1))
+            print("probe_flaky: injected fast failure", file=sys.stderr)
+            sys.exit(1)
+
     import jax
 
     child_platform_override(jax)

@@ -9,7 +9,10 @@ import sys
 import jax
 
 if "--platform" in sys.argv:
-    jax.config.update("jax_platforms", sys.argv[sys.argv.index("--platform") + 1])
+    i = sys.argv.index("--platform")
+    if i + 1 >= len(sys.argv):
+        sys.exit("usage: --platform <backend>, e.g. --platform cpu")
+    jax.config.update("jax_platforms", sys.argv[i + 1])
 if jax.default_backend() != "tpu":
     jax.config.update("jax_enable_x64", True)
 

@@ -28,8 +28,6 @@ sample needs per-device wall-clock the fused program cannot expose).
 
 from __future__ import annotations
 
-import functools
-
 import numpy as np
 
 import jax

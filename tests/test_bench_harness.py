@@ -100,3 +100,25 @@ def test_tpu_refresh_aborts_on_unhealthy_backend(tmp_path):
     assert "ABORT: bench did not reach the TPU backend" in proc.stdout
     for f in glob.glob(os.path.join(REPO, "docs", "bench", "refresh-*.log")):
         os.remove(f)
+
+
+def test_probe_retries_through_fast_failures(tmp_path):
+    """A resetting tunnel fails probes FAST (UNAVAILABLE); the probe phase
+    must keep retrying cheap failures instead of giving up after 3 — five
+    injected fast failures then success must still land on the (cpu test)
+    backend WITHOUT the cpu_fallback degradation label."""
+    counter = str(tmp_path / "flaky")
+    proc, rec = run_bench(timeout=360, env_extra={
+        "BENCH_PLATFORM": "cpu",
+        "BENCH_WATCHDOG_S": "240",
+        "BENCH_STEPS": "3",
+        "BENCH_LADDER": "64",
+        "BENCH_GRID": "64",
+        "BENCH_FAULT": "probe_flaky",
+        "BENCH_FAULT_FILE": counter,
+        "BENCH_FAULT_N": "5",
+    })
+    assert rec["value"] > 0
+    assert "cpu_fallback" not in rec, rec
+    assert int(open(counter).read()) == 5  # all five injected failures hit
+    assert proc.stderr.count("probe attempt failed") >= 5

@@ -567,7 +567,18 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         window_len = self.measure_window if self.nbalance else self.nt
         prev_in_window = False
         self._gang_active = False
-        use_gang = self._use_fused and self.use_gang
+        # gang works for both regimes: band halos when eps <= tile, the
+        # full-gather global-reassembly form when eps > tile.  The general
+        # form materializes per device the global grid AND every tile's
+        # padded window, so gate on BOTH footprints (the degenerate
+        # small-tile regime satisfies them comfortably)
+        window_elems = (self.npx * self.npy
+                        * (self.nx + 2 * self.eps)
+                        * (self.ny + 2 * self.eps))
+        use_gang = self.use_gang and (
+            self._use_fused
+            or (self.NX * self.NY <= (1 << 24)
+                and window_elems <= (1 << 25)))
         if use_gang and self._gang is None:
             # created once per solver: jit keys on shapes, so repeated
             # do_work calls (and T_max changes) reuse/retrace automatically

@@ -94,6 +94,48 @@ class GangPlan:
                 for j, key in enumerate(own)}
 
 
+def _make_run_driver(op, mesh: Mesh, local_step, aux_specs, test: bool):
+    """Shared shard_map + jit + fori_loop driver for both gang regimes.
+
+    ``local_step(own, *aux, [g, lg,] t)`` sees per-device local views; aux
+    arguments are described by ``aux_specs`` (P("d") entries arrive with the
+    leading device axis stripped, P() entries replicated as-is).  The
+    returned run is (state, *aux, [g, lg,] t0, nsteps) -> state; nsteps is
+    traced, so one compile serves every stretch length.
+    """
+    spec = P("d")
+    n_aux = len(aux_specs)
+    in_specs = [spec, *aux_specs] + ([spec, spec] if test else []) + [P()]
+    vma_ok = op.method != "pallas" or jax.default_backend() == "tpu"
+    n_sharded_rest = 2 if test else 0  # g, lg carry the device axis too
+
+    def wrapper(own, *args):
+        aux = [a[0] if aux_specs[i] == P("d") else a
+               for i, a in enumerate(args[:n_aux])]
+        rest = [r[0] if i < n_sharded_rest else r
+                for i, r in enumerate(args[n_aux:])]
+        return local_step(own[0], *aux, *rest)[None]
+
+    sharded_step = shard_map(
+        wrapper, mesh=mesh, in_specs=tuple(in_specs), out_specs=spec,
+        check_vma=vma_ok)
+
+    @jax.jit
+    def run(state, *args):
+        aux = args[: n_aux]
+        if test:
+            g, lg, t0, nsteps = args[n_aux:]
+            def body(i, carry):
+                return sharded_step(carry, *aux, g, lg, t0 + i)
+        else:
+            t0, nsteps = args[n_aux:]
+            def body(i, carry):
+                return sharded_step(carry, *aux, t0 + i)
+        return lax.fori_loop(0, nsteps, body, state)
+
+    return run
+
+
 def make_gang_run(op, mesh: Mesh, nx: int, ny: int, test: bool, dtype):
     """One jitted SPMD program advancing every tile a traced ``nsteps``.
 
@@ -138,29 +180,53 @@ def make_gang_run(op, mesh: Mesh, nx: int, ny: int, test: bool, dtype):
             (t,) = rest
         return own + jnp.asarray(op.dt, dtype) * du
 
-    spec = P("d")
-    in_specs = [spec, spec] + ([spec, spec] if test else []) + [P()]
-    vma_ok = op.method != "pallas" or jax.default_backend() == "tpu"
-    sharded_step = shard_map(
-        lambda own, idx, *rest: local_step(own[0], idx[0], *[
-            r[0] if i < (2 if test else 0) else r for i, r in enumerate(rest)
-        ])[None],
-        mesh=mesh, in_specs=tuple(in_specs), out_specs=spec,
-        check_vma=vma_ok)
+    return _make_run_driver(op, mesh, local_step, aux_specs=(P("d"),),
+                            test=test)
 
-    @jax.jit
-    def run(state, idx, *rest):
+
+def make_gang_run_general(op, mesh: Mesh, npx: int, npy: int,
+                          nx: int, ny: int, test: bool, dtype):
+    """Gang run for the eps > tile-edge regime (the reference's degenerate
+    nx <= eps path, src/2d_nonlocal_distributed.cpp:1202-1212).
+
+    When the horizon exceeds the tile, a tile's halo is (a window of) the
+    whole grid, so the honest collective is one all_gather of every tile;
+    each device then reassembles the global grid from the gathered slots by
+    a TRACED (npx, npy) position->slot index, pads it once, and
+    dynamic-slices each own tile's (nx+2e, ny+2e) window (vmapped over
+    slots).  Values are identical to the per-tile rectangle-walk assembly —
+    same global field, same window — so results stay bit-identical to the
+    serial oracle.  Memory: every device materializes the global grid;
+    callers gate this on grid size (the regime's tiles are tiny by
+    definition).
+    """
+    e = op.eps
+    NX, NY = npx * nx, npy * ny
+
+    def local_step(own, pos_idx, txy, *rest):
+        # own: (T_max, nx, ny); pos_idx: (npx, npy) slot ids;
+        # txy: (T_max, 2) tile coords of own slots (pad slots -> (0, 0))
+        gathered = lax.all_gather(own, "d", axis=0, tiled=True)
+        # reassemble the global grid: (npx, npy, nx, ny) -> (NX, NY)
+        global_u = gathered[pos_idx].transpose(0, 2, 1, 3).reshape(NX, NY)
+        gpad = jnp.pad(global_u, ((e, e), (e, e)))
+
+        def window(t):
+            return lax.dynamic_slice(
+                gpad, (t[0] * nx, t[1] * ny), (nx + 2 * e, ny + 2 * e))
+
+        upad = jax.vmap(window)(txy)
+        du = jax.vmap(op.apply_padded)(upad)
         if test:
-            g, lg, t0, nsteps = rest
-            def body(i, carry):
-                return sharded_step(carry, idx, g, lg, t0 + i)
+            from nonlocalheatequation_tpu.ops.nonlocal_op import source_at
+            g, lg, t = rest
+            du = du + source_at(g, lg, t, op.dt)
         else:
-            (t0, nsteps) = rest
-            def body(i, carry):
-                return sharded_step(carry, idx, t0 + i)
-        return lax.fori_loop(0, nsteps, body, state)
+            (t,) = rest
+        return own + jnp.asarray(op.dt, dtype) * du
 
-    return run
+    return _make_run_driver(op, mesh, local_step,
+                            aux_specs=(P(), P("d")), test=test)
 
 
 class GangExecutor:
@@ -175,7 +241,7 @@ class GangExecutor:
         self.s = solver
         self.mesh = Mesh(np.asarray(solver.devices), ("d",))
         self.plan: GangPlan | None = None
-        self._runs: dict[int, object] = {}
+        self._runs: dict[tuple[bool, bool], object] = {}
         self._state = None
         self._g = self._lg = None
 
@@ -195,6 +261,17 @@ class GangExecutor:
         self._state = jax.device_put(
             plan.pack(tiles, s.nx, s.ny, np_dtype), sh)
         self._idx = jax.device_put(plan.idx, sh)
+        if not s._use_fused:
+            # general (eps > tile) plan: global position->slot map +
+            # per-slot tile coords (pad slots pinned to (0, 0))
+            pos = np.zeros((s.npx, s.npy), np.int32)
+            txy = np.zeros((plan.ndev, plan.t_max, 2), np.int32)
+            for d, own in plan.order.items():
+                for j, (gx, gy) in enumerate(own):
+                    pos[gx, gy] = d * plan.t_max + j
+                    txy[d, j] = (gx, gy)
+            self._pos_idx = jnp.asarray(pos)  # replicated (P() spec)
+            self._txy = jax.device_put(txy, sh)
         if s.test and gtiles is not None:
             g = {k: v[0] for k, v in gtiles.items()}
             lg = {k: v[1] for k, v in gtiles.items()}
@@ -203,16 +280,22 @@ class GangExecutor:
 
     def run_stretch(self, t0: int, nsteps: int) -> None:
         s = self.s
-        key = bool(s.test)
+        key = (bool(s.test), bool(s._use_fused))
         if key not in self._runs:
-            self._runs[key] = make_gang_run(
-                s.op, self.mesh, s.nx, s.ny, s.test, s.dtype)
+            if s._use_fused:
+                self._runs[key] = make_gang_run(
+                    s.op, self.mesh, s.nx, s.ny, s.test, s.dtype)
+            else:
+                self._runs[key] = make_gang_run_general(
+                    s.op, self.mesh, s.npx, s.npy, s.nx, s.ny,
+                    s.test, s.dtype)
         run = self._runs[key]
         t, n = jnp.int32(t0), jnp.int32(nsteps)
+        aux = (self._idx,) if s._use_fused else (self._pos_idx, self._txy)
         if s.test:
-            self._state = run(self._state, self._idx, self._g, self._lg, t, n)
+            self._state = run(self._state, *aux, self._g, self._lg, t, n)
         else:
-            self._state = run(self._state, self._idx, t, n)
+            self._state = run(self._state, *aux, t, n)
 
     def tiles(self) -> dict:
         """Materialize the per-tile dict: one host transfer, then each tile

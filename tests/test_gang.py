@@ -115,12 +115,12 @@ def test_gang_stretch_lengths_cover_plain_steps():
     assert s._gang_stretch_len(6, True) == 2  # 6,7; 8 starts the window
 
 
-def test_gang_disabled_when_eps_exceeds_tile():
-    """eps > tile edge cannot use band assembly; the general rectangle-walk
-    path takes over transparently with use_gang still set."""
-    s = _run(True, nx=4, ny=4, npx=5, npy=5, nt=8, eps=6, nlog=1000,
+def test_gang_opt_out_keeps_per_tile_general_path():
+    """use_gang=False on the eps > tile regime keeps the per-tile
+    rectangle-walk dispatch (the measured-window path) fully working."""
+    s = _run(False, nx=4, ny=4, npx=5, npy=5, nt=8, eps=6, nlog=1000,
              dh=0.05)
-    assert s._gang is None  # never constructed: _use_fused gates it
+    assert s._gang is None  # opted out: never constructed
     o = Solver2D(20, 20, 8, eps=6, k=1.0, dt=1e-5, dh=0.05, backend="oracle")
     o.test_init()
     o.do_work()
@@ -145,3 +145,60 @@ def test_gang_checkpoint_resume_bit_identical(tmp_path):
     resumed.resume(path)
     resumed.do_work()
     assert np.array_equal(full.u, resumed.u)
+
+
+def test_gang_general_eps_exceeds_tile_bit_identical():
+    """eps > tile edge now gang-schedules too (global-reassembly form);
+    bit-identical to the per-tile rectangle-walk path."""
+    def run(gang):
+        s = ElasticSolver2D(4, 4, 5, 5, nt=10, eps=6, nlog=1000, k=1.0,
+                            dt=1e-5, dh=0.05)
+        s.use_gang = gang
+        s.test_init()
+        s.do_work()
+        return s
+
+    a, b = run(True), run(False)
+    assert np.array_equal(a.u, b.u)
+    assert a._gang is not None and a._gang.plan is not None
+    o = Solver2D(20, 20, 10, eps=6, k=1.0, dt=1e-5, dh=0.05,
+                 backend="oracle")
+    o.test_init()
+    o.do_work()
+    assert np.abs(a.u - o.u).max() < 1e-12
+
+
+def test_gang_general_reference_degenerate_case():
+    """The reference's hardest ctest shape: 20x20 grid of 1x1 tiles with
+    eps=10 — every tile's halo is the whole domain
+    (tests/2d_distributed.txt; the nx <= eps warning path,
+    src/2d_nonlocal_distributed.cpp:1202-1212, 1376-1379)."""
+    s = ElasticSolver2D(1, 1, 20, 20, nt=10, eps=10, nlog=1000, k=1.0,
+                        dt=1e-5, dh=0.05)
+    s.test_init()
+    s.do_work()
+    assert s._gang is not None and s._gang.plan is not None  # gang ran
+    o = Solver2D(20, 20, 10, eps=10, k=1.0, dt=1e-5, dh=0.05,
+                 backend="oracle")
+    o.test_init()
+    o.do_work()
+    assert np.abs(s.u - o.u).max() < 1e-12
+    assert s.error_l2 / 400 <= 1e-6
+
+
+def test_gang_general_with_rebalance_matches_oracle():
+    """General-path gang + model-telemetry rebalance between stretches."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    tele = lb.WorkTelemetry(2, speed_factors=np.array([1.0, 2.0]))
+    s = ElasticSolver2D(2, 2, 8, 8, nt=31, eps=3, nbalance=10, k=0.3,
+                        dt=1e-5, dh=0.05, telemetry=tele,
+                        devices=jax.devices()[:2])
+    assert not s._use_fused  # eps 3 > tile edge 2
+    s.test_init()
+    s.do_work()
+    o = Solver2D(16, 16, 31, eps=3, k=0.3, dt=1e-5, dh=0.05,
+                 backend="oracle")
+    o.test_init()
+    o.do_work()
+    assert np.abs(s.u - o.u).max() < 1e-12

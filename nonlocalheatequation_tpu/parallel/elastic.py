@@ -644,30 +644,27 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
             self.compute_linf(self.nt)
         return self.u
 
-    def gather(self) -> np.ndarray:
+    def _place_blocks(self, items) -> np.ndarray:
+        """Assemble the global grid from ((gx, gy), tile) pairs."""
         out = np.zeros((self.NX, self.NY), dtype=np.float64)
-        if getattr(self, "_gang_active", False):
-            # read-only snapshot straight from the resident sharded state
-            # (one host transfer; the gang stays entered)
-            for (gx, gy), tile in self._gang.plan.unpack(
-                    self._gang._state).items():
-                out[gx * self.nx:(gx + 1) * self.nx,
-                    gy * self.ny:(gy + 1) * self.ny] = tile
-            return out
-        if self._bstate and getattr(self, "_tiles_stale", False):
-            # batched path: one host transfer per device, sliced on host
-            for d, own in self._order.items():
-                if not own:
-                    continue
-                batch = np.asarray(self._bstate[d])
-                for i, (gx, gy) in enumerate(own):
-                    out[gx * self.nx:(gx + 1) * self.nx,
-                        gy * self.ny:(gy + 1) * self.ny] = batch[i]
-            return out
-        for (gx, gy), tile in self._tiles.items():
+        for (gx, gy), tile in items:
             out[gx * self.nx:(gx + 1) * self.nx,
                 gy * self.ny:(gy + 1) * self.ny] = np.asarray(tile)
         return out
+
+    def gather(self) -> np.ndarray:
+        if getattr(self, "_gang_active", False):
+            # read-only snapshot straight from the resident sharded state
+            # (one host transfer; the gang stays entered)
+            return self._place_blocks(
+                self._gang.plan.unpack(self._gang._state).items())
+        if self._bstate and getattr(self, "_tiles_stale", False):
+            # batched path: one host transfer per device, sliced on host
+            return self._place_blocks(
+                (key, np.asarray(self._bstate[d])[i])
+                for d, own in self._order.items() if own
+                for i, key in enumerate(own))
+        return self._place_blocks(self._tiles.items())
 
     def busy_rates(self) -> np.ndarray:
         """Current-window measured rates; falls back to the last completed

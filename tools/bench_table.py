@@ -258,21 +258,11 @@ def bench_elastic(steps: int):
     rng = np.random.default_rng(0)
     u0 = rng.normal(size=(n, n))
 
-    # SPMD side (the flagship path)
+    # SPMD side (the flagship path; same rng(0) state as u0)
     s = Solver2DDistributed(n, n, 1, 1, nt=steps, eps=8, k=1.0,
                             dt=1e-7, dh=1.0 / n, method=method,
                             dtype=jnp.float32)
-    s.input_init(u0)
-    step = s._build_step()
-    u, _src = s._device_state()
-    from jax import lax
-
-    @jax.jit
-    def multi(ustate):
-        return lax.scan(lambda c, t: (step(c, t), None), ustate,
-                        jnp.arange(steps))[0]
-
-    spmd_sec, _ = time_steps(multi, u, steps)
+    spmd_sec = _time_dist_solver(s, steps)
 
     # elastic side: same grid, 8x8 tiles, overlapped batched dispatch
     # (do_work includes tile placement; amortized over the steps, as the

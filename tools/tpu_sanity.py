@@ -11,7 +11,9 @@ actual backend and cross-checks each against the sat path:
   * 3D at eps values not divisible by 4 (the round-3 bug class),
   * pallas inside shard_map on the real device.
 
-Exit 0 = all compiled and matched; nonzero = at least one FAIL line.
+Exit 0 = all compiled and matched; 1 = at least one FAIL line; 3 = the
+watchdog aborted a wedged sweep (no FAIL lines — the sweep never ran to
+completion; see SANITY_WATCHDOG_S).
 Run:  python tools/tpu_sanity.py        (a few minutes on a v5e)
 """
 
@@ -54,6 +56,23 @@ def check(label, fn):
 
 
 def main() -> int:
+    # a wedged tunnel hangs the first jax.devices() with no exception; this
+    # sweep is meant to be run standalone on real hardware, so guard the
+    # whole run with a hard watchdog (tpu_refresh.sh additionally gates it
+    # on bench.py's hang-proof probe)
+    import threading
+
+    budget_s = float(os.environ.get("SANITY_WATCHDOG_S", 1200))
+    done = threading.Event()
+
+    def _watchdog():
+        if not done.wait(budget_s):
+            print(f"WATCHDOG: sanity sweep wedged for {budget_s:.0f}s; "
+                  "aborting (chip/tunnel unhealthy)", flush=True)
+            os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     rng = np.random.default_rng(0)
     print(f"backend: {jax.default_backend()} ({jax.devices()[0]})", flush=True)
     if jax.default_backend() != "tpu":
@@ -138,6 +157,7 @@ def main() -> int:
     check("pallas in shard_map 1-dev 64^2 eps=5", f_sm)
 
     print("FAILS:", fails, flush=True)
+    done.set()  # sweep finished: cancel the watchdog (host-process safe)
     return 1 if fails else 0
 
 

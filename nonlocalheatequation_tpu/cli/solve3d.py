@@ -42,12 +42,30 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("auto", "shift", "sat", "pallas"))
     p.add_argument("--distributed", action="store_true",
                    help="shard over the device mesh (SPMD + halo exchange)")
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint file to write every --ncheckpoint steps")
+    p.add_argument("--ncheckpoint", type=int, default=0,
+                   help="steps between checkpoints (0 = never)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the --checkpoint file before running")
     add_platform_flags(p)
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.resume and not args.checkpoint:
+        print("--resume requires --checkpoint", file=sys.stderr)
+        return 1
+    if args.test_batch and (args.resume or args.checkpoint):
+        print("--checkpoint/--resume cannot be combined with --test_batch",
+              file=sys.stderr)
+        return 1
+    if args.distributed and args.checkpoint:
+        print("--checkpoint is supported on the serial 3D solver "
+              "(the distributed 3D solver has no checkpoint hook yet)",
+              file=sys.stderr)
+        return 1
     if args.distributed and args.backend == "oracle":
         print("--distributed runs the SPMD jit solver; it has no oracle "
               "backend (use the serial oracle for ground truth)",
@@ -67,7 +85,9 @@ def main(argv=None) -> int:
             return Solver3DDistributed(nx, ny, nz, nt, eps, nlog=args.nlog,
                                        k=k, dt=dt, dh=dh, method=args.method)
         return Solver3D(nx, ny, nz, nt, eps, nlog=args.nlog, k=k, dt=dt,
-                        dh=dh, backend=args.backend, method=args.method)
+                        dh=dh, backend=args.backend, method=args.method,
+                        checkpoint_path=args.checkpoint,
+                        ncheckpoint=args.ncheckpoint)
 
     if args.test_batch:
         # row: nx ny nz nt eps k dt dh
@@ -89,9 +109,11 @@ def main(argv=None) -> int:
                     args.dt, args.dh)
     if args.test:
         s.test_init()
-    else:
+    elif not args.resume:
         n = args.nx * args.ny * args.nz
         s.input_init(np.array(sys.stdin.read().split(), dtype=np.float64)[:n])
+    if args.resume:
+        s.resume(args.checkpoint)
 
     t0 = time.perf_counter()
     s.do_work()

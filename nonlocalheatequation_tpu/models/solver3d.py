@@ -15,9 +15,13 @@ import jax.numpy as jnp
 
 from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D, source_at
+from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
 
 
-class Solver3D(ManufacturedMetrics2D):
+class Solver3D(CheckpointMixin, ManufacturedMetrics2D):
+    """3D serial/jit solver on the (nx, ny, nz) grid — see module docstring;
+    checkpoint/resume via CheckpointMixin."""
+
     def __init__(
         self,
         nx: int,
@@ -33,6 +37,8 @@ class Solver3D(ManufacturedMetrics2D):
         method: str = "sat",
         logger=None,
         dtype=None,
+        checkpoint_path: str | None = None,
+        ncheckpoint: int = 0,
     ):
         self.nx, self.ny, self.nz = int(nx), int(ny), int(nz)
         self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
@@ -40,6 +46,9 @@ class Solver3D(ManufacturedMetrics2D):
         self.backend = backend
         self.logger = logger
         self.dtype = dtype
+        self.checkpoint_path = checkpoint_path
+        self.ncheckpoint = int(ncheckpoint)
+        self.t0 = 0
         self.test = False
         self.u0 = np.zeros((self.nx, self.ny, self.nz), dtype=np.float64)
         self.u = None
@@ -64,13 +73,14 @@ class Solver3D(ManufacturedMetrics2D):
 
         if self.backend == "oracle":
             u = self.u0.copy()
-            for t in range(self.nt):
+            for t in range(self.t0, self.nt):
                 du = self.op.apply_np(u)
                 if self.test:
                     du = du + source_at(g, lg, t, self.op.dt)
                 u = u + self.op.dt * du
                 if t % self.nlog == 0 and self.logger is not None:
                     self.logger(t, u)
+                self._maybe_checkpoint(t, u)
         else:
             u = self._run_jit(g, lg)
 
@@ -90,14 +100,27 @@ class Solver3D(ManufacturedMetrics2D):
             jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         )
         u = jnp.asarray(self.u0, dtype)
+        checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
+        if self.logger is None and not checkpointing:
+            multi = make_multi_step_fn(self.op, self.nt - self.t0, g, lg,
+                                       dtype)
+            return np.asarray(multi(u, self.t0))
         if self.logger is None:
-            multi = make_multi_step_fn(self.op, self.nt, g, lg, dtype)
-            return np.asarray(multi(u, 0))
+            # checkpoint-only: one fused scan per checkpoint segment
+            multis = {}
+            for start, count in self._ckpt_chunks():
+                if count not in multis:
+                    multis[count] = make_multi_step_fn(
+                        self.op, count, g, lg, dtype)
+                u = multis[count](u, start)
+                self._maybe_checkpoint(start + count - 1, u)
+            return np.asarray(u)
         step = jax.jit(make_step_fn(self.op, g, lg, dtype))
-        for t in range(self.nt):
+        for t in range(self.t0, self.nt):
             u = step(u, t)
-            if t % self.nlog == 0:
+            if t % self.nlog == 0 and self.logger is not None:
                 self.logger(t, np.asarray(u))
+            self._maybe_checkpoint(t, u)
         return np.asarray(u)
 
     # -- error metrics: ManufacturedMetrics2D (rank-agnostic) ---------------

@@ -36,6 +36,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
+
+
 def _load_native():
     from nonlocalheatequation_tpu.utils.native import load_native_lib
 
@@ -427,19 +430,41 @@ class ShardedUnstructuredOp:
         return out.reshape(self.S * self.B)[: self.n]
 
 
-class UnstructuredSolver:
+class UnstructuredSolver(CheckpointMixin):
     """Forward-Euler solver on a point cloud, same contract as the grid
     solvers: ``test_init`` + ``do_work`` + ``error_l2/#points <= 1e-6``."""
 
-    def __init__(self, op: UnstructuredNonlocalOp, nt: int, backend="jit"):
+    def __init__(self, op: UnstructuredNonlocalOp, nt: int, backend="jit",
+                 checkpoint_path: str | None = None, ncheckpoint: int = 0):
         self.op = op
         self.nt = int(nt)
         self.backend = backend
+        self.checkpoint_path = checkpoint_path
+        self.ncheckpoint = int(ncheckpoint)
+        self.t0 = 0
         self.test = False
         self.u0 = np.zeros(op.n)
         self.u = None
         self.error_l2 = 0.0
         self.error_linf = 0.0
+
+    def _ckpt_params(self) -> dict:
+        """Canonical params for the point cloud: eps is a per-point FIELD
+        here, so record scalar invariants of it (mean + L2) rather than the
+        grid mixin's single integer."""
+        inner = getattr(self.op, "inner", self.op)  # unwrap Sharded
+        return dict(
+            shape=[int(inner.n)],
+            eps=float(np.mean(inner.eps)),
+            eps_l2=float(np.sum(inner.eps ** 2)),
+            k=float(inner.k),
+            dt=float(self.op.dt),
+            test=bool(self.test),
+        )
+
+    @property
+    def _grid_shape(self):
+        return (getattr(self.op, "inner", self.op).n,)
 
     def test_init(self):
         self.test = True
@@ -456,11 +481,12 @@ class UnstructuredSolver:
         op = self.op
         if self.backend == "oracle":
             u = self.u0.copy()
-            for t in range(self.nt):
+            for t in range(self.t0, self.nt):
                 du = op.apply_np(u)
                 if self.test:
                     du = du + source_at(g, lg, t, op.dt)
                 u = u + op.dt * du
+                self._maybe_checkpoint(t, u)
         else:
             test = self.test
             dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
@@ -473,11 +499,30 @@ class UnstructuredSolver:
                     du = du + source_at(gd, lgd, t, op.dt)
                 return u + op.dt * du, None
 
-            @jax.jit
-            def multi(u):
-                return jax.lax.scan(step, u, jnp.arange(self.nt))[0]
+            chunks = {}
 
-            u = np.asarray(multi(jnp.asarray(self.u0, dtype)))
+            def run_chunk(u, t0, count):
+                # one compiled scan per DISTINCT count (ncheckpoint + the
+                # remainder at most) — fused stretches, not per-step calls
+                if count not in chunks:
+                    @jax.jit
+                    def run(u, t0, _n=count):
+                        ts = t0 + jnp.arange(_n)
+                        return jax.lax.scan(step, u, ts)[0]
+
+                    chunks[count] = run
+                return chunks[count](u, jnp.int32(t0))
+
+            if self.checkpoint_path and self.ncheckpoint:
+                u = jnp.asarray(self.u0, dtype)
+                for start, count in self._ckpt_chunks():
+                    u = run_chunk(u, start, count)
+                    self._maybe_checkpoint(start + count - 1, u)
+                u = np.asarray(u)
+            else:
+                u = np.asarray(run_chunk(
+                    jnp.asarray(self.u0, dtype), self.t0,
+                    self.nt - self.t0))
         self.u = u
         if self.test:
             d = u - op.manufactured_solution(self.nt)

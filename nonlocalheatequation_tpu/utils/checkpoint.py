@@ -113,6 +113,18 @@ class CheckpointMixin:
         return bool(self.checkpoint_path and self.ncheckpoint
                     and (t + 1) % self.ncheckpoint == 0)
 
+    def _ckpt_chunks(self):
+        """(start, count) segments of [t0, nt) ending at each checkpoint
+        step, so jit paths can run one fused multi-step program per segment
+        instead of dispatching per step."""
+        chunks = []
+        start = self.t0
+        for t in range(self.t0, self.nt):
+            if self._ckpt_due(t) or t == self.nt - 1:
+                chunks.append((start, t - start + 1))
+                start = t + 1
+        return chunks
+
     def _maybe_checkpoint(self, t: int, u=None) -> None:
         if self._ckpt_due(t):
             state = np.asarray(u) if u is not None else self.gather()

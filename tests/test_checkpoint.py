@@ -164,3 +164,75 @@ def test_legacy_nx_ny_params_translate_to_shape(tmp_path):
     assert params["shape"] == list(s._grid_shape)
     s.resume(path)  # must not raise "'shape' missing"
     assert s.t0 == 0
+
+
+def test_solver3d_checkpoint_resume_bit_identical(tmp_path):
+    from nonlocalheatequation_tpu.models.solver3d import Solver3D
+
+    path = str(tmp_path / "c3.npz")
+
+    def make(**kw):
+        return Solver3D(10, 10, 10, 12, eps=2, k=0.5, dt=1e-4, dh=0.1,
+                        backend="jit", **kw)
+
+    full = make()
+    full.test_init()
+    full.do_work()
+    first = make(checkpoint_path=path, ncheckpoint=5)
+    first.test_init()
+    first.nt = 7  # crash after the t=4 checkpoint
+    first.do_work()
+    second = make(checkpoint_path=path, ncheckpoint=5)
+    second.test_init()
+    second.resume(path)
+    second.do_work()
+    assert np.array_equal(full.u, second.u)
+
+
+def test_unstructured_checkpoint_resume_bit_identical(tmp_path):
+    from nonlocalheatequation_tpu.ops.unstructured import (
+        UnstructuredNonlocalOp,
+        UnstructuredSolver,
+    )
+
+    path = str(tmp_path / "cu.npz")
+    rng = np.random.default_rng(0)
+    m, h = 12, 1.0 / 12
+    xs, ys = np.meshgrid(np.arange(m) * h, np.arange(m) * h, indexing="ij")
+    pts = np.stack([xs.ravel(), ys.ravel()], axis=1)
+    pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+    op = UnstructuredNonlocalOp(pts, 2.8 * h, k=0.5, dt=1e-5, vol=h * h)
+
+    full = UnstructuredSolver(op, nt=12)
+    full.test_init()
+    full.do_work()
+    first = UnstructuredSolver(op, nt=12, checkpoint_path=path, ncheckpoint=5)
+    first.test_init()
+    first.nt = 7
+    first.do_work()
+    second = UnstructuredSolver(op, nt=12, checkpoint_path=path,
+                                ncheckpoint=5)
+    second.test_init()
+    second.resume(path)
+    second.do_work()
+    assert np.array_equal(full.u, second.u)
+
+
+def test_unstructured_checkpoint_param_mismatch_refuses(tmp_path):
+    from nonlocalheatequation_tpu.ops.unstructured import (
+        UnstructuredNonlocalOp,
+        UnstructuredSolver,
+    )
+
+    path = str(tmp_path / "cu2.npz")
+    rng = np.random.default_rng(1)
+    pts = rng.uniform(size=(64, 2))
+    op = UnstructuredNonlocalOp(pts, 0.2, k=0.5, dt=1e-5, vol=1.0 / 64)
+    s = UnstructuredSolver(op, nt=6, checkpoint_path=path, ncheckpoint=3)
+    s.test_init()
+    s.do_work()
+    op2 = UnstructuredNonlocalOp(pts, 0.3, k=0.5, dt=1e-5, vol=1.0 / 64)
+    other = UnstructuredSolver(op2, nt=6)
+    other.test_init()
+    with pytest.raises(ValueError):
+        other.resume(path)

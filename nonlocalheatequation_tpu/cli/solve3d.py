@@ -61,11 +61,6 @@ def main(argv=None) -> int:
         print("--checkpoint/--resume cannot be combined with --test_batch",
               file=sys.stderr)
         return 1
-    if args.distributed and args.checkpoint:
-        print("--checkpoint is supported on the serial 3D solver "
-              "(the distributed 3D solver has no checkpoint hook yet)",
-              file=sys.stderr)
-        return 1
     if args.distributed and args.backend == "oracle":
         print("--distributed runs the SPMD jit solver; it has no oracle "
               "backend (use the serial oracle for ground truth)",
@@ -83,7 +78,9 @@ def main(argv=None) -> int:
             )
 
             return Solver3DDistributed(nx, ny, nz, nt, eps, nlog=args.nlog,
-                                       k=k, dt=dt, dh=dh, method=args.method)
+                                       k=k, dt=dt, dh=dh, method=args.method,
+                                       checkpoint_path=args.checkpoint,
+                                       ncheckpoint=args.ncheckpoint)
         return Solver3D(nx, ny, nz, nt, eps, nlog=args.nlog, k=k, dt=dt,
                         dh=dh, backend=args.backend, method=args.method,
                         checkpoint_path=args.checkpoint,

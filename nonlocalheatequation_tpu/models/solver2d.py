@@ -120,15 +120,9 @@ class Solver2D(CheckpointMixin, ManufacturedMetrics2D):
             return np.asarray(multi(u, self.t0))
         if self.logger is None and self.nd is None:
             # checkpoint-only: one fused scan per checkpoint segment
-            # (compiled once per DISTINCT length: ncheckpoint + remainder)
-            multis = {}
-            for start, count in self._ckpt_chunks():
-                if count not in multis:
-                    multis[count] = make_multi_step_fn(
-                        self.op, count, g, lg, dtype)
-                u = multis[count](u, start)
-                self._maybe_checkpoint(start + count - 1, u)
-            return np.asarray(u)
+            return np.asarray(self._run_chunked(
+                u, lambda count: make_multi_step_fn(
+                    self.op, count, g, lg, dtype)))
 
         step = jax.jit(make_step_fn(self.op, g, lg, dtype))
         inflight = []

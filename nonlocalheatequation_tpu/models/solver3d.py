@@ -107,18 +107,13 @@ class Solver3D(CheckpointMixin, ManufacturedMetrics2D):
             return np.asarray(multi(u, self.t0))
         if self.logger is None:
             # checkpoint-only: one fused scan per checkpoint segment
-            multis = {}
-            for start, count in self._ckpt_chunks():
-                if count not in multis:
-                    multis[count] = make_multi_step_fn(
-                        self.op, count, g, lg, dtype)
-                u = multis[count](u, start)
-                self._maybe_checkpoint(start + count - 1, u)
-            return np.asarray(u)
+            return np.asarray(self._run_chunked(
+                u, lambda count: make_multi_step_fn(
+                    self.op, count, g, lg, dtype)))
         step = jax.jit(make_step_fn(self.op, g, lg, dtype))
         for t in range(self.t0, self.nt):
             u = step(u, t)
-            if t % self.nlog == 0 and self.logger is not None:
+            if t % self.nlog == 0:
                 self.logger(t, np.asarray(u))
             self._maybe_checkpoint(t, u)
         return np.asarray(u)

@@ -499,30 +499,20 @@ class UnstructuredSolver(CheckpointMixin):
                     du = du + source_at(gd, lgd, t, op.dt)
                 return u + op.dt * du, None
 
-            chunks = {}
+            def make_runner(count):
+                @jax.jit
+                def run(u, t0):
+                    ts = t0 + jnp.arange(count)
+                    return jax.lax.scan(step, u, ts)[0]
 
-            def run_chunk(u, t0, count):
-                # one compiled scan per DISTINCT count (ncheckpoint + the
-                # remainder at most) — fused stretches, not per-step calls
-                if count not in chunks:
-                    @jax.jit
-                    def run(u, t0, _n=count):
-                        ts = t0 + jnp.arange(_n)
-                        return jax.lax.scan(step, u, ts)[0]
+                return lambda u, start: run(u, jnp.int32(start))
 
-                    chunks[count] = run
-                return chunks[count](u, jnp.int32(t0))
-
+            u = jnp.asarray(self.u0, dtype)
             if self.checkpoint_path and self.ncheckpoint:
-                u = jnp.asarray(self.u0, dtype)
-                for start, count in self._ckpt_chunks():
-                    u = run_chunk(u, start, count)
-                    self._maybe_checkpoint(start + count - 1, u)
-                u = np.asarray(u)
+                u = np.asarray(self._run_chunked(u, make_runner))
             else:
-                u = np.asarray(run_chunk(
-                    jnp.asarray(self.u0, dtype), self.t0,
-                    self.nt - self.t0))
+                u = np.asarray(
+                    make_runner(self.nt - self.t0)(u, self.t0))
         self.u = u
         if self.test:
             d = u - op.manufactured_solution(self.nt)

@@ -174,21 +174,27 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         u, source_args = self._device_state()
 
         checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
-        if self.logger is None and not checkpointing:
-            def body(carry, t):
-                return step(carry, *source_args, t), None
+        if self.logger is None:
+            def make_runner(count):
+                @jax.jit
+                def run(u0, t_start):
+                    ts = t_start + jnp.arange(count)
+                    return lax.scan(
+                        lambda c, t: (step(c, *source_args, t), None),
+                        u0, ts)[0]
 
-            @jax.jit
-            def run(u0):
-                out, _ = lax.scan(body, u0, jnp.arange(self.t0, self.nt))
-                return out
+                return lambda u0, start: run(u0, jnp.int32(start))
 
-            u = run(u)
+            if checkpointing:
+                # one fused scan per checkpoint segment
+                u = self._run_chunked(u, make_runner)
+            else:
+                u = make_runner(self.nt - self.t0)(u, self.t0)
         else:
             jstep = jax.jit(step)
             for t in range(self.t0, self.nt):
                 u = jstep(u, *source_args, t)
-                if t % self.nlog == 0 and self.logger is not None:
+                if t % self.nlog == 0:
                     self.logger(t, np.asarray(u))
                 self._maybe_checkpoint(t, u)
 

@@ -25,6 +25,7 @@ from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D, source_at
 from nonlocalheatequation_tpu.parallel.halo import halo_pad_nd
 from nonlocalheatequation_tpu.parallel.mesh import grid_sharding_3d, make_mesh_3d
+from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
 
 
 def choose_mesh_for_grid_3d(NX: int, NY: int, NZ: int, devices=None) -> Mesh:
@@ -51,8 +52,10 @@ def choose_mesh_for_grid_3d(NX: int, NY: int, NZ: int, devices=None) -> Mesh:
     return make_mesh_3d(*best, devices=devices)
 
 
-class Solver3DDistributed(ManufacturedMetrics2D):
-    """Solve on the global (NX, NY, NZ) grid, sharded over a 3D mesh."""
+class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
+    """Solve on the global (NX, NY, NZ) grid, sharded over a 3D mesh;
+    checkpoint/resume via CheckpointMixin (portable with Solver3D on the
+    same global grid)."""
 
     def __init__(
         self,
@@ -69,6 +72,8 @@ class Solver3DDistributed(ManufacturedMetrics2D):
         method: str = "sat",
         logger=None,
         dtype=None,
+        checkpoint_path: str | None = None,
+        ncheckpoint: int = 0,
     ):
         self.NX, self.NY, self.NZ = int(NX), int(NY), int(NZ)
         self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
@@ -79,6 +84,9 @@ class Solver3DDistributed(ManufacturedMetrics2D):
         )
         self.logger = logger
         self.dtype = dtype
+        self.checkpoint_path = checkpoint_path
+        self.ncheckpoint = int(ncheckpoint)
+        self.t0 = 0
         self.test = False
         self.u0 = np.zeros((self.NX, self.NY, self.NZ), dtype=np.float64)
         self.u = None
@@ -135,22 +143,29 @@ class Solver3DDistributed(ManufacturedMetrics2D):
         step = self._build_step()
         u, source_args = self._device_state()
 
+        checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
         if self.logger is None:
-            def body(carry, t):
-                return step(carry, *source_args, t), None
+            def make_runner(count):
+                @jax.jit
+                def run(u0, t_start):
+                    ts = t_start + jnp.arange(count)
+                    return lax.scan(
+                        lambda c, t: (step(c, *source_args, t), None),
+                        u0, ts)[0]
 
-            @jax.jit
-            def run(u0):
-                out, _ = lax.scan(body, u0, jnp.arange(self.nt))
-                return out
+                return lambda u0, start: run(u0, jnp.int32(start))
 
-            u = run(u)
+            if checkpointing:
+                u = self._run_chunked(u, make_runner)
+            else:
+                u = make_runner(self.nt - self.t0)(u, self.t0)
         else:
             jstep = jax.jit(step)
-            for t in range(self.nt):
+            for t in range(self.t0, self.nt):
                 u = jstep(u, *source_args, t)
                 if t % self.nlog == 0:
                     self.logger(t, np.asarray(u))
+                self._maybe_checkpoint(t, u)
 
         self.u = np.asarray(u)
         if self.test:

@@ -125,6 +125,19 @@ class CheckpointMixin:
                 start = t + 1
         return chunks
 
+    def _run_chunked(self, u, make_runner):
+        """Drive the checkpoint-segmented time loop: one fused runner call
+        per segment, compiled once per DISTINCT segment length (ncheckpoint
+        + the remainder at most).  ``make_runner(count)`` returns a callable
+        ``(u, start) -> u`` advancing ``count`` steps from ``start``."""
+        runners = {}
+        for start, count in self._ckpt_chunks():
+            if count not in runners:
+                runners[count] = make_runner(count)
+            u = runners[count](u, start)
+            self._maybe_checkpoint(start + count - 1, u)
+        return u
+
     def _maybe_checkpoint(self, t: int, u=None) -> None:
         if self._ckpt_due(t):
             state = np.asarray(u) if u is not None else self.gather()

@@ -287,6 +287,35 @@ def bench_elastic(steps: int):
              elastic_over_spmd=best / spmd_sec)
 
 
+def bench_elastic_general(steps: int):
+    """The degenerate-horizon regime (eps > tile edge, the reference's
+    nx <= eps ctest rows): gang global-reassembly vs per-tile rectangle
+    walk, on a deliberately small grid (the regime's natural habitat)."""
+    from nonlocalheatequation_tpu.parallel.elastic import ElasticSolver2D
+
+    n, ntiles, eps = 64, 16, 8  # tile edge 4 < eps: general path
+    rng = np.random.default_rng(0)
+    u0 = rng.normal(size=(n, n))
+    for label, gang in (("2d/elastic-general", True),
+                        ("2d/elastic-general/pertile", False)):
+        e = ElasticSolver2D(n // ntiles, n // ntiles, ntiles, ntiles,
+                            nt=steps, eps=eps, k=1.0, dt=1e-7, dh=1.0 / n,
+                            method="sat", nlog=10 ** 9, dtype=jnp.float32)
+        assert not e._use_fused
+        e.use_gang = gang
+        e.input_init(u0)
+        t0 = time.perf_counter()
+        e.do_work()
+        log(f"    {label} compile+first: {time.perf_counter() - t0:.2f}s")
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            e.do_work()
+            best = min(best, time.perf_counter() - t0)
+        emit(label, n * n, steps, best, grid=n, eps=eps,
+             tiles=ntiles * ntiles, devices=len(jax.devices()))
+
+
 BENCHES = {
     "methods2d": bench_methods2d,
     "dist2d": bench_dist2d,
@@ -294,6 +323,7 @@ BENCHES = {
     "3d": bench_3d,
     "unstructured": bench_unstructured,
     "elastic": bench_elastic,
+    "elastic-general": bench_elastic_general,
 }
 
 

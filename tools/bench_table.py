@@ -287,6 +287,29 @@ def bench_elastic(steps: int):
              elastic_over_spmd=best / spmd_sec)
 
 
+def bench_eps_sweep(steps: int):
+    """Kernel scaling with horizon size: pallas (and sat for contrast) at
+    fixed grid across eps — the strip plan's op count grows with the
+    number of distinct heights/runs, not eps^2; this charts it."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp2D,
+        make_multi_step_fn,
+    )
+
+    n = cfg("BT_GRID2D", 4096, 512)
+    methods = ["pallas", "sat"] if on_tpu() else ["sat"]
+    rng = np.random.default_rng(0)
+    u0 = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
+    for eps in (2, 4, 8, 16, 32):
+        for method in methods:
+            op = NonlocalOp2D(eps, k=1.0, dt=1.0, dh=1.0 / n, method=method)
+            op = NonlocalOp2D(eps, k=1.0, dt=stable_dt(op), dh=1.0 / n,
+                              method=method)
+            multi = make_multi_step_fn(op, steps)
+            sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
+            emit(f"2d/{method}/eps{eps}", n * n, steps, sec, grid=n, eps=eps)
+
+
 def bench_elastic_general(steps: int):
     """The degenerate-horizon regime (eps > tile edge, the reference's
     nx <= eps ctest rows): gang global-reassembly vs per-tile rectangle
@@ -324,6 +347,7 @@ BENCHES = {
     "unstructured": bench_unstructured,
     "elastic": bench_elastic,
     "elastic-general": bench_elastic_general,
+    "eps-sweep": bench_eps_sweep,
 }
 
 

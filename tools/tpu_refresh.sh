@@ -40,7 +40,7 @@ run sanity python tools/tpu_sanity.py
 
 # 4. full table: methods, dist, 3d, unstructured (+sharded halos), elastic+gang
 run table env BT_STEPS=200 python tools/bench_table.py \
-    methods2d dist2d scaling 3d unstructured elastic
+    methods2d dist2d scaling 3d unstructured elastic elastic-general eps-sweep
 
 # 5. profiler trace of the headline rung
 run profile env BENCH_PROFILE=docs/bench/profile_r03b python bench.py

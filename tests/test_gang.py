@@ -202,3 +202,24 @@ def test_gang_general_with_rebalance_matches_oracle():
     o.test_init()
     o.do_work()
     assert np.abs(s.u - o.u).max() < 1e-12
+
+
+def test_gang_pad_slots_stay_zero():
+    """Devices with fewer tiles than T_max carry pad slots; the halo
+    reasoning requires they remain EXACTLY zero through a run (their
+    assembly reads only the zero slot)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    assignment = np.ones((4, 4), dtype=np.int64)
+    assignment[0, 0] = 0  # device 0: 1 tile, device 1: 15 -> T_max = 15
+    s = ElasticSolver2D(6, 6, 4, 4, nt=6, eps=2, nlog=1000, k=1.0,
+                        dt=1e-5, dh=0.04, assignment=assignment,
+                        devices=jax.devices()[:2])
+    s.test_init()
+    s.do_work()
+    gang = s._gang
+    assert gang is not None and gang.plan.t_max == 15
+    state = np.asarray(gang._state)
+    for d, own in gang.plan.order.items():
+        for j in range(len(own), gang.plan.t_max):
+            assert np.all(state[d, j] == 0.0), (d, j)

@@ -118,8 +118,8 @@ class Solver2D(CheckpointMixin, ManufacturedMetrics2D):
             # fast path: the whole time loop is one lax.scan program
             multi = make_multi_step_fn(self.op, nsteps, g, lg, dtype)
             return np.asarray(multi(u, self.t0))
-        if self.logger is None and self.nd is None:
-            # checkpoint-only: one fused scan per checkpoint segment
+        if self.nd is None:
+            # fused scan per segment; barriers = log and checkpoint steps
             return np.asarray(self._run_chunked(
                 u, lambda count: make_multi_step_fn(
                     self.op, count, g, lg, dtype)))

@@ -93,7 +93,6 @@ class Solver3D(CheckpointMixin, ManufacturedMetrics2D):
     def _run_jit(self, g, lg):
         from nonlocalheatequation_tpu.ops.nonlocal_op import (
             make_multi_step_fn,
-            make_step_fn,
         )
 
         dtype = self.dtype or (
@@ -105,18 +104,9 @@ class Solver3D(CheckpointMixin, ManufacturedMetrics2D):
             multi = make_multi_step_fn(self.op, self.nt - self.t0, g, lg,
                                        dtype)
             return np.asarray(multi(u, self.t0))
-        if self.logger is None:
-            # checkpoint-only: one fused scan per checkpoint segment
-            return np.asarray(self._run_chunked(
-                u, lambda count: make_multi_step_fn(
-                    self.op, count, g, lg, dtype)))
-        step = jax.jit(make_step_fn(self.op, g, lg, dtype))
-        for t in range(self.t0, self.nt):
-            u = step(u, t)
-            if t % self.nlog == 0:
-                self.logger(t, np.asarray(u))
-            self._maybe_checkpoint(t, u)
-        return np.asarray(u)
+        return np.asarray(self._run_chunked(
+            u, lambda count: make_multi_step_fn(
+                self.op, count, g, lg, dtype)))
 
     # -- error metrics: ManufacturedMetrics2D (rank-agnostic) ---------------
     @property

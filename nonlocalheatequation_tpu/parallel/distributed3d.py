@@ -144,28 +144,21 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         u, source_args = self._device_state()
 
         checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
-        if self.logger is None:
-            def make_runner(count):
-                @jax.jit
-                def run(u0, t_start):
-                    ts = t_start + jnp.arange(count)
-                    return lax.scan(
-                        lambda c, t: (step(c, *source_args, t), None),
-                        u0, ts)[0]
 
-                return lambda u0, start: run(u0, jnp.int32(start))
+        def make_runner(count):
+            @jax.jit
+            def run(u0, t_start):
+                ts = t_start + jnp.arange(count)
+                return lax.scan(
+                    lambda c, t: (step(c, *source_args, t), None),
+                    u0, ts)[0]
 
-            if checkpointing:
-                u = self._run_chunked(u, make_runner)
-            else:
-                u = make_runner(self.nt - self.t0)(u, self.t0)
+            return lambda u0, start: run(u0, jnp.int32(start))
+
+        if self.logger is None and not checkpointing:
+            u = make_runner(self.nt - self.t0)(u, self.t0)
         else:
-            jstep = jax.jit(step)
-            for t in range(self.t0, self.nt):
-                u = jstep(u, *source_args, t)
-                if t % self.nlog == 0:
-                    self.logger(t, np.asarray(u))
-                self._maybe_checkpoint(t, u)
+            u = self._run_chunked(u, make_runner)
 
         self.u = np.asarray(u)
         if self.test:

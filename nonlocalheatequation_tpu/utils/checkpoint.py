@@ -113,29 +113,41 @@ class CheckpointMixin:
         return bool(self.checkpoint_path and self.ncheckpoint
                     and (t + 1) % self.ncheckpoint == 0)
 
-    def _ckpt_chunks(self):
-        """(start, count) segments of [t0, nt) ending at each checkpoint
-        step, so jit paths can run one fused multi-step program per segment
-        instead of dispatching per step."""
+    def _ckpt_chunks(self, extra_due=None):
+        """(start, count) segments of [t0, nt) ending at each barrier step
+        (checkpoint cadence plus any ``extra_due(t)`` — e.g. a logging
+        cadence), so jit paths can run one fused multi-step program per
+        segment instead of dispatching per step."""
         chunks = []
         start = self.t0
         for t in range(self.t0, self.nt):
-            if self._ckpt_due(t) or t == self.nt - 1:
+            if (self._ckpt_due(t) or (extra_due is not None and extra_due(t))
+                    or t == self.nt - 1):
                 chunks.append((start, t - start + 1))
                 start = t + 1
         return chunks
 
     def _run_chunked(self, u, make_runner):
-        """Drive the checkpoint-segmented time loop: one fused runner call
-        per segment, compiled once per DISTINCT segment length (ncheckpoint
-        + the remainder at most).  ``make_runner(count)`` returns a callable
-        ``(u, start) -> u`` advancing ``count`` steps from ``start``."""
+        """Drive the barrier-segmented time loop: one fused runner call per
+        segment (barriers = the host's logging cadence, if any, plus the
+        checkpoint cadence), compiled once per DISTINCT segment length.
+        ``make_runner(count)`` returns ``(u, start) -> u`` advancing
+        ``count`` steps from ``start``.  Logging (self.logger every
+        self.nlog steps, the convention every solver shares) runs at each
+        barrier before the checkpoint, matching the per-step loops."""
+        logger = getattr(self, "logger", None)
+        nlog = getattr(self, "nlog", 0)
+        log_due = ((lambda t: t % nlog == 0)
+                   if logger is not None and nlog else None)
         runners = {}
-        for start, count in self._ckpt_chunks():
+        for start, count in self._ckpt_chunks(log_due):
             if count not in runners:
                 runners[count] = make_runner(count)
             u = runners[count](u, start)
-            self._maybe_checkpoint(start + count - 1, u)
+            last = start + count - 1
+            if log_due is not None and log_due(last):
+                logger(last, np.asarray(u))
+            self._maybe_checkpoint(last, u)
         return u
 
     def _maybe_checkpoint(self, t: int, u=None) -> None:

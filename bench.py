@@ -39,8 +39,10 @@ not a missing one.
 Env knobs: BENCH_GRID, BENCH_EPS, BENCH_STEPS, BENCH_WATCHDOG_S,
 BENCH_PLATFORM (cpu for CI smoke), BENCH_METHOD (skip the method probe),
 BENCH_LADDER (comma grids), BENCH_PROFILE (jax.profiler trace dir),
-BENCH_ALLOW_CPU_FALLBACK (default 1: if the TPU never answers, measure on
-CPU and say so rather than emit 0.0).
+BENCH_CARRIED=1 (pallas: carry the halo-padded state across the scan —
+opt-in until measured on hardware), BENCH_ALLOW_CPU_FALLBACK (default 1:
+if the TPU never answers, measure on CPU and say so rather than emit
+0.0).
 """
 
 import json

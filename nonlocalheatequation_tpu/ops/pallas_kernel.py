@@ -536,7 +536,9 @@ def _lane_runs_3d(eps: int):
     return tuple(runs)
 
 
-def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int):
+def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int,
+                           row0: int | None = None,
+                           col0: int | None = None):
     """Masked-sphere neighbor sum for one (tm, tn, nz) block.
 
     ``w`` is the (tm + pad, tn + 2*eps, nz + 2*eps) window; row r of axis 0
@@ -544,8 +546,14 @@ def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int):
     axis 0; wrap garbage lands in the never-read bottom pad rows.  The final
     accumulation sums each z-run of equal heights with one slice-add of a
     shared lane-window sum (see _lane_runs_3d); lane-roll wrap garbage stays
-    beyond every slice's read range (kk0 + L <= 2*eps + 1).
+    beyond every slice's read range (kk0 + L <= 2*eps + 1).  ``row0``/
+    ``col0`` are the window coordinates of the block's first center along
+    x/y (default eps; the carried-frame kernel passes its dead-band D).
     """
+    if row0 is None:
+        row0 = eps
+    if col0 is None:
+        col0 = eps
     _heights, parts_by_h, pows, _pad = _strip_plan_3d(eps)
     tmw = w.shape[0]
     down = lambda x, s: pltpu.roll(x, tmw - s, 0)  # noqa: E731
@@ -570,8 +578,9 @@ def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int):
         v, [(h, L) for h, _jj, _kk0, L in _lane_runs_3d(eps)], lane_down)
     acc = None
     for h, jj, kk0, run_len in _lane_runs_3d(eps):
-        a = eps - h
-        sl = wsums[h, run_len][a : a + tm, jj : jj + tn, kk0 : kk0 + nz]
+        a = row0 - h
+        cj = (col0 - eps) + jj
+        sl = wsums[h, run_len][a : a + tm, cj : cj + tn, kk0 : kk0 + nz]
         acc = sl if acc is None else acc + sl
     return acc
 
@@ -589,7 +598,27 @@ def _fits_3d(tm: int, tn: int, nz: int, eps: int, itemsize: int) -> bool:
     return stack <= _VMEM_BUDGET
 
 
-def _choose_tiles_3d(nx: int, ny: int, nz: int, eps: int, itemsize: int):
+def _fits_carried_3d(tm: int, tn: int, nz: int, eps: int,
+                     itemsize: int) -> bool:
+    """_fits_3d for the carried frame: taller x window, wider y window
+    (dead bands), and an out block spanning the full z = nz + 2*eps."""
+    heights, parts_by_h, _pows, pad = _strip_plan_3d(eps)
+    D = _round_up(eps, 8)
+    tmw = tm + _round_up((D - eps) + pad, 8)
+    ywin = _round_up(D + tn + eps, 8)
+    Lz = nz + 2 * eps
+    window = tmw * ywin * Lz * itemsize
+    out = tm * tn * Lz * itemsize
+    runs = _lane_runs_3d(eps)
+    lane_slots = _lane_slots({(h, L) for h, _jj, _kk0, L in runs})
+    log_steps = max(1, int(np.ceil(np.log2(tmw))))
+    stack = ((2 * log_steps + 4 + len(parts_by_h) + lane_slots) * window
+             + (2 * len(runs) + 3) * out)
+    return stack <= _VMEM_BUDGET
+
+
+def _choose_tiles_3d(nx: int, ny: int, nz: int, eps: int, itemsize: int,
+                     fits2=None):
     """(tm, tn): block footprint that fits VMEM, preferring divisors of nx/ny.
 
     Small blocks win on hardware: sweeping tm/tn on a v5e (round 3, post
@@ -616,8 +645,10 @@ def _choose_tiles_3d(nx: int, ny: int, nz: int, eps: int, itemsize: int):
                 return t
         return cap
 
-    tn = pick("ny", ny, lambda t: _fits_3d(8, t, nz, eps, itemsize), 16)
-    tm = pick("nx", nx, lambda t: _fits_3d(t, tn, nz, eps, itemsize), 8)
+    if fits2 is None:
+        fits2 = lambda tm, tn: _fits_3d(tm, tn, nz, eps, itemsize)  # noqa: E731
+    tn = pick("ny", ny, lambda t: fits2(8, t), 16)
+    tm = pick("nx", nx, lambda t: fits2(t, tn), 8)
     return tm, tn
 
 
@@ -779,6 +810,107 @@ def make_carried_multi_step_fn(op, nsteps: int, dtype=None):
 
         (A, _B), _ = lax.scan(body, (C0, C1), None, length=nsteps)
         return A[D + eps : D + eps + nx, eps : eps + ny]
+
+    return multi
+
+
+@functools.lru_cache(maxsize=None)
+def _build_carried_kernel_3d(eps: int, nx: int, ny: int, nz: int,
+                             dtype_name: str, c: float, dh: float,
+                             dt: float, wsum: float):
+    """3D mirror of _build_carried_kernel: the (Rx, Ry, Lz) frame carries
+    the halo-padded state across steps.  Both blocked axes get a
+    round_up(eps, 8) dead band so every Element offset stays 8-aligned
+    (windows at (i*tm, j*tn); out at the mul-form shifted offsets); z rides
+    whole in lanes with in-kernel halo re-zeroing, rows/y re-zeroed by iota
+    masks.  Ping-ponged aliased buffers avoid the in-place stencil hazard;
+    unwritten frame regions stay zero through the donate."""
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    tm, tn = _choose_tiles_3d(
+        nx, ny, nz, eps, dtype.itemsize,
+        fits2=lambda tm, tn: _fits_carried_3d(tm, tn, nz, eps,
+                                              dtype.itemsize))
+    D = _round_up(eps, 8)
+    pad_x = _strip_plan_3d(eps)[3]
+    tmw = tm + _round_up((D - eps) + pad_x, 8)
+    ywin = _round_up(D + tn + eps, 8)
+    Lz = nz + 2 * eps
+    Gx = -(-(nx + 2 * eps) // tm)
+    Gy = -(-(ny + 2 * eps) // tn)
+    Rx = max(D + Gx * tm, (Gx - 1) * tm + tmw)
+    Ry = max(D + Gy * tn, (Gy - 1) * tn + ywin)
+    scale = c * dh ** 3
+
+    def kernel(win_ref, dst_ref, out_ref):
+        del dst_ref  # alias target
+        w = win_ref[:]
+        acc = _block_neighbor_sum_3d(w, tm, tn, nz, eps, row0=D, col0=D)
+        center = w[D : D + tm, D : D + tn, eps : eps + nz]
+        nxt = center + dt * (scale * (acc - wsum * center))
+        i, j = pl.program_id(0), pl.program_id(1)
+        rows = D + i * tm + lax.broadcasted_iota(jnp.int32, (tm, tn, nz), 0)
+        cols = D + j * tn + lax.broadcasted_iota(jnp.int32, (tm, tn, nz), 1)
+        ok = ((rows >= D + eps) & (rows < D + eps + nx)
+              & (cols >= D + eps) & (cols < D + eps + ny))
+        out_ref[:, :, eps : eps + nz] = jnp.where(ok, nxt, 0).astype(dtype)
+        out_ref[:, :, :eps] = jnp.zeros((tm, tn, eps), dtype)
+        out_ref[:, :, eps + nz :] = jnp.zeros((tm, tn, eps), dtype)
+
+    def step(A, B):
+        return pl.pallas_call(
+            kernel,
+            grid=(Gx, Gy),
+            in_specs=[
+                pl.BlockSpec(
+                    (pl.Element(tmw), pl.Element(ywin), pl.Element(Lz)),
+                    lambda i, j: (i * tm, j * tn, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(
+                (pl.Element(tm), pl.Element(tn), pl.Element(Lz)),
+                lambda i, j: ((i * (tm // 8) + D // 8) * 8,
+                              (j * (tn // 8) + D // 8) * 8, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((Rx, Ry, Lz), dtype),
+            input_output_aliases={1: 0},
+            **_kernel_params(),
+        )(A, B)
+
+    return step, Rx, Ry, Lz, D
+
+
+def make_carried_multi_step_fn_3d(op, nsteps: int, dtype=None):
+    """(u, t0) -> u after ``nsteps`` 3D steps, state carried in padded form.
+
+    Drop-in for make_multi_step_fn on the production path when
+    op.method == 'pallas'; see _build_carried_kernel_3d."""
+    eps = op.eps
+
+    @jax.jit
+    def multi(u, t0):
+        del t0
+        dt_ = dtype or u.dtype
+        nx, ny, nz = u.shape
+        step, Rx, Ry, Lz, D = _build_carried_kernel_3d(
+            eps, nx, ny, nz, jnp.dtype(dt_).name, op.c, op.dh, op.dt,
+            op.wsum)
+        C0 = (jnp.zeros((Rx, Ry, Lz), dt_)
+              .at[D + eps : D + eps + nx, D + eps : D + eps + ny,
+                  eps : eps + nz]
+              .set(u.astype(dt_)))
+        C1 = jnp.zeros((Rx, Ry, Lz), dt_)
+
+        def body(carry, _):
+            A, B = carry
+            return (step(A, B), A), None
+
+        (A, _B), _ = lax.scan(body, (C0, C1), None, length=nsteps)
+        return A[D + eps : D + eps + nx, D + eps : D + eps + ny,
+                 eps : eps + nz]
 
     return multi
 

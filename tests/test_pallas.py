@@ -186,3 +186,27 @@ def test_carried_multi_step_bit_identical():
         a = np.asarray(ref(u, jnp.int32(0)))
         b = np.asarray(new(u, jnp.int32(0)))
         assert np.array_equal(a, b), (n, eps, np.abs(a - b).max())
+
+
+def test_carried_multi_step_3d_bit_identical():
+    """3D carried-frame multi-step kernel: bit-identical to the per-step
+    pad+kernel path (same plan, same summation order)."""
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp3D,
+        make_multi_step_fn,
+    )
+    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        make_carried_multi_step_fn_3d,
+    )
+
+    rng = np.random.default_rng(5)
+    for n, eps, steps in [(32, 4, 3), (24, 6, 2), (40, 3, 2)]:
+        op = NonlocalOp3D(eps, k=1.0, dt=1e-7, dh=1.0 / n, method="pallas")
+        ref = make_multi_step_fn(op, steps, dtype=jnp.float32)
+        new = make_carried_multi_step_fn_3d(op, steps, dtype=jnp.float32)
+        u = jnp.asarray(rng.normal(size=(n, n, n)), jnp.float32)
+        a = np.asarray(ref(u, jnp.int32(0)))
+        b = np.asarray(new(u, jnp.int32(0)))
+        assert np.array_equal(a, b), (n, eps, np.abs(a - b).max())

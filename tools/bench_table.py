@@ -189,6 +189,14 @@ def bench_3d(steps: int):
         multi = make_multi_step_fn(op, steps)
         sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
         emit(f"3d/{method}", n ** 3, steps, sec, grid=n, eps=4)
+        if method == "pallas" and on_tpu():
+            from nonlocalheatequation_tpu.ops.pallas_kernel import (
+                make_carried_multi_step_fn_3d,
+            )
+
+            multi = make_carried_multi_step_fn_3d(op, steps)
+            sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
+            emit("3d/pallas-carried", n ** 3, steps, sec, grid=n, eps=4)
 
 
 def bench_unstructured(steps: int):

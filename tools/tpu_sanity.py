@@ -126,6 +126,24 @@ def main() -> int:
             assert rel < 1e-6, f"rel diff {rel:.2e}"
         check(f"carried multi-step {n}^2 eps={eps}", f)
 
+    for n, eps in [(64, 4), (48, 6)]:
+        def f(n=n, eps=eps):
+            from nonlocalheatequation_tpu.ops.nonlocal_op import (
+                make_multi_step_fn,
+            )
+            from nonlocalheatequation_tpu.ops.pallas_kernel import (
+                make_carried_multi_step_fn_3d,
+            )
+            op = NonlocalOp3D(eps, 1.0, 1e-7, 1.0 / n, method="pallas")
+            ref = make_multi_step_fn(op, 2, dtype=jnp.float32)
+            new = make_carried_multi_step_fn_3d(op, 2, dtype=jnp.float32)
+            u = jnp.asarray(rng.normal(size=(n, n, n)), jnp.float32)
+            a = np.asarray(ref(u, jnp.int32(0)))
+            b = np.asarray(new(u, jnp.int32(0)))
+            rel = np.abs(a - b).max() / max(np.abs(a).max(), 1e-30)
+            assert rel < 1e-6, f"rel diff {rel:.2e}"
+        check(f"carried 3d multi-step {n}^3 eps={eps}", f)
+
     def f_f64_guard():
         # explicit pallas + f64 on TPU must fail with the guidance message,
         # not a raw Mosaic trace (and certainly not a hang)

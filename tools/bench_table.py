@@ -110,6 +110,14 @@ def bench_methods2d(steps: int):
         multi = make_multi_step_fn(op, steps)
         sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
         emit(f"2d/{method}", n * n, steps, sec, grid=n, eps=8)
+        if method == "pallas" and on_tpu():
+            from nonlocalheatequation_tpu.ops.pallas_kernel import (
+                make_carried_multi_step_fn,
+            )
+
+            multi = make_carried_multi_step_fn(op, steps)
+            sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
+            emit("2d/pallas-carried", n * n, steps, sec, grid=n, eps=8)
 
 
 def _time_dist_solver(s, steps: int) -> float:

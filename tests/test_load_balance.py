@@ -185,7 +185,9 @@ class _DraggedDeviceSolver(ElasticSolver2D):
     Only a measurement can see this — no tile-count model would."""
 
     slow_device = 1
-    drag_s = 0.003
+    # large enough that host scheduling noise (parallel test runs, CI
+    # neighbors) cannot mask the dragged device inside a 5-step window
+    drag_s = 0.006
 
     def _tile_hook(self, key):
         if int(self.assignment[key]) == self.slow_device:

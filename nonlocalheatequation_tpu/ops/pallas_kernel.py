@@ -41,6 +41,7 @@ code path is exercised by the CPU test suite (tests/conftest.py), in f64.
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -256,7 +257,31 @@ def _strip_plan(eps: int):
     return heights, parts_by_h, pows, pad
 
 
+def _lane_runs_enabled() -> bool:
+    """NLHEAT_LANE_RUNS=0 disables the two-level lane accumulation (every
+    run degenerates to length 1 == the pre-optimization per-lane slice-add
+    path).  Debug/bisect knob: the two-level form is the only 2D kernel
+    change between the 13/13-green compiled sweep of 2026-07-29 and the
+    eps=10 compile hang observed 2026-07-30; set it BEFORE the first
+    kernel build (plans are cached per enabled-state)."""
+    return os.environ.get("NLHEAT_LANE_RUNS", "1") != "0"
+
+
 @functools.lru_cache(maxsize=None)
+def _lane_runs_cached(eps: int, enabled: bool):
+    heights = _strip_plan(eps)[0]
+    if not enabled:
+        return tuple((h, j, 1) for j, h in enumerate(heights))
+    runs = []
+    j = 0
+    while j < len(heights):
+        j0, h = j, heights[j]
+        while j < len(heights) and heights[j] == h:
+            j += 1
+        runs.append((h, j0, j - j0))
+    return tuple(runs)
+
+
 def _lane_runs(eps: int):
     """Maximal runs of equal column half-height along the lane offsets.
 
@@ -267,15 +292,7 @@ def _lane_runs(eps: int):
     the same dyadic-window idea applied a second time, along lanes.
     Returns ((h, j0, L), ...): height, first lane offset, run length.
     """
-    heights = _strip_plan(eps)[0]
-    runs = []
-    j = 0
-    while j < len(heights):
-        j0, h = j, heights[j]
-        while j < len(heights) and heights[j] == h:
-            j += 1
-        runs.append((h, j0, j - j0))
-    return tuple(runs)
+    return _lane_runs_cached(eps, _lane_runs_enabled())
 
 
 def _strip_neighbor_sum(w, tm: int, ny: int, eps: int, row0: int | None = None):
@@ -510,15 +527,7 @@ def _strip_plan_3d(eps: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _lane_runs_3d(eps: int):
-    """Runs of equal half-height along the z (lane) offsets, per y offset.
-
-    The 2D kernel's second-level trick, one more axis: for each fixed jj the
-    sphere's column heights h(jj, kk) are flat in stretches of kk, so each
-    run sums with ONE slice-add of a lane-window sum W_L(v[h]) — and W_L is
-    shared across every (jj, kk0) run with the same (h, L), anywhere on the
-    sphere.  Returns ((h, jj, kk0, L), ...).
-    """
+def _lane_runs_3d_cached(eps: int, enabled: bool):
     heights = _strip_plan_3d(eps)[0]
     runs = []
     for jj in sorted({j for j, _k in heights}):
@@ -528,12 +537,25 @@ def _lane_runs_3d(eps: int):
             k0 = kks[i]
             h = heights[jj, k0]
             L = 1
-            while (i + L < len(kks) and kks[i + L] == k0 + L
+            while (enabled and i + L < len(kks) and kks[i + L] == k0 + L
                    and heights[jj, k0 + L] == h):
                 L += 1
             runs.append((h, jj, k0, L))
             i += L
     return tuple(runs)
+
+
+def _lane_runs_3d(eps: int):
+    """Runs of equal half-height along the z (lane) offsets, per y offset.
+
+    The 2D kernel's second-level trick, one more axis: for each fixed jj the
+    sphere's column heights h(jj, kk) are flat in stretches of kk, so each
+    run sums with ONE slice-add of a lane-window sum W_L(v[h]) — and W_L is
+    shared across every (jj, kk0) run with the same (h, L), anywhere on the
+    sphere.  Returns ((h, jj, kk0, L), ...).  NLHEAT_LANE_RUNS=0 degrades
+    every run to length 1 (see _lane_runs_enabled).
+    """
+    return _lane_runs_3d_cached(eps, _lane_runs_enabled())
 
 
 def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int,

@@ -21,7 +21,28 @@ echo "== TPU refresh $STAMP ==" | tee "$OUT"
 run() {  # run <label> <cmd...>  (no timeout: see header)
   echo "-- $1" | tee -a "$OUT"
   "${@:2}" >> "$OUT" 2>&1
-  echo "-- $1 rc=$?" | tee -a "$OUT"
+  local rc=$?
+  echo "-- $1 rc=$rc" | tee -a "$OUT"
+  if [ $rc -eq 0 ]; then return 0; fi
+  if [ "$1" = sanity ] && [ $rc -eq 1 ]; then
+    # rc=1 means the sweep RAN TO COMPLETION with FAIL lines — a kernel
+    # cross-check mismatch, not a wedge (hangs exit 3).  The tunnel is
+    # healthy by construction; keep measuring, but flag the numbers.
+    echo "WARN: sanity completed with FAIL lines (see $OUT); tunnel is" \
+         "healthy — continuing, but treat kernel rows as suspect" | tee -a "$OUT"
+    return 0
+  fi
+  # Anything else (sanity rc=3 = named hang; unexpected tool crashes)
+  # means the tunnel state is unknown at best (observed live 2026-07-30:
+  # the sanity sweep hung on one config and everything after it sat on a
+  # wedged tunnel).  Stop here: the remaining tools are unprotected and
+  # would only deepen a wedge.
+  echo "ABORT: step '$1' failed (rc=$rc); tunnel state unknown/wedged —" \
+       "skipping the remaining refresh steps. See $OUT" | tee -a "$OUT"
+  grep -h '"bench"\|"metric"' "$OUT" >> "$TABLE"
+  echo "-- appended $(grep -c '"bench"\|"metric"' "$OUT") rows (partial)" \
+    | tee -a "$OUT"
+  exit 1
 }
 
 # 1. health gate + the headline artifact (self-watchdogged)

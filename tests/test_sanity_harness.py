@@ -1,0 +1,85 @@
+"""The compiled-mode sanity sweep must never kill a client mid-compile.
+
+The 2026-07-30 wedge showed the failure shape: one config hung, a blind
+in-process watchdog killed the whole sweep (a mid-compile kill is itself a
+wedge trigger, docs/bench/README.md "Wedge trigger"), and the refresh then
+ran unprotected tools against the dead tunnel.  tools/tpu_sanity.py now
+runs each check in its own subprocess under a two-phase budget; these
+tests drive the parent as a black box on CPU with injected hangs and
+assert the kill policy:
+
+  * an init-phase hang (no PHASE:init-ok line) is killed at the init
+    budget and aborts the sweep naming the config — safe phase, same kill
+    bench.py's probes use;
+  * a compile/run-phase hang (PHASE printed, then wedged) is NOT killed
+    at the check budget — only the hard cap may kill it, and the abort
+    names the config and the cap.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SANITY = os.path.join(REPO, "tools", "tpu_sanity.py")
+
+
+def run_sweep(env_extra, timeout=300):
+    env = dict(os.environ)
+    env.pop("SANITY_FAULT", None)
+    env.update({"BENCH_PLATFORM": "cpu", "SANITY_TEST_MODE": "1"}, **env_extra)
+    return subprocess.run(
+        [sys.executable, SANITY], capture_output=True, text=True, env=env,
+        timeout=timeout,
+    )
+
+
+def test_init_hang_is_killed_at_init_budget_and_names_config():
+    # init budget well above a loaded machine's real import+init time (~5s)
+    # so only the injected hang — which never prints PHASE — trips it
+    proc = run_sweep({
+        "SANITY_FAULT": "hang_init",
+        "SANITY_FAULT_INDEX": "1",
+        "SANITY_INIT_BUDGET_S": "25",
+        "SANITY_CHECK_BUDGET_S": "60",
+        "SANITY_HARD_CAP_S": "120",
+    })
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "HANG 2d 200^2 eps=5 (init)" in proc.stdout
+    # the sweep stopped: the check after the hung one never ran
+    assert "2d 50^2 eps=10" not in proc.stdout
+    # check 0 still passed before the hang
+    assert "ok   2d 50^2 eps=5" in proc.stdout
+
+
+def test_check_phase_hang_waits_past_budget_then_hard_cap_kills():
+    proc = run_sweep({
+        "SANITY_FAULT": "hang_check",
+        "SANITY_FAULT_INDEX": "0",
+        "SANITY_INIT_BUDGET_S": "60",
+        "SANITY_CHECK_BUDGET_S": "6",
+        "SANITY_HARD_CAP_S": "18",
+    }, timeout=240)
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    # the soft budget warned instead of killing
+    assert "NOT killing" in proc.stdout
+    # only the hard cap ended it, and the abort names config and phase
+    assert "HANG 2d 50^2 eps=5 (compile/run > 18s hard cap)" in proc.stdout
+
+
+def test_healthy_interpreted_sweep_is_labeled():
+    # no faults: first check passes and the off-TPU disclaimer is printed
+    # (run just past the first check, then the backend note must be there)
+    proc = run_sweep({
+        "SANITY_FAULT": "hang_init",   # hang check 1 so the run stays short
+        "SANITY_FAULT_INDEX": "1",
+        "SANITY_INIT_BUDGET_S": "25",
+    })
+    assert "backend: cpu" in proc.stdout
+    assert "kernels run interpreted" in proc.stdout
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

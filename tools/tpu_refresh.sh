@@ -18,6 +18,11 @@ OUT=docs/bench/refresh-$STAMP.log
 TABLE=docs/bench/BENCH_TABLE_r03.jsonl
 echo "== TPU refresh $STAMP ==" | tee "$OUT"
 
+append_rows() {  # copy every JSON measurement row from the log to the table
+  grep -h '"bench"\|"metric"' "$OUT" >> "$TABLE"
+  echo "-- appended $(grep -c '"bench"\|"metric"' "$OUT") rows$1" | tee -a "$OUT"
+}
+
 run() {  # run <label> <cmd...>  (no timeout: see header)
   echo "-- $1" | tee -a "$OUT"
   "${@:2}" >> "$OUT" 2>&1
@@ -39,9 +44,7 @@ run() {  # run <label> <cmd...>  (no timeout: see header)
   # would only deepen a wedge.
   echo "ABORT: step '$1' failed (rc=$rc); tunnel state unknown/wedged —" \
        "skipping the remaining refresh steps. See $OUT" | tee -a "$OUT"
-  grep -h '"bench"\|"metric"' "$OUT" >> "$TABLE"
-  echo "-- appended $(grep -c '"bench"\|"metric"' "$OUT") rows (partial)" \
-    | tee -a "$OUT"
+  append_rows " (partial)"
   exit 1
 }
 
@@ -66,7 +69,6 @@ run table env BT_STEPS=200 python tools/bench_table.py \
 # 5. profiler trace of the headline rung
 run profile env BENCH_PROFILE=docs/bench/profile_r03b python bench.py
 
-grep -h '"bench"\|"metric"' "$OUT" >> "$TABLE"
-echo "-- appended $(grep -c '"bench"\|"metric"' "$OUT") rows to $TABLE" | tee -a "$OUT"
+append_rows " to $TABLE"
 grep -h '"bench"\|"metric"' "$OUT" | tail -40
 echo "refresh log: $OUT"

@@ -283,10 +283,21 @@ def main() -> int:
         # child mode: init the backend first (phase breadcrumb lets the
         # parent distinguish an init hang, which is killable, from a
         # compile hang, which is not), then run exactly one check
-        label, fn = checks[int(sys.argv[2])]
+        idx = int(sys.argv[2])
+        label, fn = checks[idx]
+        # fault injection for the harness tests (tests/test_sanity_harness.py);
+        # gated on an explicit test-mode flag so a SANITY_FAULT leaked into a
+        # real shell cannot stall a live refresh for the 30-min hard cap
+        fault = (os.environ.get("SANITY_FAULT")
+                 if os.environ.get("SANITY_TEST_MODE") == "1" else None)
+        fault_idx = int(os.environ.get("SANITY_FAULT_INDEX", 0))
+        if fault == "hang_init" and idx == fault_idx:
+            time.sleep(10 ** 6)
         _np, jax = _setup()
         jax.devices()
         print("PHASE:init-ok", flush=True)
+        if fault == "hang_check" and idx == fault_idx:
+            time.sleep(10 ** 6)
         fn()
         print(f"one ok {label}", flush=True)
         return 0
@@ -310,8 +321,18 @@ def main() -> int:
                   "not starting the sweep", flush=True)
             return 3
         backend_line = next(
-            (ln for ln in out.splitlines() if ln.startswith("backend:")),
-            f"backend probe rc={rc}")
+            (ln for ln in out.splitlines() if ln.startswith("backend:")), None)
+        if rc != 0 or backend_line is None:
+            # a probe that CRASHES (fast plugin/connect error) is as
+            # disqualifying as one that hangs: the backend is broken, and
+            # running the sweep against it would exit 1 — which the refresh
+            # runbook would misread as "completed with kernel FAILs,
+            # tunnel healthy".  Abort with the wedge exit code instead.
+            tail = out.strip().splitlines()
+            print(f"ABORT backend probe rc={rc} "
+                  f"({tail[-1][:140] if tail else 'no output'}): backend "
+                  "broken; not starting the sweep", flush=True)
+            return 3
         print(backend_line, flush=True)
         if "backend: tpu" not in backend_line:
             print("note: not a TPU backend — kernels run interpreted; this "

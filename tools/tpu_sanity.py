@@ -37,7 +37,13 @@ which config hung — into a named offender and phase.
 Exit 0 = all compiled and matched; 1 = at least one FAIL line (checks that
 raise keep the sweep going); 3 = a HANG aborted the sweep.
 Run:  python tools/tpu_sanity.py        (a few minutes on a v5e)
-      python tools/tpu_sanity.py --one 4   (single check, in-process)
+      python tools/tpu_sanity.py --one 4    (single check, in-process, no
+                                             supervision — for debugging)
+      python tools/tpu_sanity.py --only 4   (single check under the
+                                             two-phase budget — for
+                                             bisecting a hang-suspect
+                                             config without running the
+                                             rest of the sweep)
 """
 
 from __future__ import annotations
@@ -275,15 +281,30 @@ def _run_one_child(args, init_budget_s, check_budget_s, hard_cap_s, tmpdir):
 def main() -> int:
     checks = _build_checks()
 
-    if len(sys.argv) > 1 and (sys.argv[1] == "--one" or len(sys.argv) > 2):
-        if len(sys.argv) != 3 or sys.argv[1] != "--one":
-            print(f"usage: {sys.argv[0]} [--one INDEX]  "
+    # one parse block: mode flag + range-checked index (bad input must exit
+    # rc=2, never rc=1 — the sweep contract reserves 1 for real kernel FAILs)
+    mode: str | None = None
+    idx = 0
+    if len(sys.argv) > 1:
+        def usage() -> int:
+            print(f"usage: {sys.argv[0]} [--one INDEX | --only INDEX]  "
                   f"(INDEX in 0..{len(checks) - 1})", file=sys.stderr)
             return 2
+        if len(sys.argv) != 3 or sys.argv[1] not in ("--one", "--only"):
+            return usage()
+        mode = sys.argv[1]
+        try:
+            idx = int(sys.argv[2])
+        except ValueError:
+            return usage()
+        if not 0 <= idx < len(checks):
+            return usage()
+    only = idx if mode == "--only" else None
+
+    if mode == "--one":
         # child mode: init the backend first (phase breadcrumb lets the
         # parent distinguish an init hang, which is killable, from a
         # compile hang, which is not), then run exactly one check
-        idx = int(sys.argv[2])
         label, fn = checks[idx]
         # fault injection for the harness tests (tests/test_sanity_harness.py);
         # gated on an explicit test-mode flag so a SANITY_FAULT leaked into a
@@ -338,7 +359,9 @@ def main() -> int:
             print("note: not a TPU backend — kernels run interpreted; this "
                   "sweep only proves anything on real hardware", flush=True)
 
-        for i, (label, _fn) in enumerate(checks):
+        todo = list(enumerate(checks)) if only is None else [
+            (only, checks[only])]
+        for i, (label, _fn) in todo:
             t0 = time.monotonic()
             status, rc, out = _run_one_child(
                 [sys.executable,

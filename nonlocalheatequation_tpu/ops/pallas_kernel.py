@@ -143,13 +143,8 @@ def _fits_carried(tm: int, nx: int, ny: int, eps: int, itemsize: int) -> bool:
 
 
 def _chain_steps(run_len: int) -> int:
-    """Roll+add count of the W_L doubling chain (shared with the VMEM model)."""
-    steps = 0
-    built = 1
-    while built * 2 <= run_len:
-        built *= 2
-        steps += 1
-    return steps + run_len - built
+    """Roll+add count of the linear W_L build (shared with the VMEM model)."""
+    return max(run_len - 1, 0)
 
 
 def _lane_slots(run_keys) -> int:
@@ -161,20 +156,33 @@ def _lane_slots(run_keys) -> int:
 
 
 def _build_lane_wsums(v, run_keys, lane_down):
-    """W_L(v[h]) per distinct (h, run_len) via the doubling chain."""
+    """W_L(v[h]) per distinct (h, run_len), built with LEAF-operand rolls:
+    W_L = v[h] + roll(v[h], 1) + ... + roll(v[h], L-1).
+
+    This was a doubling chain (roll the accumulator by built powers of
+    two).  For L <= 3 — every measured headline config — the two forms
+    trace to the bitwise-identical op sequence (the first doubling rolls
+    the still-unmodified accumulator == v[h]); at L >= 4 linear costs
+    (L-1) roll+adds against the chain's ~log2(L)+popcount-ish count (one
+    extra for L in 4..7, four extra at L=9 — lengths 3d eps >= 9 does
+    reach) but never lane-rolls a value that is itself a lane-roll
+    result.  That op pattern (first produced at L=4, a pure
+    power-of-two run) is the one thing distinguishing the 2026-07-30
+    compile-hang configs (2d eps=10; by the same analysis 3d eps in
+    {6, 7}) from the ones that compiled green on real TPU: rolling
+    computed values is routine on the sublane axis (the D_k chains roll
+    their own partial sums and compile fine at every eps), so the suspect
+    is roll-of-roll specifically on the LANE axis, and this build is the
+    only place that produced it (see docs/bench/README.md, third wedge).
+    """
     wsums = {}
     for h, run_len in run_keys:
         if (h, run_len) in wsums:
             continue
         x = v[h]
         acc_l = x
-        built = 1
-        while built * 2 <= run_len:
-            acc_l = acc_l + lane_down(acc_l, built)
-            built *= 2
-        while built < run_len:
-            acc_l = acc_l + lane_down(x, built)
-            built += 1
+        for j in range(1, run_len):
+            acc_l = acc_l + lane_down(x, j)
         wsums[h, run_len] = acc_l
     return wsums
 

@@ -34,6 +34,7 @@ from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, source_at
 from nonlocalheatequation_tpu.parallel.halo import halo_pad_2d
 from nonlocalheatequation_tpu.parallel.mesh import grid_sharding, make_mesh
+from nonlocalheatequation_tpu.parallel.multihost import fetch_global, put_global
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
 
 
@@ -160,12 +161,17 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
             jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
         )
         sharding = grid_sharding(self.mesh)
-        u = jax.device_put(jnp.asarray(self.u0, dtype), sharding)
+        # put_global == device_put single-controller; per-process shard
+        # materialization when the mesh spans hosts (parallel/multihost.py).
+        # The cast stays in numpy: a jnp cast would allocate the full
+        # unsharded array on the default device first.
+        npdt = np.dtype(dtype)
+        u = put_global(np.asarray(self.u0, npdt), sharding)
         if not self.test:
             return u, ()
         g, lg = self.op.source_parts(self.NX, self.NY)
-        g = jax.device_put(jnp.asarray(g, dtype), sharding)
-        lg = jax.device_put(jnp.asarray(lg, dtype), sharding)
+        g = put_global(np.asarray(g, npdt), sharding)
+        lg = put_global(np.asarray(lg, npdt), sharding)
         return u, (g, lg)
 
     # -- time loop (2d_nonlocal_distributed.cpp:1271-1325) ------------------
@@ -176,14 +182,17 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
 
         def make_runner(count):
+            # source arrays enter as jit ARGUMENTS, not closure constants:
+            # a constant capture would try to materialize the whole array
+            # in the trace, which a mesh spanning processes cannot do
             @jax.jit
-            def run(u0, t_start):
+            def run(u0, t_start, srcs):
                 ts = t_start + jnp.arange(count)
                 return lax.scan(
-                    lambda c, t: (step(c, *source_args, t), None),
+                    lambda c, t: (step(c, *srcs, t), None),
                     u0, ts)[0]
 
-            return lambda u0, start: run(u0, jnp.int32(start))
+            return lambda u0, start: run(u0, jnp.int32(start), source_args)
 
         if self.logger is None and not checkpointing:
             u = make_runner(self.nt - self.t0)(u, self.t0)
@@ -191,7 +200,7 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
             # fused scan per segment; barriers = log and checkpoint steps
             u = self._run_chunked(u, make_runner)
 
-        self.u = np.asarray(u)
+        self.u = fetch_global(u)
         if self.test:
             self.compute_l2(self.nt)
             self.compute_linf(self.nt)

@@ -119,6 +119,40 @@ def host_block_slice(n_rows: int, axis_size: int | None = None,
     return slice(p * B, min((p + 1) * B, n_rows))
 
 
+def put_global(host_array, sharding):
+    """Place a host-replicated array as a (possibly cross-process) jax.Array.
+
+    Single-controller this is exactly ``jax.device_put``.  Multi-controller,
+    each process materializes only its ADDRESSABLE shards from its local
+    copy of the array (which must be identical on every process — the init
+    contract, see assert_same_on_all_hosts), the supported way to build a
+    global array without touching other hosts' devices.  This is the
+    host-side analog of the reference's per-locality tile construction
+    (src/2d_nonlocal_distributed.cpp:458-460: every locality constructs the
+    tiles it owns from the same global parameters).
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(host_array, sharding)
+    arr = np.asarray(host_array)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def fetch_global(x) -> np.ndarray:
+    """Fetch a (possibly cross-process) jax.Array to host np on EVERY process.
+
+    Single-controller this is ``np.asarray``.  Multi-controller it
+    all-gathers the non-addressable shards over the process mesh first —
+    the analog of the reference's full-grid gather for logging and error
+    metrics (vector_get_data, src/2d_nonlocal_distributed.cpp:1121-1131).
+    """
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def assert_same_on_all_hosts(x, tag: str = "value") -> None:
     """Cross-host determinism check: every process must hold identical
     ``x`` (the multi-controller contract — divergent host values silently

@@ -11,15 +11,34 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 
 import numpy as np
+
+
+def _fetch_global(u):
+    # lazy: utils.checkpoint is imported by the models package, which the
+    # parallel package (multihost's home) itself imports at init time
+    from nonlocalheatequation_tpu.parallel.multihost import fetch_global
+
+    return fetch_global(u)
+
+
+def _process_index() -> int:
+    # lazy for the same reason; callers only reach this mid-solve, when
+    # jax is long since imported
+    import jax
+
+    return jax.process_index()
 
 FORMAT_VERSION = 1
 
 
 def save_state(path: str, u: np.ndarray, t: int, params: dict | None = None):
     """Atomically write solver state at timestep ``t`` (u = state AFTER t steps)."""
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # host-unique tmp: on a multi-host shared filesystem, pids alone can
+    # collide across hosts' independent pid namespaces
+    tmp = f"{path}.tmp.{socket.gethostname()}.{os.getpid()}"
     meta = dict(params or {})
     try:
         with open(tmp, "wb") as f:
@@ -146,13 +165,19 @@ class CheckpointMixin:
             u = runners[count](u, start)
             last = start + count - 1
             if log_due is not None and log_due(last):
-                logger(last, np.asarray(u))
+                logger(last, _fetch_global(u))
             self._maybe_checkpoint(last, u)
         return u
 
     def _maybe_checkpoint(self, t: int, u=None) -> None:
         if self._ckpt_due(t):
-            state = np.asarray(u) if u is not None else self.gather()
+            # the fetch is a COLLECTIVE multi-controller (every process must
+            # participate) but the file write is process 0's alone — the
+            # framework's own "log from one process" rule (docs/multihost.md);
+            # N racing writers to one shared checkpoint path corrupt it
+            state = _fetch_global(u) if u is not None else self.gather()
+            if _process_index() != 0:
+                return
             save_state(self.checkpoint_path, state, t + 1, self._ckpt_params())
 
 

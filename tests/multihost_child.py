@@ -1,0 +1,52 @@
+"""One controller of the two-process loopback solve.
+
+Run by tests/test_multihost.py (not collected by pytest — no test_ prefix):
+``python multihost_child.py <coordinator> <num_processes> <process_id>``.
+Each process owns 2 virtual CPU devices (XLA_FLAGS set by the parent); the
+2x2 mesh therefore SPANS the process boundary, so the shard_map halo
+exchange rides the cross-process (gloo) transport — the DCN analog of the
+reference's multi-locality parcelport (src/2d_nonlocal_distributed.cpp's
+get_data RPCs under srun -n N).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+from nonlocalheatequation_tpu.parallel import multihost  # noqa: E402
+
+assert multihost.init_from_env(coord, nproc, pid), "explicit init must run"
+assert jax.process_count() == nproc
+assert len(jax.devices()) == 2 * nproc, "expected 2 local devices per process"
+
+from nonlocalheatequation_tpu.models.solver2d import Solver2D  # noqa: E402
+from nonlocalheatequation_tpu.parallel.distributed2d import (  # noqa: E402
+    Solver2DDistributed,
+)
+from nonlocalheatequation_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+# shard edge 8: eps=3 = one-hop band exchange, eps=9 = multi-hop ring (the
+# long-horizon path), both now crossing the process boundary
+for eps in (3, 9):
+    mesh = make_mesh(2, 2)
+    d = Solver2DDistributed(16, 16, 1, 1, nt=3, eps=eps, k=1.0, dt=1e-4,
+                            dh=1.0 / 16, mesh=mesh)
+    d.test_init()
+    ud = d.do_work()
+    multihost.assert_same_on_all_hosts(ud, f"solution eps={eps}")
+    o = Solver2D(16, 16, 3, eps=eps, k=1.0, dt=1e-4, dh=1.0 / 16,
+                 backend="oracle")
+    o.test_init()
+    err = float(np.abs(ud - o.do_work()).max())
+    assert err < 1e-12, f"eps={eps}: deviates from serial oracle by {err:.3e}"
+    print(f"MH-OK p{pid} eps={eps} err={err:.2e}", flush=True)

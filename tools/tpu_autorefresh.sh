@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Fire tools/tpu_refresh.sh automatically when a wedged tunnel heals.
+#
+# Spawns a fresh NO-KILL init probe every PROBE_INTERVAL_S (default 1200);
+# each probe either succeeds — the first success fires the refresh once —
+# or hangs harmlessly.  Hung probes are never killed: mid-init kill churn
+# is suspected of prolonging wedges (docs/bench/README.md "Wedge
+# trigger"), and the observed recovery pattern is that NEW clients start
+# succeeding while old stuck ones stay stuck, so each probe is a fresh
+# client.  MAX_PROBES (default 18, i.e. ~6 h) bounds the number of stuck
+# clients left behind on a tunnel that never heals.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL=${PROBE_INTERVAL_S:-1200}
+MAX=${MAX_PROBES:-18}
+STAMP=$(date +%Y%m%d-%H%M%S)
+MARK=$(mktemp -d)/healed
+echo "autorefresh $STAMP: probing every ${INTERVAL}s (max $MAX probes)"
+
+for i in $(seq 1 "$MAX"); do
+  python - "$MARK" <<'EOF' &
+import sys
+import jax
+d = jax.devices()  # hangs on a wedged tunnel; never killed
+if d and d[0].platform != "cpu":
+    with open(sys.argv[1], "w") as f:
+        f.write(str(d[0]))
+EOF
+  # poll the marker in short increments so a heal fires the refresh within
+  # seconds, not at the end of the full probe interval
+  waited=0
+  while [ "$waited" -lt "$INTERVAL" ]; do
+    sleep 15
+    waited=$((waited + 15))
+    if [ -f "$MARK" ]; then
+      echo "autorefresh: tunnel healed ($(cat "$MARK")); firing refresh"
+      exec bash tools/tpu_refresh.sh
+    fi
+  done
+  echo "autorefresh: probe $i still dark"
+done
+echo "autorefresh: gave up after $MAX probes (tunnel still wedged)"
+exit 1

@@ -3,10 +3,16 @@
 Run by tests/test_multihost.py (not collected by pytest — no test_ prefix):
 ``python multihost_child.py <coordinator> <num_processes> <process_id>``.
 Each process owns 2 virtual CPU devices (XLA_FLAGS set by the parent); the
-2x2 mesh therefore SPANS the process boundary, so the shard_map halo
-exchange rides the cross-process (gloo) transport — the DCN analog of the
+meshes therefore SPAN the process boundary, so the shard_map halo exchange
+rides the cross-process (gloo) transport — the DCN analog of the
 reference's multi-locality parcelport (src/2d_nonlocal_distributed.cpp's
 get_data RPCs under srun -n N).
+
+Legs: 2D 16x16 on a 2x2 mesh at eps=3 (one-hop halo) and eps=9 (multi-hop
+ring); 3D 8^3 on a (2,2,1) mesh at eps=2 (one-hop) and eps=5 (multi-hop).
+Each leg asserts cross-host determinism and <=1e-12 agreement with the
+serial oracle, and prints one ``MH-OK p<pid> ...`` line the parent test
+greps for.
 """
 
 import os
@@ -50,3 +56,27 @@ for eps in (3, 9):
     err = float(np.abs(ud - o.do_work()).max())
     assert err < 1e-12, f"eps={eps}: deviates from serial oracle by {err:.3e}"
     print(f"MH-OK p{pid} eps={eps} err={err:.2e}", flush=True)
+
+# 3D over a (2, 2, 1) mesh — same cross-process halo, one more axis:
+# eps=2 is the one-hop band exchange, eps=5 > shard edge 4 the multi-hop
+# ring, mirroring the 2D pair above
+from nonlocalheatequation_tpu.models.solver3d import Solver3D  # noqa: E402
+from nonlocalheatequation_tpu.parallel.distributed3d import (  # noqa: E402
+    Solver3DDistributed,
+)
+from nonlocalheatequation_tpu.parallel.mesh import make_mesh_3d  # noqa: E402
+
+for eps3 in (2, 5):
+    mesh3 = make_mesh_3d(2, 2, 1)
+    d3 = Solver3DDistributed(8, 8, 8, nt=2, eps=eps3, k=1.0, dt=1e-4,
+                             dh=0.05, mesh=mesh3)
+    d3.test_init()
+    u3 = d3.do_work()
+    multihost.assert_same_on_all_hosts(u3, f"3d solution eps={eps3}")
+    o3 = Solver3D(8, 8, 8, 2, eps=eps3, k=1.0, dt=1e-4, dh=0.05,
+                  backend="oracle")
+    o3.test_init()
+    err3 = float(np.abs(u3 - o3.do_work()).max())
+    assert err3 < 1e-12, (
+        f"3d eps={eps3}: deviates from serial oracle by {err3:.3e}")
+    print(f"MH-OK p{pid} 3d eps={eps3} err={err3:.2e}", flush=True)

@@ -84,9 +84,10 @@ def test_two_controller_loopback_solve():
     """Two real processes, one global mesh: the DCN-analog halo exchange.
 
     Spawns two controllers (2 virtual CPU devices each) wired by
-    jax.distributed.initialize; tests/multihost_child.py solves 16x16 on a
-    2x2 mesh spanning the process boundary for eps=3 (one-hop halo) and
-    eps=9 (multi-hop ring), asserts cross-host determinism
+    jax.distributed.initialize; tests/multihost_child.py solves 2D 16x16
+    on a 2x2 mesh (eps=3 one-hop, eps=9 multi-hop ring) and 3D 8^3 on a
+    (2,2,1) mesh (eps=2 one-hop, eps=5 multi-hop), every mesh spanning
+    the process boundary, asserting cross-host determinism
     (assert_same_on_all_hosts) and <=1e-12 agreement with the serial
     oracle in each process.
     """
@@ -126,3 +127,5 @@ def test_two_controller_loopback_solve():
         assert p.returncode == 0, f"process {pid} failed:\n{out[-2000:]}"
         assert f"MH-OK p{pid} eps=3" in out
         assert f"MH-OK p{pid} eps=9" in out
+        assert f"MH-OK p{pid} 3d eps=2" in out
+        assert f"MH-OK p{pid} 3d eps=5" in out

@@ -90,16 +90,20 @@ def test_tpu_refresh_aborts_on_unhealthy_backend(tmp_path):
     bench.py's hang-proof probe: a CPU-fallback artifact aborts the run."""
     import subprocess
 
+    # log + table routed into tmp_path: the docs/bench/ evidence directory
+    # must never be touched by tests (a blanket refresh-*.log cleanup here
+    # destroyed a real measurement log on 2026-07-30)
     env = dict(os.environ, BENCH_PLATFORM="cpu", BENCH_WATCHDOG_S="240",
-               BENCH_STEPS="3")
+               BENCH_STEPS="3",
+               BENCH_REFRESH_OUT=str(tmp_path / "refresh.log"),
+               BENCH_REFRESH_TABLE=str(tmp_path / "table.jsonl"))
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "tools", "tpu_refresh.sh")],
         capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
     )
     assert proc.returncode == 1
     assert "ABORT: bench did not reach the TPU backend" in proc.stdout
-    for f in glob.glob(os.path.join(REPO, "docs", "bench", "refresh-*.log")):
-        os.remove(f)
+    assert (tmp_path / "refresh.log").exists()
 
 
 def test_probe_retries_through_fast_failures(tmp_path):

@@ -14,8 +14,10 @@
 set -u
 cd "$(dirname "$0")/.."
 STAMP=$(date +%Y%m%d-%H%M%S)
-OUT=docs/bench/refresh-$STAMP.log
-TABLE=docs/bench/BENCH_TABLE_r03.jsonl
+# overridable so tests (and ad-hoc runs) can write outside docs/bench/ —
+# the evidence directory must only ever hold real measurement logs
+OUT=${BENCH_REFRESH_OUT:-docs/bench/refresh-$STAMP.log}
+TABLE=${BENCH_REFRESH_TABLE:-docs/bench/BENCH_TABLE_r03.jsonl}
 echo "== TPU refresh $STAMP ==" | tee "$OUT"
 
 append_rows() {  # copy every JSON measurement row from the log to the table

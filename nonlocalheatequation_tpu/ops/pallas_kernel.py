@@ -106,7 +106,25 @@ def _choose_tm(nx: int, ny: int, eps: int, itemsize: int, n_aux: int,
     slice-copy (nxp == nx) and every strip carries real rows.  ``fits``
     overrides the stack model (the carried-frame kernel has a taller
     window and a full-lane-width output).
+
+    ``NLHEAT_TM`` (experiment knob) forces the strip height, bypassing the
+    stack model: the model conservatively assumes Mosaic stack-allocates
+    every SSA temporary with no reuse, so a forced-larger tm either
+    compiles (model too pessimistic — measure it) or fails with a clean
+    Mosaic allocation error, never a wedge.  Rounded to a multiple of 8.
+    Like NLHEAT_LANE_RUNS, set it BEFORE the first kernel build: the
+    builders are cached per (eps, shape, dtype), so an in-process sweep
+    over settings would silently reuse the first build — run one process
+    per setting (what the measurement tools do anyway).
     """
+    forced = os.environ.get("NLHEAT_TM")
+    if forced:
+        try:
+            return max(8, _round_up(int(forced), 8))
+        except ValueError:
+            raise ValueError(
+                f"NLHEAT_TM must be an integer strip height, got {forced!r}"
+            ) from None
     if fits is None:
         fits = lambda tm: _fits(tm, ny, eps, itemsize, n_aux)  # noqa: E731
     cap = min(256, _round_up(nx, 8))

@@ -75,19 +75,31 @@ def _setup():
     return np, jax
 
 
-def _check_2d(n, eps):
+def _assert_rel(a, b, tol):
+    """max |a-b| relative to max |b| — the sweep's closeness criterion."""
+    import numpy as np
+
+    rel = np.abs(a - b).max() / max(np.abs(b).max(), 1e-30)
+    assert rel < tol, f"rel diff {rel:.2e}"
+
+
+def _op_classes(ndim):
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, NonlocalOp3D
+
+    # dt chosen for stability at the sweep's grid sizes per dimension
+    return (NonlocalOp2D, 1e-6) if ndim == 2 else (NonlocalOp3D, 1e-7)
+
+
+def _check_pallas_vs_sat(ndim, n, eps):
     np, jax = _setup()
     import jax.numpy as jnp
 
-    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
-
+    cls, dt = _op_classes(ndim)
     rng = np.random.default_rng(0)
-    op_p = NonlocalOp2D(eps, 1.0, 1e-6, 1.0 / n, method="pallas")
-    op_s = NonlocalOp2D(eps, 1.0, 1e-6, 1.0 / n, method="sat")
-    u = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
-    a, b = np.asarray(op_p.apply(u)), np.asarray(op_s.apply(u))
-    rel = np.abs(a - b).max() / max(np.abs(b).max(), 1e-30)
-    assert rel < 1e-5, f"rel diff {rel:.2e}"
+    op_p = cls(eps, 1.0, dt, 1.0 / n, method="pallas")
+    op_s = cls(eps, 1.0, dt, 1.0 / n, method="sat")
+    u = jnp.asarray(rng.normal(size=(n,) * ndim), jnp.float32)
+    _assert_rel(np.asarray(op_p.apply(u)), np.asarray(op_s.apply(u)), 1e-5)
 
 
 def _check_fused(n, eps):
@@ -103,62 +115,26 @@ def _check_fused(n, eps):
     assert np.isfinite(np.asarray(out)).all()
 
 
-def _check_3d(n, eps):
+def _check_carried(ndim, n, eps):
     np, jax = _setup()
     import jax.numpy as jnp
 
-    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D
-
-    rng = np.random.default_rng(0)
-    op_p = NonlocalOp3D(eps, 1.0, 1e-7, 1.0 / n, method="pallas")
-    op_s = NonlocalOp3D(eps, 1.0, 1e-7, 1.0 / n, method="sat")
-    u = jnp.asarray(rng.normal(size=(n, n, n)), jnp.float32)
-    a, b = np.asarray(op_p.apply(u)), np.asarray(op_s.apply(u))
-    rel = np.abs(a - b).max() / max(np.abs(b).max(), 1e-30)
-    assert rel < 1e-5, f"rel diff {rel:.2e}"
-
-
-def _check_carried_2d(n, eps):
-    np, jax = _setup()
-    import jax.numpy as jnp
-
-    from nonlocalheatequation_tpu.ops.nonlocal_op import (
-        NonlocalOp2D,
-        make_multi_step_fn,
-    )
-    from nonlocalheatequation_tpu.ops.pallas_kernel import make_carried_multi_step_fn
-
-    rng = np.random.default_rng(0)
-    op = NonlocalOp2D(eps, 1.0, 1e-6, 1.0 / n, method="pallas")
-    ref = make_multi_step_fn(op, 3, dtype=jnp.float32)
-    new = make_carried_multi_step_fn(op, 3, dtype=jnp.float32)
-    u = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
-    a, b = np.asarray(ref(u, jnp.int32(0))), np.asarray(new(u, jnp.int32(0)))
-    rel = np.abs(a - b).max() / max(np.abs(a).max(), 1e-30)
-    assert rel < 1e-6, f"rel diff {rel:.2e}"
-
-
-def _check_carried_3d(n, eps):
-    np, jax = _setup()
-    import jax.numpy as jnp
-
-    from nonlocalheatequation_tpu.ops.nonlocal_op import (
-        NonlocalOp3D,
-        make_multi_step_fn,
-    )
+    from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn
     from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        make_carried_multi_step_fn,
         make_carried_multi_step_fn_3d,
     )
 
+    cls, dt = _op_classes(ndim)
+    make_carried, steps = ((make_carried_multi_step_fn, 3) if ndim == 2
+                           else (make_carried_multi_step_fn_3d, 2))
     rng = np.random.default_rng(0)
-    op = NonlocalOp3D(eps, 1.0, 1e-7, 1.0 / n, method="pallas")
-    ref = make_multi_step_fn(op, 2, dtype=jnp.float32)
-    new = make_carried_multi_step_fn_3d(op, 2, dtype=jnp.float32)
-    u = jnp.asarray(rng.normal(size=(n, n, n)), jnp.float32)
-    a = np.asarray(ref(u, jnp.int32(0)))
-    b = np.asarray(new(u, jnp.int32(0)))
-    rel = np.abs(a - b).max() / max(np.abs(a).max(), 1e-30)
-    assert rel < 1e-6, f"rel diff {rel:.2e}"
+    op = cls(eps, 1.0, dt, 1.0 / n, method="pallas")
+    ref = make_multi_step_fn(op, steps, dtype=jnp.float32)
+    new = make_carried(op, steps, dtype=jnp.float32)
+    u = jnp.asarray(rng.normal(size=(n,) * ndim), jnp.float32)
+    _assert_rel(np.asarray(new(u, jnp.int32(0))),
+                np.asarray(ref(u, jnp.int32(0))), 1e-6)
 
 
 def _check_f64_guard():
@@ -201,23 +177,25 @@ def _check_shard_map():
 def _build_checks():
     checks = []
     for n, eps in [(50, 5), (200, 5), (50, 10), (100, 40), (200, 3), (130, 7)]:
-        checks.append((f"2d {n}^2 eps={eps}", lambda n=n, e=eps: _check_2d(n, e)))
+        checks.append((f"2d {n}^2 eps={eps}",
+                       lambda n=n, e=eps: _check_pallas_vs_sat(2, n, e)))
     for n, eps in [(50, 5), (200, 5), (64, 9)]:
         checks.append(
             (f"2d fused test step {n}^2 eps={eps}",
              lambda n=n, e=eps: _check_fused(n, e))
         )
     for n, eps in [(64, 6), (48, 5), (96, 7)]:
-        checks.append((f"3d {n}^3 eps={eps}", lambda n=n, e=eps: _check_3d(n, e)))
+        checks.append((f"3d {n}^3 eps={eps}",
+                       lambda n=n, e=eps: _check_pallas_vs_sat(3, n, e)))
     for n, eps in [(512, 8), (200, 5)]:
         checks.append(
             (f"carried multi-step {n}^2 eps={eps}",
-             lambda n=n, e=eps: _check_carried_2d(n, e))
+             lambda n=n, e=eps: _check_carried(2, n, e))
         )
     for n, eps in [(64, 4), (48, 6)]:
         checks.append(
             (f"carried 3d multi-step {n}^3 eps={eps}",
-             lambda n=n, e=eps: _check_carried_3d(n, e))
+             lambda n=n, e=eps: _check_carried(3, n, e))
         )
     checks.append(("pallas f64-on-TPU guard message", _check_f64_guard))
     checks.append(("pallas in shard_map 1-dev 64^2 eps=5", _check_shard_map))

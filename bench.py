@@ -42,7 +42,10 @@ BENCH_LADDER (comma grids), BENCH_PROFILE (jax.profiler trace dir),
 BENCH_CARRIED=1 (pallas: carry the halo-padded state across the scan —
 opt-in until measured on hardware), BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
-0.0).
+0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
+budget above this re-probes the TPU once — the wedge cycle often heals
+mid-watchdog — and a real TPU rung replaces the fallback headline,
+labeled cpu_fallback="recovered-late").
 """
 
 import json
@@ -147,6 +150,14 @@ class Best:
         with self.lock:
             self.meta.update(kw)
 
+    def snapshot_meta(self):
+        with self.lock:
+            return dict(self.meta)
+
+    def replace_meta(self, meta):
+        with self.lock:
+            self.meta = dict(meta)
+
     def emit_now(self, error=None):
         """Emit whatever we have.  Returns (emitted, had_value)."""
         with self.lock:
@@ -247,33 +258,34 @@ class EventReader:
             return None
 
 
-def probe_device():
+def probe_device(phase_deadline=None, hang_cap=3, tag="probe"):
     """Phase A: can a fresh process init the backend?  Killable + retried.
 
     Two failure modes with different economics (both observed live):
     a HANG (wedged tunnel) costs a full PROBE_TIMEOUT_S kill budget, so
-    those are capped at 3; a FAST failure (tunnel resetting: init returns
-    `UNAVAILABLE` within seconds) is nearly free, so those retry every few
-    seconds until the probe-phase deadline — a tunnel that comes back
-    mid-reset still gets the round onto the TPU instead of the CPU
-    fallback.  Returns the probe record {"ok": True, ...} or None.
+    those are capped (3 for the main phase, 1 for the late-heal retry);
+    a FAST failure (tunnel resetting: init returns `UNAVAILABLE` within
+    seconds) is nearly free, so those retry every few seconds until the
+    phase deadline — a tunnel that comes back mid-reset still gets the
+    round onto the TPU instead of the CPU fallback.  Returns the probe
+    record {"ok": True, ...} or None.
     """
-    hang_cap, hangs, attempt = 3, 0, 0
-    phase_deadline = T0 + 0.45 * WATCHDOG_S  # leave the rest for measuring
+    hangs, attempt = 0, 0
+    if phase_deadline is None:
+        phase_deadline = T0 + 0.45 * WATCHDOG_S  # leave the rest for measuring
     while True:
         if time.time() >= phase_deadline:
-            log("probe: phase deadline reached "
-                f"({0.45 * WATCHDOG_S:.0f}s); proceeding without the device")
+            log(f"{tag}: phase deadline reached; proceeding without the device")
             return None
         # an attempt may not overrun the phase deadline by more than a
         # hang-kill: clamp its budget to the window that is actually left
         budget = min(PROBE_TIMEOUT_S, remaining(),
                      phase_deadline - time.time() + 5.0)
         if budget <= 5:
-            log("probe: out of time budget")
+            log(f"{tag}: out of time budget")
             return None
         attempt += 1
-        log(f"probe attempt {attempt} (budget {budget:.0f}s, "
+        log(f"{tag} attempt {attempt} (budget {budget:.0f}s, "
             f"hangs {hangs}/{hang_cap})")
         proc = spawn_child("--probe")
         t_start = time.time()
@@ -282,19 +294,19 @@ def probe_device():
             if proc.returncode == 0 and out.strip():
                 rec = json.loads(out.strip().splitlines()[-1])
                 if rec.get("ok"):
-                    log(f"probe ok: backend={rec['backend']} device={rec['device']}")
+                    log(f"{tag} ok: backend={rec['backend']} device={rec['device']}")
                     return rec
-            log(f"probe attempt failed (rc={proc.returncode}, "
+            log(f"{tag} attempt failed (rc={proc.returncode}, "
                 f"{time.time() - t_start:.1f}s)")
         except subprocess.TimeoutExpired:
             hangs += 1
-            log(f"probe attempt HUNG past {budget:.0f}s; killing child")
+            log(f"{tag} attempt HUNG past {budget:.0f}s; killing child")
             kill(proc)
         except Exception as e:  # noqa: BLE001
-            log(f"probe attempt errored: {e!r}")
+            log(f"{tag} attempt errored: {e!r}")
             kill(proc)
         if hangs >= hang_cap:
-            log(f"probe: giving up after {hangs} hangs")
+            log(f"{tag}: giving up after {hangs} hangs")
             return None
         # fast failures retry quickly (the tunnel may recover any second);
         # hang kills back off longer (the chip needs time to settle)
@@ -382,6 +394,39 @@ def main():
                 sys.exit(1)
 
         harvested, clean = run_measure_child()
+
+        # Late-heal retry: the tunnel's observed wedge cycle ends with init
+        # suddenly answering again (hangs -> fast UNAVAILABLE -> healthy,
+        # docs/bench/README.md).  If we fell back to CPU because the probe
+        # phase never reached the device, and the (fast) CPU ladder left
+        # budget over, give the TPU ONE more chance: a real TPU rung at any
+        # grid replaces the fallback headline (update_rung keeps the latest).
+        late_retry_s = float(os.environ.get("BENCH_LATE_RETRY_S", 90))
+        if cpu_fallback and harvested > 0 and remaining() > late_retry_s:
+            os.environ.pop("BENCH_PLATFORM", None)  # back to the default backend
+            log("late-heal retry: re-probing the TPU with the leftover budget")
+            # reserve the back half (capped at 45s) of what's left for the
+            # measurement itself; the probe may spend the front half
+            reserve = min(45.0, 0.5 * remaining())
+            probe2 = probe_device(
+                phase_deadline=deadline() - reserve, hang_cap=1,
+                tag="late-probe")
+            if probe2 is not None:
+                # snapshot the CPU run's meta: a late child that inits (its
+                # events overwrite backend/device/method) but lands no rung
+                # must not leave TPU labels on a CPU-measured headline —
+                # and the label stays honest if the watchdog fires mid-retry
+                saved_meta = BEST.snapshot_meta()
+                BEST.update_meta(cpu_fallback="late-retry-in-progress")
+                h2, clean2 = run_measure_child()
+                if h2:
+                    harvested, clean = harvested + h2, clean2
+                    BEST.update_meta(cpu_fallback="recovered-late")
+                else:
+                    BEST.replace_meta(saved_meta)
+            else:
+                os.environ["BENCH_PLATFORM"] = "cpu"
+
         if harvested == 0 and not cpu_fallback:
             # zero rungs is retry-worthy whether the child hung (killed) or
             # exited "cleanly" after a rung_error — either way the pallas
@@ -424,6 +469,16 @@ def main():
 def child_platform_override(jax):
     # The axon TPU plugin ignores the JAX_PLATFORMS env var; honor an
     # explicit override through the config knob (BENCH_PLATFORM=cpu in CI).
+    if (os.environ.get("BENCH_FAULT") == "probe_heal_after"
+            and os.environ.get("BENCH_TEST_MODE") == "1"):
+        # fault injection (tests/test_bench_harness.py): simulates the
+        # wedge-then-heal tunnel cycle on a CPU-only test host — children
+        # always run CPU; the parent's BENCH_PLATFORM pops/sets still
+        # exercise the real late-heal control flow.  Gated on an explicit
+        # test-mode flag (like SANITY_TEST_MODE) so a leaked BENCH_FAULT
+        # cannot silently ship a CPU number as a recovered-TPU artifact.
+        jax.config.update("jax_platforms", "cpu")
+        return
     if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
@@ -440,6 +495,16 @@ def child_probe():
             with open(path, "w") as f:
                 f.write(str(n + 1))
             print("probe_flaky: injected fast failure", file=sys.stderr)
+            sys.exit(1)
+
+    if (os.environ.get("BENCH_FAULT") == "probe_heal_after"
+            and os.environ.get("BENCH_TEST_MODE") == "1"):
+        # fail fast (the resetting-tunnel UNAVAILABLE mode) until the heal
+        # moment, then behave normally (on CPU — see child_platform_override)
+        t0 = float(os.environ["BENCH_FAULT_T0"])
+        heal_s = float(os.environ.get("BENCH_FAULT_HEAL_S", 30))
+        if time.time() < t0 + heal_s:
+            print("probe_heal_after: injected fast failure", file=sys.stderr)
             sys.exit(1)
 
     import jax

@@ -357,7 +357,7 @@ def _strip_neighbor_sum(w, tm: int, ny: int, eps: int, row0: int | None = None):
     # second level: the lane-offset accumulation dominates the kernel on
     # real hardware (measured round 3: 0.39 of 0.94 ms/step at 4096^2), so
     # sum each RUN of equal-height lane offsets with one slice-add of a
-    # lane-window sum W_L(v[h]) built by a doubling chain.  Symmetric runs
+    # lane-window sum W_L(v[h]) built from leaf-operand rolls.  Symmetric runs
     # (every circle has them in pairs) share the same W_L(v[h]).  Lane-roll
     # wrap garbage lands in lanes >= wlanes - (L-1), beyond every slice's
     # read range (j0 + ny - 1 < wlanes - L + 1 since j0 + L <= 2*eps + 1).

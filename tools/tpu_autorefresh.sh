@@ -17,7 +17,17 @@ STAMP=$(date +%Y%m%d-%H%M%S)
 MARK=$(mktemp -d)/healed
 echo "autorefresh $STAMP: probing every ${INTERVAL}s (max $MAX probes)"
 
-for i in $(seq 1 "$MAX"); do
+# the give-up bound is WALL TIME (MAX full probe intervals, ~6h default),
+# not probe count: fast-fail probes recycle in ~60s and must not burn the
+# budget — the resetting stage they indicate often precedes the heal
+END=$(($(date +%s) + MAX * INTERVAL))
+i=0
+fire() {
+  echo "autorefresh: tunnel healed ($(cat "$MARK")); firing refresh"
+  exec bash tools/tpu_refresh.sh
+}
+while [ "$(date +%s)" -lt "$END" ]; do
+  i=$((i + 1))
   python - "$MARK" <<'EOF' &
 import sys
 import jax
@@ -26,18 +36,27 @@ if d and d[0].platform != "cpu":
     with open(sys.argv[1], "w") as f:
         f.write(str(d[0]))
 EOF
+  probe_pid=$!
   # poll the marker in short increments so a heal fires the refresh within
-  # seconds, not at the end of the full probe interval
+  # seconds, not at the end of the full probe interval.  A probe that EXITS
+  # without writing the marker failed FAST (the tunnel's resetting
+  # UNAVAILABLE stage) — move to the next probe after one more short wait
+  # instead of burning the full interval.
   waited=0
   while [ "$waited" -lt "$INTERVAL" ]; do
     sleep 15
     waited=$((waited + 15))
-    if [ -f "$MARK" ]; then
-      echo "autorefresh: tunnel healed ($(cat "$MARK")); firing refresh"
-      exec bash tools/tpu_refresh.sh
+    [ -f "$MARK" ] && fire
+    if ! kill -0 "$probe_pid" 2>/dev/null; then
+      sleep 45
+      [ -f "$MARK" ] && fire
+      echo "autorefresh: probe $i failed fast (tunnel resetting); retrying"
+      break
     fi
   done
-  echo "autorefresh: probe $i still dark"
+  if [ "$waited" -ge "$INTERVAL" ]; then
+    echo "autorefresh: probe $i still dark (hung the full interval)"
+  fi
 done
-echo "autorefresh: gave up after $MAX probes (tunnel still wedged)"
+echo "autorefresh: gave up after ${MAX}x${INTERVAL}s of wall time (tunnel still wedged)"
 exit 1

@@ -106,3 +106,33 @@ def test_unstructured_cli_sharded(capsys):
     out = capsys.readouterr().out
     assert f"sharded over {ndev} devices" in out or ndev == 1
     assert "error_l2/N" in out
+
+
+def test_reference_workflow_chain(tmp_path):
+    """The reference's full documented workflow, end to end (README.md:45-72):
+    GMSH mesh -> decomposition tool -> partition map -> distributed solve
+    with --file + manufactured test -> the L2/N <= 1e-6 contract."""
+    mapfile = str(tmp_path / "map.txt")
+    # the decompose tool is pure host code (no backend, no --platform flag)
+    r = subprocess.run(
+        [sys.executable, "-m", "nonlocalheatequation_tpu.cli.decompose",
+         os.path.join(REPO, "data/10x10.msh"), mapfile, "4",
+         "--sx", "5", "--sy", "5"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    header = open(mapfile).read().splitlines()
+    # "mx/npx my/npy npx npy dh" + one row per tile (reference map format,
+    # src/domain_decomposition.cpp:31-50)
+    assert header[0].split() == ["5", "5", "2", "2", "0.1"]
+    assert len(header) == 1 + 4
+    owners = {int(row.split()[2]) for row in header[1:]}
+    assert owners <= {0, 1, 2, 3} and len(owners) > 1
+
+    r = run_cli("solve2d_distributed",
+                ["--file", mapfile, "--nt", "10", "--test", "true",
+                 "--cmp", "false"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    l2 = float(r.stdout.split("l2:")[1].split()[0])
+    npoints = 10 * 10
+    assert l2 / npoints <= 1e-6, f"L2/N contract violated: {l2 / npoints}"

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Strip-height (NLHEAT_TM) sweep of the 2D Pallas kernel on real TPU.
+#
+# The VMEM stack model caps tm at 128 for the 4096^2 eps=8 flagship by
+# assuming Mosaic stack-allocates every SSA temporary with no reuse; if
+# that is pessimistic, taller strips may compile and run faster.  One
+# bench process per setting (the kernel builders cache per process —
+# see _choose_tm's NLHEAT_TM note); a setting that overflows VMEM fails
+# with a clean Mosaic allocation error inside the measure child, and the
+# bench's ladder recovery still emits a labeled artifact.
+#
+# Run AFTER a green tools/tpu_refresh.sh only (this script has no health
+# gate of its own beyond bench.py's built-in probes).
+set -u
+cd "$(dirname "$0")/.."
+OUT=docs/bench/tm-sweep-$(date +%Y%m%d-%H%M%S).log
+GRID=${TM_SWEEP_GRID:-4096}
+echo "== NLHEAT_TM sweep at ${GRID}^2 ==" | tee "$OUT"
+for tm in "" 160 192 224 256; do
+  label=${tm:-default}
+  echo "-- tm=$label" | tee -a "$OUT"
+  # per-run capture so a run killed before its JSON line cannot alias the
+  # previous setting's metric under this label
+  RUN=$(mktemp)
+  env ${tm:+NLHEAT_TM=$tm} BENCH_GRID="$GRID" BENCH_LADDER="$GRID" \
+      python bench.py > "$RUN" 2>&1
+  echo "-- tm=$label rc=$?" | tee -a "$OUT"
+  cat "$RUN" >> "$OUT"
+  grep -h '"metric"' "$RUN" | tail -1 || echo "tm=$label: no metric emitted"
+  rm -f "$RUN"
+done
+echo "sweep log: $OUT"

@@ -169,6 +169,7 @@ class Best:
             "steps": rung["steps"],
             "ms_per_step": rung["ms_per_step"],
             "partial": rung["grid"] != GRID,
+            **({"variant": rung["variant"]} if "variant" in rung else {}),
             **meta,
         }
         if error is not None:
@@ -610,6 +611,7 @@ def child_measure():
             probe = NonlocalOp2D(EPS, k=1.0, dt=1.0, dh=1.0 / grid, method=method)
             dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
             op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / grid, method=method)
+            variant = None
             if method == "pallas" and os.environ.get("BENCH_CARRIED") == "1":
                 # opt-in: halo-padded state carried across the scan (skips
                 # the per-step pad round-trip); bit-identical to the
@@ -619,6 +621,23 @@ def child_measure():
                 )
 
                 multi = make_carried_multi_step_fn(op, steps)
+                variant = "carried"
+            elif method == "pallas" and os.environ.get("BENCH_RESIDENT") == "1":
+                # opt-in: whole run in ONE pallas_call, state resident in
+                # VMEM scratch (small grids — the reference's own regime —
+                # are per-call-overhead-bound); bit-identical to per-step
+                from nonlocalheatequation_tpu.ops.pallas_kernel import (
+                    fits_resident,
+                    make_resident_multi_step_fn,
+                )
+
+                if fits_resident(grid, grid, EPS):
+                    multi = make_resident_multi_step_fn(op, steps)
+                    variant = "resident"
+                else:
+                    log(f"rung {grid}^2 exceeds VMEM residency; using the "
+                        "per-step path (rung will carry no variant label)")
+                    multi = make_multi_step_fn(op, steps)
             else:
                 multi = make_multi_step_fn(op, steps)
             u = jnp.asarray(rng.normal(size=(grid, grid)), jnp.float32)
@@ -649,6 +668,7 @@ def child_measure():
                 best_s=best,
                 ms_per_step=best / steps * 1e3,
                 value=grid * grid * steps / best,
+                **({"variant": variant} if variant else {}),
             )
             last_op = op
             any_rung = True

@@ -210,3 +210,50 @@ def test_carried_multi_step_3d_bit_identical():
         a = np.asarray(ref(u, jnp.int32(0)))
         b = np.asarray(new(u, jnp.int32(0)))
         assert np.array_equal(a, b), (n, eps, np.abs(a - b).max())
+
+
+def test_resident_multi_step_bit_identical():
+    """The VMEM-resident whole-run kernel (one pallas_call for all steps,
+    state ping-ponging between two scratch frames) must be BIT-identical
+    to the per-step pad+kernel path: _strip_neighbor_sum over the full
+    frame in one strip sums the same slices in the same order as the
+    strip-partitioned form.  Covers odd/even step counts and steps=1."""
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp2D,
+        make_multi_step_fn,
+    )
+    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        fits_resident,
+        make_resident_multi_step_fn,
+    )
+
+    rng = np.random.default_rng(4)
+    for n, eps, steps in [(64, 5, 5), (40, 3, 4), (48, 12, 1), (128, 8, 2)]:
+        assert fits_resident(n, n, eps)
+        op = NonlocalOp2D(eps, k=1.0, dt=1e-6, dh=1.0 / n, method="pallas")
+        ref = make_multi_step_fn(op, steps, dtype=jnp.float32)
+        new = make_resident_multi_step_fn(op, steps, dtype=jnp.float32)
+        u = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
+        a = np.asarray(ref(u, jnp.int32(0)))
+        b = np.asarray(new(u, jnp.int32(0)))
+        assert np.array_equal(a, b), (n, eps, steps, np.abs(a - b).max())
+
+
+def test_resident_rejects_overflowing_grid():
+    """A grid past the VMEM budget must fail with the named error, not an
+    opaque Mosaic allocation failure at compile time."""
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        fits_resident,
+        make_resident_multi_step_fn,
+    )
+
+    assert not fits_resident(4096, 4096, 8)
+    op = NonlocalOp2D(8, k=1.0, dt=1e-6, dh=1.0 / 4096, method="pallas")
+    multi = make_resident_multi_step_fn(op, 2, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="resident kernel"):
+        multi(jnp.zeros((4096, 4096), jnp.float32), jnp.int32(0))

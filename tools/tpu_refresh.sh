@@ -61,6 +61,11 @@ fi
 # 2. carried-kernel A/B on the same ladder
 run bench-carried env BENCH_CARRIED=1 python bench.py
 
+# 2b. VMEM-resident whole-run kernel A/B at its target scale (small grids;
+# 512^2 is the largest flagship-eps grid that fits residency)
+run bench-resident env BENCH_RESIDENT=1 BENCH_GRID=512 BENCH_LADDER=512 \
+    python bench.py
+
 # 3. compiled-mode sanity sweep (all kernels, eps classes, carried, shard_map)
 run sanity python tools/tpu_sanity.py
 

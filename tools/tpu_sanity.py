@@ -137,6 +137,25 @@ def _check_carried(ndim, n, eps):
                 np.asarray(ref(u, jnp.int32(0))), 1e-6)
 
 
+def _check_resident(n, eps, steps=4):
+    np, jax = _setup()
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn
+    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        make_resident_multi_step_fn,
+    )
+
+    cls, dt = _op_classes(2)
+    rng = np.random.default_rng(0)
+    op = cls(eps, 1.0, dt, 1.0 / n, method="pallas")
+    ref = make_multi_step_fn(op, steps, dtype=jnp.float32)
+    new = make_resident_multi_step_fn(op, steps, dtype=jnp.float32)
+    u = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
+    _assert_rel(np.asarray(new(u, jnp.int32(0))),
+                np.asarray(ref(u, jnp.int32(0))), 1e-6)
+
+
 def _check_f64_guard():
     np, jax = _setup()
     import jax.numpy as jnp
@@ -196,6 +215,11 @@ def _build_checks():
         checks.append(
             (f"carried 3d multi-step {n}^3 eps={eps}",
              lambda n=n, e=eps: _check_carried(3, n, e))
+        )
+    for n, eps in [(512, 8), (200, 5)]:
+        checks.append(
+            (f"resident multi-step {n}^2 eps={eps}",
+             lambda n=n, e=eps: _check_resident(n, e))
         )
     checks.append(("pallas f64-on-TPU guard message", _check_f64_guard))
     checks.append(("pallas in shard_map 1-dev 64^2 eps=5", _check_shard_map))

@@ -531,7 +531,7 @@ def child_measure():
 
     from nonlocalheatequation_tpu.ops.nonlocal_op import (
         NonlocalOp2D,
-        make_multi_step_fn,
+        make_multi_step_fn_base as make_multi_step_fn,
     )
 
     t_start = time.time()

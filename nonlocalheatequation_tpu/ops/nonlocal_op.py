@@ -43,6 +43,8 @@ additionally reassociates across the whole column (see caveat above).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -392,7 +394,46 @@ def _make_generic_step(op, g, lg, dtype, test):
 
 
 def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
-    """(u, t0) -> u after ``nsteps`` forward-Euler steps, via lax.scan."""
+    """(u, t0) -> u after ``nsteps`` forward-Euler steps, via lax.scan.
+
+    With ``NLHEAT_RESIDENT=1`` the production (source-free) 2D pallas path
+    upgrades to the VMEM-resident whole-run kernel when the grid fits
+    (pallas_kernel.make_resident_multi_step_fn — bit-identical, one
+    pallas_call for all steps).  Opt-in until the hardware A/B lands; the
+    contract (signature, numerics) is unchanged either way.
+    """
+    if (g is None and nsteps > 0
+            and getattr(op, "method", None) == "pallas"
+            and os.environ.get("NLHEAT_RESIDENT") == "1"
+            and getattr(op, "mask", None) is not None and op.mask.ndim == 2):
+        from nonlocalheatequation_tpu.ops.pallas_kernel import (
+            fits_resident,
+            make_resident_multi_step_fn,
+        )
+
+        # shape is only known at call time; dispatch per call (the inner
+        # callables are jitted) with the built fn memoized per (shape,
+        # dtype) so repeated calls reuse jit's compile cache
+        built: dict = {}
+
+        def multi_resident(u, t0):
+            key = (u.shape, jnp.dtype(dtype or u.dtype).name)
+            fn = built.get(key)
+            if fn is None:
+                nx, ny = u.shape
+                if fits_resident(nx, ny, op.eps, dtype or u.dtype):
+                    fn = make_resident_multi_step_fn(op, nsteps, dtype)
+                else:
+                    fn = make_multi_step_fn_base(op, nsteps, g, lg, dtype)
+                built[key] = fn
+            return fn(u, t0)
+
+        return multi_resident
+    return make_multi_step_fn_base(op, nsteps, g, lg, dtype)
+
+
+def make_multi_step_fn_base(op, nsteps: int, g=None, lg=None, dtype=None):
+    """The plain lax.scan form of make_multi_step_fn (always available)."""
     step = make_step_fn(op, g, lg, dtype)
 
     def body(u, t):

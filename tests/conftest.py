@@ -12,6 +12,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # the ambient env selects the TPU ('axon')
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# kernel experiment knobs leaked from a developer shell must not silently
+# switch the paths the suite compares (e.g. the resident-vs-scan oracles)
+for _knob in ("NLHEAT_RESIDENT", "NLHEAT_LANE_RUNS", "NLHEAT_TM"):
+    os.environ.pop(_knob, None)
 
 import jax
 

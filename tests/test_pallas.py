@@ -171,7 +171,7 @@ def test_carried_multi_step_bit_identical():
 
     from nonlocalheatequation_tpu.ops.nonlocal_op import (
         NonlocalOp2D,
-        make_multi_step_fn,
+        make_multi_step_fn_base as make_multi_step_fn,
     )
     from nonlocalheatequation_tpu.ops.pallas_kernel import (
         make_carried_multi_step_fn,
@@ -195,7 +195,7 @@ def test_carried_multi_step_3d_bit_identical():
 
     from nonlocalheatequation_tpu.ops.nonlocal_op import (
         NonlocalOp3D,
-        make_multi_step_fn,
+        make_multi_step_fn_base as make_multi_step_fn,
     )
     from nonlocalheatequation_tpu.ops.pallas_kernel import (
         make_carried_multi_step_fn_3d,
@@ -222,7 +222,7 @@ def test_resident_multi_step_bit_identical():
 
     from nonlocalheatequation_tpu.ops.nonlocal_op import (
         NonlocalOp2D,
-        make_multi_step_fn,
+        make_multi_step_fn_base as make_multi_step_fn,
     )
     from nonlocalheatequation_tpu.ops.pallas_kernel import (
         fits_resident,

@@ -119,7 +119,9 @@ def _check_carried(ndim, n, eps):
     np, jax = _setup()
     import jax.numpy as jnp
 
-    from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        make_multi_step_fn_base as make_multi_step_fn,
+    )
     from nonlocalheatequation_tpu.ops.pallas_kernel import (
         make_carried_multi_step_fn,
         make_carried_multi_step_fn_3d,
@@ -141,7 +143,9 @@ def _check_resident(n, eps, steps=4):
     np, jax = _setup()
     import jax.numpy as jnp
 
-    from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        make_multi_step_fn_base as make_multi_step_fn,
+    )
     from nonlocalheatequation_tpu.ops.pallas_kernel import (
         make_resident_multi_step_fn,
     )

@@ -359,8 +359,40 @@ def bench_elastic_general(steps: int):
              tiles=ntiles * ntiles, devices=len(jax.devices()))
 
 
+def bench_small2d(steps: int):
+    """Reference-scale grids: per-step scan vs the VMEM-resident whole-run
+    kernel.  The resident rows are TPU-only (off-TPU only the scan rows
+    run — the resident kernel's interpreter-mode coverage lives in
+    tests/test_pallas.py and the sanity sweep, and timing it interpreted
+    would be noise).  Small grids are per-call-overhead bound, so this is
+    where residency should show."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp2D,
+        make_multi_step_fn_base,
+    )
+    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        fits_resident,
+        make_resident_multi_step_fn,
+    )
+
+    method = "pallas" if on_tpu() else "sat"
+    rng = np.random.default_rng(0)
+    for n in (128, 256, 512):
+        op = NonlocalOp2D(8, k=1.0, dt=1.0, dh=1.0 / n, method=method)
+        op = NonlocalOp2D(8, k=1.0, dt=stable_dt(op), dh=1.0 / n, method=method)
+        u0 = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
+        multi = make_multi_step_fn_base(op, steps)
+        sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
+        emit(f"2d/small/{n}/scan", n * n, steps, sec, grid=n, eps=8)
+        if method == "pallas" and fits_resident(n, n, 8):
+            multi = make_resident_multi_step_fn(op, steps)
+            sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
+            emit(f"2d/small/{n}/resident", n * n, steps, sec, grid=n, eps=8)
+
+
 BENCHES = {
     "methods2d": bench_methods2d,
+    "small2d": bench_small2d,
     "dist2d": bench_dist2d,
     "scaling": bench_scaling,
     "3d": bench_3d,

@@ -69,9 +69,11 @@ run bench-resident env BENCH_RESIDENT=1 BENCH_GRID=512 BENCH_LADDER=512 \
 # 3. compiled-mode sanity sweep (all kernels, eps classes, carried, shard_map)
 run sanity python tools/tpu_sanity.py
 
-# 4. full table: methods, dist, 3d, unstructured (+sharded halos), elastic+gang
+# 4. full table: methods, small-grid resident A/B, dist, 3d, unstructured
+# (+sharded halos), elastic+gang
 run table env BT_STEPS=200 python tools/bench_table.py \
-    methods2d dist2d scaling 3d unstructured elastic elastic-general eps-sweep
+    methods2d small2d dist2d scaling 3d unstructured elastic \
+    elastic-general eps-sweep
 
 # 5. profiler trace of the headline rung
 run profile env BENCH_PROFILE=docs/bench/profile_r03b python bench.py

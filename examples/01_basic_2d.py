@@ -2,7 +2,11 @@
 
 Run:  python examples/01_basic_2d.py  [--platform cpu]
 """
+import os
 import sys
+
+# runnable from a plain git clone (no install): repo root on the path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 

@@ -4,7 +4,11 @@ Run on any device set; simulate 8 chips on CPU with
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python examples/02_distributed.py --platform cpu
 """
+import os
 import sys
+
+# runnable from a plain git clone (no install): repo root on the path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 

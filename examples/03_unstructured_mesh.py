@@ -6,6 +6,10 @@ Run:  python examples/03_unstructured_mesh.py [--platform cpu]
 import os
 import sys
 
+# runnable from a plain git clone (no install): repo root on the path
+repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, repo)
+
 import jax
 
 if "--platform" in sys.argv:
@@ -18,7 +22,6 @@ if jax.default_backend() != "tpu":
 
 from nonlocalheatequation_tpu.cli import solve_unstructured
 
-repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 rc = solve_unstructured.main([
     "--mesh", os.path.join(repo, "data", "50x50.msh"),
     "--test", "--nt", "20", "--vtu", "example_out.vtu", "--no-header",

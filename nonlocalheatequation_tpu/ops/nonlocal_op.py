@@ -396,19 +396,23 @@ def _make_generic_step(op, g, lg, dtype, test):
 def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
     """(u, t0) -> u after ``nsteps`` forward-Euler steps, via lax.scan.
 
-    With ``NLHEAT_RESIDENT=1`` the production (source-free) 2D pallas path
-    upgrades to the VMEM-resident whole-run kernel when the grid fits
-    (pallas_kernel.make_resident_multi_step_fn — bit-identical, one
-    pallas_call for all steps).  Opt-in until the hardware A/B lands; the
-    contract (signature, numerics) is unchanged either way.
+    With ``NLHEAT_RESIDENT=1`` the production (source-free) 2D and 3D
+    pallas paths upgrade to the VMEM-resident whole-run kernels when the
+    grid fits (pallas_kernel.make_resident_multi_step_fn{,_3d} —
+    bit-identical, one pallas_call for all steps).  Opt-in until the
+    hardware A/B lands; the contract (signature, numerics) is unchanged
+    either way.
     """
+    ndim = getattr(getattr(op, "mask", None), "ndim", 0)
     if (g is None and nsteps > 0
             and getattr(op, "method", None) == "pallas"
             and os.environ.get("NLHEAT_RESIDENT") == "1"
-            and getattr(op, "mask", None) is not None and op.mask.ndim == 2):
+            and ndim in (2, 3)):
         from nonlocalheatequation_tpu.ops.pallas_kernel import (
             fits_resident,
+            fits_resident_3d,
             make_resident_multi_step_fn,
+            make_resident_multi_step_fn_3d,
         )
 
         # shape is only known at call time; dispatch per call (the inner
@@ -420,9 +424,11 @@ def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
             key = (u.shape, jnp.dtype(dtype or u.dtype).name)
             fn = built.get(key)
             if fn is None:
-                nx, ny = u.shape
-                if fits_resident(nx, ny, op.eps, dtype or u.dtype):
+                dt_ = dtype or u.dtype
+                if ndim == 2 and fits_resident(*u.shape, op.eps, dt_):
                     fn = make_resident_multi_step_fn(op, nsteps, dtype)
+                elif ndim == 3 and fits_resident_3d(*u.shape, op.eps, dt_):
+                    fn = make_resident_multi_step_fn_3d(op, nsteps, dtype)
                 else:
                     fn = make_multi_step_fn_base(op, nsteps, g, lg, dtype)
                 built[key] = fn

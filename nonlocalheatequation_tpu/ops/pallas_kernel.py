@@ -985,6 +985,109 @@ def make_resident_multi_step_fn(op, nsteps: int, dtype=None):
     return multi
 
 
+def _fits_resident_3d(nx: int, ny: int, nz: int, eps: int,
+                      itemsize: int) -> bool:
+    """3D residency model: same shape as _fits_resident with the sphere
+    plan's pad/slot counts and a (Rx, Ry, Lz) frame."""
+    _heights, parts_by_h, _pows, pad = _strip_plan_3d(eps)
+    Rx = nx + 2 * eps + pad
+    Ry = ny + 2 * eps
+    Lz = nz + 2 * eps
+    frame = Rx * Ry * Lz * itemsize
+    out = nx * ny * nz * itemsize
+    runs = _lane_runs_3d(eps)
+    lane_slots = _lane_slots({(h, Ln) for h, _jj, _kk0, Ln in runs})
+    log_steps = max(1, int(np.ceil(np.log2(Rx))))
+    stack = 1.5 * (2 * log_steps + 4 + len(parts_by_h) + lane_slots) * frame
+    return stack + 6 * frame + (2 * len(runs) + 3) * out <= _VMEM_BUDGET
+
+
+@functools.lru_cache(maxsize=None)
+def _build_resident_kernel_3d(eps: int, nx: int, ny: int, nz: int,
+                              dtype_name: str, c: float, dh: float,
+                              dt: float, wsum: float, nsteps: int):
+    """3D mirror of _build_resident_kernel: the whole (Rx, Ry, Lz) frame
+    lives in VMEM scratch for all ``nsteps`` steps (one pallas_call,
+    in-kernel fori ping-pong; see the 2D builder for the design notes)."""
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    if not _fits_resident_3d(nx, ny, nz, eps, dtype.itemsize):
+        raise ValueError(
+            f"resident 3D kernel: {nx}x{ny}x{nz} eps={eps} does not fit "
+            f"the {_VMEM_BUDGET >> 20} MiB VMEM budget; use the per-step path"
+        )
+    pad = _strip_plan_3d(eps)[3]
+    Rx = nx + 2 * eps + pad
+    Ry = ny + 2 * eps
+    Lz = nz + 2 * eps
+    scale = c * dh ** 3
+
+    def step_body(src_ref, dst_ref):
+        w = src_ref[:]
+        acc = _block_neighbor_sum_3d(w, nx, ny, nz, eps)
+        center = w[eps : eps + nx, eps : eps + ny, eps : eps + nz]
+        nxt = center + dt * (scale * (acc - wsum * center))
+        dst_ref[eps : eps + nx, eps : eps + ny, eps : eps + nz] = (
+            nxt.astype(dtype))
+
+    def kernel(in_ref, out_ref, a_ref, b_ref):
+        a_ref[...] = in_ref[...]
+        b_ref[...] = jnp.zeros((Rx, Ry, Lz), dtype)
+
+        def two(_i, carry):
+            step_body(a_ref, b_ref)
+            step_body(b_ref, a_ref)
+            return carry
+
+        lax.fori_loop(0, nsteps // 2, two, 0)
+        if nsteps % 2:
+            step_body(a_ref, b_ref)
+            out_ref[...] = b_ref[...]
+        else:
+            out_ref[...] = a_ref[...]
+
+    def run(frame):
+        return pl.pallas_call(
+            kernel,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((Rx, Ry, Lz), dtype),
+            scratch_shapes=[pltpu.VMEM((Rx, Ry, Lz), dtype),
+                            pltpu.VMEM((Rx, Ry, Lz), dtype)],
+            **_kernel_params(),
+        )(frame)
+
+    return run, Rx, Ry, Lz
+
+
+def fits_resident_3d(nx: int, ny: int, nz: int, eps: int,
+                     dtype=jnp.float32) -> bool:
+    """Public gate for make_resident_multi_step_fn_3d."""
+    return _fits_resident_3d(nx, ny, nz, eps, jnp.dtype(dtype).itemsize)
+
+
+def make_resident_multi_step_fn_3d(op, nsteps: int, dtype=None):
+    """(u, t0) -> u after ``nsteps`` 3D steps, entire run in one
+    pallas_call; see make_resident_multi_step_fn."""
+    eps = op.eps
+
+    @jax.jit
+    def multi(u, t0):
+        del t0
+        dt_ = dtype or u.dtype
+        nx, ny, nz = u.shape
+        run, Rx, Ry, Lz = _build_resident_kernel_3d(
+            eps, nx, ny, nz, jnp.dtype(dt_).name, op.c, op.dh, op.dt,
+            op.wsum, int(nsteps))
+        frame = (jnp.zeros((Rx, Ry, Lz), dt_)
+                 .at[eps : eps + nx, eps : eps + ny, eps : eps + nz]
+                 .set(u.astype(dt_)))
+        out = run(frame)
+        return out[eps : eps + nx, eps : eps + ny, eps : eps + nz]
+
+    return multi
+
+
 @functools.lru_cache(maxsize=None)
 def _build_carried_kernel_3d(eps: int, nx: int, ny: int, nz: int,
                              dtype_name: str, c: float, dh: float,

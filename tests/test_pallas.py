@@ -257,3 +257,35 @@ def test_resident_rejects_overflowing_grid():
     multi = make_resident_multi_step_fn(op, 2, dtype=jnp.float32)
     with pytest.raises(ValueError, match="resident kernel"):
         multi(jnp.zeros((4096, 4096), jnp.float32), jnp.int32(0))
+
+
+def test_resident_multi_step_3d_bit_identical():
+    """3D mirror of the resident whole-run kernel: bit-identical to the
+    per-step path for grids that fit the (stricter) 3D VMEM model."""
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp3D,
+        make_multi_step_fn_base,
+    )
+    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        fits_resident_3d,
+        make_resident_multi_step_fn_3d,
+    )
+
+    rng = np.random.default_rng(5)
+    for n, eps, steps in [(32, 4, 5), (24, 3, 1), (48, 3, 2), (40, 4, 3)]:
+        assert fits_resident_3d(n, n, n, eps)
+        op = NonlocalOp3D(eps, k=1.0, dt=1e-7, dh=1.0 / n, method="pallas")
+        ref = make_multi_step_fn_base(op, steps, dtype=jnp.float32)
+        new = make_resident_multi_step_fn_3d(op, steps, dtype=jnp.float32)
+        u = jnp.asarray(rng.normal(size=(n, n, n)), jnp.float32)
+        a = np.asarray(ref(u, jnp.int32(0)))
+        b = np.asarray(new(u, jnp.int32(0)))
+        assert np.array_equal(a, b), (n, eps, steps, np.abs(a - b).max())
+    # a config past the stricter 3D budget is refused with the named error
+    assert not fits_resident_3d(64, 64, 64, 6)
+    op = NonlocalOp3D(6, k=1.0, dt=1e-7, dh=1.0 / 64, method="pallas")
+    multi = make_resident_multi_step_fn_3d(op, 2, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="resident 3D kernel"):
+        multi(jnp.zeros((64, 64, 64), jnp.float32), jnp.int32(0))

@@ -139,7 +139,7 @@ def _check_carried(ndim, n, eps):
                 np.asarray(ref(u, jnp.int32(0))), 1e-6)
 
 
-def _check_resident(n, eps, steps=4):
+def _check_resident(ndim, n, eps, steps=4):
     np, jax = _setup()
     import jax.numpy as jnp
 
@@ -148,14 +148,17 @@ def _check_resident(n, eps, steps=4):
     )
     from nonlocalheatequation_tpu.ops.pallas_kernel import (
         make_resident_multi_step_fn,
+        make_resident_multi_step_fn_3d,
     )
 
-    cls, dt = _op_classes(2)
+    cls, dt = _op_classes(ndim)
+    make_resident = (make_resident_multi_step_fn if ndim == 2
+                     else make_resident_multi_step_fn_3d)
     rng = np.random.default_rng(0)
     op = cls(eps, 1.0, dt, 1.0 / n, method="pallas")
     ref = make_multi_step_fn(op, steps, dtype=jnp.float32)
-    new = make_resident_multi_step_fn(op, steps, dtype=jnp.float32)
-    u = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
+    new = make_resident(op, steps, dtype=jnp.float32)
+    u = jnp.asarray(rng.normal(size=(n,) * ndim), jnp.float32)
     _assert_rel(np.asarray(new(u, jnp.int32(0))),
                 np.asarray(ref(u, jnp.int32(0))), 1e-6)
 
@@ -223,8 +226,12 @@ def _build_checks():
     for n, eps in [(512, 8), (200, 5)]:
         checks.append(
             (f"resident multi-step {n}^2 eps={eps}",
-             lambda n=n, e=eps: _check_resident(n, e))
+             lambda n=n, e=eps: _check_resident(2, n, e))
         )
+    checks.append(
+        ("resident 3d multi-step 40^3 eps=4",
+         lambda: _check_resident(3, 40, 4))
+    )
     checks.append(("pallas f64-on-TPU guard message", _check_f64_guard))
     checks.append(("pallas in shard_map 1-dev 64^2 eps=5", _check_shard_map))
     return checks

@@ -40,7 +40,9 @@ Env knobs: BENCH_GRID, BENCH_EPS, BENCH_STEPS, BENCH_WATCHDOG_S,
 BENCH_PLATFORM (cpu for CI smoke), BENCH_METHOD (skip the method probe),
 BENCH_LADDER (comma grids), BENCH_PROFILE (jax.profiler trace dir),
 BENCH_CARRIED=1 (pallas: carry the halo-padded state across the scan —
-opt-in until measured on hardware), BENCH_ALLOW_CPU_FALLBACK (default 1:
+opt-in until measured on hardware), BENCH_RESIDENT=1 (pallas: whole run
+in one pallas_call for grids that fit VMEM residency — opt-in, rung
+labeled "variant"), BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
 budget above this re-probes the TPU once — the wedge cycle often heals

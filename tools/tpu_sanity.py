@@ -10,6 +10,7 @@ actual backend and cross-checks each against the sat path:
   * the fused test-mode step kernel (in-kernel manufactured source),
   * 3D at eps values not divisible by 4 (the round-3 bug class),
   * the carried-frame multi-step kernels (2D and 3D),
+  * the VMEM-resident whole-run kernels (2D and 3D),
   * pallas inside shard_map on the real device.
 
 Process model (hardened after the 2026-07-30 wedge): the parent never

@@ -172,6 +172,7 @@ class Best:
             "ms_per_step": rung["ms_per_step"],
             "partial": rung["grid"] != GRID,
             **({"variant": rung["variant"]} if "variant" in rung else {}),
+            **({"tm": rung["tm"]} if "tm" in rung else {}),
             **meta,
         }
         if error is not None:
@@ -663,6 +664,16 @@ def child_measure():
                     best = min(best, dt_s)
                     log(f"rung {grid}^2 iter {it}: {dt_s * 1e3:.1f} ms "
                         f"({dt_s / steps * 1e3:.3f} ms/step)")
+            # a forced strip height (tools/tpu_opportunistic.sh tm sweep)
+            # must label its rows — four identical-looking 4096^2 pallas
+            # rows would otherwise be indistinguishable in the table.
+            # Label with the EFFECTIVE height (the kernel rounds the knob:
+            # pallas_kernel._choose_tm), not the raw env string.
+            forced_tm = os.environ.get("NLHEAT_TM")
+            if forced_tm and method == "pallas":
+                from nonlocalheatequation_tpu.ops.pallas_kernel import _round_up
+
+                forced_tm = max(8, _round_up(int(forced_tm), 8))
             event(
                 event="rung",
                 grid=grid,
@@ -671,6 +682,8 @@ def child_measure():
                 ms_per_step=best / steps * 1e3,
                 value=grid * grid * steps / best,
                 **({"variant": variant} if variant else {}),
+                **({"tm": int(forced_tm)} if forced_tm and method == "pallas"
+                   else {}),
             )
             last_op = op
             any_rung = True

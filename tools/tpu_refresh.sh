@@ -21,8 +21,11 @@ TABLE=${BENCH_REFRESH_TABLE:-docs/bench/BENCH_TABLE_r03.jsonl}
 echo "== TPU refresh $STAMP ==" | tee "$OUT"
 
 append_rows() {  # copy every JSON measurement row from the log to the table
-  grep -h '"bench"\|"metric"' "$OUT" >> "$TABLE"
-  echo "-- appended $(grep -c '"bench"\|"metric"' "$OUT") rows$1" | tee -a "$OUT"
+  # cpu_fallback rows are recovery artifacts, not measurements — they stay
+  # in the log but must not enter the TPU evidence table
+  grep -h '"bench"\|"metric"' "$OUT" | grep -v '"cpu_fallback": true' >> "$TABLE"
+  echo "-- appended $(grep -h '"bench"\|"metric"' "$OUT" \
+    | grep -vc '"cpu_fallback": true') rows$1" | tee -a "$OUT"
 }
 
 run() {  # run <label> <cmd...>  (no timeout: see header)

@@ -1,0 +1,80 @@
+"""Black-box CI tests for tools/tpu_opportunistic.sh (CPU smoke mode).
+
+The opportunistic queue is the flaky-tunnel measurement runner: it probes
+for heal windows, gates each window on a no-fallback bench, and works
+through prioritized steps whose outputs must carry backend and variant/tm
+evidence before rows enter the table.  These tests exercise the success
+path (resident variant engages, queue completes) and the strike path (a
+step that deterministically cannot produce its required label is struck
+twice on a healthy backend, then skipped) — the same policy-level testing
+the bench/sanity harnesses get (tests/test_bench_harness.py,
+tests/test_sanity_harness.py).
+"""
+
+import os
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tools", "tpu_opportunistic.sh")
+
+ALL_STEPS = [
+    "resident512", "carried4096", "tm160", "tm192", "tm224", "tm256",
+    "stretch8192", "sanity", "table-a", "table-b", "table-c", "profile",
+]
+
+
+def _run(tmp_path, leave_undone, extra_env, timeout=560):
+    state = tmp_path / "state"
+    state.write_text(
+        "".join(f"{s}\n" for s in ALL_STEPS if s != leave_undone))
+    table = tmp_path / "table.jsonl"
+    out = tmp_path / "opp.log"
+    env = dict(os.environ)
+    # scrub every ambient bench knob that could flip a child's behavior
+    # (same hygiene as tests/test_bench_harness.py)
+    for k in ("BENCH_PLATFORM", "BENCH_CARRIED", "BENCH_RESIDENT",
+              "BENCH_FAULT", "BENCH_METHOD", "BENCH_GRID", "BENCH_LADDER",
+              "NLHEAT_TM"):
+        env.pop(k, None)
+    env.update(
+        OPP_GATE_BACKEND="cpu",
+        OPP_STATE=str(state),
+        OPP_TABLE=str(table),
+        OPP_OUT=str(out),
+        PROBE_INTERVAL_S="15",
+        OPP_BUDGET_H="1",
+        BENCH_STEPS="2",  # keep every bench child fast on CPU
+        **extra_env,
+    )
+    proc = subprocess.run(
+        ["bash", SCRIPT], env=env, cwd=REPO, timeout=timeout,
+        capture_output=True, text=True)
+    return proc, state.read_text(), table.read_text(), out.read_text()
+
+
+def test_success_path_resident_variant(tmp_path):
+    # interpreted pallas on CPU lets the resident kernel genuinely engage;
+    # the step must record a variant-labeled row and complete the queue
+    proc, state, table, _out = _run(
+        tmp_path, "resident512", {"BENCH_METHOD": "pallas"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    assert "resident512\n" in state
+    assert "fail:" not in state
+    assert '"variant": "resident"' in table
+
+
+def test_strike_path_unlabelable_step(tmp_path):
+    # with the sat method the artifact can never carry a "tm" label, and
+    # the backend stays healthy, so the step must strike twice (classified
+    # deterministic by the post-failure re-gate) and then be skipped
+    proc, state, table, out = _run(
+        tmp_path, "tm160",
+        {"BENCH_METHOD": "sat", "OPP_GRID_LARGE": "256"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    lines = state.splitlines()
+    assert lines.count("fail:tm160") == 2
+    assert "tm160" not in lines  # struck out, never marked done
+    assert '"tm": 160' not in table  # no mislabeled/unlabeled row landed
+    assert "strike 2/2" in out

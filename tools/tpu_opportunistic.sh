@@ -60,12 +60,18 @@ log() { echo "[opp $(date -u +%H:%M:%S)] $*" | tee -a "$OUT"; }
 
 bench_nofb() { env "$@" BENCH_ALLOW_CPU_FALLBACK=0 python bench.py; }
 
+# knob exists for the CI harness test only (tests/test_opportunistic.py
+# exercises the strike path with small CPU grids); real runs use the default
+GRID_LG=${OPP_GRID_LARGE:-4096}
+
 run_step_cmd() {  # the queue's one name->command map
   case $1 in
     resident512) bench_nofb BENCH_RESIDENT=1 BENCH_GRID=512 BENCH_LADDER=512 ;;
-    carried4096) bench_nofb BENCH_CARRIED=1 BENCH_GRID=4096 BENCH_LADDER=4096 ;;
+    carried4096)
+      bench_nofb BENCH_CARRIED=1 BENCH_GRID="$GRID_LG" BENCH_LADDER="$GRID_LG" ;;
     tm160 | tm192 | tm224 | tm256)
-      bench_nofb "NLHEAT_TM=${1#tm}" BENCH_GRID=4096 BENCH_LADDER=4096 ;;
+      bench_nofb "NLHEAT_TM=${1#tm}" BENCH_GRID="$GRID_LG" \
+        BENCH_LADDER="$GRID_LG" ;;
     stretch8192) bench_nofb BENCH_GRID=8192 BENCH_LADDER=8192 ;;
     sanity) python tools/tpu_sanity.py ;;
     table-a) timeout -k 10 "$HARD_CAP_S" \

@@ -42,7 +42,9 @@ BENCH_LADDER (comma grids), BENCH_PROFILE (jax.profiler trace dir),
 BENCH_CARRIED=1 (pallas: carry the halo-padded state across the scan —
 opt-in until measured on hardware), BENCH_RESIDENT=1 (pallas: whole run
 in one pallas_call for grids that fit VMEM residency — opt-in, rung
-labeled "variant"), BENCH_ALLOW_CPU_FALLBACK (default 1:
+labeled "variant"), BENCH_SUPERSTEP=K (pallas: K steps fused per
+pallas_call, temporal blocking of the copy-floor-bound kernel — opt-in,
+rung labeled "variant": "superstepK"), BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
 budget above this re-probes the TPU once — the wedge cycle often heals
@@ -625,6 +627,22 @@ def child_measure():
 
                 multi = make_carried_multi_step_fn(op, steps)
                 variant = "carried"
+            elif method == "pallas" and os.environ.get("BENCH_SUPERSTEP"):
+                # opt-in: K steps fused per pallas_call (temporal blocking
+                # — each strip reads a K*eps-expanded halo and advances K
+                # steps in VMEM, cutting the copy-floor HBM traffic that
+                # dominates the measured kernel); bit-identical to the
+                # per-step path (tests/test_pallas.py)
+                from nonlocalheatequation_tpu.ops.pallas_kernel import (
+                    make_superstep_multi_step_fn,
+                )
+
+                # label with the CLAMPED K the maker actually runs (K is
+                # capped at the step count), not the raw env value
+                ksup = max(1, min(int(os.environ["BENCH_SUPERSTEP"]),
+                                  steps if steps else 1))
+                multi = make_superstep_multi_step_fn(op, steps, ksteps=ksup)
+                variant = f"superstep{ksup}"
             elif method == "pallas" and os.environ.get("BENCH_RESIDENT") == "1":
                 # opt-in: whole run in ONE pallas_call, state resident in
                 # VMEM scratch (small grids — the reference's own regime —
@@ -667,13 +685,14 @@ def child_measure():
             # a forced strip height (tools/tpu_opportunistic.sh tm sweep)
             # must label its rows — four identical-looking 4096^2 pallas
             # rows would otherwise be indistinguishable in the table.
-            # Label with the EFFECTIVE height (the kernel rounds the knob:
-            # pallas_kernel._choose_tm), not the raw env string.
-            forced_tm = os.environ.get("NLHEAT_TM")
-            if forced_tm and method == "pallas":
-                from nonlocalheatequation_tpu.ops.pallas_kernel import _round_up
+            # pallas_kernel.forced_tm is the same rounding the chooser
+            # applies, so the label is the strip height that actually ran.
+            if method == "pallas":
+                from nonlocalheatequation_tpu.ops.pallas_kernel import forced_tm
 
-                forced_tm = max(8, _round_up(int(forced_tm), 8))
+                tm_label = forced_tm()
+            else:
+                tm_label = None
             event(
                 event="rung",
                 grid=grid,
@@ -682,8 +701,7 @@ def child_measure():
                 ms_per_step=best / steps * 1e3,
                 value=grid * grid * steps / best,
                 **({"variant": variant} if variant else {}),
-                **({"tm": int(forced_tm)} if forced_tm and method == "pallas"
-                   else {}),
+                **({"tm": tm_label} if tm_label else {}),
             )
             last_op = op
             any_rung = True

@@ -98,6 +98,22 @@ def _fits(tm: int, ny: int, eps: int, itemsize: int, n_aux: int) -> bool:
     return stack <= _VMEM_BUDGET
 
 
+def forced_tm() -> int | None:
+    """Effective NLHEAT_TM strip height — the exact rounding _choose_tm
+    applies — or None when the knob is unset.  The single source of truth
+    for both the chooser and the bench row label (bench.py labels forced
+    runs with this value so a sweep's rows stay distinguishable)."""
+    v = os.environ.get("NLHEAT_TM")
+    if not v:
+        return None
+    try:
+        return max(8, _round_up(int(v), 8))
+    except ValueError:
+        raise ValueError(
+            f"NLHEAT_TM must be an integer strip height, got {v!r}"
+        ) from None
+
+
 def _choose_tm(nx: int, ny: int, eps: int, itemsize: int, n_aux: int,
                fits=None) -> int:
     """Largest strip height (multiple of 8) whose stack footprint fits VMEM.
@@ -117,14 +133,9 @@ def _choose_tm(nx: int, ny: int, eps: int, itemsize: int, n_aux: int,
     over settings would silently reuse the first build — run one process
     per setting (what the measurement tools do anyway).
     """
-    forced = os.environ.get("NLHEAT_TM")
+    forced = forced_tm()
     if forced:
-        try:
-            return max(8, _round_up(int(forced), 8))
-        except ValueError:
-            raise ValueError(
-                f"NLHEAT_TM must be an integer strip height, got {forced!r}"
-            ) from None
+        return forced
     if fits is None:
         fits = lambda tm: _fits(tm, ny, eps, itemsize, n_aux)  # noqa: E731
     cap = min(256, _round_up(nx, 8))
@@ -858,6 +869,171 @@ def make_carried_multi_step_fn(op, nsteps: int, dtype=None):
               .set(u.astype(dt_)))
 
         A, _ = lax.scan(lambda A, _: (step(A), None), C0, None, length=nsteps)
+        return A[D + eps : D + eps + nx, eps : eps + ny]
+
+    return multi
+
+
+def _fits_superstep(tm: int, nx: int, ny: int, eps: int, itemsize: int,
+                    ksteps: int) -> bool:
+    """_fits for the temporally blocked frame (see
+    _build_superstep_kernel): the window is ~K*eps rows taller than the
+    carried window and the kernel instantiates K sequential band levels,
+    each with its own roll chains and band temporaries (no cross-level
+    reuse assumed — conservative, like the rest of the stack model)."""
+    D = _round_up(ksteps * eps, 8)
+    tmw = tm + D + _round_up((ksteps - 1) * eps, 8) + _window_pad(eps)
+    Lc = ny + 2 * eps
+    window = tmw * Lc * itemsize
+    out = tm * Lc * itemsize
+    log_steps = max(1, int(np.ceil(np.log2(tmw))))
+    lane_slots = _lane_slots({(h, L) for h, _j0, L in _lane_runs(eps)})
+    stack = ksteps * (2 * log_steps + 6 + lane_slots) * window + 3 * out
+    return stack <= _VMEM_BUDGET
+
+
+def _build_superstep_kernel(eps: int, nx: int, ny: int, dtype_name: str,
+                            c: float, dh: float, dt: float, wsum: float,
+                            ksteps: int, tm: int, D: int, Rc: int):
+    """K-step temporally blocked kernel over the carried frame layout.
+
+    The carried kernel still moves ~2 full frames of HBM traffic per step
+    (read the window, write the strip) and the measured kernel is
+    copy-floor-bound (docs/round3.md: copy floor 0.78 of 0.96 ms/step at
+    4096^2), so the remaining lever is temporal blocking: each strip reads
+    a window expanded by K*eps rows of halo, advances K steps locally in
+    VMEM — level j computes a band that shrinks by eps rows per side, the
+    classic trapezoidal tiling — and writes only the final tm-row strip.
+    Per-step HBM traffic drops from ~(1 + tmw/tm) frames to
+    ~(1 + tmw_K/tm)/K frames for ~(sum of band heights)/(K*tm) ~ 1.1-1.2x
+    extra compute.
+
+    Frame layout generalizes the carried kernel's: dead band D =
+    round_up(K*eps, 8) rows (>= the K*eps rows of upward reach), halo,
+    real rows, chain pad.  Soundness of garbage rows is level-wise the
+    carried argument: every level masks its band to zero outside the real
+    rows (the volumetric BC re-applied each level, exactly like the
+    per-step path's zero pad), so dead-band/out-of-band garbage only ever
+    feeds values the mask forces to zero.
+
+    Numerics are IDENTICAL to the per-step kernel: each level runs the
+    same _strip_neighbor_sum plan and the same update expression on
+    identical inputs, so retained values are bit-equal (tests/test_pallas
+    pins this).  Production (source-free) path only — the timed bench
+    rungs.  ``ksteps`` may be smaller than the frame was sized for (the
+    remainder kernel reuses the same D/Rc so scan carries stay compatible).
+    """
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    pad = _window_pad(eps)
+    tmw = tm + D + _round_up((ksteps - 1) * eps, 8) + pad
+    Lc = ny + 2 * eps
+    G = -(-(nx + 2 * eps) // tm)  # out rows [D, D+G*tm) cover halo+real
+    scale = c * dh * dh
+
+    def kernel(win_ref, out_ref):
+        i = pl.program_id(0)
+        state = win_ref[:]
+        for j in range(1, ksteps + 1):
+            bh = tm + 2 * (ksteps - j) * eps
+            # window row of this band's first row inside `state`: the
+            # level-0 window starts D-(K-1)*eps above the final band;
+            # each constructed band array starts exactly at its band
+            row0 = (D - (ksteps - 1) * eps) if j == 1 else eps
+            acc = _strip_neighbor_sum(state, bh, ny, eps, row0=row0)
+            center = state[row0 : row0 + bh, eps : eps + ny]
+            du = scale * (acc - wsum * center)
+            nxt = center + dt * du
+            start = i * tm + D - (ksteps - j) * eps  # frame row of band[0]
+            rows = start + jax.lax.broadcasted_iota(jnp.int32, (bh, ny), 0)
+            ok = (rows >= D + eps) & (rows < D + eps + nx)
+            nxt = jnp.where(ok, nxt, 0).astype(dtype)
+            if j == ksteps:
+                out_ref[:, eps : eps + ny] = nxt
+                out_ref[:, :eps] = jnp.zeros((tm, eps), dtype)
+                out_ref[:, eps + ny :] = jnp.zeros((tm, eps), dtype)
+            else:
+                # re-glue the zero lane halo (volumetric BC on the lane
+                # axis) and pad slack rows below for the next level's roll
+                # garbage (2*eps + pad >= the plan's deepest read past the
+                # band end, see _strip_plan)
+                zl = jnp.zeros((bh, eps), dtype)
+                band = jnp.concatenate([zl, nxt, zl], axis=1)
+                state = jnp.concatenate(
+                    [band, jnp.zeros((pad, Lc), dtype)], axis=0)
+                # Materialization boundary AFTER the glue: the per-step
+                # path reads each step from a materialized buffer, fixing
+                # XLA's fusion context (FMA regionalization) for the next
+                # level's consumers; without it the fused concat lets XLA
+                # compile the level's arithmetic differently and flip last
+                # ulps (observed: 40^2 eps=3 K=3, one element).  Verified:
+                # barriers on `nxt` or `acc` alone do NOT restore
+                # bit-identity; the opaque state does.
+                state = jax.lax.optimization_barrier(state)
+
+    def step(A):
+        return pl.pallas_call(
+            kernel,
+            grid=(G,),
+            in_specs=[
+                pl.BlockSpec(
+                    (pl.Element(tmw), pl.Element(Lc)),
+                    lambda i: (i * tm, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (pl.Element(tm), pl.Element(Lc)),
+                lambda i: ((i * (tm // 8) + D // 8) * 8, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((Rc, Lc), dtype),
+            **_kernel_params(),
+        )(A)
+
+    return step
+
+
+def make_superstep_multi_step_fn(op, nsteps: int, ksteps: int = 2,
+                                 dtype=None):
+    """(u, t0) -> u after ``nsteps`` steps, ``ksteps`` fused per pallas_call.
+
+    Drop-in for ops.nonlocal_op.make_multi_step_fn on the production
+    (source-free) path when op.method == 'pallas'; see
+    _build_superstep_kernel.  A remainder of nsteps % ksteps runs one
+    shallower superstep call on the same frame.  The t0 argument is
+    accepted for signature parity (the production step is
+    time-independent).
+    """
+    eps = op.eps
+
+    @jax.jit
+    def multi(u, t0):
+        del t0
+        dt_ = dtype or u.dtype
+        nx, ny = u.shape
+        K = max(1, min(ksteps, nsteps if nsteps else 1))
+        itemsize = jnp.dtype(dt_).itemsize
+        tm = _choose_tm(
+            nx, ny, eps, itemsize, n_aux=0,
+            fits=lambda t: _fits_superstep(t, nx, ny, eps, itemsize, K))
+        D = _round_up(K * eps, 8)
+        tmw = tm + D + _round_up((K - 1) * eps, 8) + _window_pad(eps)
+        Lc = ny + 2 * eps
+        G = -(-(nx + 2 * eps) // tm)
+        Rc = max(D + G * tm, (G - 1) * tm + tmw)
+        name = jnp.dtype(dt_).name
+        step_K = _build_superstep_kernel(
+            eps, nx, ny, name, op.c, op.dh, op.dt, op.wsum, K, tm, D, Rc)
+        C0 = (jnp.zeros((Rc, Lc), dt_)
+              .at[D + eps : D + eps + nx, eps : eps + ny]
+              .set(u.astype(dt_)))
+        q, r = divmod(nsteps, K)
+        A, _ = lax.scan(lambda A, _: (step_K(A), None), C0, None, length=q)
+        if r:
+            step_r = _build_superstep_kernel(
+                eps, nx, ny, name, op.c, op.dh, op.dt, op.wsum, r, tm, D, Rc)
+            A = step_r(A)
         return A[D + eps : D + eps + nx, eps : eps + ny]
 
     return multi

@@ -53,8 +53,8 @@ fi
 touch "$STATE"
 
 # one list drives both execution order and the done check
-STEPS="resident512 carried4096 tm160 tm192 tm224 tm256 stretch8192 \
-sanity table-a table-b table-c profile"
+STEPS="resident512 carried4096 superstep2 superstep3 tm160 tm192 tm224 \
+tm256 stretch8192 sanity table-a table-b table-c profile"
 
 log() { echo "[opp $(date -u +%H:%M:%S)] $*" | tee -a "$OUT"; }
 
@@ -69,6 +69,9 @@ run_step_cmd() {  # the queue's one name->command map
     resident512) bench_nofb BENCH_RESIDENT=1 BENCH_GRID=512 BENCH_LADDER=512 ;;
     carried4096)
       bench_nofb BENCH_CARRIED=1 BENCH_GRID="$GRID_LG" BENCH_LADDER="$GRID_LG" ;;
+    superstep2 | superstep3)
+      bench_nofb "BENCH_SUPERSTEP=${1#superstep}" BENCH_GRID="$GRID_LG" \
+        BENCH_LADDER="$GRID_LG" ;;
     tm160 | tm192 | tm224 | tm256)
       bench_nofb "NLHEAT_TM=${1#tm}" BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" ;;
@@ -105,6 +108,8 @@ step_variant_ok() {  # <name> <run-log>: opt-in kernel actually engaged?
   case $1 in
     resident512) grep -q '"variant": "resident"' "$2" ;;
     carried4096) grep -q '"variant": "carried"' "$2" ;;
+    superstep2 | superstep3)
+      grep -q "\"variant\": \"superstep${1#superstep}\"" "$2" ;;
     tm160 | tm192 | tm224 | tm256) grep -q "\"tm\": ${1#tm}" "$2" ;;
     *) return 0 ;;
   esac

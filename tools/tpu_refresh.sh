@@ -22,10 +22,14 @@ echo "== TPU refresh $STAMP ==" | tee "$OUT"
 
 append_rows() {  # copy every JSON measurement row from the log to the table
   # cpu_fallback rows are recovery artifacts, not measurements — they stay
-  # in the log but must not enter the TPU evidence table
-  grep -h '"bench"\|"metric"' "$OUT" | grep -v '"cpu_fallback": true' >> "$TABLE"
+  # in the log but must not enter the TPU evidence table.  That includes
+  # the "late-retry-in-progress" string form (a CPU-measured headline whose
+  # late re-probe died mid-retry — backend labels may even say tpu);
+  # "recovered-late" stays: it is a genuine TPU rung.
+  CPU_ROWS='"cpu_fallback": true\|"cpu_fallback": "late-retry-in-progress"'
+  grep -h '"bench"\|"metric"' "$OUT" | grep -v "$CPU_ROWS" >> "$TABLE"
   echo "-- appended $(grep -h '"bench"\|"metric"' "$OUT" \
-    | grep -vc '"cpu_fallback": true') rows$1" | tee -a "$OUT"
+    | grep -vc "$CPU_ROWS") rows$1" | tee -a "$OUT"
 }
 
 run() {  # run <label> <cmd...>  (no timeout: see header)
@@ -67,6 +71,10 @@ run bench-carried env BENCH_CARRIED=1 python bench.py
 # 2b. VMEM-resident whole-run kernel A/B at its target scale (small grids;
 # 512^2 is the largest flagship-eps grid that fits residency)
 run bench-resident env BENCH_RESIDENT=1 BENCH_GRID=512 BENCH_LADDER=512 \
+    python bench.py
+
+# 2c. temporally blocked kernel A/B on the headline rung
+run bench-superstep env BENCH_SUPERSTEP=2 BENCH_GRID=4096 BENCH_LADDER=4096 \
     python bench.py
 
 # 3. compiled-mode sanity sweep (all kernels, eps classes, carried, shard_map)

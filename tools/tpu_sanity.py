@@ -140,6 +140,32 @@ def _check_carried(ndim, n, eps):
                 np.asarray(ref(u, jnp.int32(0))), 1e-6)
 
 
+def _check_superstep(n, eps, ksteps):
+    """Compiled-mode check of the temporally blocked kernel: Mosaic must
+    lower the multi-level bands + optimization_barrier, and the result
+    must match the per-step pallas path (1e-6 rel — TPU vs TPU)."""
+    np, jax = _setup()
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        make_multi_step_fn_base as make_multi_step_fn,
+    )
+    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        make_superstep_multi_step_fn,
+    )
+
+    cls, dt = _op_classes(2)
+    rng = np.random.default_rng(0)
+    op = cls(eps, 1.0, dt, 1.0 / n, method="pallas")
+    steps = ksteps + 1  # exercises the remainder kernel too
+    ref = make_multi_step_fn(op, steps, dtype=jnp.float32)
+    new = make_superstep_multi_step_fn(op, steps, ksteps=ksteps,
+                                       dtype=jnp.float32)
+    u = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
+    _assert_rel(np.asarray(new(u, jnp.int32(0))),
+                np.asarray(ref(u, jnp.int32(0))), 1e-6)
+
+
 def _check_resident(ndim, n, eps, steps=4):
     np, jax = _setup()
     import jax.numpy as jnp
@@ -228,6 +254,11 @@ def _build_checks():
         checks.append(
             (f"resident multi-step {n}^2 eps={eps}",
              lambda n=n, e=eps: _check_resident(2, n, e))
+        )
+    for n, eps, k in [(512, 8, 2), (200, 5, 3)]:
+        checks.append(
+            (f"superstep K={k} {n}^2 eps={eps}",
+             lambda n=n, e=eps, k=k: _check_superstep(n, e, k))
         )
     checks.append(
         ("resident 3d multi-step 40^3 eps=4",

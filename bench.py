@@ -627,20 +627,22 @@ def child_measure():
 
                 multi = make_carried_multi_step_fn(op, steps)
                 variant = "carried"
-            elif method == "pallas" and os.environ.get("BENCH_SUPERSTEP"):
-                # opt-in: K steps fused per pallas_call (temporal blocking
-                # — each strip reads a K*eps-expanded halo and advances K
-                # steps in VMEM, cutting the copy-floor HBM traffic that
+            elif (method == "pallas"
+                  and int(os.environ.get("BENCH_SUPERSTEP", 0)) >= 2):
+                # opt-in (K >= 2; 0/1 mean off, like the sibling knobs):
+                # K steps fused per pallas_call (temporal blocking — each
+                # strip reads a K*eps-expanded halo and advances K steps
+                # in VMEM, cutting the copy-floor HBM traffic that
                 # dominates the measured kernel); bit-identical to the
                 # per-step path (tests/test_pallas.py)
                 from nonlocalheatequation_tpu.ops.pallas_kernel import (
                     make_superstep_multi_step_fn,
+                    superstep_k,
                 )
 
-                # label with the CLAMPED K the maker actually runs (K is
-                # capped at the step count), not the raw env value
-                ksup = max(1, min(int(os.environ["BENCH_SUPERSTEP"]),
-                                  steps if steps else 1))
+                # label with the EFFECTIVE K the maker runs (superstep_k
+                # is the maker's own clamp), not the raw env value
+                ksup = superstep_k(int(os.environ["BENCH_SUPERSTEP"]), steps)
                 multi = make_superstep_multi_step_fn(op, steps, ksteps=ksup)
                 variant = f"superstep{ksup}"
             elif method == "pallas" and os.environ.get("BENCH_RESIDENT") == "1":

@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices", type=int, default=0,
                    help="limit the device count (the reference's number of "
                         "localities, srun -n N); 0 = all")
+    p.add_argument("--superstep", type=int, default=1, metavar="K",
+                   help="exchange a K*eps-wide halo once per K steps and "
+                        "advance K steps locally (communication-avoiding; "
+                        "K-fold fewer collective rounds)")
     p.add_argument("--method", default="auto",
                    choices=("auto", "conv", "shift", "sat", "pallas"))
     p.add_argument("--log", action="store_true")
@@ -95,6 +99,15 @@ def main(argv=None) -> int:
     # rebalancing.  The plain path stays on the fused SPMD program.
     use_elastic = (assignment is not None or args.nbalance > 0
                    or args.test_load_balance)
+    if use_elastic and args.superstep > 1:
+        # same honesty rule as Solver2DDistributed's nbalance rejection:
+        # silently running the per-step elastic path under a --superstep
+        # flag would misattribute its behavior
+        raise SystemExit(
+            "--superstep is not supported on the elastic executor path "
+            "(partition maps / --nbalance / --test_load_balance exchange "
+            "per step); drop --superstep or the elastic-selecting flags"
+        )
 
     if nx <= args.eps:
         print("[WARNING] Mesh size on a single node (nx * ny) is too small "
@@ -137,6 +150,7 @@ def main(argv=None) -> int:
             nx, ny, npx, npy, nt, eps, nlog=args.nlog,
             k=k, dt=dt, dh=dh, mesh=mesh, method=args.method,
             checkpoint_path=args.checkpoint, ncheckpoint=args.ncheckpoint,
+            superstep=args.superstep,
         )
 
     if args.test_batch:

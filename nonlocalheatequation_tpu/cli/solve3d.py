@@ -42,6 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("auto", "shift", "sat", "pallas"))
     p.add_argument("--distributed", action="store_true",
                    help="shard over the device mesh (SPMD + halo exchange)")
+    p.add_argument("--superstep", type=int, default=1, metavar="K",
+                   help="with --distributed: exchange a K*eps-wide halo "
+                        "once per K steps (communication-avoiding)")
     p.add_argument("--checkpoint", default=None,
                    help="checkpoint file to write every --ncheckpoint steps")
     p.add_argument("--ncheckpoint", type=int, default=0,
@@ -60,6 +63,12 @@ def main(argv=None) -> int:
     if args.test_batch and (args.resume or args.checkpoint):
         print("--checkpoint/--resume cannot be combined with --test_batch",
               file=sys.stderr)
+        return 1
+    if args.superstep > 1 and not args.distributed:
+        # honesty rule (see solve2d_distributed): never run the per-step
+        # path under a flag that claims the communication-avoiding schedule
+        print("--superstep requires --distributed (the serial solvers have "
+              "no halo exchange to avoid)", file=sys.stderr)
         return 1
     if args.distributed and args.backend == "oracle":
         print("--distributed runs the SPMD jit solver; it has no oracle "
@@ -80,7 +89,8 @@ def main(argv=None) -> int:
             return Solver3DDistributed(nx, ny, nz, nt, eps, nlog=args.nlog,
                                        k=k, dt=dt, dh=dh, method=args.method,
                                        checkpoint_path=args.checkpoint,
-                                       ncheckpoint=args.ncheckpoint)
+                                       ncheckpoint=args.ncheckpoint,
+                                       superstep=args.superstep)
         return Solver3D(nx, ny, nz, nt, eps, nlog=args.nlog, k=k, dt=dt,
                         dh=dh, backend=args.backend, method=args.method,
                         checkpoint_path=args.checkpoint,

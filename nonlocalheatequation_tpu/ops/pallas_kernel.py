@@ -994,6 +994,13 @@ def _build_superstep_kernel(eps: int, nx: int, ny: int, dtype_name: str,
     return step
 
 
+def superstep_k(ksteps: int, nsteps: int) -> int:
+    """The effective fused-step depth make_superstep_multi_step_fn runs —
+    the single source of truth for row labels (bench.py) and the maker's
+    own clamp (K can never exceed the step count)."""
+    return max(1, min(int(ksteps), nsteps if nsteps else 1))
+
+
 def make_superstep_multi_step_fn(op, nsteps: int, ksteps: int = 2,
                                  dtype=None):
     """(u, t0) -> u after ``nsteps`` steps, ``ksteps`` fused per pallas_call.
@@ -1012,7 +1019,7 @@ def make_superstep_multi_step_fn(op, nsteps: int, ksteps: int = 2,
         del t0
         dt_ = dtype or u.dtype
         nx, ny = u.shape
-        K = max(1, min(ksteps, nsteps if nsteps else 1))
+        K = superstep_k(ksteps, nsteps)
         itemsize = jnp.dtype(dt_).itemsize
         tm = _choose_tm(
             nx, ny, eps, itemsize, n_aux=0,

@@ -75,9 +75,14 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         dtype=None,
         checkpoint_path: str | None = None,
         ncheckpoint: int = 0,
+        superstep: int = 1,
     ):
         self.NX, self.NY, self.NZ = int(NX), int(NY), int(NZ)
         self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
+        # superstep K > 1: one K*eps-wide halo exchange per K steps (the
+        # communication-avoiding schedule; see Solver2DDistributed, incl.
+        # the note that segment boundaries reset the K-grouping)
+        self.ksteps = max(1, int(superstep))
         self.op = NonlocalOp3D(eps, k, dt, dh, method=method)
         self.mesh = (
             mesh if mesh is not None
@@ -104,25 +109,78 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
             self.NX, self.NY, self.NZ
         )
 
-    def _build_step(self):
+    def _build_step(self, ksteps: int = 1):
+        """3D mirror of Solver2DDistributed._build_step: ``ksteps`` > 1 is
+        the communication-avoiding superstep (one K*eps-wide exchange, K
+        shrinking-band local levels with per-level collar re-zeroing and
+        an optimization_barrier pinning the level boundary)."""
         op, eps, mesh = self.op, self.eps, self.mesh
         mesh_shape = (mesh.shape["x"], mesh.shape["y"], mesh.shape["z"])
         names = ("x", "y", "z")
         spec = P(*names)
+        K = max(1, int(ksteps))
+        NX, NY, NZ = self.NX, self.NY, self.NZ
+        src_halo = (self.ksteps - 1) * eps  # see the 2D solver
 
-        if self.test:
-            def local_step(u_blk, g_blk, lg_blk, t):
-                upad = halo_pad_nd(u_blk, eps, mesh_shape, names)
-                du = op.apply_padded(upad) + source_at(g_blk, lg_blk, t, op.dt)
-                return u_blk + op.dt * du
+        if self.ksteps == 1:
+            if self.test:
+                def local_step(u_blk, g_blk, lg_blk, t):
+                    upad = halo_pad_nd(u_blk, eps, mesh_shape, names)
+                    du = op.apply_padded(upad) + source_at(
+                        g_blk, lg_blk, t, op.dt)
+                    return u_blk + op.dt * du
 
-            in_specs = (spec, spec, spec, P())
+                in_specs = (spec, spec, spec, P())
+            else:
+                def local_step(u_blk, t):
+                    upad = halo_pad_nd(u_blk, eps, mesh_shape, names)
+                    return u_blk + op.dt * op.apply_padded(upad)
+
+                in_specs = (spec, P())
         else:
-            def local_step(u_blk, t):
-                upad = halo_pad_nd(u_blk, eps, mesh_shape, names)
-                return u_blk + op.dt * op.apply_padded(upad)
+            def _superstep(u_blk, t, gp=None, lgp=None):
+                bx, by, bz = u_blk.shape
+                o0 = (lax.axis_index("x") * bx, lax.axis_index("y") * by,
+                      lax.axis_index("z") * bz)
+                Pk = halo_pad_nd(u_blk, K * eps, mesh_shape, names)
+                for j in range(1, K + 1):
+                    m = (K - j) * eps
+                    du = op.apply_padded(Pk)
+                    if gp is not None:
+                        o = src_halo - m
+                        ext = (bx + 2 * m, by + 2 * m, bz + 2 * m)
+                        gs = lax.slice(gp, (o, o, o),
+                                       tuple(o + e for e in ext))
+                        lgs = lax.slice(lgp, (o, o, o),
+                                        tuple(o + e for e in ext))
+                        du = du + source_at(gs, lgs, t + (j - 1), op.dt)
+                    center = lax.slice(
+                        Pk, (eps, eps, eps),
+                        tuple(eps + s for s in du.shape))
+                    nxt = center + op.dt * du
+                    if j < K:
+                        ok = None
+                        for ax, (start, N) in enumerate(
+                                zip(o0, (NX, NY, NZ))):
+                            c = (start - m) + lax.broadcasted_iota(
+                                jnp.int32, nxt.shape, ax)
+                            in_ax = (c >= 0) & (c < N)
+                            ok = in_ax if ok is None else ok & in_ax
+                        nxt = jnp.where(ok, nxt, jnp.zeros_like(nxt))
+                        nxt = lax.optimization_barrier(nxt)
+                    Pk = nxt
+                return Pk
 
-            in_specs = (spec, P())
+            if self.test:
+                def local_step(u_blk, gp_blk, lgp_blk, t):
+                    return _superstep(u_blk, t, gp_blk, lgp_blk)
+
+                in_specs = (spec, spec, spec, P())
+            else:
+                def local_step(u_blk, t):
+                    return _superstep(u_blk, t)
+
+                in_specs = (spec, P())
         vma_ok = op.method != "pallas" or jax.default_backend() == "tpu"
         return shard_map(local_step, mesh=mesh, in_specs=in_specs,
                          out_specs=spec, check_vma=vma_ok)
@@ -145,22 +203,55 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         lg = put_global(np.asarray(lg, npdt), sharding)
         return u, (g, lg)
 
+    def _prep_sources(self, g, lg):
+        """Pad the source blocks with the (ksteps-1)*eps ring once per run
+        (see Solver2DDistributed._prep_sources)."""
+        eps, mesh = self.eps, self.mesh
+        mesh_shape = (mesh.shape["x"], mesh.shape["y"], mesh.shape["z"])
+        names = ("x", "y", "z")
+        spec = P(*names)
+        src_halo = (self.ksteps - 1) * eps
+
+        def pad2(g_blk, lg_blk):
+            return (halo_pad_nd(g_blk, src_halo, mesh_shape, names),
+                    halo_pad_nd(lg_blk, src_halo, mesh_shape, names))
+
+        return jax.jit(shard_map(pad2, mesh=mesh, in_specs=(spec, spec),
+                                 out_specs=(spec, spec)))(g, lg)
+
     def do_work(self) -> np.ndarray:
-        step = self._build_step()
+        steps_by_k: dict = {}
+
+        def get_step(K):
+            if K not in steps_by_k:
+                steps_by_k[K] = self._build_step(K)
+            return steps_by_k[K]
+
         u, source_args = self._device_state()
+        if source_args and self.ksteps > 1:
+            source_args = self._prep_sources(*source_args)
 
         checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
 
         def make_runner(count):
             # source arrays enter as jit ARGUMENTS, not closure constants:
             # a constant capture would try to materialize the whole array
-            # in the trace, which a mesh spanning processes cannot do
+            # in the trace, which a mesh spanning processes cannot do.
+            # count steps = q supersteps of K + one shallower remainder.
+            K = max(1, min(self.ksteps, count))
+            q, r = divmod(count, K)
+            step_K = get_step(K)
+            step_r = get_step(r) if r else None
+
             @jax.jit
             def run(u0, t_start, srcs):
-                ts = t_start + jnp.arange(count)
-                return lax.scan(
-                    lambda c, t: (step(c, *srcs, t), None),
+                ts = t_start + K * jnp.arange(q)
+                u1 = lax.scan(
+                    lambda c, t: (step_K(c, *srcs, t), None),
                     u0, ts)[0]
+                if step_r is not None:
+                    u1 = step_r(u1, *srcs, t_start + q * K)
+                return u1
 
             return lambda u0, start: run(u0, jnp.int32(start), source_args)
 

@@ -53,9 +53,23 @@ for eps in (3, 9):
     o = Solver2D(16, 16, 3, eps=eps, k=1.0, dt=1e-4, dh=1.0 / 16,
                  backend="oracle")
     o.test_init()
-    err = float(np.abs(ud - o.do_work()).max())
+    uo = o.do_work()
+    err = float(np.abs(ud - uo).max())
     assert err < 1e-12, f"eps={eps}: deviates from serial oracle by {err:.3e}"
     print(f"MH-OK p{pid} eps={eps} err={err:.2e}", flush=True)
+    if eps == 3:
+        # communication-avoiding superstep across the PROCESS boundary: one
+        # K*eps-wide exchange per K steps over the gloo transport (the DCN
+        # analog — the latency-bound regime the schedule exists for)
+        ds = Solver2DDistributed(16, 16, 1, 1, nt=3, eps=eps, k=1.0,
+                                 dt=1e-4, dh=1.0 / 16, mesh=make_mesh(2, 2),
+                                 superstep=2)
+        ds.test_init()
+        us = ds.do_work()
+        multihost.assert_same_on_all_hosts(us, "superstep solution")
+        errs = float(np.abs(us - uo).max())
+        assert errs < 1e-12, f"superstep deviates by {errs:.3e}"
+        print(f"MH-OK p{pid} superstep err={errs:.2e}", flush=True)
 
 # 3D over a (2, 2, 1) mesh — same cross-process halo, one more axis:
 # eps=2 is the one-hop band exchange, eps=5 > shard edge 4 the multi-hop

@@ -126,6 +126,7 @@ def test_two_controller_loopback_solve():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-2000:]}"
         assert f"MH-OK p{pid} eps=3" in out
+        assert f"MH-OK p{pid} superstep" in out
         assert f"MH-OK p{pid} eps=9" in out
         assert f"MH-OK p{pid} 3d eps=2" in out
         assert f"MH-OK p{pid} 3d eps=5" in out

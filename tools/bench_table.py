@@ -126,35 +126,45 @@ def bench_methods2d(steps: int):
 
 def _time_dist_solver(s, steps: int) -> float:
     """Best seconds for `steps` scanned applications of a distributed
-    solver's SPMD step (shared by dist2d / scaling / elastic's SPMD side)."""
+    solver's SPMD step (shared by dist2d / scaling / elastic's SPMD side).
+    A solver built with superstep=K scans steps//K K-step supersteps
+    (steps must divide; configs use powers of two)."""
     from jax import lax
 
     rng = np.random.default_rng(0)
     s.input_init(rng.normal(size=(s.NX, s.NY)))
-    step = s._build_step()
+    K = getattr(s, "ksteps", 1)
+    assert steps % K == 0, (
+        f"BT_STEPS={steps} must be divisible by superstep K={K} — a "
+        "truncated scan would emit an inflated per-step throughput")
+    step = s._build_step(K)
     u, _src = s._device_state()
 
     @jax.jit
     def multi(u0):
         return lax.scan(lambda c, t: (step(c, t), None), u0,
-                        jnp.arange(steps))[0]
+                        jnp.arange(steps // K))[0]
 
     sec, _ = time_steps(multi, u, steps)
     return sec
 
 
 def bench_dist2d(steps: int):
-    """BASELINE config 3: distributed 2D with ppermute halos."""
+    """BASELINE config 3: distributed 2D with ppermute halos; plus the
+    communication-avoiding superstep variant (one K*eps-wide exchange per
+    K steps — the collective-round savings show on multi-device meshes)."""
     from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
 
     n = cfg("BT_DIST_GRID", 2048, 256)
     method = "pallas" if on_tpu() else "sat"
-    s = Solver2DDistributed(n, n, 1, 1, nt=steps, eps=8, k=1.0,
-                            dt=1e-7, dh=1.0 / n, method=method,
-                            dtype=jnp.float32)
-    sec = _time_dist_solver(s, steps)
-    emit("2d/distributed", n * n, steps, sec, grid=n, eps=8,
-         devices=len(jax.devices()), mesh=dict(s.mesh.shape))
+    for K in (1, 4):
+        s = Solver2DDistributed(n, n, 1, 1, nt=steps, eps=8, k=1.0,
+                                dt=1e-7, dh=1.0 / n, method=method,
+                                dtype=jnp.float32, superstep=K)
+        sec = _time_dist_solver(s, steps)
+        name = "2d/distributed" if K == 1 else f"2d/distributed-superstep{K}"
+        emit(name, n * n, steps, sec, grid=n, eps=8,
+             devices=len(jax.devices()), mesh=dict(s.mesh.shape))
 
 
 def bench_scaling(steps: int):

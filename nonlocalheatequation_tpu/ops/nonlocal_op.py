@@ -399,20 +399,30 @@ def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
     With ``NLHEAT_RESIDENT=1`` the production (source-free) 2D and 3D
     pallas paths upgrade to the VMEM-resident whole-run kernels when the
     grid fits (pallas_kernel.make_resident_multi_step_fn{,_3d} —
-    bit-identical, one pallas_call for all steps).  Opt-in until the
-    hardware A/B lands; the contract (signature, numerics) is unchanged
-    either way.
+    bit-identical, one pallas_call for all steps).  With
+    ``NLHEAT_SUPERSTEP=K`` (K >= 2) the production 2D pallas path runs K
+    steps fused per pallas_call (temporal blocking of the copy-floor-bound
+    kernel, pallas_kernel.make_superstep_multi_step_fn — bit-identical).
+    Both opt-in until the hardware A/B lands; the contract (signature,
+    numerics) is unchanged either way.  The per-shape resolution order is
+    resident (when enabled and the grid fits) -> superstep (when enabled
+    and the frame fits at the minimum strip) -> the per-step base path —
+    so RESIDENT=1 plus SUPERSTEP=K gives residency on small grids and
+    temporal blocking on the rest.
     """
     ndim = getattr(getattr(op, "mask", None), "ndim", 0)
-    if (g is None and nsteps > 0
+    ksup = int(os.environ.get("NLHEAT_SUPERSTEP", 0) or 0)
+    resident_on = os.environ.get("NLHEAT_RESIDENT") == "1"
+    if (g is None and nsteps > 0 and ndim in (2, 3)
             and getattr(op, "method", None) == "pallas"
-            and os.environ.get("NLHEAT_RESIDENT") == "1"
-            and ndim in (2, 3)):
+            and (resident_on or (ksup >= 2 and ndim == 2))):
         from nonlocalheatequation_tpu.ops.pallas_kernel import (
             fits_resident,
             fits_resident_3d,
+            fits_superstep,
             make_resident_multi_step_fn,
             make_resident_multi_step_fn_3d,
+            make_superstep_multi_step_fn,
         )
 
         # shape is only known at call time; dispatch per call (the inner
@@ -420,21 +430,28 @@ def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
         # dtype) so repeated calls reuse jit's compile cache
         built: dict = {}
 
-        def multi_resident(u, t0):
+        def multi_fast(u, t0):
             key = (u.shape, jnp.dtype(dtype or u.dtype).name)
             fn = built.get(key)
             if fn is None:
                 dt_ = dtype or u.dtype
-                if ndim == 2 and fits_resident(*u.shape, op.eps, dt_):
+                if (resident_on and ndim == 2
+                        and fits_resident(*u.shape, op.eps, dt_)):
                     fn = make_resident_multi_step_fn(op, nsteps, dtype)
-                elif ndim == 3 and fits_resident_3d(*u.shape, op.eps, dt_):
+                elif (resident_on and ndim == 3
+                        and fits_resident_3d(*u.shape, op.eps, dt_)):
                     fn = make_resident_multi_step_fn_3d(op, nsteps, dtype)
+                elif (ksup >= 2 and ndim == 2
+                        and fits_superstep(*u.shape, op.eps, ksup, dt_)):
+                    fn = make_superstep_multi_step_fn(op, nsteps,
+                                                      ksteps=ksup,
+                                                      dtype=dtype)
                 else:
                     fn = make_multi_step_fn_base(op, nsteps, g, lg, dtype)
                 built[key] = fn
             return fn(u, t0)
 
-        return multi_resident
+        return multi_fast
     return make_multi_step_fn_base(op, nsteps, g, lg, dtype)
 
 

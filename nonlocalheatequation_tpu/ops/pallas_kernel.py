@@ -994,6 +994,20 @@ def _build_superstep_kernel(eps: int, nx: int, ny: int, dtype_name: str,
     return step
 
 
+def fits_superstep(nx: int, ny: int, eps: int, ksteps: int,
+                   dtype=jnp.float32) -> bool:
+    """Whether the K-step temporally blocked kernel is buildable for this
+    grid — i.e. even the minimum 8-row strip fits the VMEM stack model.
+    The production dispatch (nonlocal_op.make_multi_step_fn) uses this to
+    fall back to the per-step path instead of letting an opt-in knob turn
+    a working config into a trace-time VMEM error.  A forced NLHEAT_TM
+    bypasses the model in the builder, so honor it here the same way."""
+    if forced_tm():
+        return True  # the knob bypasses the stack model by contract
+    return _fits_superstep(8, nx, ny, eps, jnp.dtype(dtype).itemsize,
+                           max(1, int(ksteps)))
+
+
 def superstep_k(ksteps: int, nsteps: int) -> int:
     """The effective fused-step depth make_superstep_multi_step_fn runs —
     the single source of truth for row labels (bench.py) and the maker's

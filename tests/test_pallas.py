@@ -226,6 +226,47 @@ def test_superstep_multi_step_bit_identical():
                                           np.abs(a - b).max())
 
 
+def test_superstep_production_dispatch(monkeypatch):
+    """NLHEAT_SUPERSTEP=K upgrades make_multi_step_fn's production 2D
+    pallas path to the temporally blocked kernel, bit-identically.  The
+    superstep is bit-identical BY CONTRACT, so equality alone cannot
+    detect a dispatch regression — spy on the maker to pin that the
+    branch actually fires, and that resident wins when both knobs are
+    set and the grid fits residency."""
+    import jax.numpy as jnp
+
+    import nonlocalheatequation_tpu.ops.pallas_kernel as pk
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp2D,
+        make_multi_step_fn,
+        make_multi_step_fn_base,
+    )
+
+    calls = []
+    real_sup = pk.make_superstep_multi_step_fn
+    real_res = pk.make_resident_multi_step_fn
+    monkeypatch.setattr(
+        pk, "make_superstep_multi_step_fn",
+        lambda *a, **kw: calls.append("superstep") or real_sup(*a, **kw))
+    monkeypatch.setattr(
+        pk, "make_resident_multi_step_fn",
+        lambda *a, **kw: calls.append("resident") or real_res(*a, **kw))
+
+    op = NonlocalOp2D(5, k=1.0, dt=1e-6, dh=1.0 / 64, method="pallas")
+    u = jnp.asarray(np.random.default_rng(2).normal(size=(64, 64)),
+                    jnp.float32)
+    ref = make_multi_step_fn_base(op, 5, dtype=jnp.float32)(u, jnp.int32(0))
+    monkeypatch.setenv("NLHEAT_SUPERSTEP", "2")
+    got = make_multi_step_fn(op, 5, dtype=jnp.float32)(u, jnp.int32(0))
+    assert calls == ["superstep"]
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+    # resident wins when both knobs are set and the grid fits residency
+    monkeypatch.setenv("NLHEAT_RESIDENT", "1")
+    both = make_multi_step_fn(op, 5, dtype=jnp.float32)(u, jnp.int32(0))
+    assert calls == ["superstep", "resident"]
+    assert np.array_equal(np.asarray(ref), np.asarray(both))
+
+
 def test_carried_multi_step_3d_bit_identical():
     """3D carried-frame multi-step kernel: bit-identical to the per-step
     pad+kernel path (same plan, same summation order)."""

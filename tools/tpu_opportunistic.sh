@@ -52,9 +52,16 @@ if [ "$GATE_BACKEND" = cpu ]; then
 fi
 touch "$STATE"
 
-# one list drives both execution order and the done check
-STEPS="resident512 carried4096 superstep2 superstep3 tm160 tm192 tm224 \
-tm256 stretch8192 sanity table-a table-b table-c profile"
+# one list drives both execution order and the done check.  The VMEM
+# stack model picks tm=32 for superstep2 at 4096^2 and rejects K=3
+# outright; the model is known-conservative (the tm sweep exists to probe
+# exactly that), so the forced-tm combos are where the big traffic wins
+# would live if Mosaic accepts them (superstep2+tm128 ~1.25 frames/step,
+# superstep3+tm96 ~0.89 vs the carried ~2.2) — a clean Mosaic allocation
+# error just strikes the step.
+STEPS="resident512 carried4096 superstep2 superstep2-tm128 \
+superstep3-tm96 tm160 tm192 tm224 tm256 stretch8192 sanity table-a \
+table-b table-c profile"
 
 log() { echo "[opp $(date -u +%H:%M:%S)] $*" | tee -a "$OUT"; }
 
@@ -69,8 +76,14 @@ run_step_cmd() {  # the queue's one name->command map
     resident512) bench_nofb BENCH_RESIDENT=1 BENCH_GRID=512 BENCH_LADDER=512 ;;
     carried4096)
       bench_nofb BENCH_CARRIED=1 BENCH_GRID="$GRID_LG" BENCH_LADDER="$GRID_LG" ;;
-    superstep2 | superstep3)
-      bench_nofb "BENCH_SUPERSTEP=${1#superstep}" BENCH_GRID="$GRID_LG" \
+    superstep2)
+      bench_nofb BENCH_SUPERSTEP=2 BENCH_GRID="$GRID_LG" \
+        BENCH_LADDER="$GRID_LG" ;;
+    superstep2-tm128)
+      bench_nofb BENCH_SUPERSTEP=2 NLHEAT_TM=128 BENCH_GRID="$GRID_LG" \
+        BENCH_LADDER="$GRID_LG" ;;
+    superstep3-tm96)
+      bench_nofb BENCH_SUPERSTEP=3 NLHEAT_TM=96 BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" ;;
     tm160 | tm192 | tm224 | tm256)
       bench_nofb "NLHEAT_TM=${1#tm}" BENCH_GRID="$GRID_LG" \
@@ -108,8 +121,11 @@ step_variant_ok() {  # <name> <run-log>: opt-in kernel actually engaged?
   case $1 in
     resident512) grep -q '"variant": "resident"' "$2" ;;
     carried4096) grep -q '"variant": "carried"' "$2" ;;
-    superstep2 | superstep3)
-      grep -q "\"variant\": \"superstep${1#superstep}\"" "$2" ;;
+    superstep2) grep -q '"variant": "superstep2"' "$2" ;;
+    superstep2-tm128)
+      grep -q '"variant": "superstep2"' "$2" && grep -q '"tm": 128' "$2" ;;
+    superstep3-tm96)
+      grep -q '"variant": "superstep3"' "$2" && grep -q '"tm": 96' "$2" ;;
     tm160 | tm192 | tm224 | tm256) grep -q "\"tm\": ${1#tm}" "$2" ;;
     *) return 0 ;;
   esac

@@ -380,6 +380,14 @@ def run_measure_child(force_method=None):
 
 def main():
     done = start_watchdog()
+    # Dispatch knobs leaked from a developer shell must not silently
+    # reroute the rungs (the variant selection here is explicit via
+    # BENCH_CARRIED / BENCH_RESIDENT / BENCH_SUPERSTEP and must stay
+    # honestly labeled); NLHEAT_TM / NLHEAT_LANE_RUNS stay — they are
+    # deliberate sweep knobs whose effect the artifact records.
+    for knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE"):
+        if os.environ.pop(knob, None) is not None:
+            log(f"scrubbed leaked {knob} from the bench environment")
     try:
         rungs = ladder()
         log(f"bench start: grid {GRID}^2 eps {EPS} steps {STEPS} "

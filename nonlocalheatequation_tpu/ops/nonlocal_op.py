@@ -408,11 +408,34 @@ def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
     resident (when enabled and the grid fits) -> superstep (when enabled
     and the frame fits at the minimum strip) -> the per-step base path —
     so RESIDENT=1 plus SUPERSTEP=K gives residency on small grids and
-    temporal blocking on the rest.
+    temporal blocking on the rest.  ``NLHEAT_AUTOTUNE=1`` supersedes the
+    manual knobs on the 2D production path: it MEASURES the fitting
+    variants once per shape and runs the winner (utils/autotune; every
+    candidate computes the identical function, so the swap cannot change
+    results).
     """
     ndim = getattr(getattr(op, "mask", None), "ndim", 0)
     ksup = int(os.environ.get("NLHEAT_SUPERSTEP", 0) or 0)
     resident_on = os.environ.get("NLHEAT_RESIDENT") == "1"
+    if (g is None and nsteps > 0 and ndim == 2
+            and getattr(op, "method", None) == "pallas"
+            and os.environ.get("NLHEAT_AUTOTUNE") == "1"):
+        # measure the fitting variants once per shape and run the winner
+        # (all candidates compute the identical function — utils/autotune)
+        from nonlocalheatequation_tpu.utils.autotune import pick_multi_step_fn
+
+        built_at: dict = {}
+
+        def multi_autotuned(u, t0):
+            key = (u.shape, jnp.dtype(dtype or u.dtype).name)
+            fn = built_at.get(key)
+            if fn is None:
+                fn, _winner = pick_multi_step_fn(
+                    op, nsteps, u.shape, dtype or u.dtype)
+                built_at[key] = fn
+            return fn(u, t0)
+
+        return multi_autotuned
     if (g is None and nsteps > 0 and ndim in (2, 3)
             and getattr(op, "method", None) == "pallas"
             and (resident_on or (ksup >= 2 and ndim == 2))):

@@ -1,0 +1,184 @@
+"""Variant autotuner for production multi-step pallas runs.
+
+The production path has four interchangeable multi-step programs — the
+per-step scan, the carried frame, K-step temporal blocking, and the
+VMEM-resident whole-run kernel — all bit-identical by contract
+(tests/test_pallas.py), with hardware-dependent crossovers: per-call
+overhead dominates small grids (residency wins), HBM copy floor
+dominates large ones (temporal blocking), and the tunnel's fixed
+dispatch latency rewards fewer calls.  ``NLHEAT_AUTOTUNE=1`` measures
+the candidates that fit once per (device kind, shape, eps, dtype) and
+runs the winner; because every candidate computes the identical
+function, the swap can never change results.
+
+The measurement cache is in-process by default; set
+``NLHEAT_AUTOTUNE_CACHE=/path/file.json`` to persist winners across
+processes (the file records the measured ms/step per candidate, so it
+doubles as a tuning record).
+
+Reference parity note: the reference has a single code path and nothing
+to tune (src/2d_nonlocal_serial.cpp:273-303 is the whole hot loop);
+this is framework-native added value in the spirit of XLA's own
+autotuning passes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# probe length: long enough to amortize per-call dispatch into the same
+# regime the real run sees (the tunnel adds ~64 ms per call,
+# docs/bench/README.md), short enough to keep tuning cheap
+PROBE_STEPS = 32
+PROBE_ITERS = 2
+
+_memory_cache: dict = {}
+
+
+def _cache_path() -> str | None:
+    return os.environ.get("NLHEAT_AUTOTUNE_CACHE") or None
+
+
+def _load_file_cache() -> dict:
+    path = _cache_path()
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _store_file_cache(cache: dict) -> None:
+    path = _cache_path()
+    if not path:
+        return
+    # merge-on-write: re-read right before replacing so concurrent
+    # processes tuning different shapes don't drop each other's entries
+    # (best-effort — a lost race re-measures one shape, nothing worse)
+    merged = {**_load_file_cache(), **cache}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(merged, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
+def candidates(op, shape, nsteps: int, dtype):
+    """[(name, maker(op, nsteps, dtype) -> multi_fn)] that fit this shape.
+
+    Only 2D production-path variants participate (the 3D families have
+    their own resident/carried makers but no superstep — see
+    docs/round3.md for why temporal blocking loses at 3D block sizes).
+    """
+    from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn_base
+    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        fits_resident,
+        fits_superstep,
+        make_carried_multi_step_fn,
+        make_resident_multi_step_fn,
+        make_superstep_multi_step_fn,
+        superstep_k,
+    )
+
+    out = [("per-step", lambda o, n, d: make_multi_step_fn_base(o, n, dtype=d))]
+    if len(shape) != 2:
+        return out
+    out.append(
+        ("carried", lambda o, n, d: make_carried_multi_step_fn(o, n, dtype=d)))
+    for k in (2, 3):
+        if superstep_k(k, nsteps) == k and fits_superstep(*shape, op.eps, k,
+                                                          dtype):
+            out.append(
+                (f"superstep{k}",
+                 lambda o, n, d, k=k: make_superstep_multi_step_fn(
+                     o, n, ksteps=k, dtype=d)))
+    if fits_resident(*shape, op.eps, dtype):
+        out.append(
+            ("resident",
+             lambda o, n, d: make_resident_multi_step_fn(o, n, dtype=d)))
+    return out
+
+
+def _measure(maker, op, shape, dtype) -> float:
+    """Best seconds/step of a PROBE_STEPS program (compile excluded)."""
+    fn = maker(op, PROBE_STEPS, dtype)
+    u = jnp.asarray(
+        np.random.default_rng(0).normal(size=shape).astype(
+            np.dtype(jnp.dtype(dtype).name)))
+    t0 = jnp.int32(0)
+    out = fn(u, t0)
+    float(jnp.sum(out))  # fence (block_until_ready lies over the tunnel)
+    best = float("inf")
+    for _ in range(PROBE_ITERS):
+        t = time.perf_counter()
+        out = fn(out, t0)
+        float(jnp.sum(out))
+        best = min(best, time.perf_counter() - t)
+    return best / PROBE_STEPS
+
+
+def pick_multi_step_fn(op, nsteps: int, shape, dtype):
+    """Measure the fitting variants (cached) and build the winner at the
+    real step count.  Returns (fn, winner_name)."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn_base
+
+    dtype = jnp.dtype(dtype)
+    if jax.default_backend() == "tpu" and dtype.itemsize == 8:
+        # NEVER measure here: the pallas candidates are f32-only on TPU
+        # (they raise), which would leave the probe timing f64 lax.scan
+        # programs on the live chip — the documented tunnel-wedge trigger
+        # (docs/bench/README.md "Wedge trigger").  f64-on-TPU runs keep
+        # the per-step path untuned.
+        return (make_multi_step_fn_base(op, nsteps, dtype=dtype),
+                "per-step (f64 on TPU: not tuned)")
+    key = "/".join([
+        jax.devices()[0].device_kind, getattr(op, "method", "?"),
+        "x".join(map(str, shape)), f"eps{op.eps}", dtype.name,
+    ])
+    cands = dict(candidates(op, shape, nsteps, dtype))
+    entry = _memory_cache.get(key)
+    if entry is None:
+        file_cache = _load_file_cache()
+        entry = file_cache.get(key)
+        if entry is None or entry.get("winner") not in cands:
+            timings = {}
+            for name, maker in cands.items():
+                try:
+                    timings[name] = _measure(maker, op, shape, dtype)
+                except Exception as e:  # noqa: BLE001 — a variant that
+                    # fails to build/compile simply doesn't compete
+                    timings[name] = None
+                    timings[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
+            valid = {n: t for n, t in timings.items()
+                     if isinstance(t, float)}
+            winner = min(valid, key=valid.get) if valid else "per-step"
+            entry = {"winner": winner, "ms_per_step": {
+                n: (t * 1e3 if isinstance(t, float) else t)
+                for n, t in timings.items()}}
+            file_cache[key] = entry
+            _store_file_cache(file_cache)
+        _memory_cache[key] = entry
+    winner = entry["winner"]
+    if winner not in cands:
+        # the cached winner doesn't fit THIS nsteps (e.g. superstep3 won
+        # on a long segment, this segment has 2 steps): the entry already
+        # holds every candidate's measured rate — run the fastest one
+        # that fits now, not the slowest
+        rates = {n: t for n, t in entry.get("ms_per_step", {}).items()
+                 if n in cands and isinstance(t, float)}
+        winner = min(rates, key=rates.get) if rates else "per-step"
+    return cands[winner](op, nsteps, dtype), winner

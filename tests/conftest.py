@@ -14,8 +14,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 # kernel experiment knobs leaked from a developer shell must not silently
 # switch the paths the suite compares (e.g. the resident-vs-scan oracles)
-for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_LANE_RUNS",
-              "NLHEAT_TM"):
+for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
+              "NLHEAT_AUTOTUNE_CACHE", "NLHEAT_LANE_RUNS", "NLHEAT_TM"):
     os.environ.pop(_knob, None)
 
 import jax

@@ -93,3 +93,28 @@ def test_cli_batch(tmp_path, capsys):
         sys.stdin = old
     assert rc == 0
     assert "Tests Passed" in capsys.readouterr().out
+
+
+def test_cli_superstep_requires_distributed(capsys):
+    from nonlocalheatequation_tpu.cli import solve3d
+
+    rc = solve3d.main(["--superstep", "2", "--nt", "2"])
+    assert rc == 1
+    assert "requires --distributed" in capsys.readouterr().err
+
+
+def test_cli_distributed_superstep_batch(capsys):
+    from nonlocalheatequation_tpu.cli import solve3d
+
+    import io
+    import sys
+
+    old = sys.stdin
+    sys.stdin = io.StringIO("1\n12 12 12 10 2 1 0.0002 0.0833333333\n")
+    try:
+        rc = solve3d.main(["--test_batch", "--distributed",
+                           "--superstep", "3"])
+    finally:
+        sys.stdin = old
+    assert rc == 0
+    assert "Tests Passed" in capsys.readouterr().out

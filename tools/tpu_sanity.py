@@ -330,10 +330,16 @@ def main() -> int:
     # rc=2, never rc=1 — the sweep contract reserves 1 for real kernel FAILs)
     mode: str | None = None
     idx = 0
+    if len(sys.argv) == 2 and sys.argv[1] == "--list":
+        # index -> label map for the --only bisect (backend never touched)
+        for i, (label, _fn) in enumerate(checks):
+            print(f"{i:3d}  {label}")
+        return 0
     if len(sys.argv) > 1:
         def usage() -> int:
-            print(f"usage: {sys.argv[0]} [--one INDEX | --only INDEX]  "
-                  f"(INDEX in 0..{len(checks) - 1})", file=sys.stderr)
+            print(f"usage: {sys.argv[0]} [--list | --one INDEX | "
+                  f"--only INDEX]  (INDEX in 0..{len(checks) - 1})",
+                  file=sys.stderr)
             return 2
         if len(sys.argv) != 3 or sys.argv[1] not in ("--one", "--only"):
             return usage()

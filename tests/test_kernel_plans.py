@@ -147,3 +147,52 @@ def test_chain_steps_counts_actual_wsum_ops(run_len):
     assert _chain_steps(run_len) == calls["lane_down"]
     if run_len == 1:
         assert calls["lane_down"] == 0  # aliases v[h]: no temporaries
+
+
+@pytest.mark.parametrize("eps,K,tm", [
+    (3, 2, 40), (3, 3, 40), (5, 2, 64), (7, 4, 56), (8, 2, 128),
+    (8, 3, 32), (12, 2, 48), (16, 2, 64), (1, 2, 16),
+])
+def test_superstep_frame_geometry_invariants(eps, K, tm):
+    """Analytic coverage bounds of the temporally blocked frame
+    (_build_superstep_kernel): every read any level can issue stays inside
+    the window/band arrays, independent of the empirical bit-identity
+    tests.  Mirrors the construction's derivation (docs in the builder)."""
+    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        _round_up,
+        _strip_plan,
+        _window_pad,
+    )
+
+    heights, parts_by_h, _pows, pad = _strip_plan(eps)
+    max_need = max(
+        (eps - h) + max(off + k for k, off, _ in parts)
+        for h, parts in parts_by_h.items()
+    )
+    D = _round_up(K * eps, 8)
+    tmw = tm + D + _round_up((K - 1) * eps, 8) + pad
+
+    # dead band covers the upward reach of the shallowest level
+    assert D >= K * eps and D % 8 == 0
+    # level 1 (row0 = D - (K-1)*eps, band tm + 2*(K-1)*eps): slices start
+    # at row0 - h >= 0 and the deepest read stays inside the window
+    row0_1 = D - (K - 1) * eps
+    bh_1 = tm + 2 * (K - 1) * eps
+    assert row0_1 - max(heights) >= 0
+    assert row0_1 + bh_1 - 1 + max_need <= tmw - 1
+    # levels j >= 2 read from the constructed band array (height
+    # bh_{j-1} + pad, row0 = eps): top margin and bottom slack both hold
+    for j in range(2, K + 1):
+        bh_prev = tm + 2 * (K - j + 1) * eps
+        bh_j = tm + 2 * (K - j) * eps
+        assert max(heights) <= eps  # slice anchors a = eps - h >= 0
+        assert eps + bh_j - 1 + max_need <= bh_prev + pad - 1
+    # the frame covers the last strip's window and all out blocks
+    for nx in (tm, 3 * tm - 8, 4 * tm):
+        G = -(-(nx + 2 * eps) // tm)
+        Rc = max(D + G * tm, (G - 1) * tm + tmw)
+        assert Rc >= (G - 1) * tm + tmw
+        assert Rc >= D + G * tm
+        assert G * tm >= nx + 2 * eps
+    # out-block offsets stay 8-aligned in the Mosaic mul-form
+    assert tm % 8 == 0 and D % 8 == 0

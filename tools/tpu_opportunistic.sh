@@ -88,7 +88,12 @@ run_step_cmd() {  # the queue's one name->command map
     tm160 | tm192 | tm224 | tm256)
       bench_nofb "NLHEAT_TM=${1#tm}" BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" ;;
-    stretch8192) bench_nofb BENCH_GRID=8192 BENCH_LADDER=8192 ;;
+    stretch8192)
+      # 4x the headline's work per rung: give the silent-phase watchdog
+      # compile headroom — a mid-compile kill is the documented wedge
+      # deepener (docs/bench/README.md)
+      bench_nofb BENCH_GRID=8192 BENCH_LADDER=8192 \
+        BENCH_RUNG_TIMEOUT_S=300 BENCH_WATCHDOG_S=600 ;;
     sanity) python tools/tpu_sanity.py ;;
     table-a) timeout -k 10 "$HARD_CAP_S" \
       env BT_STEPS=200 python tools/bench_table.py methods2d small2d ;;

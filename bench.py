@@ -122,19 +122,35 @@ def emit(value, vs_baseline, extra=None, error=None):
     return True
 
 
-def read_baseline(points_steps_per_sec):
+def _load_baseline():
     try:
-        base_path = os.path.join(
+        base_path = os.environ.get("BENCH_BASELINE_PATH") or os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json"
         )
         if os.path.exists(base_path):
             with open(base_path) as f:
-                base = json.load(f)
-            if base.get("points_steps_per_sec"):
-                return points_steps_per_sec / float(base["points_steps_per_sec"])
+                return json.load(f)
     except Exception as e:  # a bad side-channel file must not void the result
         log(f"baseline read failed ({e!r}); reporting vs_baseline=0.0")
+    return None
+
+
+def read_baseline(points_steps_per_sec, base):
+    try:  # a bad side-channel VALUE must not void the result either
+        denom = float(base.get("points_steps_per_sec") or 0.0)
+        if denom > 0:
+            return points_steps_per_sec / denom
+    except Exception as e:
+        log(f"baseline value unusable ({e!r}); reporting vs_baseline=0.0")
     return 0.0
+
+
+def baseline_basis(base):
+    """Comparison-basis label from the baseline artifact (honesty: a 1-thread
+    baseline makes vs_baseline a PER-CORE ratio — the reference's single-node
+    solver is task-parallel on all cores, 2d_nonlocal_async.cpp:434-436)."""
+    basis = base.get("basis")
+    return {"vs_baseline_basis": basis} if isinstance(basis, str) else {}
 
 
 class Best:
@@ -168,6 +184,7 @@ class Best:
             rung, meta = self.rung, dict(self.meta)
         if rung is None:
             return emit(0.0, 0.0, extra=meta, error=error or "no rung completed"), False
+        base = _load_baseline() or {}
         extra = {
             "grid": rung["grid"],
             "steps": rung["steps"],
@@ -175,12 +192,13 @@ class Best:
             "partial": rung["grid"] != GRID,
             **({"variant": rung["variant"]} if "variant" in rung else {}),
             **({"tm": rung["tm"]} if "tm" in rung else {}),
+            **baseline_basis(base),
             **meta,
         }
         if error is not None:
             extra["note"] = error  # a partial result is not an "error" result
         value = rung["value"]
-        return emit(value, read_baseline(value), extra=extra), True
+        return emit(value, read_baseline(value, base), extra=extra), True
 
 
 BEST = Best()

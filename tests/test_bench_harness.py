@@ -163,3 +163,25 @@ def test_late_heal_retry_replaces_cpu_fallback():
     assert rec.get("cpu_fallback") == "recovered-late", rec
     assert "late-probe ok" in proc.stderr
     assert proc.returncode == 0
+
+def test_malformed_baseline_value_does_not_void_the_line(tmp_path):
+    # the one-JSON-line contract must survive a JSON-valid baseline whose
+    # VALUE is unusable (string, zero) — the division lives outside the
+    # file-read try, so it needs its own guard (round-4 review finding)
+    for bad in ('{"points_steps_per_sec": "fast"}',
+                '{"points_steps_per_sec": 0}'):
+        p = tmp_path / "baseline.json"
+        p.write_text(bad)
+        proc, rec = run_bench({"BENCH_BASELINE_PATH": str(p)})
+        assert proc.returncode == 0
+        assert rec["value"] > 0
+        assert rec["vs_baseline"] == 0.0
+
+
+def test_baseline_basis_label_flows_into_the_emitted_line(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text('{"points_steps_per_sec": 1000.0, "basis": "per-core"}')
+    proc, rec = run_bench({"BENCH_BASELINE_PATH": str(p)})
+    assert proc.returncode == 0
+    assert rec["vs_baseline"] > 0
+    assert rec["vs_baseline_basis"] == "per-core"

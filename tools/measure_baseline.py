@@ -58,6 +58,8 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=3,
                     help="timed steps; the per-step cost is flat so few are needed")
     ap.add_argument("--out", default=os.path.join(REPO, "BENCH_BASELINE.json"))
+    ap.add_argument("--force", action="store_true",
+                    help="overwrite even if the existing baseline is faster")
     args = ap.parse_args()
 
     build()
@@ -100,7 +102,55 @@ def main() -> int:
         "elapsed_sec": best["elapsed_sec"],
         "host": platform.processor() or platform.machine(),
         "solver": "native/baseline_solver (OpenMP, reference-faithful math)",
+        # honesty label: the reference's single-node solver is task-parallel
+        # on all cores (/root/reference/src/2d_nonlocal_async.cpp:434-436), so
+        # a 1-thread measurement makes downstream vs_baseline a PER-CORE
+        # ratio, not a node-level one.
+        "basis": ("per-core" if best["threads"] <= 1
+                  else f"node ({best['threads']} threads)"),
     }
+    if best["threads"] <= 1:
+        record["note"] = (
+            "single-core measurement (this host exposes "
+            f"{ncpu} CPU{'s' if ncpu != 1 else ''}); divide vs_baseline by "
+            "the target node's core count for an ideal-linear-scaling "
+            "node-level comparison — the stencil is memory-bound, so linear "
+            "scaling OVERSTATES the baseline and the quotient is a lower "
+            "bound on the true node-level ratio"
+        )
+    # keep-max: a re-run on a loaded host must not silently LOWER the
+    # baseline (that would inflate every downstream vs_baseline).  Use
+    # --force to accept a slower measurement deliberately.
+    if os.path.exists(args.out) and not args.force:
+        prev = prev_rate = None
+        try:  # narrow: only the read/parse may fall through to overwrite
+            with open(args.out) as f:
+                prev = json.load(f)
+            prev_rate = float(prev.get("points_steps_per_sec", 0))
+        except Exception as e:
+            print(f"existing baseline unreadable ({e!r}); overwriting",
+                  file=sys.stderr)
+            prev = None
+        if (prev is not None and prev.get("grid") == args.grid
+                and prev.get("eps") == args.eps
+                and prev.get("threads") == best["threads"]
+                and prev_rate > record["points_steps_per_sec"]):
+            # keep the faster number but still ship the honesty labels
+            # onto an old-format artifact
+            merged = dict(prev)
+            for key in ("basis", "note"):
+                if key in record and key not in merged:
+                    merged[key] = record[key]
+            if merged != prev:
+                with open(args.out, "w") as f:
+                    json.dump(merged, f, indent=2)
+                    f.write("\n")
+            print(
+                f"keeping existing faster baseline {prev_rate:.3e} > "
+                f"{record['points_steps_per_sec']:.3e} "
+                "(re-run --force to override)", file=sys.stderr)
+            print(json.dumps(merged))
+            return 0
     with open(args.out, "w") as f:
         json.dump(record, f, indent=2)
         f.write("\n")

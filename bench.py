@@ -129,7 +129,11 @@ def _load_baseline():
         )
         if os.path.exists(base_path):
             with open(base_path) as f:
-                return json.load(f)
+                base = json.load(f)
+            if isinstance(base, dict):
+                return base
+            log(f"baseline file is not a JSON object ({type(base).__name__});"
+                " reporting vs_baseline=0.0")
     except Exception as e:  # a bad side-channel file must not void the result
         log(f"baseline read failed ({e!r}); reporting vs_baseline=0.0")
     return None

@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shard over the first N devices (1 = single device)")
     p.add_argument("--halo", default="auto",
                    choices=("auto", "export", "gather"))
+    p.add_argument("--layout", default="auto",
+                   choices=("auto", "offsets", "windowed", "ell", "edges"),
+                   help="operator layout (single-device; auto prefers the "
+                        "gather-free offsets/windowed paths on TPU)")
     p.add_argument("--vtu", default=None, metavar="FILE",
                    help="write the final field as a .vtu point cloud")
     p.add_argument("--no-header", action="store_true", dest="no_header")
@@ -108,10 +112,14 @@ def main(argv=None) -> int:
             op, mesh=Mesh(np.asarray(devs), ("p",)), halo=args.halo)
         print(f"sharded over {len(devs)} devices, halo={the_op.halo_mode} "
               f"(comm ratio {the_op.halo_comm_ratio:.3f})")
+        if args.layout != "auto":
+            print("--layout is single-device only; the sharded operator "
+                  "keeps its edge layout")
+            args.layout = "auto"
     print(f"nodes {n} (dim {pts.shape[1]}), edges {len(op.tgt)}, "
           f"eps {eps:.5g} ({eps / dh:.2f} dh), dt {op.dt:.3e}")
 
-    s = UnstructuredSolver(the_op, nt=args.nt)
+    s = UnstructuredSolver(the_op, nt=args.nt, layout=args.layout)
     if args.test:
         s.test_init()
     else:

@@ -30,6 +30,7 @@ reproduces that quirk on the grid path, where bit-parity matters).
 from __future__ import annotations
 
 import ctypes
+import os
 
 import numpy as np
 
@@ -189,6 +190,8 @@ class UnstructuredNonlocalOp:
         deg = np.bincount(tgt, minlength=n) if len(tgt) else np.zeros(n, np.int64)
         self.kmax = int(deg.max()) if len(tgt) else 0
         self._ell_arrays = None  # built lazily; see _ell()
+        self._windowed_plan = None  # built lazily; see windowed_plan()
+        self._offset_plan = None  # built lazily; see offset_plan()
 
     # ELL (padded-row) layout of the same edges: neighbor column ids and
     # weights as dense (n, kmax) with zero-weight padding.  A regular
@@ -219,6 +222,88 @@ class UnstructuredNonlocalOp:
                 and self.n * self.kmax
                 <= self._ELL_MAX_PAD_RATIO * len(self.tgt))
 
+    # Windowed block-dense layout (ops/windowed.py): the gather-free Pallas
+    # path.  Worthwhile when the cloud is large enough that gathers dominate
+    # (the plan build is an O(E log E) host one-time cost), the Morton
+    # windows actually capture the edges, and the dense strips fit a budget.
+    _WINDOWED_MIN_N = 65536
+    _WINDOWED_MIN_COVERAGE = 0.90
+
+    def windowed_plan(self, **kwargs):
+        """Build and return the windowed layout plan (cached per kwargs:
+        asking with different parameters rebuilds rather than silently
+        returning a plan built under other constraints)."""
+        key = tuple(sorted(kwargs.items()))
+        if self._windowed_plan is None or self._windowed_plan[0] != key:
+            from .windowed import build_plan
+
+            self._windowed_plan = (key, build_plan(
+                self.points, self.eps, self.tgt, self.src, self.edge_w,
+                self.c, self.wsum, **kwargs,
+            ))
+        return self._windowed_plan[1]
+
+    def _windowed_budget_bytes(self) -> int:
+        return int(os.environ.get("NLHEAT_WINDOWED_BUDGET_MB", "2048")) << 20
+
+    def _windowed_worthwhile(self) -> bool:
+        forced = os.environ.get("NLHEAT_WINDOWED")
+        if forced is not None:
+            return forced not in ("", "0")
+        if self.n < self._WINDOWED_MIN_N or len(self.tgt) == 0:
+            return False
+        if jax.default_backend() != "tpu":
+            # gathers are cheap on CPU; the strips only pay off where the
+            # gather path is the bottleneck
+            return False
+        plan = self.windowed_plan()
+        return (plan.coverage >= self._WINDOWED_MIN_COVERAGE
+                and plan.p_bytes_f32 <= self._windowed_budget_bytes())
+
+    # Offset (DIA) layout: the fastest path when src-tgt index offsets
+    # cluster (quasi-uniform clouds in their natural order — a jittered
+    # 512^2 grid keeps the whole 7.7M-edge set on 45 distinct offsets).
+    _OFFSETS_MIN_N = 4096
+    _OFFSETS_MIN_COVERAGE = 0.98
+
+    def offset_plan(self, **kwargs):
+        """Build and return the diagonal-offset layout plan (cached per
+        kwargs, same rebuild-on-mismatch rule as :meth:`windowed_plan`)."""
+        key = tuple(sorted(kwargs.items()))
+        if self._offset_plan is None or self._offset_plan[0] != key:
+            from .windowed import build_offset_plan
+
+            self._offset_plan = (key, build_offset_plan(
+                self.tgt, self.src, self.edge_w, self.c, self.wsum, self.n,
+                **kwargs,
+            ))
+        return self._offset_plan[1]
+
+    def _offsets_worthwhile(self) -> bool:
+        forced = os.environ.get("NLHEAT_OFFSETS")
+        if forced is not None:
+            return forced not in ("", "0")
+        if self.n < self._OFFSETS_MIN_N or len(self.tgt) == 0:
+            return False
+        if jax.default_backend() != "tpu":
+            return False
+        # cheap precheck: judge coverage/size from the offset histogram
+        # alone; the dense diagonals are only materialized if accepted
+        from .windowed import offset_stats
+
+        coverage, _, w_bytes = offset_stats(self.tgt, self.src, self.n)
+        return (coverage >= self._OFFSETS_MIN_COVERAGE
+                and w_bytes <= self._windowed_budget_bytes())
+
+    def choose_layout(self) -> str:
+        """The auto policy, in one place: offsets (quasi-grid clouds) >
+        windowed (Morton-sortable clouds, TPU) > ELL > edges."""
+        if self._offsets_worthwhile():
+            return "offsets"
+        if self._windowed_worthwhile():
+            return "windowed"
+        return "ell" if self._ell_worthwhile() else "edges"
+
     # -- operator -----------------------------------------------------------
     def apply_np(self, u: np.ndarray) -> np.ndarray:
         acc = np.zeros(self.n)
@@ -226,15 +311,21 @@ class UnstructuredNonlocalOp:
         return self.c * (acc - self.wsum * u)
 
     def apply(self, u: jnp.ndarray, layout: str = "auto") -> jnp.ndarray:
-        """L(u) on device.  ``layout="ell"`` uses the padded-row gather +
-        row-sum (TPU-fast for near-uniform degrees); ``layout="edges"`` the
-        segment_sum scatter-add (O(edges) memory, any degree profile);
-        ``"auto"`` (default) picks ELL when padding stays under
-        ``_ELL_MAX_PAD_RATIO``.  Same edges either way, different reduction
-        order — both hold the 1e-6 contract; the sharded path keeps the
-        edge layout."""
+        """L(u) on device.  ``layout="offsets"`` runs the diagonal (DIA)
+        layout — static shifted slices, the fast path for quasi-grid
+        clouds; ``layout="windowed"`` the gather-free block-dense Pallas
+        path (ops/windowed.py; permute in, invert out); ``layout="ell"``
+        the padded-row gather + row-sum; ``layout="edges"`` the segment_sum
+        scatter-add (O(edges) memory, any degree profile); ``"auto"``
+        (default) resolves via :meth:`choose_layout`.  Same edges every
+        way, different reduction order — all hold the 1e-6 contract; the
+        sharded path keeps the edge layout."""
         if layout == "auto":
-            layout = "ell" if self._ell_worthwhile() else "edges"
+            layout = self.choose_layout()
+        if layout == "offsets":
+            return self.offset_plan().for_dtype(u.dtype).L(u)
+        if layout == "windowed":
+            return self.windowed_plan().for_dtype(u.dtype).L(u)
         if layout == "ell":
             col, w = self._ell()
             acc = jnp.sum(jnp.asarray(w, u.dtype) * u[jnp.asarray(col)],
@@ -435,10 +526,12 @@ class UnstructuredSolver(CheckpointMixin):
     solvers: ``test_init`` + ``do_work`` + ``error_l2/#points <= 1e-6``."""
 
     def __init__(self, op: UnstructuredNonlocalOp, nt: int, backend="jit",
+                 layout: str = "auto",
                  checkpoint_path: str | None = None, ncheckpoint: int = 0):
         self.op = op
         self.nt = int(nt)
         self.backend = backend
+        self.layout = layout
         self.checkpoint_path = checkpoint_path
         self.ncheckpoint = int(ncheckpoint)
         self.t0 = 0
@@ -490,11 +583,36 @@ class UnstructuredSolver(CheckpointMixin):
         else:
             test = self.test
             dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            layout = self.layout
+            if getattr(op, "choose_layout", None) is None:
+                # sharded/wrapped operators own their layout; an explicit
+                # request cannot apply, so fall back instead of TypeError-ing
+                layout = "auto"
+            elif layout == "auto":
+                layout = op.choose_layout()
+            # windowed fast path: the whole scan runs in Morton order (one
+            # permute in, one un-permute out PER CHUNK, not per step), so
+            # chunk-boundary state — checkpoints, logging — stays in the
+            # original node order and resume is portable across layouts
+            windowed = (layout == "windowed"
+                        and getattr(op, "windowed_plan", None) is not None)
+            if windowed:
+                ex = op.windowed_plan().for_dtype(dtype)
             if test:
-                gd, lgd = jnp.asarray(g, dtype), jnp.asarray(lg, dtype)
+                if windowed:
+                    perm_np = np.asarray(ex.perm)
+                    gd = jnp.asarray(g[perm_np], dtype)
+                    lgd = jnp.asarray(lg[perm_np], dtype)
+                else:
+                    gd, lgd = jnp.asarray(g, dtype), jnp.asarray(lg, dtype)
 
             def step(u, t):
-                du = op.apply(u)
+                if windowed:
+                    du = ex.L_perm(u)
+                elif layout == "auto":
+                    du = op.apply(u)
+                else:
+                    du = op.apply(u, layout=layout)
                 if test:
                     du = du + source_at(gd, lgd, t, op.dt)
                 return u + op.dt * du, None
@@ -503,7 +621,12 @@ class UnstructuredSolver(CheckpointMixin):
                 @jax.jit
                 def run(u, t0):
                     ts = t0 + jnp.arange(count)
-                    return jax.lax.scan(step, u, ts)[0]
+                    if windowed:
+                        u = u[ex.perm]
+                    u = jax.lax.scan(step, u, ts)[0]
+                    if windowed:
+                        u = u[ex.rank]
+                    return u
 
                 return lambda u, start: run(u, jnp.int32(start))
 

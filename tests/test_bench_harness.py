@@ -169,7 +169,8 @@ def test_malformed_baseline_value_does_not_void_the_line(tmp_path):
     # VALUE is unusable (string, zero) — the division lives outside the
     # file-read try, so it needs its own guard (round-4 review finding)
     for bad in ('{"points_steps_per_sec": "fast"}',
-                '{"points_steps_per_sec": 0}'):
+                '{"points_steps_per_sec": 0}',
+                '[1, 2]'):  # valid JSON, not an object
         p = tmp_path / "baseline.json"
         p.write_text(bad)
         proc, rec = run_bench({"BENCH_BASELINE_PATH": str(p)})

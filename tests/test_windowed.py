@@ -1,0 +1,265 @@
+"""Windowed block-dense unstructured path (ops/windowed.py).
+
+Contract: identical operator to the edge-list/ELL paths (1e-12-close in
+f64 — the reduction order differs, same family contract as the grid
+kernels' method equivalence), exact under forced window overflow, and the
+solver's permuted-space scan must keep chunk-boundary state in original
+node order.  Math parity target: the same L as apply_np
+(/root/reference/description/problem_description.tex:131-158).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from nonlocalheatequation_tpu.ops.unstructured import (
+    UnstructuredNonlocalOp,
+    UnstructuredSolver,
+)
+from nonlocalheatequation_tpu.ops.windowed import build_plan, morton_perm
+
+
+def _cloud(m, d=2, seed=0, eps_fn=None):
+    rng = np.random.default_rng(seed)
+    h = 1.0 / m
+    axes = [np.arange(m) * h for _ in range(d)]
+    grids = np.meshgrid(*axes, indexing="ij")
+    pts = np.stack([g.ravel() for g in grids], axis=1)
+    pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+    eps = (3.0 * h * (1.0 + 0.2 * np.sin(7.0 * pts[:, 0]))
+           if eps_fn is None else eps_fn(pts, h))
+    return UnstructuredNonlocalOp(pts, eps, k=1.0, dt=1e-6, vol=h ** d)
+
+
+def _plan_of(op, **kw):
+    return build_plan(op.points, op.eps, op.tgt, op.src, op.edge_w,
+                      op.c, op.wsum, **kw)
+
+
+def test_windowed_matches_oracle_2d():
+    op = _cloud(48)
+    u = np.random.default_rng(1).normal(size=op.n)
+    want = op.apply_np(u)
+    got = np.asarray(op.apply(jnp.asarray(u), layout="windowed"))
+    assert np.max(np.abs(got - want)) < 1e-12 * max(1.0, np.abs(want).max())
+
+
+def test_windowed_matches_oracle_3d():
+    op = _cloud(12, d=3)
+    u = np.random.default_rng(2).normal(size=op.n)
+    want = op.apply_np(u)
+    got = np.asarray(op.apply(jnp.asarray(u), layout="windowed"))
+    assert np.max(np.abs(got - want)) < 1e-12 * max(1.0, np.abs(want).max())
+
+
+def test_forced_overflow_stays_exact():
+    # a tiny wmax forces most edges out of the windows; the residual
+    # segment_sum path must keep the operator exact anyway
+    op = _cloud(32)
+    plan = _plan_of(op, wmax=128)
+    assert plan.W == 128
+    assert plan.ov_tgt.size > 0
+    u = np.random.default_rng(3).normal(size=op.n)
+    got = np.asarray(plan.for_dtype(jnp.float64).L(jnp.asarray(u)))
+    want = op.apply_np(u)
+    assert np.max(np.abs(got - want)) < 1e-12 * max(1.0, np.abs(want).max())
+
+
+def test_plan_accounts_for_every_edge():
+    op = _cloud(32)
+    plan = _plan_of(op)
+    in_window = int((np.asarray(plan.P) != 0).sum())
+    # zero-weight edges can hide in P (none here: J==1, vol>0), so nnz(P)
+    # plus the residual list must cover the whole edge set exactly
+    assert in_window + plan.ov_tgt.size == len(op.tgt)
+    assert 0.0 <= plan.coverage <= 1.0
+    assert plan.coverage == pytest.approx(in_window / len(op.tgt))
+
+
+def test_keep_order_on_premorton_points_is_tight():
+    # points already fed in Morton order should yield the same W whether
+    # the plan re-sorts or trusts the caller
+    op = _cloud(32)
+    perm = morton_perm(op.points, float(op.eps.max()))
+    op2 = UnstructuredNonlocalOp(op.points[perm], op.eps[perm], k=1.0,
+                                 dt=1e-6, vol=1.0 / 32 ** 2)
+    plan_keep = _plan_of(op2, order="keep")
+    plan_morton = _plan_of(op2)
+    assert plan_keep.W == plan_morton.W
+
+
+def test_n_not_multiple_of_block():
+    rng = np.random.default_rng(4)
+    pts = rng.uniform(size=(1000, 2))  # not a multiple of 128
+    op = UnstructuredNonlocalOp(pts, 0.08, k=1.0, dt=1e-6, vol=1e-3)
+    u = rng.normal(size=op.n)
+    got = np.asarray(op.apply(jnp.asarray(u), layout="windowed"))
+    want = op.apply_np(u)
+    assert np.max(np.abs(got - want)) < 1e-12 * max(1.0, np.abs(want).max())
+
+
+def test_degenerate_self_only_horizon():
+    # horizon smaller than any inter-point distance: only self edges,
+    # m2 == 0 -> c == 0 -> L == 0 identically
+    pts = np.stack([np.linspace(0, 1, 40), np.zeros(40)], axis=1)
+    op = UnstructuredNonlocalOp(pts, 1e-6, k=1.0, dt=1e-6)
+    u = np.random.default_rng(5).normal(size=op.n)
+    got = np.asarray(op.apply(jnp.asarray(u), layout="windowed"))
+    assert np.max(np.abs(got)) == 0.0
+
+
+def test_solver_windowed_holds_manufactured_contract():
+    op = _cloud(24)
+    s = UnstructuredSolver(op, nt=25, backend="jit", layout="windowed")
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / op.n <= 1e-6
+
+
+def test_solver_windowed_matches_edges_trajectory():
+    op = _cloud(24)
+    runs = {}
+    for layout in ("edges", "windowed"):
+        s = UnstructuredSolver(op, nt=20, backend="jit", layout=layout)
+        s.test_init()
+        runs[layout] = np.asarray(s.do_work())
+    scale = max(1.0, np.abs(runs["edges"]).max())
+    assert np.max(np.abs(runs["windowed"] - runs["edges"])) < 1e-11 * scale
+
+
+def test_solver_windowed_checkpoint_state_is_original_order(tmp_path):
+    from nonlocalheatequation_tpu.utils.checkpoint import load_state
+
+    op = _cloud(24)
+    path = str(tmp_path / "ck.npz")
+    s = UnstructuredSolver(op, nt=20, backend="jit", layout="windowed",
+                           checkpoint_path=path, ncheckpoint=10)
+    s.test_init()
+    u_final = np.asarray(s.do_work())
+    state, t_next, _ = load_state(path)
+    # the checkpoint at t=20 must equal the final state in ORIGINAL order
+    assert t_next == 20
+    assert np.max(np.abs(np.asarray(state) - u_final)) == 0.0
+
+    # and a resumed run from the mid checkpoint must land on the same
+    # trajectory as an uninterrupted edges-layout run
+    ref = UnstructuredSolver(op, nt=20, backend="jit", layout="edges")
+    ref.test_init()
+    u_ref = np.asarray(ref.do_work())
+    assert np.max(np.abs(u_final - u_ref)) < 1e-11 * max(1.0, np.abs(u_ref).max())
+
+
+# ---------------------------------------------------------------------------
+# Offset (DIA) layout
+# ---------------------------------------------------------------------------
+
+
+def _offset_plan_of(op, **kw):
+    from nonlocalheatequation_tpu.ops.windowed import build_offset_plan
+
+    return build_offset_plan(op.tgt, op.src, op.edge_w, op.c, op.wsum,
+                             op.n, **kw)
+
+
+def test_offsets_matches_oracle_on_jittered_grid():
+    op = _cloud(48)
+    plan = _offset_plan_of(op)
+    # a jittered grid in natural order must land entirely on raster offsets
+    assert plan.coverage == 1.0
+    assert plan.ov_tgt.size == 0
+    u = np.random.default_rng(6).normal(size=op.n)
+    got = np.asarray(op.apply(jnp.asarray(u), layout="offsets"))
+    want = op.apply_np(u)
+    assert np.max(np.abs(got - want)) < 1e-12 * max(1.0, np.abs(want).max())
+
+
+def test_offsets_residual_path_stays_exact():
+    op = _cloud(32)
+    plan = _offset_plan_of(op, max_offsets=8)  # force most edges residual
+    assert plan.ov_tgt.size > 0
+    u = np.random.default_rng(7).normal(size=op.n)
+    got = np.asarray(plan.for_dtype(jnp.float64).L(jnp.asarray(u)))
+    want = op.apply_np(u)
+    assert np.max(np.abs(got - want)) < 1e-12 * max(1.0, np.abs(want).max())
+
+
+def test_offsets_on_irregular_cloud_is_exact_but_uncovered():
+    rng = np.random.default_rng(8)
+    pts = rng.uniform(size=(800, 2))  # no grid structure at all
+    op = UnstructuredNonlocalOp(pts, 0.09, k=1.0, dt=1e-6, vol=1.25e-3)
+    plan = _offset_plan_of(op, max_offsets=64)
+    assert plan.coverage < 0.9  # detection honestly reports the mismatch
+    u = rng.normal(size=op.n)
+    got = np.asarray(plan.for_dtype(jnp.float64).L(jnp.asarray(u)))
+    want = op.apply_np(u)
+    assert np.max(np.abs(got - want)) < 1e-12 * max(1.0, np.abs(want).max())
+
+
+def test_offsets_accounts_for_every_edge():
+    op = _cloud(32)
+    plan = _offset_plan_of(op)
+    in_diag = int((np.asarray(plan.W) != 0).sum())
+    assert in_diag + plan.ov_tgt.size == len(op.tgt)
+
+
+def test_solver_offsets_holds_manufactured_contract():
+    op = _cloud(24)
+    s = UnstructuredSolver(op, nt=25, backend="jit", layout="offsets")
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / op.n <= 1e-6
+
+
+def test_solver_offsets_matches_edges_trajectory():
+    op = _cloud(24)
+    runs = {}
+    for layout in ("edges", "offsets"):
+        s = UnstructuredSolver(op, nt=20, backend="jit", layout=layout)
+        s.test_init()
+        runs[layout] = np.asarray(s.do_work())
+    scale = max(1.0, np.abs(runs["edges"]).max())
+    assert np.max(np.abs(runs["offsets"] - runs["edges"])) < 1e-11 * scale
+
+
+def test_choose_layout_policy(monkeypatch):
+    op = _cloud(24)
+    # off-TPU: the device-side fast paths must not engage implicitly
+    assert op.choose_layout() in ("ell", "edges")
+    monkeypatch.setenv("NLHEAT_OFFSETS", "1")
+    assert op.choose_layout() == "offsets"
+    monkeypatch.setenv("NLHEAT_OFFSETS", "0")
+    monkeypatch.setenv("NLHEAT_WINDOWED", "1")
+    assert op.choose_layout() == "windowed"
+
+
+def test_offset_stats_matches_plan_without_materializing():
+    from nonlocalheatequation_tpu.ops.windowed import offset_stats
+
+    op = _cloud(32)
+    cov, keep_n, w_bytes = offset_stats(op.tgt, op.src, op.n)
+    plan = _offset_plan_of(op)
+    assert cov == pytest.approx(plan.coverage)
+    assert keep_n == len(plan.offs)
+    assert w_bytes == plan.w_bytes_f32
+
+
+def test_plan_cache_rebuilds_on_different_kwargs():
+    op = _cloud(32)
+    full = op.offset_plan()
+    small = op.offset_plan(max_offsets=8)
+    assert len(small.offs) == 8 < len(full.offs)
+    wfull = op.windowed_plan()
+    wsmall = op.windowed_plan(wmax=128)
+    assert wsmall.W == 128 <= wfull.W
+
+
+def test_solver_explicit_layout_on_sharded_op_falls_back(monkeypatch):
+    import jax
+    from nonlocalheatequation_tpu.ops.unstructured import ShardedUnstructuredOp
+
+    op = _cloud(16)
+    sh = ShardedUnstructuredOp(op, devices=jax.devices("cpu")[:2])
+    s = UnstructuredSolver(sh, nt=5, backend="jit", layout="ell")
+    s.test_init()
+    s.do_work()  # must not TypeError; layout silently ignored for sharded
+    assert s.error_l2 / op.n <= 1e-6

@@ -239,7 +239,16 @@ def bench_unstructured(steps: int):
 
     from jax import lax
 
-    for layout in ("ell", "edges"):
+    for layout in ("offsets", "ell", "edges"):
+        extra = {}
+        if layout == "offsets":
+            t0 = time.perf_counter()
+            plan = op.offset_plan()
+            log(f"    offset plan: {time.perf_counter() - t0:.2f}s "
+                f"|O|={len(plan.offs)} coverage={plan.coverage:.4f}")
+            extra = dict(noffsets=len(plan.offs),
+                         coverage=round(plan.coverage, 4))
+
         @jax.jit
         def multi(u, _layout=layout):
             return lax.scan(
@@ -248,7 +257,33 @@ def bench_unstructured(steps: int):
 
         sec, _ = time_steps(multi, u0, steps)
         emit(f"unstructured/{layout}", op.n, steps, sec, nodes=op.n,
-             edges=len(op.tgt), kmax=op.kmax)
+             edges=len(op.tgt), kmax=op.kmax, **extra)
+
+    # the general-cloud fallback: destroy the natural ordering (offset
+    # detection fails by design), measure the Morton-windowed Pallas path
+    shuf = rng.permutation(op.n)
+    op_shuf = UnstructuredNonlocalOp(pts[shuf], eps[shuf], k=1.0, dt=1e-7,
+                                     vol=h * h)
+    t0 = time.perf_counter()
+    wplan = op_shuf.windowed_plan()
+    log(f"    windowed plan: {time.perf_counter() - t0:.2f}s W={wplan.W} "
+        f"coverage={wplan.coverage:.4f} "
+        f"P={wplan.p_bytes_f32 / 2**20:.0f} MiB f32")
+
+    @jax.jit
+    def multi_w(u):
+        ex = op_shuf.windowed_plan().for_dtype(u.dtype)
+        return lax.scan(
+            lambda c, _: (c + op.dt * ex.L_perm(c), None),
+            u, None, length=steps)[0]
+
+    # measured in Morton space (the solver's resident form; the per-chunk
+    # permute in/out is amortized over whole chunks in production)
+    sec, _ = time_steps(multi_w, u0, steps)
+    emit("unstructured/windowed-shuffled", op.n, steps, sec, nodes=op.n,
+         edges=len(op_shuf.tgt), kmax=op_shuf.kmax, window=wplan.W,
+         coverage=round(wplan.coverage, 4),
+         p_mib=round(wplan.p_bytes_f32 / 2**20))
 
     # sharded halo forms (multi-device only): boundary-export vs full gather
     if len(jax.devices()) > 1:

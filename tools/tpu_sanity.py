@@ -190,6 +190,47 @@ def _check_resident(ndim, n, eps, steps=4):
                 np.asarray(ref(u, jnp.int32(0))), 1e-6)
 
 
+def _check_windowed_unstructured(m, wmax=None):
+    """Compiled-Mosaic validation of the windowed block-dense kernel
+    (ops/windowed.py) — interpreter CI can't see real lowering constraints
+    (scalar-prefetched index maps, the unaligned strip layout)."""
+    np, jax = _setup()
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.unstructured import UnstructuredNonlocalOp
+
+    rng = np.random.default_rng(0)
+    h = 1.0 / m
+    xs, ys = np.meshgrid(np.arange(m) * h, np.arange(m) * h, indexing="ij")
+    pts = np.stack([xs.ravel(), ys.ravel()], axis=1)
+    pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+    op = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-7, vol=h * h)
+    kw = {} if wmax is None else {"wmax": wmax}
+    plan = op.windowed_plan(**kw)
+    u = jnp.asarray(rng.normal(size=op.n), jnp.float32)
+    got = np.asarray(jax.jit(plan.for_dtype(jnp.float32).L)(u))
+    _assert_rel(got, op.apply_np(np.asarray(u, np.float64)), 1e-5)
+
+
+def _check_offsets_unstructured(m):
+    """Compiled validation of the diagonal-offset layout at f32."""
+    np, jax = _setup()
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.unstructured import UnstructuredNonlocalOp
+
+    rng = np.random.default_rng(0)
+    h = 1.0 / m
+    xs, ys = np.meshgrid(np.arange(m) * h, np.arange(m) * h, indexing="ij")
+    pts = np.stack([xs.ravel(), ys.ravel()], axis=1)
+    pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+    op = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-7, vol=h * h)
+    plan = op.offset_plan()
+    u = jnp.asarray(rng.normal(size=op.n), jnp.float32)
+    got = np.asarray(jax.jit(plan.for_dtype(jnp.float32).L)(u))
+    _assert_rel(got, op.apply_np(np.asarray(u, np.float64)), 1e-5)
+
+
 def _check_f64_guard():
     np, jax = _setup()
     import jax.numpy as jnp
@@ -266,6 +307,12 @@ def _build_checks():
     )
     checks.append(("pallas f64-on-TPU guard message", _check_f64_guard))
     checks.append(("pallas in shard_map 1-dev 64^2 eps=5", _check_shard_map))
+    checks.append(("windowed unstructured 64^2 cloud",
+                   lambda: _check_windowed_unstructured(64)))
+    checks.append(("windowed unstructured 64^2 forced-overflow wmax=128",
+                   lambda: _check_windowed_unstructured(64, wmax=128)))
+    checks.append(("offsets unstructured 64^2 cloud",
+                   lambda: _check_offsets_unstructured(64)))
     return checks
 
 

@@ -137,34 +137,133 @@ def work_realloc(busy: np.ndarray, counts: np.ndarray) -> np.ndarray:
     return out
 
 
-def _region_boundary_grabs(assignment: np.ndarray, receiver: int,
-                           donors: set[int], counts: np.ndarray):
-    """Tiles adjacent (4-neighbor, like the reference's manhattan<=1 walk)
-    to the receiver's region that belong to a donor with more than one tile."""
+_NBRS = ((1, 0), (-1, 0), (0, 1), (0, -1))
+
+
+def _region_components(assignment: np.ndarray, device: int) -> int:
+    """Number of 4-connected components of a device's tile region."""
+    npx, npy = assignment.shape
+    todo = {(int(x), int(y)) for x, y in zip(*np.nonzero(assignment == device))}
+    comps = 0
+    while todo:
+        comps += 1
+        stack = [todo.pop()]
+        while stack:
+            cx, cy = stack.pop()
+            for dx, dy in _NBRS:
+                nxt = (cx + dx, cy + dy)
+                if nxt in todo:
+                    todo.remove(nxt)
+                    stack.append(nxt)
+    return comps
+
+
+def _splits_region(assignment: np.ndarray, x: int, y: int,
+                   before: int | None = None) -> bool:
+    """Would removing tile (x, y) split its owner's region (create more
+    components than it had)?  An owner already fragmented is compared
+    against its own count, so pre-existing fragmentation is tolerated.
+    ``before`` lets callers evaluating many candidates of the SAME owner
+    pay the baseline flood-fill once."""
+    owner = assignment[x, y]
+    if before is None:
+        before = _region_components(assignment, owner)
+    assignment[x, y] = -1
+    after = _region_components(assignment, owner)
+    assignment[x, y] = owner
+    return after > before
+
+
+def _boundary_grabs(assignment: np.ndarray, receiver: int, donor: int):
+    """Donor tiles 4-adjacent to the receiver's region (the reference's
+    manhattan<=1 boundary walk, :769-779)."""
     npx, npy = assignment.shape
     recv_mask = assignment == receiver
     out = []
-    for x in range(npx):
-        for y in range(npy):
-            owner = assignment[x, y]
-            if owner == receiver or owner not in donors or counts[owner] <= 1:
-                continue
-            for dx, dy in ((1, 0), (-1, 0), (0, 1), (0, -1)):
-                jx, jy = x + dx, y + dy
-                if 0 <= jx < npx and 0 <= jy < npy and recv_mask[jx, jy]:
-                    out.append((x, y, owner))
-                    break
+    for x, y in zip(*np.nonzero(assignment == donor)):
+        for dx, dy in _NBRS:
+            jx, jy = x + dx, y + dy
+            if 0 <= jx < npx and 0 <= jy < npy and recv_mask[jx, jy]:
+                out.append((int(x), int(y)))
+                break
     return out
 
 
-def rebalance_assignment(assignment: np.ndarray, busy: np.ndarray) -> np.ndarray:
+def _region_adjacency(assignment: np.ndarray, nl: int):
+    """Region adjacency over the tile grid.  The tile grid is connected, so
+    the quotient graph over any partition is connected: a transfer path
+    exists between every pair of non-empty regions."""
+    npx, npy = assignment.shape
+    adj = [set() for _ in range(nl)]
+    for x in range(npx):
+        for y in range(npy):
+            a = assignment[x, y]
+            for dx, dy in ((1, 0), (0, 1)):
+                jx, jy = x + dx, y + dy
+                if jx < npx and jy < npy:
+                    b = assignment[jx, jy]
+                    if a != b:
+                        adj[a].add(int(b))
+                        adj[b].add(int(a))
+    return adj
+
+
+def _transfer_path(assignment: np.ndarray, receiver: int, donors: set[int],
+                   realloc: np.ndarray, nl: int):
+    """Shortest region-adjacency path from the receiver to the best
+    reachable donor (ties: most-overloaded donor, then lowest id) — the
+    graph-general cascade the reference reaches via redistribution_dfs over
+    the locality adjacency graph (:808-831).  Work flows along the path
+    through NEUTRAL regions: each intermediate gains one tile on one side
+    and gives one on the other, so only the endpoints' counts change."""
+    from collections import deque
+
+    prev = {receiver: None}
+    frontier = deque([receiver])
+    adj = _region_adjacency(assignment, nl)
+    found = []
+    depth = {receiver: 0}
+    best_depth = None
+    while frontier:
+        cur = frontier.popleft()
+        if best_depth is not None and depth[cur] >= best_depth:
+            break
+        for nxt in sorted(adj[cur]):
+            if nxt in prev:
+                continue
+            prev[nxt] = cur
+            depth[nxt] = depth[cur] + 1
+            if nxt in donors:
+                found.append(nxt)
+                best_depth = depth[nxt]
+            else:
+                frontier.append(nxt)
+    if not found:
+        return None
+    donor = min(found, key=lambda d: (realloc[d], d))
+    path = [donor]
+    while prev[path[-1]] is not None:
+        path.append(prev[path[-1]])
+    path.reverse()  # receiver ... donor
+    return path
+
+
+def rebalance_assignment(assignment: np.ndarray, busy: np.ndarray,
+                         stats: dict | None = None) -> np.ndarray:
     """One rebalance pass: new (npx, npy) tile->device assignment.
 
-    Receivers (work_realloc > 0) grow their regions by grabbing boundary
-    tiles adjacent to them, preferring tiles owned by the most-overloaded
-    donor — the effect of the reference's redistribution_dfs +
-    locality_subdomain_bfs (:706-831) without its visited-node ordering
-    quirks.  Donors are never emptied (total_subdomains > 1 guard, :751).
+    Receivers (work_realloc > 0) grow their regions with boundary-tile
+    transfers; when no donor region touches a receiver (donor islands,
+    dead-band neutrals in between), work CASCADES along the shortest
+    region-adjacency path — each hop's region grabs a boundary tile from
+    the next, so intermediates keep their counts and only the endpoint
+    donor shrinks.  This is the effect of the reference's
+    redistribution_dfs + locality_subdomain_bfs (:706-831) generalized to
+    arbitrary region shapes.  Guarantees: donors are never emptied
+    (total_subdomains > 1 guard, :751); grabs prefer tiles whose removal
+    does NOT split the donor's region (articulation check), so regions
+    that start connected stay connected unless literally every transfer
+    would split — ``stats["splits"]`` counts those forced cases.
     A device that owns zero tiles is seeded with the best boundary tile of
     the most-loaded donor first.
     """
@@ -172,6 +271,10 @@ def rebalance_assignment(assignment: np.ndarray, busy: np.ndarray) -> np.ndarray
     nl = int(max(assignment.max() + 1, len(busy)))
     counts = np.bincount(assignment.ravel(), minlength=nl)
     realloc = work_realloc(busy, counts)
+    if stats is None:
+        stats = {}
+    stats.setdefault("splits", 0)
+    stats.setdefault("chains", 0)
 
     # seed empty receivers: give each one donor tile, spread apart — the tile
     # (of the most-loaded donor) farthest from every already-placed
@@ -188,37 +291,74 @@ def rebalance_assignment(assignment: np.ndarray, busy: np.ndarray) -> np.ndarray
                 else:
                     cx, cy = xs.mean(), ys.mean()
                     dist = (xs - cx) ** 2 + (ys - cy) ** 2
-                i = int(np.argmax(dist))
+                # prefer seeds whose removal keeps the donor connected
+                order = np.argsort(-dist, kind="stable")
+                i = int(order[0])
+                for cand in order:
+                    if not _splits_region(assignment, xs[cand], ys[cand]):
+                        i = int(cand)
+                        break
+                else:
+                    stats["splits"] += 1
                 assignment[xs[i], ys[i]] = d
                 counts[donor] -= 1
                 counts[d] += 1
                 realloc[d] -= 1
                 realloc[donor] += 1
 
-    # transfer loop: receivers grab donor boundary tiles; a receiver with no
-    # reachable donor tile is set aside (NOT a global stop — another move can
-    # unblock it later)
-    blocked: set[int] = set()
+    # transfer loop: each chain moves exactly one tile of work from the
+    # endpoint donor to the neediest receiver (possibly through neutral
+    # regions), so sum(max(realloc, 0)) strictly decreases — termination
     guard = assignment.size * nl + 10
     while guard > 0:
         guard -= 1
-        receivers = [i for i in range(nl) if realloc[i] > 0 and i not in blocked]
+        receivers = sorted((i for i in range(nl) if realloc[i] > 0),
+                           key=lambda i: (-realloc[i], i))
         donors = {i for i in range(nl) if realloc[i] < 0 and counts[i] > 1}
         if not receivers or not donors:
             break
-        receiver = max(receivers, key=lambda i: realloc[i])
-        grabs = _region_boundary_grabs(assignment, receiver, donors, counts)
-        if not grabs:
-            blocked.add(receiver)
-            continue
-        # prefer the most-overloaded donor, then deterministic position
-        x, y, owner = min(grabs, key=lambda g: (realloc[g[2]], g[0], g[1]))
-        assignment[x, y] = receiver
-        counts[owner] -= 1
-        counts[receiver] += 1
-        realloc[owner] += 1
-        realloc[receiver] -= 1
-        blocked.clear()
+        progressed = False
+        for receiver in receivers:
+            path = _transfer_path(assignment, receiver, donors, realloc, nl)
+            if path is None:  # receiver owns no tiles & wasn't seeded
+                continue
+            # execute the chain DONOR-END FIRST: each hop's giver grabs its
+            # replacement from the next region before giving a tile away,
+            # so a single-tile intermediate is never emptied mid-chain and
+            # every hop's boundary (computed from the path's adjacency,
+            # which only ever GAINS tiles ahead of the current hop) is
+            # guaranteed non-empty
+            moves = []  # (x, y, previous_owner) for rollback
+            split_moves = 0
+            ok = True
+            for recv_side, donor_side in reversed(list(zip(path, path[1:]))):
+                grabs = _boundary_grabs(assignment, recv_side, donor_side)
+                if not grabs:  # unreachable per the argument above; defend
+                    ok = False
+                    break
+                before = _region_components(assignment, donor_side)
+                keep = [g for g in grabs
+                        if not _splits_region(assignment, g[0], g[1], before)]
+                forced = not keep
+                x, y = min(keep or grabs)
+                if forced:
+                    split_moves += 1
+                moves.append((x, y, int(assignment[x, y])))
+                assignment[x, y] = recv_side
+            if not ok:  # defensive rollback (see above)
+                for x, y, owner in reversed(moves):
+                    assignment[x, y] = owner
+                continue
+            stats["splits"] += split_moves
+            counts[path[0]] += 1
+            counts[path[-1]] -= 1
+            realloc[path[0]] -= 1
+            realloc[path[-1]] += 1
+            stats["chains"] += 1
+            progressed = True
+            break
+        if not progressed:
+            break
     return assignment
 
 

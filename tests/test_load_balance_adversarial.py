@@ -1,0 +1,231 @@
+"""Adversarial / property coverage for the graph-general rebalancer.
+
+The reference handles arbitrary locality adjacency with redistribution_dfs
++ locality_subdomain_bfs (/root/reference/src/2d_nonlocal_distributed.cpp:
+706-831); its acceptance criterion is max busy-rate deviation <= 1500 of
+10000 (:682-685).  These tests pin the same guarantees on
+rebalance_assignment from adversarial starts the shipped fixtures never
+exercise: donor islands behind neutral regions, checkerboards, random
+fragmentations, heterogeneous device speeds.
+
+Properties: (1) convergence to the reference criterion from arbitrary
+starts; (2) devices that own tiles never end up empty; (3) regions that
+start connected stay connected (stats reports any forced split — none may
+occur on these fixtures); (4) determinism.
+"""
+
+import numpy as np
+import pytest
+
+from nonlocalheatequation_tpu.parallel.load_balance import (
+    WorkTelemetry,
+    _region_components,
+    balance_check,
+    rebalance_assignment,
+)
+
+
+def _iterate(assignment, telemetry, max_rounds=40, stats=None):
+    """Drive rebalance rounds the way the solvers do (busy-rates from the
+    current assignment feed the next pass) until balanced or the cap."""
+    for _ in range(max_rounds):
+        busy = telemetry.busy_rates(assignment)
+        ok, _dev = balance_check(busy)
+        if ok:
+            return assignment, True
+        assignment = rebalance_assignment(assignment, busy, stats=stats)
+    return assignment, balance_check(telemetry.busy_rates(assignment))[0]
+
+
+def _components_per_device(assignment, nl):
+    return [_region_components(assignment, d)
+            for d in range(nl) if (assignment == d).any()]
+
+
+def test_checkerboard_two_devices_converges_and_defragments_nothing():
+    npx = npy = 8
+    a = np.fromfunction(lambda x, y: (x + y) % 2, (npx, npy), dtype=int)
+    tele = WorkTelemetry(2)
+    # a perfect checkerboard is already balanced for equal speeds — make it
+    # unbalanced with a slow device
+    tele = WorkTelemetry(2, speed_factors=np.array([1.0, 3.0]))
+    out, ok = _iterate(a.copy(), tele)
+    assert ok
+    counts = np.bincount(out.ravel(), minlength=2)
+    assert (counts > 0).all()
+
+
+def test_checkerboard_four_devices_converges():
+    npx = npy = 8
+    a = np.fromfunction(lambda x, y: (x % 2) * 2 + (y % 2), (npx, npy),
+                        dtype=int)
+    tele = WorkTelemetry(4, speed_factors=np.array([1.0, 2.0, 3.0, 4.0]))
+    out, ok = _iterate(a.copy(), tele)
+    assert ok
+    assert (np.bincount(out.ravel(), minlength=4) > 0).all()
+
+
+def test_donor_island_behind_neutral_ring_cascades():
+    # device 0 (donor, overloaded) sits in the center, fully enclosed by
+    # device 1 (neutral ring); device 2 (receiver) owns the outer frame and
+    # never touches the donor.  A boundary-grab-only balancer deadlocks
+    # here; the reference's DFS cascades — ours must too.
+    npx = npy = 9
+    a = np.full((npx, npy), 2, dtype=np.int64)
+    a[2:7, 2:7] = 1
+    a[3:6, 3:6] = 0
+    assert not np.any((a == 0)[:, [0, -1]]) and not np.any((a == 0)[[0, -1]])
+    # single pass with explicit rates: island overloaded (donor), ring at
+    # the mean (dead-band neutral), frame underloaded (receiver)
+    busy = np.array([10000.0, 6000.0, 2000.0])
+    assert not balance_check(busy)[0]
+    stats = {}
+    out = rebalance_assignment(a.copy(), busy, stats=stats)
+    # the island is not adjacent to the receiver: any tile it loses must
+    # have flowed through the neutral ring (2-hop chains)
+    moved_from_donor = (a == 0).sum() - (out == 0).sum()
+    assert moved_from_donor > 0
+    assert stats["chains"] >= moved_from_donor
+    # the neutral ring's count is preserved by cascading
+    assert (out == 1).sum() == (a == 1).sum()
+    # and full convergence under iteration with a genuinely slow island
+    # (equilibrium wants the 20x-cost device down to ~2 tiles)
+    out, ok = _iterate(
+        a.copy(), WorkTelemetry(3, speed_factors=np.array([20.0, 1.0, 1.0])))
+    assert ok
+
+
+def test_random_fragmented_starts_converge(seed_count=12):
+    rng = np.random.default_rng(0)
+    for trial in range(seed_count):
+        nl = int(rng.integers(2, 6))
+        npx = int(rng.integers(4, 9))
+        npy = int(rng.integers(4, 9))
+        a = rng.integers(0, nl, size=(npx, npy)).astype(np.int64)
+        speed = rng.uniform(0.5, 2.0, size=nl)
+        tele = WorkTelemetry(nl, speed_factors=speed)
+        out, ok = _iterate(a.copy(), tele)
+        assert ok, f"trial {trial}: did not converge\n{a}\n->\n{out}"
+        # no initially-populated device was emptied
+        before = np.bincount(a.ravel(), minlength=nl)
+        after = np.bincount(out.ravel(), minlength=nl)
+        assert ((after > 0) | (before == 0)).all(), f"trial {trial} emptied"
+
+
+def _grow_connected_partition(rng, npx, npy, nl):
+    """Random CONNECTED regions via multi-source BFS growth."""
+    a = np.full((npx, npy), -1, dtype=np.int64)
+    seeds = rng.permutation(npx * npy)[:nl]
+    frontiers = []
+    for d, s in enumerate(seeds):
+        x, y = divmod(int(s), npy)
+        a[x, y] = d
+        frontiers.append([(x, y)])
+    remaining = npx * npy - nl
+    while remaining:
+        d = int(rng.integers(0, nl))
+        if not frontiers[d]:
+            continue
+        x, y = frontiers[d][int(rng.integers(0, len(frontiers[d])))]
+        nbrs = [(x + dx, y + dy) for dx, dy in ((1, 0), (-1, 0), (0, 1), (0, -1))
+                if 0 <= x + dx < npx and 0 <= y + dy < npy
+                and a[x + dx, y + dy] == -1]
+        if not nbrs:
+            frontiers[d].remove((x, y))
+            continue
+        jx, jy = nbrs[int(rng.integers(0, len(nbrs)))]
+        a[jx, jy] = d
+        frontiers[d].append((jx, jy))
+        remaining -= 1
+    return a
+
+
+def test_connected_regions_stay_connected(seed_count=12):
+    rng = np.random.default_rng(1)
+    for trial in range(seed_count):
+        nl = int(rng.integers(2, 5))
+        npx = int(rng.integers(5, 10))
+        npy = int(rng.integers(5, 10))
+        a = _grow_connected_partition(rng, npx, npy, nl)
+        assert max(_components_per_device(a, nl)) == 1
+        speed = rng.uniform(0.5, 3.0, size=nl)
+        tele = WorkTelemetry(nl, speed_factors=speed)
+        cur = a.copy()
+        for _ in range(30):
+            busy = tele.busy_rates(cur)
+            if balance_check(busy)[0]:
+                break
+            stats = {}
+            cur = rebalance_assignment(cur, busy, stats=stats)
+            assert stats["splits"] == 0, f"trial {trial}: forced split"
+            comps = _components_per_device(cur, nl)
+            assert max(comps) == 1, (
+                f"trial {trial}: region fragmented\n{a}\n->\n{cur}")
+        assert balance_check(tele.busy_rates(cur))[0], f"trial {trial}"
+
+
+def test_determinism():
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, 4, size=(7, 7)).astype(np.int64)
+    busy = np.array([9000.0, 4000.0, 2500.0, 1200.0])
+    out1 = rebalance_assignment(a.copy(), busy)
+    out2 = rebalance_assignment(a.copy(), busy)
+    assert (out1 == out2).all()
+
+
+def test_single_tile_donors_never_emptied():
+    # every donor owns exactly one tile: nothing can move, but the pass
+    # must terminate cleanly and keep everyone populated
+    a = np.arange(4, dtype=np.int64).reshape(2, 2)
+    busy = np.array([10000.0, 9000.0, 500.0, 400.0])
+    out = rebalance_assignment(a.copy(), busy)
+    assert (np.bincount(out.ravel(), minlength=4) > 0).all()
+
+
+def test_reference_fixture_shapes_still_converge():
+    # the shipped 25s/2n map: 24 of 25 tiles on locality 1 (the reference's
+    # own deliberately-imbalanced manual fixture, README.md:69-72)
+    a = np.ones((5, 5), dtype=np.int64)
+    a[0, 0] = 0
+    tele = WorkTelemetry(2)
+    out, ok = _iterate(a.copy(), tele)
+    assert ok
+    counts = np.bincount(out.ravel(), minlength=2)
+    assert abs(counts[0] - counts[1]) <= 1
+
+
+@pytest.mark.parametrize("nl,n", [(2, 21), (3, 21), (5, 20), (7, 21)])
+def test_long_strip_grid(nl, n):
+    # degenerate 1xN geometry: regions are intervals; transfers must flow
+    # along the line through every intermediate.  n chosen so an integer
+    # split can actually meet the <=1500 criterion (21 tiles over 5 devices
+    # bottoms out at 1600 under the lockstep busy model — infeasible)
+    a = np.zeros((1, n), dtype=np.int64)
+    # all tiles on the last device
+    a[:] = nl - 1
+    for d in range(nl - 1):
+        a[0, d] = d
+    tele = WorkTelemetry(nl)
+    out, ok = _iterate(a.copy(), tele, max_rounds=60)
+    assert ok
+    assert (np.bincount(out.ravel(), minlength=nl) > 0).all()
+    assert max(_components_per_device(out, nl)) == 1
+
+
+def test_single_tile_neutral_intermediate_does_not_deadlock():
+    # reviewer repro: receiver | single-tile dead-band neutral | donor on a
+    # 1x5 strip.  Receiver-end-first chain execution emptied the neutral
+    # before it could grab its replacement and silently gave up; the
+    # donor-first order must move work through it
+    a = np.array([[0, 1, 2, 2, 2]], dtype=np.int64)
+    busy = np.array([1000.0, 5000.0, 9000.0])
+    stats = {}
+    out = rebalance_assignment(a.copy(), busy, stats=stats)
+    assert stats["chains"] > 0
+    assert (out != a).any()
+    # the neutral's count is preserved, the donor shrank, receiver grew
+    assert (out == 1).sum() == 1
+    assert (out == 2).sum() < (a == 2).sum()
+    assert (out == 0).sum() > 1
+    # nobody emptied
+    assert (np.bincount(out.ravel(), minlength=3) > 0).all()

@@ -70,10 +70,17 @@ int partition_rcb(int64_t n, const double* xy, int32_t nparts, int32_t* parts) {
 }
 
 // Greedy edge-cut refinement on a CSR dual graph (adj[xadj[i], xadj[i+1])
-// are i's neighbors).  Moves a boundary element to the neighboring part with
-// the most adjacent elements when that strictly reduces its cut edges and
-// keeps every part within +-1 of the ideal size.  npasses bounds the sweeps.
-// Returns the number of moves made.
+// are i's neighbors).  Two alternating phases per pass, METIS-style
+// semantics on a budget:
+//   * MOVE: relocate a boundary element to the neighboring part with the
+//     most adjacent elements when that strictly reduces its cut edges and
+//     keeps every part within +-1 of the ideal size;
+//   * SWAP: exchange two adjacent elements of different parts when the
+//     combined cut strictly drops — this is what makes refinement live at
+//     EXACT balance, where the move phase's donor guard blocks everything
+//     (RCB output is exactly balanced, so without swaps the refine pass
+//     was a no-op precisely where it runs).
+// npasses bounds the sweeps.  Returns moves + swaps made.
 int64_t refine_cut(int64_t n, const int64_t* xadj, const int64_t* adj,
                    int32_t nparts, int32_t* parts, int32_t npasses) {
   if (n <= 0 || nparts <= 0) return 0;
@@ -82,6 +89,13 @@ int64_t refine_cut(int64_t n, const int64_t* xadj, const int64_t* adj,
   const int64_t cap = n / nparts + 1;
   int64_t moves = 0;
   std::vector<int64_t> gain(nparts);
+  // cut edges incident to element i under the current assignment
+  auto local_cut = [&](int64_t i) {
+    int64_t c = 0;
+    for (int64_t e = xadj[i]; e < xadj[i + 1]; ++e)
+      c += (parts[adj[e]] != parts[i]);
+    return c;
+  };
   for (int32_t pass = 0; pass < npasses; ++pass) {
     int64_t pass_moves = 0;
     for (int64_t i = 0; i < n; ++i) {
@@ -100,6 +114,25 @@ int64_t refine_cut(int64_t n, const int64_t* xadj, const int64_t* adj,
         size[best]++;
         ++moves;
         ++pass_moves;
+      }
+    }
+    // swap phase: adjacent cross-part pairs, exchanged when the cut drops.
+    // The (i, j) edge is cut both before and after a swap of different
+    // parts, so comparing (local_cut(i) + local_cut(j)) before vs after
+    // double-counts it identically on both sides — the comparison is exact.
+    for (int64_t i = 0; i < n; ++i) {
+      for (int64_t e = xadj[i]; e < xadj[i + 1]; ++e) {
+        const int64_t j = adj[e];
+        if (j <= i || parts[i] == parts[j]) continue;
+        const int64_t before = local_cut(i) + local_cut(j);
+        std::swap(parts[i], parts[j]);
+        const int64_t after = local_cut(i) + local_cut(j);
+        if (after < before) {
+          ++moves;
+          ++pass_moves;
+        } else {
+          std::swap(parts[i], parts[j]);
+        }
       }
     }
     if (!pass_moves) break;

@@ -208,19 +208,20 @@ def _region_adjacency(assignment: np.ndarray, nl: int):
     return adj
 
 
-def _transfer_path(assignment: np.ndarray, receiver: int, donors: set[int],
-                   realloc: np.ndarray, nl: int):
+def _transfer_path(adj, receiver: int, donors: set[int],
+                   realloc: np.ndarray):
     """Shortest region-adjacency path from the receiver to the best
     reachable donor (ties: most-overloaded donor, then lowest id) — the
     graph-general cascade the reference reaches via redistribution_dfs over
     the locality adjacency graph (:808-831).  Work flows along the path
     through NEUTRAL regions: each intermediate gains one tile on one side
-    and gives one on the other, so only the endpoints' counts change."""
+    and gives one on the other, so only the endpoints' counts change.
+    ``adj`` is the current _region_adjacency (built once per outer
+    iteration — the assignment is unchanged between receiver attempts)."""
     from collections import deque
 
     prev = {receiver: None}
     frontier = deque([receiver])
-    adj = _region_adjacency(assignment, nl)
     found = []
     depth = {receiver: 0}
     best_depth = None
@@ -318,8 +319,9 @@ def rebalance_assignment(assignment: np.ndarray, busy: np.ndarray,
         if not receivers or not donors:
             break
         progressed = False
+        adj = _region_adjacency(assignment, nl)
         for receiver in receivers:
-            path = _transfer_path(assignment, receiver, donors, realloc, nl)
+            path = _transfer_path(adj, receiver, donors, realloc)
             if path is None:  # receiver owns no tiles & wasn't seeded
                 continue
             # execute the chain DONOR-END FIRST: each hop's giver grabs its

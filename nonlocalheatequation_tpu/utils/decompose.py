@@ -168,3 +168,22 @@ def decompose(mesh: str | MshData, nparts: int, coarse_x: int, coarse_y: int) ->
     npx, npy = mx // coarse_x, my // coarse_y
     assignment = partition_coarse_grid(npx, npy, nparts)
     return PartitionMap(mx // npx, my // npy, npx, npy, dh, assignment)
+
+
+def edge_cut(assignment: np.ndarray) -> int:
+    """Dual-graph edge cut of a coarse-grid partition — the quantity
+    METIS_PartMeshDual minimizes (domain_decomposition.cpp:185-187,
+    ncommon=1 -> 8-neighbor adjacency).  ``assignment`` is the (npx, npy)
+    owner grid; returns the number of adjacent tile pairs with different
+    owners (each undirected pair counted once)."""
+    a = np.asarray(assignment)
+    npx, npy = a.shape
+    cut = 0
+    for dx, dy in ((1, 0), (0, 1), (1, 1), (1, -1)):
+        xs, xt = slice(0, npx - dx), slice(dx, npx)
+        if dy >= 0:
+            ys, yt = slice(0, npy - dy), slice(dy, npy)
+        else:
+            ys, yt = slice(-dy, npy), slice(0, npy + dy)
+        cut += int((a[xs, ys] != a[xt, yt]).sum())
+    return cut

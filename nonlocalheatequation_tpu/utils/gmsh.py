@@ -115,16 +115,110 @@ def _parse_elements_22(body: list[str]) -> np.ndarray:
     return np.asarray(quads, np.int64).reshape(-1, 4)
 
 
+# nodes per GMSH element type, for skipping non-quad blocks in binary files
+_NODES_PER_TYPE = {1: 2, 2: 3, 3: 4, 4: 4, 5: 8, 6: 6, 7: 5, 8: 3, 9: 6,
+                   10: 9, 11: 10, 12: 27, 13: 18, 14: 14, 15: 1, 16: 8,
+                   17: 20, 18: 15, 19: 13}
+
+
+class _BinCursor:
+    """Sequential reader over the binary body of a 4.1 .msh file."""
+
+    def __init__(self, raw: bytes, endian: str, path: str):
+        self.raw, self.endian, self.path, self.pos = raw, endian, path, 0
+
+    def seek_section(self, name: str) -> None:
+        marker = f"${name}".encode()
+        at = self.raw.find(marker, self.pos)
+        if at < 0:
+            raise ValueError(f"{self.path}: no ${name} section")
+        nl = self.raw.index(b"\n", at)
+        self.pos = nl + 1
+
+    def take(self, dtype: str, n: int) -> np.ndarray:
+        dt = np.dtype(self.endian + dtype)
+        end = self.pos + dt.itemsize * n
+        if end > len(self.raw):
+            raise ValueError(f"{self.path}: truncated binary .msh")
+        out = np.frombuffer(self.raw[self.pos:end], dt)
+        self.pos = end
+        return out
+
+
+def _read_msh_binary_41(raw: bytes, path: str, dsize: int) -> MshData:
+    """GMSH 4.1 binary: same sections as ASCII, counts as size_t (the
+    data-size from the header — 8 on common builds, 4 on 32-bit GMSH),
+    block headers as 3 ints (+ one size_t), coordinates as doubles.
+    Endianness comes from the int 1 written right after the format line
+    (the reference reads these via the GMSH API,
+    domain_decomposition.cpp:68-80)."""
+    if dsize not in (4, 8):
+        raise ValueError(
+            f"{path}: unsupported binary .msh data-size {dsize} "
+            "(expected 4 or 8)")
+    szt = f"u{dsize}"
+    fmt_at = raw.index(b"$MeshFormat")
+    line_end = raw.index(b"\n", fmt_at + 12)
+    one = raw[line_end + 1:line_end + 5]
+    if len(one) < 4:
+        raise ValueError(f"{path}: truncated binary .msh header")
+    if int.from_bytes(one, "little") == 1:
+        endian = "<"
+    elif int.from_bytes(one, "big") == 1:
+        endian = ">"
+    else:
+        raise ValueError(f"{path}: bad endianness probe in binary .msh")
+    cur = _BinCursor(raw, endian, path)
+    cur.pos = line_end + 5
+
+    cur.seek_section("Nodes")
+    nblocks, _nnodes, _mn, _mx = cur.take(szt, 4)
+    tags, coords = [], []
+    for _ in range(int(nblocks)):
+        _dim, _etag, parametric = cur.take("i4", 3)
+        if parametric:
+            raise ValueError(f"{path}: parametric nodes not supported")
+        n = int(cur.take(szt, 1)[0])
+        tags.append(cur.take(szt, n).astype(np.int64))
+        coords.append(cur.take("f8", 3 * n).reshape(n, 3))
+
+    cur.seek_section("Elements")
+    nblocks, _nelems, _mn, _mx = cur.take(szt, 4)
+    quads = []
+    for _ in range(int(nblocks)):
+        _dim, _etag, etype = cur.take("i4", 3)
+        n = int(cur.take(szt, 1)[0])
+        if int(etype) not in _NODES_PER_TYPE:
+            raise ValueError(
+                f"{path}: unknown element type {int(etype)} in binary .msh")
+        k = _NODES_PER_TYPE[int(etype)]
+        block = cur.take(szt, n * (1 + k)).reshape(n, 1 + k)
+        if int(etype) == QUAD_TYPE:
+            quads.append(block[:, 1:5].astype(np.int64))
+    return MshData(
+        np.concatenate(tags) if tags else np.zeros(0, np.int64),
+        np.concatenate(coords) if coords else np.zeros((0, 3)),
+        np.concatenate(quads) if quads else np.zeros((0, 4), np.int64),
+    )
+
+
 def read_msh(path: str) -> MshData:
-    """Parse a GMSH ASCII .msh file (format 4.1 or 2.2)."""
-    with open(path) as f:
-        sections = _sections(f.read())
-    if "MeshFormat" not in sections:
+    """Parse a GMSH .msh file: ASCII 4.1 / 2.2, or binary 4.1."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    head = raw[:4096].decode("latin-1")
+    if "$MeshFormat" not in head:
         raise ValueError(f"{path}: not a GMSH .msh file (no $MeshFormat)")
-    version, filetype = sections["MeshFormat"][0].split()[:2]
-    if filetype != "0":
-        raise ValueError(f"{path}: binary .msh not supported (file-type {filetype})")
+    fmt_line = head.split("$MeshFormat", 1)[1].lstrip().splitlines()[0]
+    version, filetype = fmt_line.split()[:2]
     major = version.split(".")[0]
+    if filetype == "1":
+        if major != "4":
+            raise ValueError(
+                f"{path}: binary .msh only supported for format 4.x "
+                f"(got {version}); re-export as 4.1 binary or ASCII")
+        return _read_msh_binary_41(raw, path, int(fmt_line.split()[2]))
+    sections = _sections(raw.decode("latin-1"))
     if major == "4":
         tags, coords = _parse_nodes_41(sections["Nodes"])
         quads = _parse_elements_41(sections["Elements"])
@@ -137,13 +231,18 @@ def read_msh(path: str) -> MshData:
 
 
 def write_structured_msh(path: str, mx: int, my: int, dh: float,
-                         x0: float = 0.0, y0: float = 0.0) -> None:
-    """Write an mx x my structured quad mesh as GMSH 4.1 ASCII.
+                         x0: float = 0.0, y0: float = 0.0,
+                         binary: bool = False) -> None:
+    """Write an mx x my structured quad mesh as GMSH 4.1 (ASCII, or binary
+    with ``binary=True`` — the variant the GMSH API also emits, which the
+    reference accepts through its API linkage, domain_decomposition.cpp:68-70).
 
     Replaces running GMSH to mesh a rectangle: one surface entity, nodes on
     the (mx+1) x (my+1) lattice with spacing dh, row-major quads.  Readable
     by this module and by GMSH itself.
     """
+    if binary:
+        return _write_structured_msh_binary(path, mx, my, dh, x0, y0)
     nnx, nny = mx + 1, my + 1
     nnodes, nquads = nnx * nny, mx * my
     with open(path, "w") as f:
@@ -169,3 +268,41 @@ def write_structured_msh(path: str, mx: int, my: int, dh: float,
                 f.write(f"{tag} {n0} {n0 + nnx} {n0 + nnx + 1} {n0 + 1}\n")
                 tag += 1
         f.write("$EndElements\n")
+
+
+def _write_structured_msh_binary(path: str, mx: int, my: int, dh: float,
+                                 x0: float, y0: float) -> None:
+    import struct
+
+    nnx, nny = mx + 1, my + 1
+    nnodes, nquads = nnx * nny, mx * my
+    u8 = lambda *v: struct.pack(f"<{len(v)}Q", *v)  # noqa: E731
+    i4 = lambda *v: struct.pack(f"<{len(v)}i", *v)  # noqa: E731
+    with open(path, "wb") as f:
+        f.write(b"$MeshFormat\n4.1 1 8\n")
+        f.write(struct.pack("<i", 1))
+        f.write(b"\n$EndMeshFormat\n")
+        f.write(b"$Nodes\n")
+        f.write(u8(1, nnodes, 1, nnodes))          # one block
+        f.write(i4(2, 1, 0) + u8(nnodes))          # dim, etag, parametric, n
+        f.write(np.arange(1, nnodes + 1, dtype="<u8").tobytes())
+        xyz = np.zeros((nnodes, 3))
+        jj, ii = np.divmod(np.arange(nnodes), nnx)
+        xyz[:, 0] = x0 + ii * dh
+        xyz[:, 1] = y0 + jj * dh
+        f.write(xyz.astype("<f8").tobytes())
+        f.write(b"\n$EndNodes\n")
+        f.write(b"$Elements\n")
+        f.write(u8(1, nquads, 1, nquads))
+        f.write(i4(2, 1, QUAD_TYPE) + u8(nquads))
+        rows = np.empty((nquads, 5), np.uint64)
+        q = np.arange(nquads)
+        j, i = np.divmod(q, mx)
+        n0 = j * nnx + i + 1
+        rows[:, 0] = q + 1
+        rows[:, 1] = n0
+        rows[:, 2] = n0 + nnx
+        rows[:, 3] = n0 + nnx + 1
+        rows[:, 4] = n0 + 1
+        f.write(rows.astype("<u8").tobytes())
+        f.write(b"\n$EndElements\n")

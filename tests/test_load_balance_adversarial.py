@@ -45,7 +45,6 @@ def _components_per_device(assignment, nl):
 def test_checkerboard_two_devices_converges_and_defragments_nothing():
     npx = npy = 8
     a = np.fromfunction(lambda x, y: (x + y) % 2, (npx, npy), dtype=int)
-    tele = WorkTelemetry(2)
     # a perfect checkerboard is already balanced for equal speeds — make it
     # unbalanced with a slow device
     tele = WorkTelemetry(2, speed_factors=np.array([1.0, 3.0]))

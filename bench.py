@@ -407,7 +407,11 @@ def main():
     # BENCH_CARRIED / BENCH_RESIDENT / BENCH_SUPERSTEP and must stay
     # honestly labeled); NLHEAT_TM / NLHEAT_LANE_RUNS stay — they are
     # deliberate sweep knobs whose effect the artifact records.
-    for knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE"):
+    # NLHEAT_AUTOTUNE is three-valued (unset = on-TPU default ON), so the
+    # scrub must PIN it off, not just delete it — a bench rung must run
+    # exactly the variant its label claims
+    os.environ["NLHEAT_AUTOTUNE"] = "0"
+    for knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP"):
         if os.environ.pop(knob, None) is not None:
             log(f"scrubbed leaked {knob} from the bench environment")
     try:

@@ -408,18 +408,34 @@ def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
     resident (when enabled and the grid fits) -> superstep (when enabled
     and the frame fits at the minimum strip) -> the per-step base path —
     so RESIDENT=1 plus SUPERSTEP=K gives residency on small grids and
-    temporal blocking on the rest.  ``NLHEAT_AUTOTUNE=1`` supersedes the
-    manual knobs on the 2D production path: it MEASURES the fitting
-    variants once per shape and runs the winner (utils/autotune; every
-    candidate computes the identical function, so the swap cannot change
-    results).
+    temporal blocking on the rest.  The autotuner supersedes the manual
+    knobs on the 2D production path: it MEASURES the fitting variants once
+    per shape and runs the winner (utils/autotune; every candidate
+    computes the identical function, so the swap cannot change results).
+    It is the DEFAULT on TPU (VERDICT r3 #2: bank the measured copy-floor
+    headroom as the production default); ``NLHEAT_AUTOTUNE=0`` forces the
+    per-step/manual-knob path, ``NLHEAT_AUTOTUNE=1`` forces tuning on any
+    backend (CPU tuning times interpreter-mode kernels — test use only).
     """
     ndim = getattr(getattr(op, "mask", None), "ndim", 0)
     ksup = int(os.environ.get("NLHEAT_SUPERSTEP", 0) or 0)
     resident_on = os.environ.get("NLHEAT_RESIDENT") == "1"
+    tune_env = os.environ.get("NLHEAT_AUTOTUNE")
+
+    def autotune_on():
+        # evaluated only AFTER the structural gate: jax.default_backend()
+        # initializes the backend, which hangs on a wedged tunnel
+        # (__graft_entry__ discipline) — 1D/3D/test/sat builds must never
+        # pay that just to reject this branch
+        return tune_env == "1" or (
+            tune_env in (None, "")
+            and not resident_on and ksup < 2  # manual knobs pin the variant
+            and jax.default_backend() == "tpu"
+        )
+
     if (g is None and nsteps > 0 and ndim == 2
             and getattr(op, "method", None) == "pallas"
-            and os.environ.get("NLHEAT_AUTOTUNE") == "1"):
+            and autotune_on()):
         # measure the fitting variants once per shape and run the winner
         # (all candidates compute the identical function — utils/autotune)
         from nonlocalheatequation_tpu.utils.autotune import pick_multi_step_fn

@@ -43,7 +43,21 @@ _memory_cache: dict = {}
 
 
 def _cache_path() -> str | None:
-    return os.environ.get("NLHEAT_AUTOTUNE_CACHE") or None
+    """Cache file for tuning results.  Default (env unset): a per-user
+    cache file, so CLI runs don't re-pay the probe compiles every
+    invocation now that tuning is the on-TPU production default.  Set
+    NLHEAT_AUTOTUNE_CACHE to a path to relocate, or to "" to disable
+    persistence (in-process cache only)."""
+    env = os.environ.get("NLHEAT_AUTOTUNE_CACHE")
+    if env is not None:
+        return env or None
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
+    path = os.path.join(base, "nlheat", "autotune.json")
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+    except OSError:
+        return None
+    return path
 
 
 def _load_file_cache() -> dict:

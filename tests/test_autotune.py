@@ -22,7 +22,10 @@ from nonlocalheatequation_tpu.utils import autotune
 @pytest.fixture(autouse=True)
 def _fresh_cache(monkeypatch):
     monkeypatch.setattr(autotune, "_memory_cache", {})
-    monkeypatch.delenv("NLHEAT_AUTOTUNE_CACHE", raising=False)
+    # "" now DISABLES persistence (unset means the per-user default cache
+    # since autotune became the on-TPU default) — tests must neither read
+    # nor pollute the developer's real tuning record
+    monkeypatch.setenv("NLHEAT_AUTOTUNE_CACHE", "")
     # keep CPU-interpreted probes fast
     monkeypatch.setattr(autotune, "PROBE_STEPS", 2)
     monkeypatch.setattr(autotune, "PROBE_ITERS", 1)
@@ -130,3 +133,36 @@ def test_cached_winner_unfit_falls_back_to_fastest_fitting(monkeypatch):
                     jnp.float32)
     ref = make_multi_step_fn_base(op, 2, dtype=jnp.float32)(u, jnp.int32(0))
     assert np.array_equal(np.asarray(ref), np.asarray(fn(u, jnp.int32(0))))
+
+
+def test_default_policy_is_backend_gated(monkeypatch):
+    """VERDICT r3 #2: autotune is the on-TPU production default.  Unset env
+    on CPU must keep the plain base path (tests/CLI smoke unaffected);
+    NLHEAT_AUTOTUNE=0 must force it off everywhere."""
+    op = NonlocalOp2D(3, k=1.0, dt=1e-6, dh=1.0 / 48, method="pallas")
+    monkeypatch.delenv("NLHEAT_AUTOTUNE", raising=False)
+    fn = make_multi_step_fn(op, 3, dtype=jnp.float32)
+    assert fn.__name__ != "multi_autotuned"  # cpu backend: default off
+
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    fn = make_multi_step_fn(op, 3, dtype=jnp.float32)
+    assert fn.__name__ == "multi_autotuned"  # tpu: default on
+    monkeypatch.setenv("NLHEAT_AUTOTUNE", "0")
+    fn = make_multi_step_fn(op, 3, dtype=jnp.float32)
+    assert fn.__name__ != "multi_autotuned"  # pinned off (bench rungs)
+    # manual variant knobs pin their variant: the default must yield
+    monkeypatch.delenv("NLHEAT_AUTOTUNE", raising=False)
+    monkeypatch.setenv("NLHEAT_SUPERSTEP", "2")
+    fn = make_multi_step_fn(op, 3, dtype=jnp.float32)
+    assert fn.__name__ != "multi_autotuned"
+
+
+def test_default_cache_path_is_per_user(monkeypatch, tmp_path):
+    monkeypatch.delenv("NLHEAT_AUTOTUNE_CACHE", raising=False)
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    path = autotune._cache_path()
+    assert path == str(tmp_path / "nlheat" / "autotune.json")
+    monkeypatch.setenv("NLHEAT_AUTOTUNE_CACHE", "")
+    assert autotune._cache_path() is None

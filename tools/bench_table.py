@@ -123,6 +123,17 @@ def bench_methods2d(steps: int):
             sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
             emit("2d/pallas-carried", n * n, steps, sec, grid=n, eps=8)
 
+            # the production default (VERDICT r3 #2): tuner-picked variant,
+            # labeled with its winner so the row stays self-describing
+            from nonlocalheatequation_tpu.utils.autotune import (
+                pick_multi_step_fn,
+            )
+
+            fn, winner = pick_multi_step_fn(op, steps, (n, n), jnp.float32)
+            sec, _ = time_steps(lambda u, m=fn: m(u, 0), u0, steps)
+            emit("2d/autotuned", n * n, steps, sec, grid=n, eps=8,
+                 winner=winner)
+
 
 def _time_dist_solver(s, steps: int) -> float:
     """Best seconds for `steps` scanned applications of a distributed
@@ -449,6 +460,13 @@ BENCHES = {
 
 
 def main() -> int:
+    # every row must run exactly the variant its name claims — pin the
+    # production autotune default off; the explicit 2d/autotuned row
+    # measures the tuner's pick and records the winner.  The persistent
+    # cache is pinned off too: an evidence row must reflect a winner
+    # measured THIS run, not one recorded under older kernel code
+    os.environ["NLHEAT_AUTOTUNE"] = "0"
+    os.environ["NLHEAT_AUTOTUNE_CACHE"] = ""
     steps = int(os.environ.get("BT_STEPS", 20))
     names = [a for a in sys.argv[1:] if not a.startswith("-")] or list(BENCHES)
     log(f"backend={jax.default_backend()} devices={len(jax.devices())} "

@@ -377,13 +377,22 @@ class ShardedUnstructuredOp:
     order, same addends — only where the source value is read from
     differs).
 
+    ``layout="offsets"`` (picked by ``layout="auto"`` + ``halo="auto"``
+    when the cloud's src-tgt offsets fully cluster, see ops/windowed.py)
+    replaces the per-edge gather entirely: each shard keeps the (|O|, B)
+    slices of the dense diagonal weights and exchanges only
+    pad_lo/pad_hi-wide halo bands with its ring neighbors via
+    ``lax.ppermute`` — the same ICI pattern as the grid solvers' halo.
+    Reduction order then follows the diagonal sum (1e-12-close to the
+    edge forms, not bit-identical).
+
     Numerics match the single-device operator to float-addition order:
     partitioning by target preserves each target's edge order, so per-segment
     accumulation sums the same values in the same sequence.
     """
 
     def __init__(self, op: UnstructuredNonlocalOp, mesh=None, devices=None,
-                 halo: str = "auto"):
+                 halo: str = "auto", layout: str = "auto"):
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         self.inner = op
@@ -397,6 +406,45 @@ class ShardedUnstructuredOp:
         B = -(-op.n // S)  # block size (last block zero-padded)
         self.B = B
         self.pad = S * B - op.n
+
+        # offsets (DIA) layout — gather-free sharded form for quasi-grid
+        # clouds: per-shard dense diagonals + ppermute halo bands (the
+        # multichip mirror of the single-device offsets layout in
+        # ops/windowed.py).  Requires full offset coverage (any residual
+        # edge would need a cross-shard gather) and one-hop halos.
+        if layout not in ("auto", "offsets", "edges"):
+            raise ValueError(f"layout must be auto/offsets/edges, got {layout!r}")
+        if layout == "offsets" and halo != "auto":
+            raise ValueError(
+                "layout='offsets' replaces the edge halo machinery; it "
+                f"cannot honor halo={halo!r} — drop one of the two")
+        if layout == "offsets" and not len(op.tgt):
+            raise ValueError("layout='offsets' needs a non-empty edge list")
+        if layout == "auto" and halo != "auto":
+            # an explicit halo request is a request for the edge layout's
+            # halo machinery — don't silently route around it
+            layout = "edges"
+        if layout in ("auto", "offsets") and len(op.tgt):
+            if op._offset_plan is not None:  # already built: reuse, no
+                plan = op.offset_plan()      # second histogram pass
+                cov = plan.coverage
+            else:
+                from .windowed import offset_stats
+
+                cov, _keep_n, _ = offset_stats(op.tgt, op.src, op.n)
+                plan = op.offset_plan() if cov >= 1.0 else None
+            fits = (plan is not None and plan.coverage >= 1.0
+                    and plan.pad_lo <= B and plan.pad_hi <= B)
+            if layout == "offsets" and not fits:
+                raise ValueError(
+                    "layout='offsets' needs full offset coverage and "
+                    f"one-hop halos (coverage {cov:.4f}, pads "
+                    f"{getattr(plan, 'pad_lo', '?')}/"
+                    f"{getattr(plan, 'pad_hi', '?')} vs block {B})")
+            if fits:
+                self._init_offsets(plan, mesh, S, B)
+                return
+        self.layout = "edges"
 
         # partition edges by target shard; order within a shard (and within
         # each target) is preserved from the global lexsorted edge list
@@ -497,6 +545,63 @@ class ShardedUnstructuredOp:
                 in_specs=(p, p, p, p, p, p), out_specs=p,
             )
 
+    def _init_offsets(self, plan, mesh, S: int, B: int) -> None:
+        """Sharded DIA form: shard s keeps the (|O|, B) slice of every
+        diagonal's weight vector; the step exchanges only pad_lo/pad_hi
+        halo bands with ring neighbors (lax.ppermute — the same ICI
+        pattern as the grid solvers' halo, parallel/halo.py) and sums
+        static shifted slices.  Ring wrap delivers garbage bands at the
+        global boundary, which is exact anyway: no edge crosses the
+        boundary, so the corresponding weights are zero."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax import shard_map
+
+        op = self.inner
+        self.layout = "offsets"
+        self.halo_mode = "offsets-ppermute"
+        pad_lo, pad_hi = plan.pad_lo, plan.pad_hi
+        self.halo_comm_ratio = (pad_lo + pad_hi) / float(S * B)
+        offs = plan.offs
+        n_pad = S * B
+        w3 = np.zeros((len(offs), n_pad), np.float64)
+        w3[:, : op.n] = plan.W
+        w3 = w3.reshape(len(offs), S, B).transpose(1, 0, 2)  # (S, |O|, B)
+
+        def blk(x):
+            xp = np.zeros(n_pad, np.float64)
+            xp[: op.n] = x
+            return xp.reshape(S, B)
+
+        row = NamedSharding(mesh, P("p"))
+        self._w3 = jax.device_put(jnp.asarray(w3), row)
+        self._c = jax.device_put(jnp.asarray(blk(op.c)), row)
+        self._wsum = jax.device_put(jnp.asarray(blk(op.wsum)), row)
+
+        right_perm = [(i, (i + 1) % S) for i in range(S)]
+        left_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        def local_apply(u_blk, w3_, c_, wsum_):
+            mine = u_blk[0]
+            parts = []
+            if pad_lo:  # band from the LEFT neighbor: everyone sends right
+                parts.append(jax.lax.ppermute(
+                    mine[B - pad_lo:], "p", right_perm))
+            parts.append(mine)
+            if pad_hi:  # band from the RIGHT neighbor: everyone sends left
+                parts.append(jax.lax.ppermute(mine[:pad_hi], "p", left_perm))
+            up = jnp.concatenate(parts) if len(parts) > 1 else mine
+            acc = jnp.zeros_like(mine)
+            for j, o in enumerate(offs):
+                start = pad_lo + o
+                acc = acc + w3_[0, j] * jax.lax.slice(up, (start,),
+                                                      (start + B,))
+            return (c_[0] * (acc - wsum_[0] * mine))[None]
+
+        p = P("p")
+        self._sharded = shard_map(
+            local_apply, mesh=mesh, in_specs=(p, p, p, p), out_specs=p,
+        )
+
     # duck-type the single-device operator's surface
     def apply_np(self, u):
         return self.inner.apply_np(u)
@@ -512,7 +617,9 @@ class ShardedUnstructuredOp:
 
     def apply(self, u: jnp.ndarray) -> jnp.ndarray:
         up = jnp.pad(u, (0, self.pad)).reshape(self.S, self.B)
-        if self.halo_mode == "export":
+        if self.layout == "offsets":
+            out = self._sharded(up, self._w3, self._c, self._wsum)
+        elif self.halo_mode == "export":
             out = self._sharded(up, self._exp_idx, self._tgt, self._src,
                                 self._w, self._c, self._wsum)
         else:

@@ -52,12 +52,7 @@ def _cache_path() -> str | None:
     if env is not None:
         return env or None
     base = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
-    path = os.path.join(base, "nlheat", "autotune.json")
-    try:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-    except OSError:
-        return None
-    return path
+    return os.path.join(base, "nlheat", "autotune.json")
 
 
 def _load_file_cache() -> dict:
@@ -81,6 +76,7 @@ def _store_file_cache(cache: dict) -> None:
     merged = {**_load_file_cache(), **cache}
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
+        os.makedirs(os.path.dirname(tmp), exist_ok=True)
         with open(tmp, "w") as f:
             json.dump(merged, f, indent=1, sort_keys=True)
         os.replace(tmp, path)
@@ -159,7 +155,13 @@ def pick_multi_step_fn(op, nsteps: int, shape, dtype):
         # the per-step path untuned.
         return (make_multi_step_fn_base(op, nsteps, dtype=dtype),
                 "per-step (f64 on TPU: not tuned)")
+    from nonlocalheatequation_tpu import __version__
+
+    # the package version is part of the key: a kernel change can flip the
+    # crossovers, and a persistent cache must not serve winners measured
+    # under older code forever
     key = "/".join([
+        f"v{__version__}",
         jax.devices()[0].device_kind, getattr(op, "method", "?"),
         "x".join(map(str, shape)), f"eps{op.eps}", dtype.name,
     ])

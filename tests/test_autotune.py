@@ -121,7 +121,10 @@ def test_cached_winner_unfit_falls_back_to_fastest_fitting(monkeypatch):
     fake = {"per-step": 9.0, "carried": 5.0, "superstep2": 2.0,
             "superstep3": 1.0, "resident": 7.0}
     # seed the memory cache with a fake record (no measurement happens)
+    from nonlocalheatequation_tpu import __version__
+
     key = "/".join([
+        f"v{__version__}",  # cache keys carry the package version
         jax.devices()[0].device_kind, "pallas", "48x48", "eps3", "float32"])
     autotune._memory_cache[key] = {
         "winner": "superstep3",

@@ -263,3 +263,85 @@ def test_solver_explicit_layout_on_sharded_op_falls_back(monkeypatch):
     s.test_init()
     s.do_work()  # must not TypeError; layout silently ignored for sharded
     assert s.error_l2 / op.n <= 1e-6
+
+
+# ---------------------------------------------------------------------------
+# Sharded offsets layout (gather-free multichip unstructured path)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_offsets_matches_oracle_and_single_device():
+    import jax
+    from nonlocalheatequation_tpu.ops.unstructured import ShardedUnstructuredOp
+
+    op = _cloud(32)
+    sh = ShardedUnstructuredOp(op, devices=jax.devices("cpu")[:4])
+    assert sh.layout == "offsets"  # jittered grid: full coverage, auto picks
+    u = np.random.default_rng(9).normal(size=op.n)
+    got = np.asarray(sh.apply(jnp.asarray(u)))
+    want = op.apply_np(u)
+    scale = max(1.0, np.abs(want).max())
+    assert np.max(np.abs(got - want)) < 1e-12 * scale
+    single = np.asarray(op.apply(jnp.asarray(u), layout="offsets"))
+    assert np.max(np.abs(got - single)) < 1e-12 * scale
+
+
+def test_sharded_offsets_n_not_divisible_by_devices():
+    import jax
+    from nonlocalheatequation_tpu.ops.unstructured import ShardedUnstructuredOp
+
+    rng = np.random.default_rng(10)
+    h = 1.0 / 30
+    xs, ys = np.meshgrid(np.arange(30) * h, np.arange(30) * h, indexing="ij")
+    pts = np.stack([xs.ravel(), ys.ravel()], axis=1)  # 900 nodes, 8 devices
+    pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+    op = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-6, vol=h * h)
+    sh = ShardedUnstructuredOp(op, devices=jax.devices("cpu"))
+    assert sh.layout == "offsets"
+    u = rng.normal(size=op.n)
+    got = np.asarray(sh.apply(jnp.asarray(u)))
+    want = op.apply_np(u)
+    assert np.max(np.abs(got - want)) < 1e-12 * max(1.0, np.abs(want).max())
+
+
+def test_sharded_offsets_explicit_on_irregular_cloud_raises():
+    import jax
+    from nonlocalheatequation_tpu.ops.unstructured import ShardedUnstructuredOp
+
+    rng = np.random.default_rng(11)
+    pts = rng.uniform(size=(600, 2))
+    op = UnstructuredNonlocalOp(pts, 0.09, k=1.0, dt=1e-6, vol=1.7e-3)
+    with pytest.raises(ValueError, match="offsets"):
+        ShardedUnstructuredOp(op, devices=jax.devices("cpu")[:4],
+                              layout="offsets")
+    # auto falls back to the edge layout silently
+    sh = ShardedUnstructuredOp(op, devices=jax.devices("cpu")[:4])
+    assert sh.layout == "edges"
+    u = rng.normal(size=op.n)
+    got = np.asarray(sh.apply(jnp.asarray(u)))
+    want = op.apply_np(u)
+    assert np.max(np.abs(got - want)) < 1e-12 * max(1.0, np.abs(want).max())
+
+
+def test_sharded_explicit_halo_keeps_edge_layout():
+    import jax
+    from nonlocalheatequation_tpu.ops.unstructured import ShardedUnstructuredOp
+
+    op = _cloud(24)  # quasi-grid: offsets WOULD fit, but halo is explicit
+    sh = ShardedUnstructuredOp(op, devices=jax.devices("cpu")[:4],
+                               halo="export")
+    assert sh.layout == "edges"
+    assert sh.halo_mode == "export"
+
+
+def test_sharded_offsets_solver_contract():
+    import jax
+    from nonlocalheatequation_tpu.ops.unstructured import ShardedUnstructuredOp
+
+    op = _cloud(24)
+    sh = ShardedUnstructuredOp(op, devices=jax.devices("cpu")[:4])
+    assert sh.layout == "offsets"
+    s = UnstructuredSolver(sh, nt=20, backend="jit")
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / op.n <= 1e-6

@@ -18,9 +18,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "tools", "tpu_opportunistic.sh")
 
 ALL_STEPS = [
-    "resident512", "carried4096", "superstep2", "superstep2-tm128",
-    "superstep3-tm96", "tm160", "tm192", "tm224", "tm256",
-    "stretch8192", "sanity", "table-a", "table-b", "table-c", "profile",
+    "bench4096", "resident512", "carried4096", "superstep2", "sanity",
+    "superstep2-tm128", "superstep3-tm96", "tm160", "tm192", "tm224",
+    "tm256", "stretch8192", "table-a", "table-b", "table-c", "profile",
 ]
 
 

@@ -59,9 +59,9 @@ touch "$STATE"
 # would live if Mosaic accepts them (superstep2+tm128 ~1.25 frames/step,
 # superstep3+tm96 ~0.89 vs the carried ~2.2) — a clean Mosaic allocation
 # error just strikes the step.
-STEPS="resident512 carried4096 superstep2 superstep2-tm128 \
-superstep3-tm96 tm160 tm192 tm224 tm256 stretch8192 sanity table-a \
-table-b table-c profile"
+STEPS="bench4096 resident512 carried4096 superstep2 sanity \
+superstep2-tm128 superstep3-tm96 tm160 tm192 tm224 tm256 stretch8192 \
+table-a table-b table-c profile"
 
 log() { echo "[opp $(date -u +%H:%M:%S)] $*" | tee -a "$OUT"; }
 
@@ -73,6 +73,14 @@ GRID_LG=${OPP_GRID_LARGE:-4096}
 
 run_step_cmd() {  # the queue's one name->command map
   case $1 in
+    bench4096)
+      # the round's headline artifact, captured at the FIRST healthy
+      # window rather than hoping the driver's end-of-round run lands in
+      # one: the full default ladder, no fallback, artifact preserved.
+      # PIPESTATUS: the step's verdict must be bench's rc, not tee's
+      bench_nofb BENCH_GRID="$GRID_LG" \
+        | tee "docs/bench/BENCH_live_r4-$STAMP.json"
+      return "${PIPESTATUS[0]}" ;;
     resident512) bench_nofb BENCH_RESIDENT=1 BENCH_GRID=512 BENCH_LADDER=512 ;;
     carried4096)
       bench_nofb BENCH_CARRIED=1 BENCH_GRID="$GRID_LG" BENCH_LADDER="$GRID_LG" ;;

@@ -90,21 +90,36 @@ def _store_file_cache(cache: dict) -> None:
 def candidates(op, shape, nsteps: int, dtype):
     """[(name, maker(op, nsteps, dtype) -> multi_fn)] that fit this shape.
 
-    Only 2D production-path variants participate (the 3D families have
-    their own resident/carried makers but no superstep — see
-    docs/round3.md for why temporal blocking loses at 3D block sizes).
+    2D tunes per-step/carried/superstep/resident; 3D tunes
+    per-step/carried3d/resident3d (no 3D superstep — see docs/round3.md
+    for why temporal blocking loses at 3D block sizes).
     """
     from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn_base
     from nonlocalheatequation_tpu.ops.pallas_kernel import (
         fits_resident,
+        fits_resident_3d,
         fits_superstep,
         make_carried_multi_step_fn,
+        make_carried_multi_step_fn_3d,
         make_resident_multi_step_fn,
+        make_resident_multi_step_fn_3d,
         make_superstep_multi_step_fn,
         superstep_k,
     )
 
     out = [("per-step", lambda o, n, d: make_multi_step_fn_base(o, n, dtype=d))]
+    if len(shape) == 3:
+        # 3D: carried + resident only (no superstep — temporal blocking
+        # read-amplifies ~6x at the 3D kernels' tiny hardware-optimal
+        # blocks, docs/round3.md)
+        out.append(("carried3d",
+                    lambda o, n, d: make_carried_multi_step_fn_3d(
+                        o, n, dtype=d)))
+        if fits_resident_3d(*shape, op.eps, dtype):
+            out.append(("resident3d",
+                        lambda o, n, d: make_resident_multi_step_fn_3d(
+                            o, n, dtype=d)))
+        return out
     if len(shape) != 2:
         return out
     out.append(

@@ -169,3 +169,25 @@ def test_default_cache_path_is_per_user(monkeypatch, tmp_path):
     assert path == str(tmp_path / "nlheat" / "autotune.json")
     monkeypatch.setenv("NLHEAT_AUTOTUNE_CACHE", "")
     assert autotune._cache_path() is None
+
+
+def test_3d_dispatch_engages_and_is_bit_identical(monkeypatch):
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D
+
+    op = NonlocalOp3D(2, k=1.0, dt=1e-7, dh=1.0 / 16, method="pallas")
+    u = jnp.asarray(np.random.default_rng(2).normal(size=(16, 16, 16)),
+                    jnp.float32)
+    ref = make_multi_step_fn_base(op, 2, dtype=jnp.float32)(u, jnp.int32(0))
+    picked = []
+    real = autotune.pick_multi_step_fn
+    monkeypatch.setattr(
+        autotune, "pick_multi_step_fn",
+        lambda *a, **kw: (lambda r: picked.append(r[1]) or r)(real(*a, **kw)))
+    monkeypatch.setenv("NLHEAT_AUTOTUNE", "1")
+    got = make_multi_step_fn(op, 2, dtype=jnp.float32)(u, jnp.int32(0))
+    assert picked, "3D autotune dispatch did not engage"
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+    # the candidate set includes the 3D variants
+    names = [n for n, _ in autotune.candidates(op, (16, 16, 16), 2,
+                                               jnp.float32)]
+    assert "carried3d" in names

@@ -76,11 +76,22 @@ run_step_cmd() {  # the queue's one name->command map
     bench4096)
       # the round's headline artifact, captured at the FIRST healthy
       # window rather than hoping the driver's end-of-round run lands in
-      # one: the full default ladder, no fallback, artifact preserved.
-      # PIPESTATUS: the step's verdict must be bench's rc, not tee's
-      bench_nofb BENCH_GRID="$GRID_LG" \
-        | tee "docs/bench/BENCH_live_r4-$STAMP.json"
-      return "${PIPESTATUS[0]}" ;;
+      # one: the full default ladder, no fallback.  The artifact is only
+      # PROMOTED into docs/bench/ when bench exits 0 with a tpu-labeled
+      # line — a mid-window fallback or smoke run must not leave bogus
+      # "headline evidence" behind (PIPESTATUS: the verdict is bench's
+      # rc, not tee's)
+      local live rc4
+      live=$(mktemp)
+      bench_nofb BENCH_GRID="$GRID_LG" | tee "$live"
+      rc4=${PIPESTATUS[0]}
+      if [ "$rc4" -eq 0 ] && [ "$GATE_BACKEND" = tpu ] \
+          && grep -q '"backend": "tpu"' "$live" \
+          && ! grep -q '"backend": "cpu"' "$live"; then
+        cp "$live" "docs/bench/BENCH_live_r4-$STAMP.json"
+      fi
+      rm -f "$live"
+      return "$rc4" ;;
     resident512) bench_nofb BENCH_RESIDENT=1 BENCH_GRID=512 BENCH_LADDER=512 ;;
     carried4096)
       bench_nofb BENCH_CARRIED=1 BENCH_GRID="$GRID_LG" BENCH_LADDER="$GRID_LG" ;;

@@ -37,6 +37,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from nonlocalheatequation_tpu.parallel.multihost import put_global
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
 
 
@@ -502,14 +503,13 @@ class ShardedUnstructuredOp:
             return xp.reshape(S, B)
 
         row = NamedSharding(mesh, P("p"))
-        self._tgt = jax.device_put(jnp.asarray(tgt_l), row)
-        self._src = jax.device_put(
-            jnp.asarray(src_cat if halo == "export" else src_g), row)
-        self._w = jax.device_put(jnp.asarray(w), row)
-        self._c = jax.device_put(jnp.asarray(blk(op.c)), row)
-        self._wsum = jax.device_put(jnp.asarray(blk(op.wsum)), row)
+        self._tgt = put_global(tgt_l, row)
+        self._src = put_global(src_cat if halo == "export" else src_g, row)
+        self._w = put_global(w, row)
+        self._c = put_global(blk(op.c), row)
+        self._wsum = put_global(blk(op.wsum), row)
         if halo == "export":
-            self._exp_idx = jax.device_put(jnp.asarray(exp_idx), row)
+            self._exp_idx = put_global(exp_idx, row)
 
         from jax import shard_map
 
@@ -573,9 +573,9 @@ class ShardedUnstructuredOp:
             return xp.reshape(S, B)
 
         row = NamedSharding(mesh, P("p"))
-        self._w3 = jax.device_put(jnp.asarray(w3), row)
-        self._c = jax.device_put(jnp.asarray(blk(op.c)), row)
-        self._wsum = jax.device_put(jnp.asarray(blk(op.wsum)), row)
+        self._w3 = put_global(w3, row)
+        self._c = put_global(blk(op.c), row)
+        self._wsum = put_global(blk(op.wsum), row)
 
         right_perm = [(i, (i + 1) % S) for i in range(S)]
         left_perm = [(i, (i - 1) % S) for i in range(S)]

@@ -94,3 +94,35 @@ for eps3 in (2, 5):
     assert err3 < 1e-12, (
         f"3d eps={eps3}: deviates from serial oracle by {err3:.3e}")
     print(f"MH-OK p{pid} 3d eps={eps3} err={err3:.2e}", flush=True)
+
+# unstructured offsets (DIA) over the process-spanning 1D mesh: per-shard
+# diagonal weights + ppermute halo bands crossing the gloo transport — the
+# gather-free multichip unstructured path, multi-controller.  Both
+# processes build the identical op (same seed: the init contract).
+from jax.sharding import NamedSharding, PartitionSpec  # noqa: E402
+
+from nonlocalheatequation_tpu.ops.unstructured import (  # noqa: E402
+    ShardedUnstructuredOp,
+    UnstructuredNonlocalOp,
+)
+
+rng = np.random.default_rng(0)
+m = 32
+h = 1.0 / m
+gx, gy = np.meshgrid(np.arange(m) * h, np.arange(m) * h, indexing="ij")
+pts = np.stack([gx.ravel(), gy.ravel()], axis=1)
+pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+uop = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-6, vol=h * h)
+sh = ShardedUnstructuredOp(uop)  # global 1D mesh over all 4 devices
+assert sh.layout == "offsets", f"expected offsets, got {sh.layout}"
+uu = rng.normal(size=uop.n)
+ug = multihost.put_global(uu, NamedSharding(sh.mesh, PartitionSpec()))
+# eager apply: shard_map passes the op's global weight arrays as runtime
+# ARGUMENTS; wrapping apply in an outer jit would capture them as closure
+# constants, which multi-controller JAX rejects (the grid solvers learned
+# the same lesson in round 3 — sources as jit arguments, docs/round3.md)
+out = multihost.fetch_global(sh.apply(ug))
+multihost.assert_same_on_all_hosts(out, "unstructured offsets")
+erru = float(np.abs(out - uop.apply_np(uu)).max())
+assert erru < 1e-12, f"unstructured offsets deviates by {erru:.3e}"
+print(f"MH-OK p{pid} unstructured err={erru:.2e}", flush=True)

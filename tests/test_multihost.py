@@ -130,3 +130,4 @@ def test_two_controller_loopback_solve():
         assert f"MH-OK p{pid} eps=9" in out
         assert f"MH-OK p{pid} 3d eps=2" in out
         assert f"MH-OK p{pid} 3d eps=5" in out
+        assert f"MH-OK p{pid} unstructured" in out

@@ -101,7 +101,9 @@ def test_export_halo_auto_selection():
     # 8 shards gives 16 grid rows per block, eps=3h reaches ~3 rows deep
     pts, h = jittered_cloud(m=128, seed=13)
     op = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-5, vol=h * h)
-    s1 = ShardedUnstructuredOp(op)
+    # layout="edges": this test targets the edge layout's halo machinery
+    # (plain auto now picks the offsets layout on a jittered grid)
+    s1 = ShardedUnstructuredOp(op, layout="edges")
     if len(jax.devices()) >= 8:
         assert s1.halo_mode == "export", s1.halo_comm_ratio
         assert s1.halo_comm_ratio < 0.5

@@ -615,17 +615,26 @@ class ShardedUnstructuredOp:
     def manufactured_solution(self, t: int):
         return self.inner.manufactured_solution(t)
 
-    def apply(self, u: jnp.ndarray) -> jnp.ndarray:
-        up = jnp.pad(u, (0, self.pad)).reshape(self.S, self.B)
+    def apply_args(self) -> tuple:
+        """The operator's device arrays, in ``apply_with`` order.  Callers
+        that jit around the operator pass these as ARGUMENTS — a closure
+        capture of arrays spanning a cross-process mesh is rejected by
+        multi-controller JAX (docs/multihost.md)."""
         if self.layout == "offsets":
-            out = self._sharded(up, self._w3, self._c, self._wsum)
-        elif self.halo_mode == "export":
-            out = self._sharded(up, self._exp_idx, self._tgt, self._src,
-                                self._w, self._c, self._wsum)
-        else:
-            out = self._sharded(up, self._tgt, self._src, self._w,
-                                self._c, self._wsum)
-        return out.reshape(self.S * self.B)[: self.n]
+            return (self._w3, self._c, self._wsum)
+        if self.halo_mode == "export":
+            return (self._exp_idx, self._tgt, self._src, self._w,
+                    self._c, self._wsum)
+        return (self._tgt, self._src, self._w, self._c, self._wsum)
+
+    def apply_with(self, u: jnp.ndarray, args: tuple) -> jnp.ndarray:
+        """L(u) with the device arrays supplied by the caller (traced jit
+        arguments); ``apply`` is the closure convenience form."""
+        up = jnp.pad(u, (0, self.pad)).reshape(self.S, self.B)
+        return self._sharded(up, *args).reshape(self.S * self.B)[: self.n]
+
+    def apply(self, u: jnp.ndarray) -> jnp.ndarray:
+        return self.apply_with(u, self.apply_args())
 
 
 class UnstructuredSolver(CheckpointMixin):
@@ -705,44 +714,74 @@ class UnstructuredSolver(CheckpointMixin):
                         and getattr(op, "windowed_plan", None) is not None)
             if windowed:
                 ex = op.windowed_plan().for_dtype(dtype)
+            # a sharded operator exposes its device arrays so the jit'd
+            # scan can take them as ARGUMENTS — a closure capture of
+            # arrays spanning a cross-process mesh is rejected by JAX
+            # (the grid solvers' sources-as-arguments rule)
+            consts = (op.apply_args()
+                      if getattr(op, "apply_args", None) is not None else ())
+            multiproc = bool(consts) and jax.process_count() > 1
+            if multiproc:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                rep = NamedSharding(op.mesh, PartitionSpec())
+                place = lambda x: put_global(  # noqa: E731
+                    np.asarray(x, np.dtype(dtype)), rep)
             if test:
                 if windowed:
                     perm_np = np.asarray(ex.perm)
                     gd = jnp.asarray(g[perm_np], dtype)
                     lgd = jnp.asarray(lg[perm_np], dtype)
+                elif multiproc:
+                    gd, lgd = place(g), place(lg)
                 else:
                     gd, lgd = jnp.asarray(g, dtype), jnp.asarray(lg, dtype)
+            extras = (gd, lgd) if test else ()
 
-            def step(u, t):
+            def step_with(u, t, consts, extras):
                 if windowed:
                     du = ex.L_perm(u)
+                elif consts:
+                    du = op.apply_with(u, consts)
                 elif layout == "auto":
                     du = op.apply(u)
                 else:
                     du = op.apply(u, layout=layout)
                 if test:
-                    du = du + source_at(gd, lgd, t, op.dt)
+                    du = du + source_at(extras[0], extras[1], t, op.dt)
                 return u + op.dt * du, None
 
             def make_runner(count):
                 @jax.jit
-                def run(u, t0):
+                def run(u, t0, consts, extras):
                     ts = t0 + jnp.arange(count)
                     if windowed:
                         u = u[ex.perm]
-                    u = jax.lax.scan(step, u, ts)[0]
+                    u = jax.lax.scan(
+                        lambda c, t: step_with(c, t, consts, extras), u, ts
+                    )[0]
                     if windowed:
                         u = u[ex.rank]
                     return u
 
-                return lambda u, start: run(u, jnp.int32(start))
+                return lambda u, start: run(u, jnp.int32(start), consts,
+                                            extras)
 
-            u = jnp.asarray(self.u0, dtype)
-            if self.checkpoint_path and self.ncheckpoint:
-                u = np.asarray(self._run_chunked(u, make_runner))
+            if multiproc:
+                from nonlocalheatequation_tpu.parallel.multihost import (
+                    fetch_global,
+                )
+
+                u = place(self.u0)
+                to_host = fetch_global
             else:
-                u = np.asarray(
-                    make_runner(self.nt - self.t0)(u, self.t0))
+                u = jnp.asarray(self.u0, dtype)
+                to_host = np.asarray
+            if self.checkpoint_path and self.ncheckpoint:
+                u = np.asarray(to_host(self._run_chunked(u, make_runner)))
+            else:
+                u = np.asarray(to_host(
+                    make_runner(self.nt - self.t0)(u, self.t0)))
         self.u = u
         if self.test:
             d = u - op.manufactured_solution(self.nt)

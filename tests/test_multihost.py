@@ -130,4 +130,5 @@ def test_two_controller_loopback_solve():
         assert f"MH-OK p{pid} eps=9" in out
         assert f"MH-OK p{pid} 3d eps=2" in out
         assert f"MH-OK p{pid} 3d eps=5" in out
-        assert f"MH-OK p{pid} unstructured" in out
+        assert f"MH-OK p{pid} unstructured " in out
+        assert f"MH-OK p{pid} unstructured-solver" in out

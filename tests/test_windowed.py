@@ -345,3 +345,36 @@ def test_sharded_offsets_solver_contract():
     s.test_init()
     s.do_work()
     assert s.error_l2 / op.n <= 1e-6
+
+
+def test_layouts_agree_with_influence_and_variable_vol():
+    # J != 1 and non-uniform volumes: every layout must carry the same
+    # per-edge weights (the DIA/window planners only re-ARRANGE edge_w)
+    rng = np.random.default_rng(12)
+    m = 24
+    h = 1.0 / m
+    xs, ys = np.meshgrid(np.arange(m) * h, np.arange(m) * h, indexing="ij")
+    pts = np.stack([xs.ravel(), ys.ravel()], axis=1)
+    pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+    vol = h * h * rng.uniform(0.5, 1.5, size=len(pts))
+    op = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-6, vol=vol,
+                                influence=lambda r: 1.0 - 0.5 * r)
+    u = rng.normal(size=op.n)
+    want = op.apply_np(u)
+    scale = max(1.0, np.abs(want).max())
+    for layout in ("offsets", "windowed", "ell", "edges"):
+        got = np.asarray(op.apply(jnp.asarray(u), layout=layout))
+        assert np.max(np.abs(got - want)) < 1e-12 * scale, layout
+
+
+def test_layouts_on_1d_cloud():
+    rng = np.random.default_rng(13)
+    n = 300
+    pts = (np.arange(n) / n + rng.uniform(-2e-4, 2e-4, n)).reshape(n, 1)
+    op = UnstructuredNonlocalOp(pts, 4.0 / n, k=1.0, dt=1e-7, vol=1.0 / n)
+    u = rng.normal(size=n)
+    want = op.apply_np(u)
+    scale = max(1.0, np.abs(want).max())
+    for layout in ("offsets", "windowed", "edges"):
+        got = np.asarray(op.apply(jnp.asarray(u), layout=layout))
+        assert np.max(np.abs(got - want)) < 1e-12 * scale, layout

@@ -461,6 +461,46 @@ def bench_small2d(steps: int):
             emit(f"2d/small/{n}/resident", n * n, steps, sec, grid=n, eps=8)
 
 
+def bench_unstructured3d(steps: int):
+    """3D point cloud (jittered 64^3 lattice): the offsets layout vs the
+    gather paths one dimension up — kmax roughly doubles (ball vs disc)
+    while the offset count stays small for a quasi-lattice cloud."""
+    from nonlocalheatequation_tpu.ops.unstructured import UnstructuredNonlocalOp
+
+    m = cfg("BT_UNSTRUCT3D_M", 64, 16)
+    rng = np.random.default_rng(0)
+    h = 1.0 / m
+    ax = np.arange(m) * h
+    gx, gy, gz = np.meshgrid(ax, ax, ax, indexing="ij")
+    pts = np.stack([gx.ravel(), gy.ravel(), gz.ravel()], axis=1)
+    pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+    eps = 2.5 * h * (1.0 + 0.1 * np.sin(5.0 * pts[:, 0]))
+    t0 = time.perf_counter()
+    op = UnstructuredNonlocalOp(pts, eps, k=1.0, dt=1e-8, vol=h ** 3)
+    log(f"    edge build: {time.perf_counter() - t0:.2f}s, "
+        f"{len(op.tgt)} edges, kmax={op.kmax}")
+    u0 = jnp.asarray(rng.normal(size=op.n), jnp.float32)
+
+    from jax import lax
+
+    for layout in ("offsets", "ell", "edges"):
+        extra = {}
+        if layout == "offsets":
+            plan = op.offset_plan()
+            extra = dict(noffsets=len(plan.offs),
+                         coverage=round(plan.coverage, 4))
+
+        @jax.jit
+        def multi(u, _layout=layout):
+            return lax.scan(
+                lambda c, _: (c + op.dt * op.apply(c, layout=_layout), None),
+                u, None, length=steps)[0]
+
+        sec, _ = time_steps(multi, u0, steps)
+        emit(f"unstructured3d/{layout}", op.n, steps, sec, nodes=op.n,
+             edges=len(op.tgt), kmax=op.kmax, **extra)
+
+
 BENCHES = {
     "methods2d": bench_methods2d,
     "small2d": bench_small2d,
@@ -468,6 +508,7 @@ BENCHES = {
     "scaling": bench_scaling,
     "3d": bench_3d,
     "unstructured": bench_unstructured,
+    "unstructured3d": bench_unstructured3d,
     "elastic": bench_elastic,
     "elastic-general": bench_elastic_general,
     "eps-sweep": bench_eps_sweep,

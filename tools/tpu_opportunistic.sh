@@ -120,7 +120,7 @@ run_step_cmd() {  # the queue's one name->command map
       env BT_STEPS=200 python tools/bench_table.py dist2d scaling 3d ;;
     table-c) timeout -k 10 "$HARD_CAP_S" \
       env BT_STEPS=200 python tools/bench_table.py \
-        unstructured elastic elastic-general eps-sweep ;;
+        unstructured unstructured3d elastic elastic-general eps-sweep ;;
     profile) bench_nofb BENCH_PROFILE=docs/bench/profile_r03b ;;
     *) log "unknown step $1"; return 2 ;;
   esac

@@ -231,6 +231,31 @@ def _check_offsets_unstructured(m):
     _assert_rel(got, op.apply_np(np.asarray(u, np.float64)), 1e-5)
 
 
+def _check_sharded_offsets_unstructured(m):
+    """Compiled shard_map validation of the sharded offsets form (on one
+    chip the ring ppermute degenerates to self-sends — still the real
+    collective lowering, which interpreter CI never exercises)."""
+    np, jax = _setup()
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.unstructured import (
+        ShardedUnstructuredOp,
+        UnstructuredNonlocalOp,
+    )
+
+    rng = np.random.default_rng(0)
+    h = 1.0 / m
+    xs, ys = np.meshgrid(np.arange(m) * h, np.arange(m) * h, indexing="ij")
+    pts = np.stack([xs.ravel(), ys.ravel()], axis=1)
+    pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+    op = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-7, vol=h * h)
+    sh = ShardedUnstructuredOp(op, devices=jax.devices()[:1])
+    assert sh.layout == "offsets", sh.layout
+    u = jnp.asarray(rng.normal(size=op.n), jnp.float32)
+    got = np.asarray(sh.apply(u))
+    _assert_rel(got, op.apply_np(np.asarray(u, np.float64)), 1e-5)
+
+
 def _check_f64_guard():
     np, jax = _setup()
     import jax.numpy as jnp
@@ -313,6 +338,8 @@ def _build_checks():
                    lambda: _check_windowed_unstructured(64, wmax=128)))
     checks.append(("offsets unstructured 64^2 cloud",
                    lambda: _check_offsets_unstructured(64)))
+    checks.append(("sharded offsets unstructured 64^2 cloud 1-dev",
+                   lambda: _check_sharded_offsets_unstructured(64)))
     return checks
 
 

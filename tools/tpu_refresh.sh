@@ -80,11 +80,11 @@ run bench-superstep env BENCH_SUPERSTEP=2 BENCH_GRID=4096 BENCH_LADDER=4096 \
 # 3. compiled-mode sanity sweep (all kernels, eps classes, carried, shard_map)
 run sanity python tools/tpu_sanity.py
 
-# 4. full table: methods, small-grid resident A/B, dist, 3d, unstructured
-# (+sharded halos), elastic+gang
+# 4. full table: methods (+autotuned row), small-grid resident A/B, dist,
+# 3d, unstructured 2D+3D (+sharded halos incl. offsets), elastic+gang
 run table env BT_STEPS=200 python tools/bench_table.py \
-    methods2d small2d dist2d scaling 3d unstructured elastic \
-    elastic-general eps-sweep
+    methods2d small2d dist2d scaling 3d unstructured unstructured3d \
+    elastic elastic-general eps-sweep
 
 # 5. profiler trace of the headline rung
 run profile env BENCH_PROFILE=docs/bench/profile_r03b python bench.py

@@ -30,6 +30,14 @@ def main(outdir: str = "data") -> None:
         write_structured_msh(path, m, m, 1.0 / m)
         print(path)
 
+    # 400x400: referenced by the reference's README run config
+    # (README.md:61-67, srun -n 4 with 20x20 tiles) but ABSENT from its
+    # repo (.MISSING_LARGE_BLOBS) — too big as ASCII.  Binary 4.1 makes
+    # it shippable (~7 MB instead of ~19 MB of text).
+    path = os.path.join(outdir, "400x400.msh")
+    write_structured_msh(path, 400, 400, 1.0 / 400, binary=True)
+    print(path)
+
     # Imbalanced partition maps (fixture shapes from the reference's tests/):
     # 4 tiles / 2 nodes — 3 tiles on node 1, one on node 0.
     a = np.full((2, 2), 1, dtype=np.int64)

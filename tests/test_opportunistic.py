@@ -19,8 +19,9 @@ SCRIPT = os.path.join(REPO, "tools", "tpu_opportunistic.sh")
 
 ALL_STEPS = [
     "bench4096", "resident512", "carried4096", "superstep2", "sanity",
-    "superstep2-tm128", "superstep3-tm96", "tm160", "tm192", "tm224",
-    "tm256", "stretch8192", "table-a", "table-b", "table-c", "profile",
+    "superstep2-tm128", "superstep3-tm96", "autotune", "tm160", "tm192",
+    "tm224", "tm256", "stretch8192", "table-a", "table-b", "table-c",
+    "profile",
 ]
 
 

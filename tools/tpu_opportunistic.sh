@@ -59,9 +59,26 @@ touch "$STATE"
 # would live if Mosaic accepts them (superstep2+tm128 ~1.25 frames/step,
 # superstep3+tm96 ~0.89 vs the carried ~2.2) — a clean Mosaic allocation
 # error just strikes the step.
+#
+# Order = VERDICT r4 priority: headline+accuracy (bench4096 runs the
+# on-device accuracy gate inside its ladder) -> copy-floor variant A/Bs
+# -> sanity -> forced-tm Mosaic probes -> autotune-default validation ->
+# unstructured/elastic TPU rows (table-c) -> tm fine sweep -> stretch ->
+# remaining tables -> profile.
+#
+# Window-budget classes (VERDICT r4 #8; the queue resumes mid-list, so a
+# short window banks the prefix that fits):
+#   ~90 s   : gate alone (compile ~25 s + 512^2 ladder) — always banked
+#   ~5 min  : + bench4096 (three-rung ladder, one compile per rung,
+#             accuracy gate at the end) — the round's headline
+#   ~15 min : + resident512/carried4096/superstep2 (one compile each,
+#             ~2-4 min/step)
+#   ~45 min : + sanity (per-config subprocess sweep, 30-min internal cap)
+#   ~2 h    : + tm probes, autotune (4-5 probe compiles/shape), table-c
+#   beyond  : tm sweep, stretch8192 (compile headroom), table-a/b, profile
 STEPS="bench4096 resident512 carried4096 superstep2 sanity \
-superstep2-tm128 superstep3-tm96 tm160 tm192 tm224 tm256 stretch8192 \
-table-a table-b table-c profile"
+superstep2-tm128 superstep3-tm96 autotune table-c tm160 tm192 tm224 tm256 \
+stretch8192 table-a table-b profile"
 
 log() { echo "[opp $(date -u +%H:%M:%S)] $*" | tee -a "$OUT"; }
 
@@ -121,6 +138,8 @@ run_step_cmd() {  # the queue's one name->command map
     table-c) timeout -k 10 "$HARD_CAP_S" \
       env BT_STEPS=200 python tools/bench_table.py \
         unstructured unstructured3d elastic elastic-general eps-sweep ;;
+    autotune) timeout -k 10 "$HARD_CAP_S" \
+      env BT_STEPS=200 python tools/bench_table.py autotune ;;
     profile) bench_nofb BENCH_PROFILE=docs/bench/profile_r03b ;;
     *) log "unknown step $1"; return 2 ;;
   esac
@@ -141,8 +160,29 @@ step_backend_ok() {  # <run-log>: step produced on-TPU evidence, no CPU rows
 step_variant_ok() {  # <name> <run-log>: opt-in kernel actually engaged?
   # bench.py silently falls back to the per-step path when the resident
   # kernel doesn't fit / build (bench.py "rung will carry no variant
-  # label") — a fallback run must not satisfy the A/B step
+  # label") — a fallback run must not satisfy the A/B step.  autotune:
+  # at least one tuned row must carry a winner whose own probe timing is
+  # numeric — a degenerate run where every candidate errored (winner
+  # defaults to per-step with a null timing) must not bank the step.
   case $1 in
+    autotune) python - "$2" <<'PYEOF'
+import json, sys
+ok = False
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    w = r.get("winner")
+    pm = r.get("probe_ms_per_step") or {}
+    if w and isinstance(pm.get(w), (int, float)):
+        ok = True
+sys.exit(0 if ok else 1)
+PYEOF
+      ;;
     resident512) grep -q '"variant": "resident"' "$2" ;;
     carried4096) grep -q '"variant": "carried"' "$2" ;;
     superstep2) grep -q '"variant": "superstep2"' "$2" ;;

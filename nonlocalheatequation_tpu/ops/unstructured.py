@@ -192,6 +192,7 @@ class UnstructuredNonlocalOp:
         self.kmax = int(deg.max()) if len(tgt) else 0
         self._ell_arrays = None  # built lazily; see _ell()
         self._windowed_plan = None  # built lazily; see windowed_plan()
+        self._windowed_stats = None  # cached (coverage, p_bytes) precheck
         self._offset_plan = None  # built lazily; see offset_plan()
 
     # ELL (padded-row) layout of the same edges: neighbor column ids and
@@ -257,9 +258,19 @@ class UnstructuredNonlocalOp:
             # gathers are cheap on CPU; the strips only pay off where the
             # gather path is the bottleneck
             return False
-        plan = self.windowed_plan()
-        return (plan.coverage >= self._WINDOWED_MIN_COVERAGE
-                and plan.p_bytes_f32 <= self._windowed_budget_bytes())
+        # stats-only precheck (ADVICE r4): judge coverage and strip bytes
+        # from the ladder search alone — the dense strips are only
+        # materialized (by windowed_plan()) once the plan is accepted.
+        # Cached: the edge set is immutable and the per-step auto path
+        # consults this gate on every apply
+        if self._windowed_stats is None:
+            from .windowed import plan_stats
+
+            self._windowed_stats = plan_stats(self.points, self.eps,
+                                              self.tgt, self.src)
+        coverage, p_bytes = self._windowed_stats
+        return (coverage >= self._WINDOWED_MIN_COVERAGE
+                and p_bytes <= self._windowed_budget_bytes())
 
     # Offset (DIA) layout: the fastest path when src-tgt index offsets
     # cluster (quasi-uniform clouds in their natural order — a jittered

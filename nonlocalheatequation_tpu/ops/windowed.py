@@ -87,6 +87,8 @@ def morton_perm(points: np.ndarray, cell: float) -> np.ndarray:
     Within a cell the original order is kept (stable sort).
     """
     pts = np.asarray(points, np.float64)
+    if pts.shape[0] == 0:  # pts.min() on an empty axis raises
+        return np.zeros(0, np.int64)
     cells = np.floor((pts - pts.min(axis=0)) / float(cell)).astype(np.uint64)
     n, d = cells.shape
     bits = min(21, 63 // max(d, 1))
@@ -240,18 +242,13 @@ class WindowedPlan:
         return self._execs[key]
 
 
-def build_plan(points, eps, tgt, src, edge_w, c, wsum, *, bm: int = LANE,
-               wmax: int = 4096, max_overflow_frac: float = 0.02,
-               order: str = "morton", windows: int = 2) -> WindowedPlan:
-    """Build the windowed layout for an edge set.
-
-    ``order="morton"`` reorders nodes along a Z-curve over eps.max()-sized
-    cells (the locality the windows rely on); ``order="keep"`` trusts the
-    caller's ordering.  W walks the ladder until the residual edge fraction
-    drops under ``max_overflow_frac`` (or the ladder ends — the plan is
-    still exact then, just with a larger residual; callers judge
-    worthwhileness via ``plan.coverage``).
-    """
+def _plan_search(points, eps, tgt, src, edge_w, *, bm, wmax,
+                 max_overflow_frac, order, windows):
+    """The permutation + per-block column sets + W-ladder search shared by
+    :func:`build_plan` and :func:`plan_stats`.  Everything here is
+    O(E log E) host work with O(E) allocations — the dense strips are NOT
+    materialized (the point: worthwhileness gates must be able to reject
+    a plan without paying its memory)."""
     points = np.asarray(points, np.float64)
     n = points.shape[0]
     tgt = np.asarray(tgt, np.int64)
@@ -316,6 +313,50 @@ def build_plan(points, eps, tgt, src, edge_w, c, wsum, *, bm: int = LANE,
         we = cand_w
         if total == 0 or (total - covered) <= max_overflow_frac * total:
             break
+
+    return dict(n=n, n_pad=n_pad, nb=nb, R=R, we=we, perm=perm, rank=rank,
+                tgt_s=tgt_s, src_s=src_s, w_s=w_s, blk=blk, s128=s128,
+                covered=covered, total=total)
+
+
+def plan_stats(points, eps, tgt, src, *, bm: int = LANE, wmax: int = 4096,
+               max_overflow_frac: float = 0.02, order: str = "morton",
+               windows: int = 2):
+    """Cheap precheck for the windowed layout: ``(coverage, p_bytes_f32)``
+    of the plan :func:`build_plan` would produce under the same parameters,
+    WITHOUT materializing the dense strips — the :func:`offset_stats`
+    analog for this layout, so the auto policy can reject an over-budget
+    plan before allocating it (a large low-locality cloud escalated to the
+    top ladder rung would otherwise transiently allocate multi-GB of host
+    memory only to be refused)."""
+    sr = _plan_search(points, eps, tgt, src,
+                      np.zeros(np.asarray(tgt).shape[0], np.float64),
+                      bm=bm, wmax=wmax, max_overflow_frac=max_overflow_frac,
+                      order=order, windows=windows)
+    coverage = 1.0 if sr["total"] == 0 else sr["covered"] / sr["total"]
+    return coverage, sr["n_pad"] * sr["R"] * sr["we"] * 4
+
+
+def build_plan(points, eps, tgt, src, edge_w, c, wsum, *, bm: int = LANE,
+               wmax: int = 4096, max_overflow_frac: float = 0.02,
+               order: str = "morton", windows: int = 2) -> WindowedPlan:
+    """Build the windowed layout for an edge set.
+
+    ``order="morton"`` reorders nodes along a Z-curve over eps.max()-sized
+    cells (the locality the windows rely on); ``order="keep"`` trusts the
+    caller's ordering.  W walks the ladder until the residual edge fraction
+    drops under ``max_overflow_frac`` (or the ladder ends — the plan is
+    still exact then, just with a larger residual; callers judge
+    worthwhileness via ``plan.coverage``).
+    """
+    sr = _plan_search(points, eps, tgt, src, edge_w, bm=bm, wmax=wmax,
+                      max_overflow_frac=max_overflow_frac, order=order,
+                      windows=windows)
+    n, n_pad, nb, R, we = sr["n"], sr["n_pad"], sr["nb"], sr["R"], sr["we"]
+    perm, rank = sr["perm"], sr["rank"]
+    tgt_s, src_s, w_s = sr["tgt_s"], sr["src_s"], sr["w_s"]
+    blk, s128 = sr["blk"], sr["s128"]
+    covered, total = sr["covered"], sr["total"]
 
     # dense strips; every edge lands in the FIRST window that contains it
     # (windows of one block may overlap — the assigned mask keeps each
@@ -458,9 +499,14 @@ def build_offset_plan(tgt, src, edge_w, c, wsum, n, *,
     inw = slot_ok & (kept[np.minimum(slot, max(len(kept) - 1, 0))] == off) \
         if len(kept) else np.zeros(E, bool)
     W = np.zeros((len(kept), n), np.float64)
-    # (tgt, off) pairs are unique because (tgt, src) pairs are — direct
-    # assignment, same argument as the windowed strips
-    W[slot[inw], tgt[inw]] = edge_w[inw]
+    # (tgt, off) pairs are unique exactly when (tgt, src) pairs are —
+    # verified, with a scatter-add fallback for callers that hand in
+    # duplicate edges (same contract as the windowed strips' build)
+    pair_keys = tgt * np.int64(max(n, 1)) + src
+    if len(pair_keys) == len(np.unique(pair_keys)):
+        W[slot[inw], tgt[inw]] = edge_w[inw]
+    else:
+        np.add.at(W, (slot[inw], tgt[inw]), edge_w[inw])
     ov = ~inw
     offs = tuple(int(o) for o in kept)
     return OffsetPlan(

@@ -181,25 +181,53 @@ def pick_multi_step_fn(op, nsteps: int, shape, dtype):
         "x".join(map(str, shape)), f"eps{op.eps}", dtype.name,
     ])
     cands = dict(candidates(op, shape, nsteps, dtype))
+
+    def covers(e) -> bool:
+        # The key deliberately omits nsteps: every candidate is probed at
+        # the same fixed PROBE_STEPS program, so the measured rates are
+        # nsteps-invariant by construction (ADVICE r4).  What DOES vary
+        # with nsteps is which candidates fit (superstep needs K | nsteps)
+        # — an entry is only reusable if it measured every candidate that
+        # fits THIS call; otherwise a short-run entry would pin a long run
+        # to per-step without superstep ever competing.  A cached winner
+        # that does not fit this nsteps is fine: the rate-based re-pick
+        # below runs the fastest candidate that does.
+        probed = e.get("ms_per_step", {})
+        return all(n in probed for n in cands)
+
     entry = _memory_cache.get(key)
+    if entry is not None and not covers(entry):
+        partial, entry = entry, None  # keep the record for merging below
+    else:
+        partial = None
     if entry is None:
         file_cache = _load_file_cache()
         entry = file_cache.get(key)
-        if entry is None or entry.get("winner") not in cands:
+        if entry is None or not covers(entry):
+            # probe ONLY candidates no record exists for (rates are
+            # nsteps-invariant, so prior measurements stay valid — on the
+            # real chip every avoided probe is a ~25 s compile saved out
+            # of a heal window) and merge into the recorded map; records
+            # may live in the file entry, the partial memory entry, or both
+            recorded = {**((entry or {}).get("ms_per_step", {})),
+                        **((partial or {}).get("ms_per_step", {}))}
             timings = {}
             for name, maker in cands.items():
+                if name in recorded:
+                    continue
                 try:
                     timings[name] = _measure(maker, op, shape, dtype)
                 except Exception as e:  # noqa: BLE001 — a variant that
                     # fails to build/compile simply doesn't compete
                     timings[name] = None
                     timings[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
-            valid = {n: t for n, t in timings.items()
-                     if isinstance(t, float)}
-            winner = min(valid, key=valid.get) if valid else "per-step"
-            entry = {"winner": winner, "ms_per_step": {
+            recorded.update({
                 n: (t * 1e3 if isinstance(t, float) else t)
-                for n, t in timings.items()}}
+                for n, t in timings.items()})
+            valid = {n: t for n, t in recorded.items()
+                     if isinstance(t, (int, float)) and not isinstance(t, bool)}
+            winner = min(valid, key=valid.get) if valid else "per-step"
+            entry = {"winner": winner, "ms_per_step": recorded}
             file_cache[key] = entry
             _store_file_cache(file_cache)
         _memory_cache[key] = entry

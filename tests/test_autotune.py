@@ -138,6 +138,35 @@ def test_cached_winner_unfit_falls_back_to_fastest_fitting(monkeypatch):
     assert np.array_equal(np.asarray(ref), np.asarray(fn(u, jnp.int32(0))))
 
 
+def test_entry_missing_fitting_candidate_triggers_remeasure(monkeypatch):
+    """ADVICE r4: the cache key omits nsteps (probe rates are
+    nsteps-invariant), but the candidate SET is not — an entry recorded at
+    a short segment (superstep3 never probed) must not pin a longer
+    segment to that subset; the missing fitting candidate forces a
+    re-measure, after which shorter calls reuse the superset entry."""
+    op = NonlocalOp2D(3, k=1.0, dt=1e-6, dh=1.0 / 48, method="pallas")
+    calls = []
+    real = autotune._measure
+    monkeypatch.setattr(
+        autotune, "_measure",
+        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+
+    autotune.pick_multi_step_fn(op, 2, (48, 48), jnp.float32)
+    n_short = len(calls)
+    assert n_short == 4  # per-step, carried, superstep2, resident
+
+    # superstep3 fits nsteps=6 but was never probed -> probe ONLY it and
+    # merge (prior rates are nsteps-invariant; re-probing them would burn
+    # heal-window compile budget on the real chip)
+    autotune.pick_multi_step_fn(op, 6, (48, 48), jnp.float32)
+    assert len(calls) == n_short + 1
+
+    # the entry now covers every subset: both lengths reuse it
+    autotune.pick_multi_step_fn(op, 2, (48, 48), jnp.float32)
+    autotune.pick_multi_step_fn(op, 6, (48, 48), jnp.float32)
+    assert len(calls) == n_short + 1
+
+
 def test_default_policy_is_backend_gated(monkeypatch):
     """VERDICT r3 #2: autotune is the on-TPU production default.  Unset env
     on CPU must keep the plain base path (tests/CLI smoke unaffected);

@@ -243,6 +243,46 @@ def test_offset_stats_matches_plan_without_materializing():
     assert w_bytes == plan.w_bytes_f32
 
 
+def test_plan_stats_matches_plan_without_materializing():
+    # ADVICE r4: the windowed worthwhileness gate must judge coverage and
+    # strip bytes without allocating the dense strips; the stats must agree
+    # with what build_plan actually produces
+    from nonlocalheatequation_tpu.ops.windowed import plan_stats
+
+    op = _cloud(32)
+    cov, p_bytes = plan_stats(op.points, op.eps, op.tgt, op.src)
+    plan = _plan_of(op)
+    assert cov == pytest.approx(plan.coverage)
+    assert p_bytes == plan.p_bytes_f32
+
+
+def test_morton_perm_and_plan_on_empty_cloud():
+    # ADVICE r4: morton_perm did pts.min() on a zero-size array
+    perm = morton_perm(np.zeros((0, 2)), 1.0)
+    assert perm.shape == (0,)
+    z = np.zeros(0)
+    plan = build_plan(np.zeros((0, 2)), z, np.zeros(0, np.int64),
+                      np.zeros(0, np.int64), z, z, z)
+    assert plan.n == 0 and plan.coverage == 1.0
+
+
+def test_offset_plan_duplicate_edges_accumulate():
+    # ADVICE r4: build_edges never produces duplicate (tgt, src) pairs, but
+    # a caller handing them in must get accumulation, not silent dropping
+    from nonlocalheatequation_tpu.ops.windowed import build_offset_plan
+
+    tgt = np.array([0, 1, 2, 0], np.int64)
+    src = np.array([1, 2, 3, 1], np.int64)
+    w = np.array([1.0, 2.0, 3.0, 4.0])
+    n = 4
+    plan = build_offset_plan(tgt, src, w, np.ones(n), np.ones(n), n)
+    u = np.array([1.0, 10.0, 100.0, 1000.0])
+    got = np.asarray(plan.for_dtype(jnp.float64).neighbor_sum(jnp.asarray(u)))
+    want = np.zeros(n)
+    np.add.at(want, tgt, w * u[src])
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-12)
+
+
 def test_plan_cache_rebuilds_on_different_kwargs():
     op = _cloud(32)
     full = op.offset_plan()

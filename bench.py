@@ -49,7 +49,9 @@ if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
 budget above this re-probes the TPU once — the wedge cycle often heals
 mid-watchdog — and a real TPU rung replaces the fallback headline,
-labeled cpu_fallback="recovered-late").
+labeled cpu_fallback="recovered-late"), BENCH_PROBE_PHASE_S (pin the
+probe phase to N seconds instead of the default 45% of the watchdog —
+for hosts whose tunnel is known to fail fast, and the fault tests).
 """
 
 import json
@@ -300,7 +302,13 @@ def probe_device(phase_deadline=None, hang_cap=3, tag="probe"):
     """
     hangs, attempt = 0, 0
     if phase_deadline is None:
-        phase_deadline = T0 + 0.45 * WATCHDOG_S  # leave the rest for measuring
+        # leave the rest for measuring; BENCH_PROBE_PHASE_S pins the phase
+        # length in absolute seconds (fast-failing probes need not consume
+        # the default 45% of the watchdog — used by the fault tests and
+        # useful on hosts whose tunnel is known to fail fast)
+        phase_s = float(os.environ.get("BENCH_PROBE_PHASE_S") or
+                        0.45 * WATCHDOG_S)
+        phase_deadline = T0 + phase_s
     while True:
         if time.time() >= phase_deadline:
             log(f"{tag}: phase deadline reached; proceeding without the device")
@@ -348,6 +356,15 @@ def run_measure_child(force_method=None):
     Returns (#rungs harvested this child, clean_done: bool).
     """
     env = {"BENCH_CHILD_BUDGET_S": f"{max(0.0, remaining()):.0f}"}
+    if (os.environ.get("BENCH_TEST_MODE") == "1"
+            and os.environ.get("BENCH_FAULT") == "tiny_child_budget"):
+        # fault injection (tests/test_bench_harness.py): pin the child's
+        # budget to a few seconds so the first-rung-always-attempted
+        # property is exercised by INJECTION rather than by racing a tight
+        # real watchdog against host load (VERDICT r4 #7: wall-clock fault
+        # schedules flake; events and injected state do not)
+        env["BENCH_CHILD_BUDGET_S"] = os.environ.get(
+            "BENCH_FAULT_BUDGET_S", "5")
     if force_method:
         env["BENCH_METHOD"] = force_method
     proc = spawn_child("--measure", env)
@@ -540,10 +557,20 @@ def child_probe():
     if (os.environ.get("BENCH_FAULT") == "probe_heal_after"
             and os.environ.get("BENCH_TEST_MODE") == "1"):
         # fail fast (the resetting-tunnel UNAVAILABLE mode) until the heal
-        # moment, then behave normally (on CPU — see child_platform_override)
-        t0 = float(os.environ["BENCH_FAULT_T0"])
-        heal_s = float(os.environ.get("BENCH_FAULT_HEAL_S", 30))
-        if time.time() < t0 + heal_s:
+        # moment, then behave normally (on CPU — see child_platform_override).
+        # The heal moment is EVENT-driven when BENCH_FAULT_FILE is set: the
+        # test touches the file once the precondition it stages (the CPU
+        # fallback) has actually happened, so no wall-clock schedule can
+        # race host load (VERDICT r4 #7).  T0/HEAL_S wall-clock mode remains
+        # for manual experiments.
+        path = os.environ.get("BENCH_FAULT_FILE")
+        if path is not None:
+            healed = os.path.exists(path)
+        else:
+            t0 = float(os.environ["BENCH_FAULT_T0"])
+            heal_s = float(os.environ.get("BENCH_FAULT_HEAL_S", 30))
+            healed = time.time() >= t0 + heal_s
+        if not healed:
             print("probe_heal_after: injected fast failure", file=sys.stderr)
             sys.exit(1)
 

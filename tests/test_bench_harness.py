@@ -76,12 +76,21 @@ def test_hung_method_probe_is_killed_and_retried_with_sat():
 
 
 def test_first_rung_always_attempted_even_late():
-    # A child budget that is nearly spent must still try the first rung.
-    # 40s: tight enough that a full ladder would not fit comfortably, wide
-    # enough that probe + import + one 64^2 rung land even on a heavily
-    # loaded single-CPU host (25s flaked under a parallel suite run)
-    proc, rec = run_bench({"BENCH_WATCHDOG_S": "40"}, timeout=120)
+    # A child budget that is nearly spent must still try the first rung
+    # (degrade the result, never zero it).  The squeeze is INJECTED — a
+    # test-mode fault pins the child budget to 5s under a generous real
+    # watchdog — instead of racing a tight watchdog against host load
+    # (the old 25s/40s schedules both flaked under parallel suite runs;
+    # VERDICT r4 #7)
+    proc, rec = run_bench({
+        "BENCH_WATCHDOG_S": "240",
+        "BENCH_TEST_MODE": "1",
+        "BENCH_FAULT": "tiny_child_budget",
+        "BENCH_FAULT_BUDGET_S": "5",
+    }, timeout=300)
     assert rec["value"] > 0, f"late start zeroed the bench: {rec}"
+    assert rec["grid"] == 64 and rec["partial"] is True, rec
+    assert "skipping rung" in proc.stderr  # the squeeze genuinely engaged
 
 
 if __name__ == "__main__":
@@ -131,44 +140,70 @@ def test_probe_retries_through_fast_failures(tmp_path):
     assert proc.stderr.count("probe attempt failed") >= 5
 
 
-def test_late_heal_retry_replaces_cpu_fallback():
+def test_late_heal_retry_replaces_cpu_fallback(tmp_path):
     """The wedge cycle often heals mid-watchdog: after the CPU fallback
     ladder completes with budget to spare, one more TPU probe runs, and a
     successful re-measure replaces the fallback headline (labeled
-    cpu_fallback="recovered-late").  The probe_heal_after fault fails
-    probes fast until the heal moment — past the 45%-budget probe phase,
-    so the fallback genuinely runs first — then lets them succeed."""
-    import time as _time
+    cpu_fallback="recovered-late").  The heal moment is EVENT-driven (the
+    test touches BENCH_FAULT_FILE the moment bench reports the fallback),
+    so the fallback is guaranteed to run first and no wall-clock schedule
+    can race host load — the old T0+80s anchor flaked under parallel
+    suite runs (VERDICT r4 #7)."""
+    import threading
 
+    heal = tmp_path / "healed"
     env = dict(os.environ)
     for k in ("BENCH_FAULT", "BENCH_METHOD", "BENCH_PLATFORM"):
         env.pop(k, None)
     env.update({
         "BENCH_GRID": "64", "BENCH_LADDER": "64", "BENCH_STEPS": "3",
-        # margins sized for HEAVILY loaded single-CPU hosts (a parallel
-        # suite run flaked the old 120/57 schedule): the heal must land
-        # past the 45%-budget probe phase (0.45*170 = 76.5s < 80s) so the
-        # fallback genuinely runs first, and the ~90s left after it cover
-        # a contended late probe + re-measure (each pays a JAX import)
+        # generous watchdog: the run ends long before it fires; the probe
+        # phase is pinned short so pre-fallback fast-fails don't burn the
+        # default 45% of the budget
         "BENCH_WATCHDOG_S": "170",
+        "BENCH_PROBE_PHASE_S": "8",
         "BENCH_PROBE_TIMEOUT_S": "20",
         "BENCH_LATE_RETRY_S": "5",
         "BENCH_TEST_MODE": "1",
         "BENCH_FAULT": "probe_heal_after",
-        "BENCH_FAULT_T0": str(_time.time()),
-        "BENCH_FAULT_HEAL_S": "80",
+        "BENCH_FAULT_FILE": str(heal),
     })
-    proc = subprocess.run(
-        [sys.executable, BENCH], capture_output=True, text=True, env=env,
-        timeout=300,
+    proc = subprocess.Popen(
+        [sys.executable, BENCH], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env,
     )
-    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
-    assert lines, f"no stdout JSON; stderr tail: {proc.stderr[-800:]}"
+    stderr_lines = []
+    stdout_chunks = []
+
+    def watch():
+        for line in proc.stderr:
+            stderr_lines.append(line)
+            if "falling back to CPU" in line and not heal.exists():
+                heal.write_text("1")
+
+    # both pipes drain on daemon threads so the timeout gate below is the
+    # real ceiling — a bench regression that hangs before its watchdog
+    # starts must fail this test at 280s, not block the suite forever
+    t = threading.Thread(target=watch, daemon=True)
+    t2 = threading.Thread(
+        target=lambda: stdout_chunks.append(proc.stdout.read()), daemon=True)
+    t.start()
+    t2.start()
+    try:
+        rc = proc.wait(timeout=280)
+    finally:
+        proc.kill()
+    t.join(timeout=10)
+    t2.join(timeout=10)
+    out = "".join(stdout_chunks)
+    stderr = "".join(stderr_lines)
+    lines = [ln for ln in out.strip().splitlines() if ln.strip()]
+    assert lines, f"no stdout JSON; stderr tail: {stderr[-800:]}"
     rec = json.loads(lines[-1])
     assert rec["value"] > 0, f"late-heal run zeroed the bench: {rec}"
     assert rec.get("cpu_fallback") == "recovered-late", rec
-    assert "late-probe ok" in proc.stderr
-    assert proc.returncode == 0
+    assert "late-probe ok" in stderr
+    assert rc == 0
 
 def test_malformed_baseline_value_does_not_void_the_line(tmp_path):
     # the one-JSON-line contract must survive a JSON-valid baseline whose

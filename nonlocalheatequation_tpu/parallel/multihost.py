@@ -138,33 +138,80 @@ def put_global(host_array, sharding):
         arr.shape, sharding, lambda idx: arr[idx])
 
 
+_REPLICATE_CACHE: dict = {}
+
+
+def _replicate(x) -> np.ndarray:
+    """All-gather a (possibly cross-process) jax.Array into a host copy on
+    EVERY process, via an XLA identity with a fully-replicated output
+    sharding.  Device-level collectives are indifferent to which PROCESS
+    owns which device, so this — unlike
+    ``jax.experimental.multihost_utils`` (whose helpers reshape the device
+    list as (process_count, local_device_count)) — also works when
+    processes own UNEVEN device counts (e.g. asymmetric host slices).
+
+    The jitted identity is cached per mesh: fetch_global runs at every
+    logging/checkpoint barrier, and a fresh ``jax.jit`` each call would
+    miss pjit's cache (keyed on the callable) and retrace+recompile per
+    barrier."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = getattr(x, "sharding", None)
+    mesh = getattr(sh, "mesh", None)
+    if mesh is None or getattr(mesh, "empty", True):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()), ("p",))
+    fn = _REPLICATE_CACHE.get(mesh)
+    if fn is None:
+        fn = jax.jit(lambda a: a,
+                     out_shardings=NamedSharding(mesh, PartitionSpec()))
+        _REPLICATE_CACHE[mesh] = fn
+    return np.asarray(fn(x))
+
+
 def fetch_global(x) -> np.ndarray:
     """Fetch a (possibly cross-process) jax.Array to host np on EVERY process.
 
     Single-controller this is ``np.asarray``.  Multi-controller it
-    all-gathers the non-addressable shards over the process mesh first —
+    all-gathers the non-addressable shards over the device mesh first —
     the analog of the reference's full-grid gather for logging and error
     metrics (vector_get_data, src/2d_nonlocal_distributed.cpp:1121-1131).
+    Safe under uneven per-process device counts (see ``_replicate``).
     """
     if jax.process_count() == 1:
         return np.asarray(x)
-    from jax.experimental import multihost_utils
-
-    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return _replicate(x)
 
 
 def assert_same_on_all_hosts(x, tag: str = "value") -> None:
     """Cross-host determinism check: every process must hold identical
     ``x`` (the multi-controller contract — divergent host values silently
-    corrupt collectives).  No-op single-process; uses a broadcast-compare
-    on multi-process runs."""
+    corrupt collectives).  No-op single-process; on multi-process runs
+    each process contributes its value on its own devices' shards of a
+    stacked array, the stack is all-gathered, and every row must match —
+    works for uneven per-process device counts (see ``_replicate``)."""
     if jax.process_count() == 1:
         return
-    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
     x = np.asarray(x)
-    ref = multihost_utils.broadcast_one_to_all(x)
-    if not np.array_equal(np.asarray(ref), x):
+    # one row per PROCESS (not per device — same-process rows would be
+    # identical copies), on a mesh of one representative device per
+    # process; the callback materializes only ADDRESSABLE shards, so each
+    # row carries the value of the process owning that device
+    rep_dev = {}
+    for d in jax.devices():
+        rep_dev.setdefault(d.process_index, d)
+    reps = [rep_dev[p] for p in sorted(rep_dev)]
+    mesh = Mesh(np.asarray(reps), ("p",))
+    stacked = jax.make_array_from_callback(
+        (len(reps),) + x.shape,
+        NamedSharding(mesh, PartitionSpec("p")),
+        lambda idx: x[np.newaxis],  # every shard is one (local) row
+    )
+    rows = _replicate(stacked)
+    if not all(np.array_equal(rows[i], x) for i in range(len(reps))):
         raise AssertionError(
             f"{tag} differs between hosts (process {jax.process_index()}): "
             "multi-controller programs must compute identical host values"

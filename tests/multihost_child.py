@@ -1,18 +1,35 @@
-"""One controller of the two-process loopback solve.
+"""One controller of the multi-process loopback solve.
 
 Run by tests/test_multihost.py (not collected by pytest — no test_ prefix):
 ``python multihost_child.py <coordinator> <num_processes> <process_id>``.
-Each process owns 2 virtual CPU devices (XLA_FLAGS set by the parent); the
-meshes therefore SPAN the process boundary, so the shard_map halo exchange
-rides the cross-process (gloo) transport — the DCN analog of the
-reference's multi-locality parcelport (src/2d_nonlocal_distributed.cpp's
-get_data RPCs under srun -n N).
+The parent sets each process's local device count via XLA_FLAGS (equal by
+default, UNEVEN in the split test) and passes the expected global device
+total in ``MH_NDEV``; the meshes SPAN the process boundary, so the
+shard_map halo exchange rides the cross-process (gloo) transport — the DCN
+analog of the reference's multi-locality parcelport
+(src/2d_nonlocal_distributed.cpp's get_data RPCs under srun -n N,
+/root/reference/README.md:64-72).
 
-Legs: 2D 16x16 on a 2x2 mesh at eps=3 (one-hop halo) and eps=9 (multi-hop
-ring); 3D 8^3 on a (2,2,1) mesh at eps=2 (one-hop) and eps=5 (multi-hop).
-Each leg asserts cross-host determinism and <=1e-12 agreement with the
-serial oracle, and prints one ``MH-OK p<pid> ...`` line the parent test
-greps for.
+``MH_LEGS`` selects legs (comma list, default all):
+
+* ``2d``       — 16 x (8*my) grid on a (2, my) mesh at eps=3 (one-hop halo)
+  and eps=9 (multi-hop ring), my = ndev//2; cross-host determinism and
+  <=1e-12 agreement with the serial oracle.
+* ``superstep``— the communication-avoiding K*eps exchange across the
+  process boundary.
+* ``3d``       — 8^3 on a (2,2,ndev//4 or 1) mesh at eps=2/eps=5.
+* ``unstructured`` — sharded-offsets (DIA) op + full solver loop,
+  multi-controller, incl. checkpoint write.
+* ``crash2d``  — run a LONG checkpointed 2D distributed solve (nt=400,
+  ncheckpoint=2 to ``MH_CK``); the parent SIGKILLs this job mid-flight
+  (one process first, then the rest) — the checkpoint on disk must stay
+  loadable (atomic tmp+rename under a hard kill).
+* ``resume2d`` — resume ``MH_CK`` on THIS topology (any process count /
+  mesh shape) and run to ``MH_NT_TOTAL``; must match the serial oracle's
+  full trajectory to 1e-12 — kill-one + resume across a DIFFERENT process
+  count (VERDICT r4 #6).
+
+Each leg prints one ``MH-OK p<pid> ...`` line the parent test greps for.
 """
 
 import os
@@ -28,12 +45,16 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+LEGS = set(os.environ.get("MH_LEGS", "2d,superstep,3d,unstructured")
+           .split(","))
 
 from nonlocalheatequation_tpu.parallel import multihost  # noqa: E402
 
 assert multihost.init_from_env(coord, nproc, pid), "explicit init must run"
 assert jax.process_count() == nproc
-assert len(jax.devices()) == 2 * nproc, "expected 2 local devices per process"
+ndev = int(os.environ.get("MH_NDEV", 2 * nproc))
+assert len(jax.devices()) == ndev, (
+    f"expected {ndev} global devices, got {len(jax.devices())}")
 
 from nonlocalheatequation_tpu.models.solver2d import Solver2D  # noqa: E402
 from nonlocalheatequation_tpu.parallel.distributed2d import (  # noqa: E402
@@ -41,112 +62,158 @@ from nonlocalheatequation_tpu.parallel.distributed2d import (  # noqa: E402
 )
 from nonlocalheatequation_tpu.parallel.mesh import make_mesh  # noqa: E402
 
-# shard edge 8: eps=3 = one-hop band exchange, eps=9 = multi-hop ring (the
-# long-horizon path), both now crossing the process boundary
-for eps in (3, 9):
-    mesh = make_mesh(2, 2)
-    d = Solver2DDistributed(16, 16, 1, 1, nt=3, eps=eps, k=1.0, dt=1e-4,
-                            dh=1.0 / 16, mesh=mesh)
-    d.test_init()
-    ud = d.do_work()
-    multihost.assert_same_on_all_hosts(ud, f"solution eps={eps}")
-    o = Solver2D(16, 16, 3, eps=eps, k=1.0, dt=1e-4, dh=1.0 / 16,
+# mesh (2, my) over ALL global devices; the grid keeps 8x8 tiles so the
+# eps=3 leg stays one-hop and eps=9 stays multi-hop at any my
+MY = ndev // 2
+NX, NY = 16, 8 * MY
+
+if "2d" in LEGS:
+    # eps=3 = one-hop band exchange, eps=9 = multi-hop ring (the
+    # long-horizon path), both crossing the process boundary
+    for eps in (3, 9):
+        mesh = make_mesh(2, MY)
+        d = Solver2DDistributed(NX, NY, 1, 1, nt=3, eps=eps, k=1.0, dt=1e-4,
+                                dh=1.0 / NX, mesh=mesh)
+        d.test_init()
+        ud = d.do_work()
+        multihost.assert_same_on_all_hosts(ud, f"solution eps={eps}")
+        o = Solver2D(NX, NY, 3, eps=eps, k=1.0, dt=1e-4, dh=1.0 / NX,
+                     backend="oracle")
+        o.test_init()
+        uo = o.do_work()
+        err = float(np.abs(ud - uo).max())
+        assert err < 1e-12, f"eps={eps}: deviates from serial oracle by {err:.3e}"
+        print(f"MH-OK p{pid} eps={eps} err={err:.2e}", flush=True)
+
+if "superstep" in LEGS:
+    # communication-avoiding superstep across the PROCESS boundary: one
+    # K*eps-wide exchange per K steps over the gloo transport (the DCN
+    # analog — the latency-bound regime the schedule exists for)
+    o = Solver2D(NX, NY, 3, eps=3, k=1.0, dt=1e-4, dh=1.0 / NX,
                  backend="oracle")
     o.test_init()
     uo = o.do_work()
-    err = float(np.abs(ud - uo).max())
-    assert err < 1e-12, f"eps={eps}: deviates from serial oracle by {err:.3e}"
-    print(f"MH-OK p{pid} eps={eps} err={err:.2e}", flush=True)
-    if eps == 3:
-        # communication-avoiding superstep across the PROCESS boundary: one
-        # K*eps-wide exchange per K steps over the gloo transport (the DCN
-        # analog — the latency-bound regime the schedule exists for)
-        ds = Solver2DDistributed(16, 16, 1, 1, nt=3, eps=eps, k=1.0,
-                                 dt=1e-4, dh=1.0 / 16, mesh=make_mesh(2, 2),
-                                 superstep=2)
-        ds.test_init()
-        us = ds.do_work()
-        multihost.assert_same_on_all_hosts(us, "superstep solution")
-        errs = float(np.abs(us - uo).max())
-        assert errs < 1e-12, f"superstep deviates by {errs:.3e}"
-        print(f"MH-OK p{pid} superstep err={errs:.2e}", flush=True)
+    ds = Solver2DDistributed(NX, NY, 1, 1, nt=3, eps=3, k=1.0,
+                             dt=1e-4, dh=1.0 / NX, mesh=make_mesh(2, MY),
+                             superstep=2)
+    ds.test_init()
+    us = ds.do_work()
+    multihost.assert_same_on_all_hosts(us, "superstep solution")
+    errs = float(np.abs(us - uo).max())
+    assert errs < 1e-12, f"superstep deviates by {errs:.3e}"
+    print(f"MH-OK p{pid} superstep err={errs:.2e}", flush=True)
 
-# 3D over a (2, 2, 1) mesh — same cross-process halo, one more axis:
-# eps=2 is the one-hop band exchange, eps=5 > shard edge 4 the multi-hop
-# ring, mirroring the 2D pair above
-from nonlocalheatequation_tpu.models.solver3d import Solver3D  # noqa: E402
-from nonlocalheatequation_tpu.parallel.distributed3d import (  # noqa: E402
-    Solver3DDistributed,
-)
-from nonlocalheatequation_tpu.parallel.mesh import make_mesh_3d  # noqa: E402
+if "3d" in LEGS:
+    # 3D over a (2, 2, mz) mesh — same cross-process halo, one more axis:
+    # eps=2 is the one-hop band exchange, eps=5 > shard edge the multi-hop
+    # ring, mirroring the 2D pair above
+    from nonlocalheatequation_tpu.models.solver3d import Solver3D  # noqa: E402
+    from nonlocalheatequation_tpu.parallel.distributed3d import (  # noqa: E402
+        Solver3DDistributed,
+    )
+    from nonlocalheatequation_tpu.parallel.mesh import make_mesh_3d  # noqa: E402
 
-for eps3 in (2, 5):
-    mesh3 = make_mesh_3d(2, 2, 1)
-    d3 = Solver3DDistributed(8, 8, 8, nt=2, eps=eps3, k=1.0, dt=1e-4,
-                             dh=0.05, mesh=mesh3)
-    d3.test_init()
-    u3 = d3.do_work()
-    multihost.assert_same_on_all_hosts(u3, f"3d solution eps={eps3}")
-    o3 = Solver3D(8, 8, 8, 2, eps=eps3, k=1.0, dt=1e-4, dh=0.05,
-                  backend="oracle")
-    o3.test_init()
-    err3 = float(np.abs(u3 - o3.do_work()).max())
-    assert err3 < 1e-12, (
-        f"3d eps={eps3}: deviates from serial oracle by {err3:.3e}")
-    print(f"MH-OK p{pid} 3d eps={eps3} err={err3:.2e}", flush=True)
+    MZ = ndev // 4 if ndev % 4 == 0 and ndev >= 4 else 1
+    for eps3 in (2, 5):
+        mesh3 = make_mesh_3d(2, 2, MZ)
+        d3 = Solver3DDistributed(8, 8, 8, nt=2, eps=eps3, k=1.0, dt=1e-4,
+                                 dh=0.05, mesh=mesh3)
+        d3.test_init()
+        u3 = d3.do_work()
+        multihost.assert_same_on_all_hosts(u3, f"3d solution eps={eps3}")
+        o3 = Solver3D(8, 8, 8, 2, eps=eps3, k=1.0, dt=1e-4, dh=0.05,
+                      backend="oracle")
+        o3.test_init()
+        err3 = float(np.abs(u3 - o3.do_work()).max())
+        assert err3 < 1e-12, (
+            f"3d eps={eps3}: deviates from serial oracle by {err3:.3e}")
+        print(f"MH-OK p{pid} 3d eps={eps3} err={err3:.2e}", flush=True)
 
-# unstructured offsets (DIA) over the process-spanning 1D mesh: per-shard
-# diagonal weights + ppermute halo bands crossing the gloo transport — the
-# gather-free multichip unstructured path, multi-controller.  Both
-# processes build the identical op (same seed: the init contract).
-from jax.sharding import NamedSharding, PartitionSpec  # noqa: E402
+if "unstructured" in LEGS:
+    # unstructured offsets (DIA) over the process-spanning 1D mesh: per-
+    # shard diagonal weights + ppermute halo bands crossing the gloo
+    # transport — the gather-free multichip unstructured path, multi-
+    # controller.  Every process builds the identical op (same seed: the
+    # init contract).
+    from jax.sharding import NamedSharding, PartitionSpec  # noqa: E402
 
-from nonlocalheatequation_tpu.ops.unstructured import (  # noqa: E402
-    ShardedUnstructuredOp,
-    UnstructuredNonlocalOp,
-)
+    from nonlocalheatequation_tpu.ops.unstructured import (  # noqa: E402
+        ShardedUnstructuredOp,
+        UnstructuredNonlocalOp,
+        UnstructuredSolver,
+    )
 
-rng = np.random.default_rng(0)
-m = 32
-h = 1.0 / m
-gx, gy = np.meshgrid(np.arange(m) * h, np.arange(m) * h, indexing="ij")
-pts = np.stack([gx.ravel(), gy.ravel()], axis=1)
-pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
-uop = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-6, vol=h * h)
-sh = ShardedUnstructuredOp(uop)  # global 1D mesh over all 4 devices
-assert sh.layout == "offsets", f"expected offsets, got {sh.layout}"
-uu = rng.normal(size=uop.n)
-ug = multihost.put_global(uu, NamedSharding(sh.mesh, PartitionSpec()))
-# eager apply: shard_map passes the op's global weight arrays as runtime
-# ARGUMENTS; wrapping apply in an outer jit would capture them as closure
-# constants, which multi-controller JAX rejects (the grid solvers learned
-# the same lesson in round 3 — sources as jit arguments, docs/round3.md)
-out = multihost.fetch_global(sh.apply(ug))
-multihost.assert_same_on_all_hosts(out, "unstructured offsets")
-erru = float(np.abs(out - uop.apply_np(uu)).max())
-assert erru < 1e-12, f"unstructured offsets deviates by {erru:.3e}"
-print(f"MH-OK p{pid} unstructured err={erru:.2e}", flush=True)
+    rng = np.random.default_rng(0)
+    m = 32
+    h = 1.0 / m
+    gx, gy = np.meshgrid(np.arange(m) * h, np.arange(m) * h, indexing="ij")
+    pts = np.stack([gx.ravel(), gy.ravel()], axis=1)
+    pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+    uop = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-6, vol=h * h)
+    sh = ShardedUnstructuredOp(uop)  # global 1D mesh over all devices
+    assert sh.layout == "offsets", f"expected offsets, got {sh.layout}"
+    uu = rng.normal(size=uop.n)
+    ug = multihost.put_global(uu, NamedSharding(sh.mesh, PartitionSpec()))
+    # eager apply: shard_map passes the op's global weight arrays as runtime
+    # ARGUMENTS; wrapping apply in an outer jit would capture them as
+    # closure constants, which multi-controller JAX rejects (the grid
+    # solvers learned the same lesson in round 3 — sources as jit
+    # arguments, docs/round3.md)
+    out = multihost.fetch_global(sh.apply(ug))
+    multihost.assert_same_on_all_hosts(out, "unstructured offsets")
+    erru = float(np.abs(out - uop.apply_np(uu)).max())
+    assert erru < 1e-12, f"unstructured offsets deviates by {erru:.3e}"
+    print(f"MH-OK p{pid} unstructured err={erru:.2e}", flush=True)
 
-# ...and the full SOLVER loop on the sharded op, multi-controller: state
-# placed via put_global, the op's weight arrays threaded through the jit'd
-# scan as arguments, result fetched with a process all-gather — the
-# manufactured-solution contract must hold in every process
-from nonlocalheatequation_tpu.ops.unstructured import (  # noqa: E402
-    UnstructuredSolver,
-)
+    # ...and the full SOLVER loop on the sharded op, multi-controller:
+    # state placed via put_global, the op's weight arrays threaded through
+    # the jit'd scan as arguments, result fetched with a process
+    # all-gather — the manufactured-solution contract must hold in every
+    # process.  Checkpointing on: the chunked runner + final fetch must
+    # both route through the process all-gather (a plain np.asarray would
+    # raise on a cross-process array); the shared path is keyed by the
+    # coordinator port
+    ck_path = f"/tmp/mh-unstruct-ck-{coord.rsplit(':', 1)[1]}.npz"
+    sol = UnstructuredSolver(sh, nt=3, backend="jit",
+                             checkpoint_path=ck_path, ncheckpoint=2)
+    sol.test_init()
+    us_final = sol.do_work()
+    multihost.assert_same_on_all_hosts(us_final, "unstructured solver")
+    assert sol.error_l2 / uop.n <= 1e-6, f"contract: {sol.error_l2 / uop.n:.3e}"
+    o_sol = UnstructuredSolver(uop, nt=3, backend="oracle")
+    o_sol.test_init()
+    err_sol = float(np.abs(us_final - o_sol.do_work()).max())
+    assert err_sol < 1e-12, f"solver deviates from oracle by {err_sol:.3e}"
+    print(f"MH-OK p{pid} unstructured-solver err={err_sol:.2e}", flush=True)
 
-# checkpointing on: the chunked runner + final fetch must both route
-# through the process all-gather (a plain np.asarray would raise on a
-# cross-process array); the shared path is keyed by the coordinator port
-ck_path = f"/tmp/mh-unstruct-ck-{coord.rsplit(':', 1)[1]}.npz"
-sol = UnstructuredSolver(sh, nt=3, backend="jit",
-                         checkpoint_path=ck_path, ncheckpoint=2)
-sol.test_init()
-us_final = sol.do_work()
-multihost.assert_same_on_all_hosts(us_final, "unstructured solver")
-assert sol.error_l2 / uop.n <= 1e-6, f"contract: {sol.error_l2 / uop.n:.3e}"
-o_sol = UnstructuredSolver(uop, nt=3, backend="oracle")
-o_sol.test_init()
-err_sol = float(np.abs(us_final - o_sol.do_work()).max())
-assert err_sol < 1e-12, f"solver deviates from oracle by {err_sol:.3e}"
-print(f"MH-OK p{pid} unstructured-solver err={err_sol:.2e}", flush=True)
+if "crash2d" in LEGS:
+    # long checkpointed run the parent will SIGKILL mid-flight; nothing
+    # after do_work() is expected to execute
+    d = Solver2DDistributed(16, 16, 1, 1, nt=400, eps=3, k=1.0, dt=1e-4,
+                            dh=1.0 / 16, mesh=make_mesh(2, MY),
+                            checkpoint_path=os.environ["MH_CK"],
+                            ncheckpoint=2)
+    d.test_init()
+    print(f"MH-CRASH-RUNNING p{pid}", flush=True)
+    d.do_work()
+    print(f"MH-UNEXPECTED p{pid} crash leg finished", flush=True)
+
+if "resume2d" in LEGS:
+    # resume the killed job's checkpoint on THIS topology (the process
+    # count and mesh shape need not match the writer's: the checkpoint is
+    # the GLOBAL state, CheckpointMixin validates the physics params) and
+    # run to MH_NT_TOTAL; the full trajectory must match the serial oracle
+    nt_total = int(os.environ["MH_NT_TOTAL"])
+    d = Solver2DDistributed(16, 16, 1, 1, nt=nt_total, eps=3, k=1.0,
+                            dt=1e-4, dh=1.0 / 16, mesh=make_mesh(2, MY))
+    d.test_init()
+    d.resume(os.environ["MH_CK"])
+    assert d.t0 > 0, "resume must continue mid-trajectory, not restart"
+    ur = d.do_work()
+    multihost.assert_same_on_all_hosts(ur, "resumed solution")
+    o = Solver2D(16, 16, nt_total, eps=3, k=1.0, dt=1e-4, dh=1.0 / 16,
+                 backend="oracle")
+    o.test_init()
+    err = float(np.abs(ur - o.do_work()).max())
+    assert err < 1e-12, f"resumed run deviates from oracle by {err:.3e}"
+    print(f"MH-OK p{pid} resume2d t0={d.t0} err={err:.2e}", flush=True)

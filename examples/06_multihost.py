@@ -1,0 +1,91 @@
+"""Multi-controller run: several processes, ONE global device mesh.
+
+The reference scales across nodes with one HPX locality per host
+(``srun -n 4 ...``, /root/reference/README.md:64-72); the TPU-native
+analog is multi-controller JAX — one process per host, every process
+running this same script, wired by ``multihost.init_from_env``.  On a
+real pod each process sees its host's chips and the mesh spans the pod;
+here the script DEMONSTRATES the topology by spawning two controller
+processes on this machine (2 virtual CPU devices each) and solving over
+a 2x2 mesh that crosses the process boundary — the halo exchange rides
+the same cross-process transport a DCN run would.
+
+Run:  python examples/06_multihost.py          (spawns its own 2 ranks)
+
+On a cluster, skip the self-spawn and launch one rank per host yourself —
+the controller body adapts to any process count (``make_mesh()`` spans
+whatever devices the pod exposes):
+
+  COORDINATOR_ADDRESS=host0:1234 JAX_NUM_PROCESSES=4 JAX_PROCESS_ID=$RANK \
+      python examples/06_multihost.py --rank $RANK
+"""
+import os
+import socket
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--rank" not in sys.argv:
+    # parent: allocate a coordinator port and launch one process per rank
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + ["--xla_force_host_platform_device_count=2"])
+        env.update(COORDINATOR_ADDRESS=f"localhost:{port}",
+                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--rank", str(rank)],
+            env=env))
+    try:
+        rcs = [p.wait(timeout=240) for p in procs]
+    finally:
+        for p in procs:  # a hung/failed rank must not orphan its peer
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert rcs == [0, 0], f"controller ranks failed: {rcs}"
+    print("both controllers agreed with the serial oracle")
+    sys.exit(0)
+
+# ---- controller body (one rank of many) ----------------------------------
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # demo runs on virtual CPU devices
+jax.config.update("jax_enable_x64", True)
+
+from nonlocalheatequation_tpu.models.solver2d import Solver2D  # noqa: E402
+from nonlocalheatequation_tpu.parallel import multihost  # noqa: E402
+from nonlocalheatequation_tpu.parallel.distributed2d import (  # noqa: E402
+    Solver2DDistributed,
+)
+from nonlocalheatequation_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+multihost.init_from_env()  # reads COORDINATOR_ADDRESS / JAX_NUM_PROCESSES
+assert jax.process_count() > 1, "meant to be launched as one rank of many"
+
+mesh = make_mesh()  # most-square mesh over ALL processes' devices
+nx, ny = 8 * mesh.shape["x"], 8 * mesh.shape["y"]
+s = Solver2DDistributed(nx, ny, 1, 1, nt=5, eps=3, k=1.0, dt=1e-4,
+                        dh=1.0 / nx, mesh=mesh)
+s.test_init()
+u = s.do_work()  # halo ppermutes cross the process boundary
+
+# every process must hold the identical result (the SPMD contract) ...
+multihost.assert_same_on_all_hosts(u, "solution")
+# ... and it must equal the serial oracle
+o = Solver2D(nx, ny, 5, eps=3, k=1.0, dt=1e-4, dh=1.0 / nx, backend="oracle")
+o.test_init()
+err = float(np.abs(u - o.do_work()).max())
+assert err < 1e-12, err
+if jax.process_index() == 0:  # log from one process (docs/multihost.md)
+    print(f"rank 0 of {jax.process_count()}: max |distributed - oracle| "
+          f"= {err:.2e}")

@@ -203,6 +203,22 @@ def pick_multi_step_fn(op, nsteps: int, shape, dtype):
     if entry is None:
         file_cache = _load_file_cache()
         entry = file_cache.get(key)
+        if entry is not None:
+            # records persisted by OTHER processes with an errored (None)
+            # probe are stripped for candidates that fit this call, so
+            # they are retried once per process: on the flaky tunnel a
+            # probe failure may just have hit a wedge window, and pinning
+            # the variant out for the lifetime of the version key would
+            # mis-tune every future run.  In-process failures (partial,
+            # merged below with precedence) are NOT retried — one failed
+            # compile per process per shape bounds the cost of a
+            # deterministic Mosaic rejection.
+            ms = dict(entry.get("ms_per_step", {}))
+            errored = [n for n in cands if ms.get(n, 0.0) is None]
+            if errored:
+                for n in errored:
+                    del ms[n]
+                entry = {**entry, "ms_per_step": ms}
         if entry is None or not covers(entry):
             # probe ONLY candidates no record exists for (rates are
             # nsteps-invariant, so prior measurements stay valid — on the

@@ -167,6 +167,45 @@ def test_entry_missing_fitting_candidate_triggers_remeasure(monkeypatch):
     assert len(calls) == n_short + 1
 
 
+def test_errored_probe_in_file_cache_is_retried_once_per_process(
+        monkeypatch, tmp_path):
+    """A probe that errored in ANOTHER process (None timing in the file
+    cache) may have hit a transient wedge window — it must be retried
+    once here, not pinned out for the lifetime of the version key.
+    In-process failures stay cached (no same-process retry loop)."""
+    import jax
+
+    from nonlocalheatequation_tpu import __version__
+
+    op = NonlocalOp2D(3, k=1.0, dt=1e-6, dh=1.0 / 48, method="pallas")
+    key = "/".join([
+        f"v{__version__}",
+        jax.devices()[0].device_kind, "pallas", "48x48", "eps3", "float32"])
+    # a prior process measured everything but 'resident' errored there
+    cache_file = tmp_path / "autotune.json"
+    entry = {"winner": "per-step", "ms_per_step": {
+        "per-step": 1.0, "carried": 2.0, "superstep2": 3.0,
+        "superstep3": 4.0, "resident": None,
+        "resident_error": "RuntimeError: transient tunnel drop"}}
+    cache_file.write_text(json.dumps({key: entry}))
+    monkeypatch.setenv("NLHEAT_AUTOTUNE_CACHE", str(cache_file))
+
+    probed = []
+    real = autotune._measure
+    monkeypatch.setattr(
+        autotune, "_measure",
+        lambda maker, op_, shape, dtype:
+        probed.append(shape) or real(maker, op_, shape, dtype))
+    autotune.pick_multi_step_fn(op, 6, (48, 48), jnp.float32)
+    assert len(probed) == 1  # exactly the errored candidate, nothing else
+    rec = json.loads(cache_file.read_text())
+    assert isinstance(rec[key]["ms_per_step"]["resident"], float)
+
+    # same process, same key: no further probing
+    autotune.pick_multi_step_fn(op, 6, (48, 48), jnp.float32)
+    assert len(probed) == 1
+
+
 def test_default_policy_is_backend_gated(monkeypatch):
     """VERDICT r3 #2: autotune is the on-TPU production default.  Unset env
     on CPU must keep the plain base path (tests/CLI smoke unaffected);

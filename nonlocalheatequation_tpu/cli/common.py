@@ -4,7 +4,40 @@ version banner (Config.h parity, Config.h.in:11-13)."""
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+
+
+def init_multihost() -> bool:
+    """Wire the CLI into a multi-controller run when the launch environment
+    says so — the reference's ``srun -n N ./2d_nonlocal_distributed``
+    workflow (README.md:64-72), where every rank runs this same binary.
+    Detection and wiring are ``multihost.init_from_env`` (SLURM task
+    counts, TPU pod workers, COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/
+    JAX_PROCESS_ID); single-process launches are a no-op returning False.
+
+    Must run BEFORE the first backend touch (``apply_platform`` queries
+    ``jax.default_backend()``, which initializes the backend and makes
+    ``jax.distributed.initialize`` refuse).  Non-zero ranks silence
+    stdout: console output belongs to rank 0, matching the reference
+    (``hpx_main`` runs on locality 0 only).
+    """
+    from nonlocalheatequation_tpu.parallel import multihost
+
+    if not multihost.init_from_env():
+        return False
+    import jax
+
+    if jax.process_index() != 0:
+        # fd-level, not just sys.stdout: native transports (gloo) write
+        # C++ chatter straight to fd 1.  Connection-setup lines emitted
+        # DURING initialize() are unavoidable; everything after this
+        # point is rank 0's alone.
+        sys.stdout.flush()
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, 1)
+        os.close(devnull)
+    return True
 
 
 def version_banner(prog: str):
@@ -33,13 +66,23 @@ def add_platform_flags(p: argparse.ArgumentParser):
     )
 
 
-def apply_platform(args):
+def apply_platform_config(args):
+    """The config-only half of :func:`apply_platform`: safe to run before
+    ``init_multihost`` because it never queries the backend (a query
+    initializes it, which both breaks ``jax.distributed.initialize`` and
+    — with ``--platform cpu`` — would touch the ambient TPU first)."""
     import jax
 
     if args.platform:
         # NB: the env var route is unreliable (some PJRT plugins ignore it);
         # the config knob always works.
         jax.config.update("jax_platforms", args.platform)
+
+
+def apply_platform(args):
+    import jax
+
+    apply_platform_config(args)
     x64 = args.x64
     if x64 is None:
         # backend-aware default: f64 off-TPU (oracle-contract precision);

@@ -19,6 +19,7 @@ from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
     apply_platform,
     bool_flag,
+    init_multihost,
     run_batch,
     version_banner,
 )
@@ -78,6 +79,15 @@ def main(argv=None) -> int:
         print("--checkpoint/--resume cannot be combined with --test_batch",
               file=sys.stderr)
         return 1
+    # the srun analog: under a multi-process launch every rank runs this
+    # same CLI; rank 0 owns the console.  Ordering matters: the platform
+    # CONFIG must land before distributed init (so --platform cpu ranks
+    # never touch the ambient TPU), and both must precede the first
+    # backend query (apply_platform's x64 default)
+    from nonlocalheatequation_tpu.cli.common import apply_platform_config
+
+    apply_platform_config(args)
+    multi = init_multihost()
     version_banner("2d_nonlocal_distributed")
     apply_platform(args)
 
@@ -99,6 +109,16 @@ def main(argv=None) -> int:
     # rebalancing.  The plain path stays on the fused SPMD program.
     use_elastic = (assignment is not None or args.nbalance > 0
                    or args.test_load_balance)
+    if use_elastic and multi:
+        # the elastic executor is single-controller by design (its
+        # migration/telemetry loop device_puts tiles from one host-side
+        # view, docs/multihost.md "Scope") — failing loudly beats N ranks
+        # silently running N independent balancers
+        raise SystemExit(
+            "partition maps / --nbalance / --test_load_balance use the "
+            "elastic executor, which is single-controller; run it on one "
+            "process or drop those flags for the SPMD path"
+        )
     if use_elastic and args.superstep > 1:
         # same honesty rule as Solver2DDistributed's nbalance rejection:
         # silently running the per-step elastic path under a --superstep
@@ -175,11 +195,31 @@ def main(argv=None) -> int:
 
         s.logger = SimulationCsvLogger(s.op, test=args.test, tag="2d",
                                        nlog=args.nlog)
+        if multi and jax.process_index() != 0:
+            # all ranks must keep a logger (it shapes the barrier chunking
+            # and runs the collective gather) but only rank 0 may write
+            # the files — N racing writers corrupt them
+            s.logger = lambda t, u: None
     if args.test:
         s.test_init()
     elif not args.resume:
+        if multi and sys.stdin.isatty():
+            # every rank reads its own stdin (srun broadcasts stdin to all
+            # tasks, the reference's own input model) — but a tty rank
+            # would block forever while its peers enter the first
+            # collective; refuse loudly instead of deadlocking
+            raise SystemExit(
+                "multi-process input runs need stdin piped to every rank "
+                "(srun broadcasts by default); use --test/--resume or "
+                "redirect the input file")
         n = nx * npx * ny * npy
         s.input_init(np.array(sys.stdin.read().split(), dtype=np.float64)[:n])
+        if multi:
+            # divergent per-rank input files would silently violate the
+            # SPMD contract; fail on every rank instead
+            from nonlocalheatequation_tpu.parallel import multihost
+
+            multihost.assert_same_on_all_hosts(s.u0, "input state")
     if args.resume:
         s.resume(args.checkpoint)
 

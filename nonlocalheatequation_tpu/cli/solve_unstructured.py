@@ -27,6 +27,7 @@ from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
     apply_platform,
     bool_flag,
+    init_multihost,
     version_banner,
 )
 
@@ -45,8 +46,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--k", type=float, default=1.0)
     p.add_argument("--dt", type=float, default=0.0,
                    help="timestep; 0 = 80%% of the forward-Euler bound")
-    p.add_argument("--devices", type=int, default=1,
-                   help="shard over the first N devices (1 = single device)")
+    p.add_argument("--devices", type=int, default=None,
+                   help="shard over the first N devices (default: 1 single "
+                        "process; the whole pod under a multi-process "
+                        "launch — pass an explicit count to limit)")
     p.add_argument("--halo", default="auto",
                    choices=("auto", "export", "gather"))
     p.add_argument("--layout", default="auto",
@@ -62,10 +65,24 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # the srun analog; platform CONFIG first (so --platform cpu ranks
+    # never touch the ambient TPU during distributed init), then wiring,
+    # then the backend-querying half of apply_platform.  Rank 0 owns the
+    # console.
+    from nonlocalheatequation_tpu.cli.common import apply_platform_config
+
+    apply_platform_config(args)
+    multi = init_multihost()
     version_banner("nlheat_unstructured")
     apply_platform(args)
 
     import jax
+
+    if args.devices is None:
+        # unset (None, not an explicit --devices 1): single device on a
+        # plain launch, the whole pod on a multi-process one — an explicit
+        # count is always honored
+        args.devices = len(jax.devices()) if multi else 1
 
     from nonlocalheatequation_tpu.ops.unstructured import (
         ShardedUnstructuredOp,
@@ -123,8 +140,19 @@ def main(argv=None) -> int:
     if args.test:
         s.test_init()
     else:
+        if multi and sys.stdin.isatty():
+            # same rule as solve2d_distributed: a tty rank would block
+            # forever while its peers enter the first collective
+            raise SystemExit(
+                "multi-process input runs need stdin piped to every rank "
+                "(srun broadcasts by default); use --test or redirect the "
+                "input file")
         s.input_init(
             np.array(sys.stdin.read().split(), dtype=np.float64)[:n])
+        if multi:
+            from nonlocalheatequation_tpu.parallel import multihost
+
+            multihost.assert_same_on_all_hosts(s.u0, "input state")
 
     t0 = time.perf_counter()
     s.do_work()
@@ -139,7 +167,9 @@ def main(argv=None) -> int:
     if args.results:
         for v in s.u:
             print(f"{v:g}")
-    if args.vtu:
+    if args.vtu and (not multi or jax.process_index() == 0):
+        # file output is rank 0's alone (docs/multihost.md "log from one
+        # process"); N racing writers to one path corrupt it
         from nonlocalheatequation_tpu.utils.vtu import write_point_cloud_vtu
 
         write_point_cloud_vtu(args.vtu, pts, {"Temperature": s.u})

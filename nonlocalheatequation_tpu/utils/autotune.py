@@ -218,6 +218,10 @@ def pick_multi_step_fn(op, nsteps: int, shape, dtype):
             if errored:
                 for n in errored:
                     del ms[n]
+                    # the companion error string is stale the moment the
+                    # retry runs — a successful retry must not persist a
+                    # candidate both timed and errored
+                    ms.pop(f"{n}_error", None)
                 entry = {**entry, "ms_per_step": ms}
         if entry is None or not covers(entry):
             # probe ONLY candidates no record exists for (rates are

@@ -26,6 +26,8 @@ from nonlocalheatequation_tpu.parallel import multihost
 from nonlocalheatequation_tpu.parallel.mesh import make_mesh
 from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
 
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def test_init_from_env_noop_single_process(monkeypatch):
     for var in ("COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "SLURM_NTASKS",
@@ -86,6 +88,17 @@ def _free_port():
         return s.getsockname()[1]
 
 
+def _controller_env(local_devices, extra_env=None):
+    """The one launch-environment recipe every loopback spawn shares:
+    ambient env, ``local_devices`` virtual CPU devices, extra vars."""
+    env = dict(os.environ, **(extra_env or {}))
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={local_devices}"])
+    return env
+
+
 def _spawn_controllers(port, dev_counts, extra_env=None):
     """One child per entry of ``dev_counts`` (its local device count —
     UNEVEN splits welcome); returns the Popen list."""
@@ -94,11 +107,7 @@ def _spawn_controllers(port, dev_counts, extra_env=None):
     ndev = sum(dev_counts)
     procs = []
     for pid, local in enumerate(dev_counts):
-        env = dict(os.environ, **(extra_env or {}))
-        flags = [f for f in env.get("XLA_FLAGS", "").split()
-                 if "device_count" not in f]
-        env["XLA_FLAGS"] = " ".join(
-            flags + [f"--xla_force_host_platform_device_count={local}"])
+        env = _controller_env(local, extra_env)
         env["MH_NDEV"] = str(ndev)
         procs.append(subprocess.Popen(
             [sys.executable, child, f"localhost:{port}", str(nproc),
@@ -110,16 +119,20 @@ def _spawn_controllers(port, dev_counts, extra_env=None):
 
 
 def _harvest(procs, timeout=240):
+    """Collect each child's stdout; when stderr is a separate pipe it is
+    preserved on the Popen (``p.stderr_text``) so failure diagnostics
+    survive even though the silence assertions need stdout pure."""
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=timeout)
+            out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             # drain whatever the child printed before hanging — the only
             # diagnostics a distributed-init flake leaves behind — and reap
             p.kill()
-            out, _ = p.communicate()
+            out, err = p.communicate()
             out = (out or "") + f"\n[parent] killed after {timeout}s timeout"
+        p.stderr_text = err or ""
         outs.append(out)
     for p in procs:
         if p.poll() is None:
@@ -189,6 +202,45 @@ def test_uneven_device_split_loopback():
         assert f"MH-OK p{pid} unstructured-solver" in out
 
 
+def test_cli_runs_multicontroller_like_srun():
+    """The reference's flagship workflow is ``srun -n N
+    ./2d_nonlocal_distributed`` — every rank runs the SAME binary
+    (README.md:64-72).  Our CLI must do the same: launched as two
+    processes with the standard env wiring (COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID — also the only coverage of
+    init_from_env's env-var path), it solves over a process-spanning
+    mesh, rank 0 owns the console, and non-zero ranks stay silent."""
+    port = _free_port()
+    procs = []
+    for pid, local in enumerate([2, 2]):
+        env = _controller_env(local, {
+            "COORDINATOR_ADDRESS": f"localhost:{port}",
+            "JAX_NUM_PROCESSES": "2", "JAX_PROCESS_ID": str(pid)})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m",
+             "nonlocalheatequation_tpu.cli.solve2d_distributed",
+             "--nx", "8", "--ny", "8", "--npx", "2", "--npy", "2",
+             "--nt", "5", "--eps", "3", "--dt", "0.0005", "--dh", "0.02",
+             "--platform", "cpu"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO_DIR,
+        ))
+    outs = _harvest(procs, timeout=180)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"rank {pid} failed:\n{out[-1500:]}\n[stderr]\n"
+            f"{p.stderr_text[-1500:]}")
+    assert "2d_nonlocal_distributed" in outs[0]  # banner
+    assert "Localities" in outs[0]  # the timing footer reached rank 0
+    assert "l2:" in outs[0]  # ... and the error report
+    # rank 1 may only emit transport connection chatter (C++ lines printed
+    # DURING jax.distributed.initialize, before the rank is known); every
+    # framework line belongs to rank 0
+    noise = [ln for ln in outs[1].splitlines()
+             if ln.strip() and not ln.startswith("[Gloo]")]
+    assert noise == [], f"rank 1 printed to stdout:\n{noise[:5]}"
+
+
 def test_assert_same_detects_divergence():
     """The determinism checker must FAIL when hosts hold different values
     (a checker that can only pass proves nothing) — here under an uneven
@@ -208,19 +260,13 @@ def test_assert_same_detects_divergence():
         "    print('RAISED-OK')\n"
     )
     port = _free_port()
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     procs = []
     for pid, local in enumerate([1, 2]):
-        env = dict(os.environ)
-        flags = [f for f in env.get("XLA_FLAGS", "").split()
-                 if "device_count" not in f]
-        env["XLA_FLAGS"] = " ".join(
-            flags + [f"--xla_force_host_platform_device_count={local}"])
         procs.append(subprocess.Popen(
             [sys.executable, "-c", code, f"localhost:{port}", "2", str(pid),
-             repo],
+             REPO_DIR],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env,
+            env=_controller_env(local),
         ))
     outs = _harvest(procs, timeout=120)
     for pid, out in enumerate(outs):

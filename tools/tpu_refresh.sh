@@ -81,10 +81,12 @@ run bench-superstep env BENCH_SUPERSTEP=2 BENCH_GRID=4096 BENCH_LADDER=4096 \
 run sanity python tools/tpu_sanity.py
 
 # 4. full table: methods (+autotuned row), small-grid resident A/B, dist,
-# 3d, unstructured 2D+3D (+sharded halos incl. offsets), elastic+gang
+# 3d, unstructured 2D+3D (+sharded halos incl. offsets), elastic+gang,
+# and the autotune-default validation (per-candidate probe rates +
+# tuned-vs-per-step A/B at the flagship shapes, VERDICT r4 #2)
 run table env BT_STEPS=200 python tools/bench_table.py \
     methods2d small2d dist2d scaling 3d unstructured unstructured3d \
-    elastic elastic-general eps-sweep
+    elastic elastic-general eps-sweep autotune
 
 # 5. profiler trace of the headline rung
 run profile env BENCH_PROFILE=docs/bench/profile_r03b python bench.py

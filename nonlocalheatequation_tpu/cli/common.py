@@ -113,14 +113,47 @@ def bool_flag(p: argparse.ArgumentParser, name: str, default: bool, help: str):
     )
 
 
-def run_batch(read_case, run_case, threshold=1e-6):
+def guard_multihost_stdin(multi: bool) -> None:
+    """Multi-process stdin rule, shared by every input-reading CLI path:
+    each rank reads its own stdin (srun broadcasts it to all tasks by
+    default — the reference's own input model), but a tty rank would
+    block forever while its peers enter the first collective.  Refuse
+    loudly instead of deadlocking."""
+    if multi and sys.stdin.isatty():
+        raise SystemExit(
+            "multi-process input runs need stdin piped to every rank "
+            "(srun broadcasts by default); use --test/--resume or "
+            "redirect the input file")
+
+
+def check_same_input_state(multi: bool, u0) -> None:
+    """Divergent per-rank input files would silently violate the SPMD
+    contract; fail on every rank instead."""
+    if multi:
+        from nonlocalheatequation_tpu.parallel import multihost
+
+        multihost.assert_same_on_all_hosts(u0, "input state")
+
+
+def run_batch(read_case, run_case, threshold=1e-6, multi=False):
     """The reference's batch_tester protocol (1d_nonlocal_serial.cpp:239-266):
     stdin = num_tests then one parameter row per test; prints "Tests Passed"
     or "Tests Failed" (the ctest pass/fail regex).
 
     ``read_case(tokens)`` parses one row; ``run_case(case) -> (error_l2, n)``.
+    Under a multi-process launch (``multi=True``) the stdin rules apply:
+    tty refusal, and the token stream must be identical on every rank.
     """
+    guard_multihost_stdin(multi)
     tokens = sys.stdin.read().split()
+    if multi:
+        import numpy as np
+
+        from nonlocalheatequation_tpu.parallel import multihost
+
+        multihost.assert_same_on_all_hosts(
+            np.frombuffer(" ".join(tokens).encode(), dtype=np.uint8),
+            "batch input")
     num_tests = int(tokens[0])
     pos = 1
     failed = False

@@ -19,6 +19,8 @@ from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
     apply_platform,
     bool_flag,
+    check_same_input_state,
+    guard_multihost_stdin,
     init_multihost,
     run_batch,
     version_banner,
@@ -187,7 +189,7 @@ def main(argv=None) -> int:
             s.do_work()
             return s.error_l2, cnx * cny * cnpx * cnpy
 
-        return run_batch(read_case, run_case)
+        return run_batch(read_case, run_case, multi=multi)
 
     s = make_solver(nx, ny, npx, npy, args.nt, args.eps, args.k, args.dt, dh)
     if args.log:
@@ -203,23 +205,10 @@ def main(argv=None) -> int:
     if args.test:
         s.test_init()
     elif not args.resume:
-        if multi and sys.stdin.isatty():
-            # every rank reads its own stdin (srun broadcasts stdin to all
-            # tasks, the reference's own input model) — but a tty rank
-            # would block forever while its peers enter the first
-            # collective; refuse loudly instead of deadlocking
-            raise SystemExit(
-                "multi-process input runs need stdin piped to every rank "
-                "(srun broadcasts by default); use --test/--resume or "
-                "redirect the input file")
+        guard_multihost_stdin(multi)
         n = nx * npx * ny * npy
         s.input_init(np.array(sys.stdin.read().split(), dtype=np.float64)[:n])
-        if multi:
-            # divergent per-rank input files would silently violate the
-            # SPMD contract; fail on every rank instead
-            from nonlocalheatequation_tpu.parallel import multihost
-
-            multihost.assert_same_on_all_hosts(s.u0, "input state")
+        check_same_input_state(multi, s.u0)
     if args.resume:
         s.resume(args.checkpoint)
 

@@ -16,7 +16,11 @@ import numpy as np
 from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
     apply_platform,
+    apply_platform_config,
     bool_flag,
+    check_same_input_state,
+    guard_multihost_stdin,
+    init_multihost,
     run_batch,
     version_banner,
 )
@@ -75,6 +79,15 @@ def main(argv=None) -> int:
               "backend (use the serial oracle for ground truth)",
               file=sys.stderr)
         return 1
+    # the srun analog (see solve2d_distributed): platform config before
+    # distributed init, both before the first backend query; rank 0 owns
+    # the console
+    apply_platform_config(args)
+    multi = init_multihost()
+    if multi and not args.distributed:
+        raise SystemExit(
+            "a multi-process launch needs --distributed (the serial "
+            "backends would run N independent solves)")
     version_banner("3d_nonlocal")
     apply_platform(args)
 
@@ -110,15 +123,17 @@ def main(argv=None) -> int:
             s.do_work()
             return s.error_l2, nx * ny * nz
 
-        return run_batch(read_case, run_case)
+        return run_batch(read_case, run_case, multi=multi)
 
     s = make_solver(args.nx, args.ny, args.nz, args.nt, args.eps, args.k,
                     args.dt, args.dh)
     if args.test:
         s.test_init()
     elif not args.resume:
+        guard_multihost_stdin(multi)
         n = args.nx * args.ny * args.nz
         s.input_init(np.array(sys.stdin.read().split(), dtype=np.float64)[:n])
+        check_same_input_state(multi, s.u0)
     if args.resume:
         s.resume(args.checkpoint)
 

@@ -27,6 +27,8 @@ from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
     apply_platform,
     bool_flag,
+    check_same_input_state,
+    guard_multihost_stdin,
     init_multihost,
     version_banner,
 )
@@ -140,19 +142,10 @@ def main(argv=None) -> int:
     if args.test:
         s.test_init()
     else:
-        if multi and sys.stdin.isatty():
-            # same rule as solve2d_distributed: a tty rank would block
-            # forever while its peers enter the first collective
-            raise SystemExit(
-                "multi-process input runs need stdin piped to every rank "
-                "(srun broadcasts by default); use --test or redirect the "
-                "input file")
+        guard_multihost_stdin(multi)
         s.input_init(
             np.array(sys.stdin.read().split(), dtype=np.float64)[:n])
-        if multi:
-            from nonlocalheatequation_tpu.parallel import multihost
-
-            multihost.assert_same_on_all_hosts(s.u0, "input state")
+        check_same_input_state(multi, s.u0)
 
     t0 = time.perf_counter()
     s.do_work()

@@ -19,6 +19,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -202,13 +203,23 @@ def test_uneven_device_split_loopback():
         assert f"MH-OK p{pid} unstructured-solver" in out
 
 
-def test_cli_runs_multicontroller_like_srun():
+@pytest.mark.parametrize("cli_args, banner, footer", [
+    (["nonlocalheatequation_tpu.cli.solve2d_distributed",
+      "--nx", "8", "--ny", "8", "--npx", "2", "--npy", "2",
+      "--nt", "5", "--eps", "3", "--dt", "0.0005", "--dh", "0.02"],
+     "2d_nonlocal_distributed", "Localities"),
+    (["nonlocalheatequation_tpu.cli.solve3d", "--distributed", "--test",
+      "--nx", "8", "--ny", "8", "--nz", "8", "--nt", "2", "--eps", "2",
+      "--dt", "0.0001", "--dh", "0.05"],
+     "3d_nonlocal", "z dimension"),
+])
+def test_cli_runs_multicontroller_like_srun(cli_args, banner, footer):
     """The reference's flagship workflow is ``srun -n N
     ./2d_nonlocal_distributed`` — every rank runs the SAME binary
-    (README.md:64-72).  Our CLI must do the same: launched as two
+    (README.md:64-72).  Our CLIs must do the same: launched as two
     processes with the standard env wiring (COORDINATOR_ADDRESS /
     JAX_NUM_PROCESSES / JAX_PROCESS_ID — also the only coverage of
-    init_from_env's env-var path), it solves over a process-spanning
+    init_from_env's env-var path), they solve over a process-spanning
     mesh, rank 0 owns the console, and non-zero ranks stay silent."""
     port = _free_port()
     procs = []
@@ -217,11 +228,7 @@ def test_cli_runs_multicontroller_like_srun():
             "COORDINATOR_ADDRESS": f"localhost:{port}",
             "JAX_NUM_PROCESSES": "2", "JAX_PROCESS_ID": str(pid)})
         procs.append(subprocess.Popen(
-            [sys.executable, "-m",
-             "nonlocalheatequation_tpu.cli.solve2d_distributed",
-             "--nx", "8", "--ny", "8", "--npx", "2", "--npy", "2",
-             "--nt", "5", "--eps", "3", "--dt", "0.0005", "--dh", "0.02",
-             "--platform", "cpu"],
+            [sys.executable, "-m", *cli_args, "--platform", "cpu"],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd=REPO_DIR,
         ))
@@ -230,9 +237,9 @@ def test_cli_runs_multicontroller_like_srun():
         assert p.returncode == 0, (
             f"rank {pid} failed:\n{out[-1500:]}\n[stderr]\n"
             f"{p.stderr_text[-1500:]}")
-    assert "2d_nonlocal_distributed" in outs[0]  # banner
-    assert "Localities" in outs[0]  # the timing footer reached rank 0
-    assert "l2:" in outs[0]  # ... and the error report
+    assert banner in outs[0]
+    assert "l2:" in outs[0]  # the error report reached rank 0
+    assert footer in outs[0]  # ... and the right CLI's timing footer
     # rank 1 may only emit transport connection chatter (C++ lines printed
     # DURING jax.distributed.initialize, before the rank is known); every
     # framework line belongs to rank 0

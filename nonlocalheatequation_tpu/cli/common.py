@@ -113,6 +113,29 @@ def bool_flag(p: argparse.ArgumentParser, name: str, default: bool, help: str):
     )
 
 
+def cli_startup(args, prog: str, validate_multi=None) -> bool:
+    """The ordering-sensitive CLI prologue, in one place: platform CONFIG
+    (so a ``--platform cpu`` rank never touches the ambient TPU) ->
+    multi-controller wiring -> ``validate_multi(multi)`` if given (a
+    launch-mode check that must FAIL before the backend query below can
+    touch — and possibly wedge — the ambient TPU) -> version banner
+    (rank 0 only — non-zero ranks are silenced by then) -> the
+    backend-querying half of :func:`apply_platform`.  Returns
+    ``init_multihost``'s result.
+
+    Three CLIs share this sequence and each step's position is
+    load-bearing (see the docstrings above); a new CLI should call this
+    rather than re-derive the order.
+    """
+    apply_platform_config(args)
+    multi = init_multihost()
+    if validate_multi is not None:
+        validate_multi(multi)
+    version_banner(prog)
+    apply_platform(args)
+    return multi
+
+
 def guard_multihost_stdin(multi: bool) -> None:
     """Multi-process stdin rule, shared by every input-reading CLI path:
     each rank reads its own stdin (srun broadcasts it to all tasks by

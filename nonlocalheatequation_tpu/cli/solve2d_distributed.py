@@ -17,13 +17,11 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
-    apply_platform,
     bool_flag,
     check_same_input_state,
+    cli_startup,
     guard_multihost_stdin,
-    init_multihost,
     run_batch,
-    version_banner,
 )
 
 
@@ -82,16 +80,9 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     # the srun analog: under a multi-process launch every rank runs this
-    # same CLI; rank 0 owns the console.  Ordering matters: the platform
-    # CONFIG must land before distributed init (so --platform cpu ranks
-    # never touch the ambient TPU), and both must precede the first
-    # backend query (apply_platform's x64 default)
-    from nonlocalheatequation_tpu.cli.common import apply_platform_config
-
-    apply_platform_config(args)
-    multi = init_multihost()
-    version_banner("2d_nonlocal_distributed")
-    apply_platform(args)
+    # same CLI; rank 0 owns the console (cli_startup holds the
+    # load-bearing ordering)
+    multi = cli_startup(args, "2d_nonlocal_distributed")
 
     import jax
 

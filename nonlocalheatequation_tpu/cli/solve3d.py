@@ -15,14 +15,11 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
-    apply_platform,
-    apply_platform_config,
     bool_flag,
     check_same_input_state,
+    cli_startup,
     guard_multihost_stdin,
-    init_multihost,
     run_batch,
-    version_banner,
 )
 
 
@@ -79,17 +76,16 @@ def main(argv=None) -> int:
               "backend (use the serial oracle for ground truth)",
               file=sys.stderr)
         return 1
-    # the srun analog (see solve2d_distributed): platform config before
-    # distributed init, both before the first backend query; rank 0 owns
-    # the console
-    apply_platform_config(args)
-    multi = init_multihost()
-    if multi and not args.distributed:
-        raise SystemExit(
-            "a multi-process launch needs --distributed (the serial "
-            "backends would run N independent solves)")
-    version_banner("3d_nonlocal")
-    apply_platform(args)
+    # the srun analog (cli_startup holds the load-bearing ordering); the
+    # launch-mode check runs via the hook so a misconfigured launch dies
+    # BEFORE the backend query can touch the ambient TPU
+    def _need_distributed(multi):
+        if multi and not args.distributed:
+            raise SystemExit(
+                "a multi-process launch needs --distributed (the serial "
+                "backends would run N independent solves)")
+
+    multi = cli_startup(args, "3d_nonlocal", validate_multi=_need_distributed)
 
     from nonlocalheatequation_tpu.models.solver3d import Solver3D
 

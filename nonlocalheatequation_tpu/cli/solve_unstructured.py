@@ -25,12 +25,10 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
-    apply_platform,
     bool_flag,
     check_same_input_state,
+    cli_startup,
     guard_multihost_stdin,
-    init_multihost,
-    version_banner,
 )
 
 
@@ -67,16 +65,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    # the srun analog; platform CONFIG first (so --platform cpu ranks
-    # never touch the ambient TPU during distributed init), then wiring,
-    # then the backend-querying half of apply_platform.  Rank 0 owns the
-    # console.
-    from nonlocalheatequation_tpu.cli.common import apply_platform_config
-
-    apply_platform_config(args)
-    multi = init_multihost()
-    version_banner("nlheat_unstructured")
-    apply_platform(args)
+    # the srun analog (cli_startup holds the load-bearing ordering)
+    multi = cli_startup(args, "nlheat_unstructured")
 
     import jax
 

@@ -81,8 +81,23 @@ def main(argv=None) -> int:
         return 1
     # the srun analog: under a multi-process launch every rank runs this
     # same CLI; rank 0 owns the console (cli_startup holds the
-    # load-bearing ordering)
-    multi = cli_startup(args, "2d_nonlocal_distributed")
+    # load-bearing ordering).  The elastic-executor flags are argv-only,
+    # so the single-controller check runs via the hook — BEFORE the
+    # backend query can touch (and possibly wedge) the ambient TPU
+    def _no_elastic_multi(multi):
+        if multi and (args.file != "None" or args.nbalance > 0
+                      or args.test_load_balance):
+            # the elastic executor is single-controller by design (its
+            # migration/telemetry loop device_puts tiles from one
+            # host-side view, docs/multihost.md "Scope") — failing loudly
+            # beats N ranks silently running N independent balancers
+            raise SystemExit(
+                "partition maps / --nbalance / --test_load_balance use "
+                "the elastic executor, which is single-controller; run "
+                "it on one process or drop those flags for the SPMD path")
+
+    multi = cli_startup(args, "2d_nonlocal_distributed",
+                        validate_multi=_no_elastic_multi)
 
     import jax
 
@@ -102,25 +117,12 @@ def main(argv=None) -> int:
     # rebalancing.  The plain path stays on the fused SPMD program.
     use_elastic = (assignment is not None or args.nbalance > 0
                    or args.test_load_balance)
-    if use_elastic and multi:
-        # the elastic executor is single-controller by design (its
-        # migration/telemetry loop device_puts tiles from one host-side
-        # view, docs/multihost.md "Scope") — failing loudly beats N ranks
-        # silently running N independent balancers
-        raise SystemExit(
-            "partition maps / --nbalance / --test_load_balance use the "
-            "elastic executor, which is single-controller; run it on one "
-            "process or drop those flags for the SPMD path"
-        )
-    if use_elastic and args.superstep > 1:
-        # same honesty rule as Solver2DDistributed's nbalance rejection:
-        # silently running the per-step elastic path under a --superstep
-        # flag would misattribute its behavior
-        raise SystemExit(
-            "--superstep is not supported on the elastic executor path "
-            "(partition maps / --nbalance / --test_load_balance exchange "
-            "per step); drop --superstep or the elastic-selecting flags"
-        )
+    # --superstep on the elastic path: gang stretches exchange one
+    # K*eps-wide halo per K steps (gang.make_gang_run_superstep — the
+    # communication-avoiding schedule under arbitrary placement); measured
+    # windows keep the per-step dispatch.  ElasticSolver2D itself refuses
+    # configurations where the schedule cannot engage (K*eps > tile edge),
+    # so the flag is never silently a no-op.
 
     if nx <= args.eps:
         print("[WARNING] Mesh size on a single node (nx * ny) is too small "
@@ -147,6 +149,7 @@ def main(argv=None) -> int:
                 assignment=place, devices=devices, method=args.method,
                 checkpoint_path=args.checkpoint,
                 ncheckpoint=args.ncheckpoint,
+                superstep=args.superstep,
             )
             if args.test_load_balance:
                 s.measure = True  # report measured rates even without nbalance

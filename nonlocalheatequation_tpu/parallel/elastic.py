@@ -97,6 +97,7 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         checkpoint_path: str | None = None,
         ncheckpoint: int = 0,
         measure_window: int | None = None,
+        superstep: int = 1,
     ):
         self.nx, self.ny, self.npx, self.npy = int(nx), int(ny), int(npx), int(npy)
         self.NX, self.NY = self.nx * self.npx, self.ny * self.npy
@@ -151,6 +152,21 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         # all devices (parallel/gang.py); numerics are bit-identical to the
         # per-device batched path.  Opt out for the pure per-step dispatch.
         self.use_gang = True
+        # superstep K > 1: gang stretches exchange ONE K*eps-wide halo per
+        # K steps (gang.make_gang_run_superstep — the SPMD solver's
+        # communication-avoiding schedule under arbitrary placement).
+        # Measured windows keep the per-step dispatch (the per-device
+        # wall-clock sample IS the capability there), as do remainder
+        # steps.  Honesty: refuse configurations where the schedule cannot
+        # engage rather than silently running per-step under the flag.
+        self.ksteps = max(1, int(superstep))
+        if self.ksteps > 1 and (
+                self.ksteps * self.eps > min(self.nx, self.ny)):
+            raise ValueError(
+                f"superstep {self.ksteps} needs ksteps*eps <= tile edge "
+                f"({self.ksteps}*{self.eps} > {min(self.nx, self.ny)}): "
+                "the gang band assembly draws the whole halo from the 8 "
+                "immediate neighbors")
         self._gang = None
         self._gang_active = False
         self._batched_test = jax.jit(self._make_batched(test=True))
@@ -579,6 +595,23 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
             self._use_fused
             or (self.NX * self.NY <= (1 << 24)
                 and window_elems <= (1 << 25)))
+        if self.ksteps > 1 and not use_gang:
+            # same honesty rule as the CLI's old refusal: the per-step
+            # dispatch must never run under a flag claiming the
+            # communication-avoiding schedule
+            raise RuntimeError(
+                "superstep > 1 requires the gang executor (use_gang was "
+                "opted out or the general-regime footprint gate rejected "
+                "it); drop superstep or re-enable gang scheduling")
+        if self.ksteps > 1 and measured and not self.nbalance:
+            # measure-everything mode (measure=True with no rebalance
+            # cadence, e.g. --test_load_balance alone): every step is a
+            # measured window, no gang stretch ever forms, and the
+            # schedule would silently never engage
+            raise RuntimeError(
+                "superstep > 1 cannot engage when every step is a "
+                "measured window (measure=True without nbalance); add a "
+                "rebalance cadence or drop superstep")
         if use_gang and self._gang is None:
             # created once per solver: jit keys on shapes, so repeated
             # do_work calls (and T_max changes) reuse/retrace automatically

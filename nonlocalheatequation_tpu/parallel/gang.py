@@ -94,14 +94,17 @@ class GangPlan:
                 for j, key in enumerate(own)}
 
 
-def _make_run_driver(op, mesh: Mesh, local_step, aux_specs, test: bool):
-    """Shared shard_map + jit + fori_loop driver for both gang regimes.
+def _make_run_driver(op, mesh: Mesh, local_step, aux_specs, test: bool,
+                     t_stride: int = 1):
+    """Shared shard_map + jit + fori_loop driver for every gang regime.
 
     ``local_step(own, *aux, [g, lg,] t)`` sees per-device local views; aux
     arguments are described by ``aux_specs`` (P("d") entries arrive with the
     leading device axis stripped, P() entries replicated as-is).  The
-    returned run is (state, *aux, [g, lg,] t0, nsteps) -> state; nsteps is
-    traced, so one compile serves every stretch length.
+    returned run is (state, *aux, [g, lg,] t0, niter) -> state; niter is
+    traced, so one compile serves every stretch length.  ``t_stride`` is
+    how many TIMESTEPS one ``local_step`` call advances (K for the
+    superstep program): iteration i sees t = t0 + i*t_stride.
     """
     spec = P("d")
     n_aux = len(aux_specs)
@@ -124,14 +127,14 @@ def _make_run_driver(op, mesh: Mesh, local_step, aux_specs, test: bool):
     def run(state, *args):
         aux = args[: n_aux]
         if test:
-            g, lg, t0, nsteps = args[n_aux:]
+            g, lg, t0, niter = args[n_aux:]
             def body(i, carry):
-                return sharded_step(carry, *aux, g, lg, t0 + i)
+                return sharded_step(carry, *aux, g, lg, t0 + i * t_stride)
         else:
-            t0, nsteps = args[n_aux:]
+            t0, niter = args[n_aux:]
             def body(i, carry):
-                return sharded_step(carry, *aux, t0 + i)
-        return lax.fori_loop(0, nsteps, body, state)
+                return sharded_step(carry, *aux, t0 + i * t_stride)
+        return lax.fori_loop(0, niter, body, state)
 
     return run
 
@@ -182,6 +185,108 @@ def make_gang_run(op, mesh: Mesh, nx: int, ny: int, test: bool, dtype):
 
     return _make_run_driver(op, mesh, local_step, aux_specs=(P("d"),),
                             test=test)
+
+
+def make_gang_run_superstep(op, mesh: Mesh, nx: int, ny: int,
+                            NX: int, NY: int, test: bool, dtype,
+                            ksteps: int):
+    """Communication-avoiding gang run: ONE K*eps-wide band exchange per K
+    steps, under ARBITRARY tile placement.
+
+    The same superstep schedule Solver2DDistributed runs on its block
+    layout (one wide halo, then K local levels on shrinking regions with
+    the volumetric BC pinned on intermediates — distributed2d.py
+    ``_superstep``), applied to the gang slot arrays: the banded
+    all_gather of :func:`make_gang_run` widens from eps to K*eps bands
+    (legal while K*eps <= tile edge — the halo then still comes from the
+    8 immediate neighbors), and each tile advances K steps per exchange,
+    vmapped over slots.  Collective rounds drop K-fold — the elastic
+    executor's flagship scenario (METIS map + ``--nbalance``,
+    /root/reference/src/2d_nonlocal_distributed.cpp:1306-1309) gets the
+    same comm avoidance the SPMD solver's ``--superstep`` provides.
+
+    Numerics: identical schedule to the SPMD superstep, so the contract
+    is the same — 1e-12-close to the per-step paths (the level order
+    differs from per-step rounding), manufactured contract vs the serial
+    oracle.  One call advances K timesteps; the driver's ``t_stride=K``
+    keeps the source times honest.
+    """
+    e = op.eps
+    K = int(ksteps)
+    E = K * e
+    if E > nx or E > ny:
+        raise ValueError("gang superstep requires ksteps*eps <= tile edge")
+    r = (K - 1) * e  # the source ring intermediates consume
+    if test:
+        from nonlocalheatequation_tpu.ops.nonlocal_op import source_at
+
+    def tile_block(Pk, gx, gy, t, gp=None, lgp=None):
+        # Pk: (nx+2E, ny+2E) one tile with its K*eps halo; gp/lgp: the
+        # tile's sources pre-padded with the r-ring (built at rebuild)
+        for j in range(1, K + 1):
+            m = (K - j) * e  # margin beyond the tile this level keeps
+            du = op.apply_padded(Pk)
+            if test:
+                o = r - m
+                gs = lax.slice(gp, (o, o), (o + nx + 2 * m, o + ny + 2 * m))
+                lgs = lax.slice(lgp, (o, o),
+                                (o + nx + 2 * m, o + ny + 2 * m))
+                du = du + source_at(gs, lgs, t + (j - 1), op.dt)
+            center = lax.slice(Pk, (e, e), (e + nx + 2 * m, e + ny + 2 * m))
+            nxt = center + jnp.asarray(op.dt, dtype) * du
+            if j < K:
+                # volumetric BC on intermediates: collar cells outside the
+                # global domain stay zero at every time (same rule and the
+                # same optimization_barrier ulp-pinning as the SPMD
+                # superstep, distributed2d.py)
+                rows = (gx * nx - m) + lax.broadcasted_iota(
+                    jnp.int32, nxt.shape, 0)
+                cols = (gy * ny - m) + lax.broadcasted_iota(
+                    jnp.int32, nxt.shape, 1)
+                ok = ((rows >= 0) & (rows < NX)
+                      & (cols >= 0) & (cols < NY))
+                nxt = jnp.where(ok, nxt, jnp.zeros_like(nxt))
+                nxt = lax.optimization_barrier(nxt)
+            Pk = nxt
+        return Pk
+
+    def local_step(own, idx, txy, *rest):
+        # own: (T_max, nx, ny); idx: (T_max, 9); txy: (T_max, 2) — the
+        # tile coords the volumetric mask needs (pad slots are (0, 0):
+        # their state, bands, and sources are all zero, and zero stays
+        # zero through every level)
+        top_all = lax.all_gather(own[:, :E, :], "d", axis=0, tiled=True)
+        bot_all = lax.all_gather(own[:, -E:, :], "d", axis=0, tiled=True)
+        left_all = lax.all_gather(own[:, :, :E], "d", axis=0, tiled=True)
+        right_all = lax.all_gather(own[:, :, -E:], "d", axis=0, tiled=True)
+        zt = jnp.zeros((1, E, ny), dtype)
+        zlr = jnp.zeros((1, nx, E), dtype)
+        top_all = jnp.concatenate([top_all, zt])
+        bot_all = jnp.concatenate([bot_all, zt])
+        left_all = jnp.concatenate([left_all, zlr])
+        right_all = jnp.concatenate([right_all, zlr])
+        top = jnp.concatenate(
+            [bot_all[idx[:, 0]][:, :, -E:], bot_all[idx[:, 1]],
+             bot_all[idx[:, 2]][:, :, :E]], axis=2)
+        mid = jnp.concatenate(
+            [right_all[idx[:, 3]], own, left_all[idx[:, 5]]], axis=2)
+        bot = jnp.concatenate(
+            [top_all[idx[:, 6]][:, :, -E:], top_all[idx[:, 7]],
+             top_all[idx[:, 8]][:, :, :E]], axis=2)
+        upad = jnp.concatenate([top, mid, bot], axis=1)
+        if test:
+            gp, lgp, t = rest
+            return jax.vmap(
+                lambda P, xy, g_, lg_: tile_block(P, xy[0], xy[1], t,
+                                                  g_, lg_)
+            )(upad, txy, gp, lgp)
+        (t,) = rest
+        return jax.vmap(
+            lambda P, xy: tile_block(P, xy[0], xy[1], t))(upad, txy)
+
+    return _make_run_driver(op, mesh, local_step,
+                            aux_specs=(P("d"), P("d")), test=test,
+                            t_stride=K)
 
 
 def make_gang_run_general(op, mesh: Mesh, npx: int, npy: int,
@@ -261,25 +366,80 @@ class GangExecutor:
         self._state = jax.device_put(
             plan.pack(tiles, s.nx, s.ny, np_dtype), sh)
         self._idx = jax.device_put(plan.idx, sh)
-        if not s._use_fused:
-            # general (eps > tile) plan: global position->slot map +
-            # per-slot tile coords (pad slots pinned to (0, 0))
-            pos = np.zeros((s.npx, s.npy), np.int32)
+        ksteps = getattr(s, "ksteps", 1)
+        if not s._use_fused or ksteps > 1:
+            # per-slot tile coords (pad slots pinned to (0, 0)): the
+            # general regime's reassembly index, and the superstep
+            # program's volumetric-mask offsets
             txy = np.zeros((plan.ndev, plan.t_max, 2), np.int32)
             for d, own in plan.order.items():
                 for j, (gx, gy) in enumerate(own):
-                    pos[gx, gy] = d * plan.t_max + j
                     txy[d, j] = (gx, gy)
-            self._pos_idx = jnp.asarray(pos)  # replicated (P() spec)
             self._txy = jax.device_put(txy, sh)
+        if not s._use_fused:
+            # general (eps > tile) plan: global position->slot map
+            pos = np.zeros((s.npx, s.npy), np.int32)
+            for d, own in plan.order.items():
+                for j, (gx, gy) in enumerate(own):
+                    pos[gx, gy] = d * plan.t_max + j
+            self._pos_idx = jnp.asarray(pos)  # replicated (P() spec)
         if s.test and gtiles is not None:
             g = {k: v[0] for k, v in gtiles.items()}
             lg = {k: v[1] for k, v in gtiles.items()}
             self._g = jax.device_put(plan.pack(g, s.nx, s.ny, np_dtype), sh)
             self._lg = jax.device_put(plan.pack(lg, s.nx, s.ny, np_dtype), sh)
+            if ksteps > 1:
+                # superstep intermediates consume an r = (K-1)*eps source
+                # ring: assemble the GLOBAL source fields once on the host
+                # and slice each slot's ring-padded window (zero ring
+                # outside the domain — the volumetric BC's source too)
+                rr = (ksteps - 1) * s.eps
+                self._gpad = jax.device_put(
+                    self._ring_pack(g, rr, np_dtype), sh)
+                self._lgpad = jax.device_put(
+                    self._ring_pack(lg, rr, np_dtype), sh)
+
+    def _ring_pack(self, tiles: dict, r: int, np_dtype) -> np.ndarray:
+        """(ndev, T_max, nx+2r, ny+2r) slot array where each slot holds its
+        tile's field padded with the true r-ring from the GLOBAL field
+        (zeros beyond the domain).  Pad slots stay all-zero."""
+        s, plan = self.s, self.plan
+        G = np.zeros((s.NX + 2 * r, s.NY + 2 * r), np_dtype)
+        for (gx, gy), v in tiles.items():
+            G[r + gx * s.nx: r + (gx + 1) * s.nx,
+              r + gy * s.ny: r + (gy + 1) * s.ny] = np.asarray(v)
+        out = np.zeros((plan.ndev, plan.t_max, s.nx + 2 * r, s.ny + 2 * r),
+                       np_dtype)
+        for d, own in plan.order.items():
+            for j, (gx, gy) in enumerate(own):
+                out[d, j] = G[gx * s.nx: (gx + 1) * s.nx + 2 * r,
+                              gy * s.ny: (gy + 1) * s.ny + 2 * r]
+        return out
 
     def run_stretch(self, t0: int, nsteps: int) -> None:
         s = self.s
+        ksteps = getattr(s, "ksteps", 1)
+        if ksteps > 1 and s._use_fused and nsteps >= ksteps:
+            # communication-avoiding blocks first (one K*eps exchange per
+            # K steps); the remainder falls through to the per-step run
+            skey = ("ss", bool(s.test))
+            if skey not in self._runs:
+                self._runs[skey] = make_gang_run_superstep(
+                    s.op, self.mesh, s.nx, s.ny, s.NX, s.NY, s.test,
+                    s.dtype, ksteps)
+            nblocks = nsteps // ksteps
+            run = self._runs[skey]
+            t, n = jnp.int32(t0), jnp.int32(nblocks)
+            if s.test:
+                self._state = run(self._state, self._idx, self._txy,
+                                  self._gpad, self._lgpad, t, n)
+            else:
+                self._state = run(self._state, self._idx, self._txy, t, n)
+            done = nblocks * ksteps
+            t0 += done
+            nsteps -= done
+            if nsteps == 0:
+                return
         key = (bool(s.test), bool(s._use_fused))
         if key not in self._runs:
             if s._use_fused:

@@ -51,15 +51,20 @@ def test_distributed_batch():
 
 
 def test_distributed_superstep_flag():
-    # the communication-avoiding schedule through the CLI surface; and the
-    # honesty guard: the flag is refused where it cannot take effect
+    # the communication-avoiding schedule through the CLI surface — on the
+    # SPMD path AND (since the gang superstep landed) the elastic path;
+    # the honesty guard refuses only where the schedule cannot engage
     r = run_cli("solve2d_distributed", ["--test_batch", "--superstep", "3"],
                 stdin="1\n25 25 2 2 45 5 1 0.0005 0.02\n")
     assert "Tests Passed" in r.stdout, r.stdout + r.stderr
     r = run_cli("solve2d_distributed",
-                ["--superstep", "2", "--nbalance", "5", "--nt", "2"])
+                ["--superstep", "2", "--nbalance", "5", "--nt", "12"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "l2:" in r.stdout
+    r = run_cli("solve2d_distributed",
+                ["--superstep", "9", "--nbalance", "5", "--nt", "2"])
     assert r.returncode != 0
-    assert "not supported on the elastic" in (r.stdout + r.stderr)
+    assert "tile edge" in (r.stdout + r.stderr)
 
 
 def test_2d_normal_run_prints_error_and_timing():

@@ -223,3 +223,88 @@ def test_gang_pad_slots_stay_zero():
     for d, own in gang.plan.order.items():
         for j in range(len(own), gang.plan.t_max):
             assert np.all(state[d, j] == 0.0), (d, j)
+
+
+# -- superstep (communication-avoiding K*eps exchange per K steps) ----------
+
+
+def test_gang_superstep_engages_and_matches_per_step_and_oracle():
+    """K in {2, 3}: gang stretches exchange ONE K*eps-wide halo per K
+    steps (gang.make_gang_run_superstep — the SPMD solver's schedule
+    under arbitrary placement).  nt indivisible by K exercises the
+    per-step remainder; values must stay 1e-12-close to the K=1 gang run
+    and to the serial oracle (with the shift method and a stable dt they
+    are bit-identical in practice — the levels see the same
+    neighborhoods in the same reduction order)."""
+    from nonlocalheatequation_tpu.parallel import gang as gang_mod
+
+    built = []
+    real = gang_mod.make_gang_run_superstep
+
+    o = Solver2D(50, 50, 23, eps=3, k=1.0, dt=1e-5, dh=0.02,
+                 backend="oracle")
+    o.test_init()
+    o.do_work()
+    base = _run(True, nx=10, ny=10, npx=5, npy=5, nt=23, eps=3, nlog=1000)
+    try:
+        gang_mod.make_gang_run_superstep = (
+            lambda *a, **kw: built.append(a[-1]) or real(*a, **kw))
+        for K in (2, 3):
+            a = _run(True, nx=10, ny=10, npx=5, npy=5, nt=23, eps=3,
+                     nlog=1000, superstep=K)
+            assert np.abs(a.u - base.u).max() < 1e-12
+            assert np.abs(a.u - o.u).max() < 1e-12
+            assert a.error_l2 / 2500 <= 1e-6
+    finally:
+        gang_mod.make_gang_run_superstep = real
+    assert built == [2, 3], "superstep program did not engage"
+
+
+def test_gang_superstep_with_barriers_windows_and_input_path():
+    """Superstep under the full barrier mix (logging cadence, checkpoints,
+    measured windows + rebalance): stretch lengths vary, remainders run
+    per-step, and the result still equals the serial oracle.  The free-
+    decay (input_init) path must agree with the K=1 run too."""
+    logs = []
+    a = _run(True, nx=10, ny=10, npx=5, npy=5, nt=24, eps=3, nlog=7,
+             nbalance=8, superstep=2, logger=lambda t, u: logs.append(t))
+    o = Solver2D(50, 50, 24, eps=3, k=1.0, dt=1e-5, dh=0.02,
+                 backend="oracle")
+    o.test_init()
+    o.do_work()
+    assert np.abs(a.u - o.u).max() < 1e-12
+    assert logs == [0, 7, 14, 21]
+
+    rng = np.random.default_rng(5)
+    u0 = rng.normal(size=(30, 30)).ravel()
+    outs = {}
+    for K in (1, 2):
+        s = ElasticSolver2D(10, 10, 3, 3, nt=7, eps=3, k=1.0, dt=1e-5,
+                            dh=0.02, superstep=K)
+        s.input_init(u0)
+        outs[K] = s.do_work()
+    assert np.abs(outs[1] - outs[2]).max() < 1e-12
+
+
+def test_gang_superstep_honesty_gates():
+    """The flag must never silently run the per-step path: K*eps > tile
+    edge is refused at construction, and opting out of gang scheduling
+    under superstep raises instead of degrading."""
+    with pytest.raises(ValueError, match="tile edge"):
+        ElasticSolver2D(5, 5, 5, 5, nt=4, eps=2, k=1.0, dt=1e-5, dh=0.02,
+                        superstep=3)
+    s = ElasticSolver2D(10, 10, 3, 3, nt=4, eps=3, k=1.0, dt=1e-5,
+                        dh=0.02, superstep=2)
+    s.use_gang = False
+    s.test_init()
+    with pytest.raises(RuntimeError, match="gang executor"):
+        s.do_work()
+    # measure-everything mode (measure=True, no nbalance — the CLI's
+    # --test_load_balance alone): every step is a measured window, so the
+    # schedule could never engage — must refuse, not silently run per-step
+    s2 = ElasticSolver2D(10, 10, 3, 3, nt=4, eps=3, k=1.0, dt=1e-5,
+                         dh=0.02, superstep=2)
+    s2.measure = True
+    s2.test_init()
+    with pytest.raises(RuntimeError, match="measured window"):
+        s2.do_work()

@@ -357,11 +357,17 @@ def bench_elastic(steps: int):
 
     # elastic side: same grid, 8x8 tiles, overlapped batched dispatch
     # (do_work includes tile placement; amortized over the steps, as the
-    # reference's do_work includes its dataflow construction)
-    for label, gang in (("2d/elastic", True), ("2d/elastic/perdevice", False)):
+    # reference's do_work includes its dataflow construction).  The
+    # superstep row is the communication-avoiding gang schedule (one
+    # 2*eps-wide exchange per 2 steps — gang.make_gang_run_superstep)
+    variants = (("2d/elastic", True, 1),
+                ("2d/elastic/superstep2", True, 2),
+                ("2d/elastic/perdevice", False, 1))
+    for label, gang, ksup in variants:
         e = ElasticSolver2D(n // ntiles, n // ntiles, ntiles, ntiles,
                             nt=steps, eps=8, k=1.0, dt=1e-7, dh=1.0 / n,
-                            method=method, nlog=10 ** 9, dtype=jnp.float32)
+                            method=method, nlog=10 ** 9, dtype=jnp.float32,
+                            superstep=ksup)
         e.use_gang = gang
         e.input_init(u0)
         t0 = time.perf_counter()
@@ -375,7 +381,8 @@ def bench_elastic(steps: int):
         emit(label, n * n, steps, best, grid=n, eps=8,
              tiles=ntiles * ntiles, devices=len(jax.devices()),
              spmd_ms_per_step=spmd_sec / steps * 1e3,
-             elastic_over_spmd=best / spmd_sec)
+             elastic_over_spmd=best / spmd_sec,
+             **({"superstep": ksup} if ksup > 1 else {}))
 
 
 def bench_eps_sweep(steps: int):

@@ -612,6 +612,17 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
                 "superstep > 1 cannot engage when every step is a "
                 "measured window (measure=True without nbalance); add a "
                 "rebalance cadence or drop superstep")
+        if (self.ksteps > 1 and measured and self.nbalance
+                and self.nbalance - self.measure_window < self.ksteps):
+            # the longest window-free run between measured windows is
+            # nbalance - measure_window steps; shorter than K means no
+            # K-block ever forms — the same silent no-op, caught here
+            raise RuntimeError(
+                f"superstep {self.ksteps} cannot engage: only "
+                f"{self.nbalance - self.measure_window} window-free "
+                "steps exist between measured windows (nbalance - "
+                "measure_window); widen nbalance, shrink measure_window, "
+                "or drop superstep")
         if use_gang and self._gang is None:
             # created once per solver: jit keys on shapes, so repeated
             # do_work calls (and T_max changes) reuse/retrace automatically

@@ -139,6 +139,39 @@ def _make_run_driver(op, mesh: Mesh, local_step, aux_specs, test: bool,
     return run
 
 
+def _assemble_halo(own, idx, width: int, nx: int, ny: int, dtype):
+    """(T_max, nx+2w, ny+2w) padded tiles from the banded all_gather.
+
+    The halo "RPC": one ``all_gather`` of only the ``width``-bands of
+    every tile, then each tile's 3x3 halo assembled by the traced
+    (T_max, 9) slot-index matrix.  The assembly ORDER is identical to
+    elastic's batched bstep (band for band) — the bit-identical
+    guarantee — and is shared by the per-step and superstep gang runs so
+    the contract lives in exactly one place.  Legal while width <= tile
+    edge (the whole halo then comes from the 8 immediate neighbors).
+    """
+    w = width
+    top_all = lax.all_gather(own[:, :w, :], "d", axis=0, tiled=True)
+    bot_all = lax.all_gather(own[:, -w:, :], "d", axis=0, tiled=True)
+    left_all = lax.all_gather(own[:, :, :w], "d", axis=0, tiled=True)
+    right_all = lax.all_gather(own[:, :, -w:], "d", axis=0, tiled=True)
+    zt = jnp.zeros((1, w, ny), dtype)
+    zlr = jnp.zeros((1, nx, w), dtype)
+    top_all = jnp.concatenate([top_all, zt])
+    bot_all = jnp.concatenate([bot_all, zt])
+    left_all = jnp.concatenate([left_all, zlr])
+    right_all = jnp.concatenate([right_all, zlr])
+    top = jnp.concatenate(
+        [bot_all[idx[:, 0]][:, :, -w:], bot_all[idx[:, 1]],
+         bot_all[idx[:, 2]][:, :, :w]], axis=2)
+    mid = jnp.concatenate(
+        [right_all[idx[:, 3]], own, left_all[idx[:, 5]]], axis=2)
+    bot = jnp.concatenate(
+        [top_all[idx[:, 6]][:, :, -w:], top_all[idx[:, 7]],
+         top_all[idx[:, 8]][:, :, :w]], axis=2)
+    return jnp.concatenate([top, mid, bot], axis=1)
+
+
 def make_gang_run(op, mesh: Mesh, nx: int, ny: int, test: bool, dtype):
     """One jitted SPMD program advancing every tile a traced ``nsteps``.
 
@@ -154,26 +187,7 @@ def make_gang_run(op, mesh: Mesh, nx: int, ny: int, test: bool, dtype):
     def local_step(own, idx, *rest):
         # own: (T_max, nx, ny) this device's slots; idx: (T_max, 9)
         # bands of every tile, gathered once per step (the halo exchange)
-        top_all = lax.all_gather(own[:, :e, :], "d", axis=0, tiled=True)
-        bot_all = lax.all_gather(own[:, -e:, :], "d", axis=0, tiled=True)
-        left_all = lax.all_gather(own[:, :, :e], "d", axis=0, tiled=True)
-        right_all = lax.all_gather(own[:, :, -e:], "d", axis=0, tiled=True)
-        zt = jnp.zeros((1, e, ny), dtype)
-        zlr = jnp.zeros((1, nx, e), dtype)
-        top_all = jnp.concatenate([top_all, zt])
-        bot_all = jnp.concatenate([bot_all, zt])
-        left_all = jnp.concatenate([left_all, zlr])
-        right_all = jnp.concatenate([right_all, zlr])
-        # identical assembly order to elastic's batched bstep -> identical bits
-        top = jnp.concatenate(
-            [bot_all[idx[:, 0]][:, :, -e:], bot_all[idx[:, 1]],
-             bot_all[idx[:, 2]][:, :, :e]], axis=2)
-        mid = jnp.concatenate(
-            [right_all[idx[:, 3]], own, left_all[idx[:, 5]]], axis=2)
-        bot = jnp.concatenate(
-            [top_all[idx[:, 6]][:, :, -e:], top_all[idx[:, 7]],
-             top_all[idx[:, 8]][:, :, :e]], axis=2)
-        upad = jnp.concatenate([top, mid, bot], axis=1)
+        upad = _assemble_halo(own, idx, e, nx, ny, dtype)
         du = jax.vmap(op.apply_padded)(upad)
         if test:
             from nonlocalheatequation_tpu.ops.nonlocal_op import source_at
@@ -255,25 +269,7 @@ def make_gang_run_superstep(op, mesh: Mesh, nx: int, ny: int,
         # tile coords the volumetric mask needs (pad slots are (0, 0):
         # their state, bands, and sources are all zero, and zero stays
         # zero through every level)
-        top_all = lax.all_gather(own[:, :E, :], "d", axis=0, tiled=True)
-        bot_all = lax.all_gather(own[:, -E:, :], "d", axis=0, tiled=True)
-        left_all = lax.all_gather(own[:, :, :E], "d", axis=0, tiled=True)
-        right_all = lax.all_gather(own[:, :, -E:], "d", axis=0, tiled=True)
-        zt = jnp.zeros((1, E, ny), dtype)
-        zlr = jnp.zeros((1, nx, E), dtype)
-        top_all = jnp.concatenate([top_all, zt])
-        bot_all = jnp.concatenate([bot_all, zt])
-        left_all = jnp.concatenate([left_all, zlr])
-        right_all = jnp.concatenate([right_all, zlr])
-        top = jnp.concatenate(
-            [bot_all[idx[:, 0]][:, :, -E:], bot_all[idx[:, 1]],
-             bot_all[idx[:, 2]][:, :, :E]], axis=2)
-        mid = jnp.concatenate(
-            [right_all[idx[:, 3]], own, left_all[idx[:, 5]]], axis=2)
-        bot = jnp.concatenate(
-            [top_all[idx[:, 6]][:, :, -E:], top_all[idx[:, 7]],
-             top_all[idx[:, 8]][:, :, :E]], axis=2)
-        upad = jnp.concatenate([top, mid, bot], axis=1)
+        upad = _assemble_halo(own, idx, E, nx, ny, dtype)
         if test:
             gp, lgp, t = rest
             return jax.vmap(

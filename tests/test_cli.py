@@ -57,10 +57,18 @@ def test_distributed_superstep_flag():
     r = run_cli("solve2d_distributed", ["--test_batch", "--superstep", "3"],
                 stdin="1\n25 25 2 2 45 5 1 0.0005 0.02\n")
     assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+    # nbalance 8 leaves 8 - min(5, 8) = 3 window-free steps per cadence:
+    # the K=2 gang superstep genuinely engages
     r = run_cli("solve2d_distributed",
-                ["--superstep", "2", "--nbalance", "5", "--nt", "12"])
+                ["--superstep", "2", "--nbalance", "8", "--nt", "17"])
     assert r.returncode == 0, r.stdout + r.stderr
     assert "l2:" in r.stdout
+    # nbalance 5 measures EVERY step (measure_window = min(5, nbalance)):
+    # the schedule could never engage — refused, not silently per-step
+    r = run_cli("solve2d_distributed",
+                ["--superstep", "2", "--nbalance", "5", "--nt", "12"])
+    assert r.returncode != 0
+    assert "window-free" in (r.stdout + r.stderr)
     r = run_cli("solve2d_distributed",
                 ["--superstep", "9", "--nbalance", "5", "--nt", "2"])
     assert r.returncode != 0

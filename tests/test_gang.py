@@ -265,9 +265,21 @@ def test_gang_superstep_with_barriers_windows_and_input_path():
     measured windows + rebalance): stretch lengths vary, remainders run
     per-step, and the result still equals the serial oracle.  The free-
     decay (input_init) path must agree with the K=1 run too."""
+    from nonlocalheatequation_tpu.parallel import gang as gang_mod
+
+    built = []
+    real = gang_mod.make_gang_run_superstep
+    gang_mod.make_gang_run_superstep = (
+        lambda *a, **kw: built.append(1) or real(*a, **kw))
     logs = []
-    a = _run(True, nx=10, ny=10, npx=5, npy=5, nt=24, eps=3, nlog=7,
-             nbalance=8, superstep=2, logger=lambda t, u: logs.append(t))
+    try:
+        a = _run(True, nx=10, ny=10, npx=5, npy=5, nt=24, eps=3, nlog=7,
+                 nbalance=8, superstep=2, logger=lambda t, u: logs.append(t))
+    finally:
+        gang_mod.make_gang_run_superstep = real
+    assert built, ("superstep never engaged under nbalance=8 — the "
+                   "window-free runs between measured windows must form "
+                   "K-blocks")
     o = Solver2D(50, 50, 24, eps=3, k=1.0, dt=1e-5, dh=0.02,
                  backend="oracle")
     o.test_init()
@@ -308,3 +320,10 @@ def test_gang_superstep_honesty_gates():
     s2.test_init()
     with pytest.raises(RuntimeError, match="measured window"):
         s2.do_work()
+    # nbalance <= measure_window measures EVERY step: no K-block could
+    # ever form between windows — refused, not silently per-step
+    s3 = ElasticSolver2D(10, 10, 3, 3, nt=12, eps=3, k=1.0, dt=1e-5,
+                         dh=0.02, superstep=2, nbalance=5)
+    s3.test_init()
+    with pytest.raises(RuntimeError, match="window-free"):
+        s3.do_work()

@@ -248,6 +248,49 @@ def test_cli_runs_multicontroller_like_srun(cli_args, banner, footer):
     assert noise == [], f"rank 1 printed to stdout:\n{noise[:5]}"
 
 
+def test_cli_batch_multicontroller_verifies_token_stream():
+    """--test_batch under two controllers: identical stdin on every rank
+    passes (rank 0 prints the verdict), DIVERGENT stdin is caught by the
+    cross-rank token check on every rank instead of silently violating
+    the SPMD contract."""
+    batch = "1\n25 25 2 2 45 5 1 0.0005 0.02\n"
+    for divergent in (False, True):
+        port = _free_port()
+        procs = []
+        for pid in range(2):
+            env = _controller_env(2, {
+                "COORDINATOR_ADDRESS": f"localhost:{port}",
+                "JAX_NUM_PROCESSES": "2", "JAX_PROCESS_ID": str(pid)})
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "nonlocalheatequation_tpu.cli.solve2d_distributed",
+                 "--test_batch", "--platform", "cpu"],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, env=env, cwd=REPO_DIR,
+            ))
+        for pid, p in enumerate(procs):
+            text = batch
+            if divergent and pid == 1:
+                text = "1\n25 25 2 2 45 5 1 0.0006 0.02\n"  # one token off
+            # close every rank's stdin NOW: the children block in
+            # stdin.read() until EOF, and a serialized close (communicate
+            # per proc) would leave rank 1 blocked while rank 0 enters the
+            # collective and trips gloo's 30s deadline.  stdin = None so
+            # _harvest's communicate() does not re-touch the closed pipe.
+            p.stdin.write(text)
+            p.stdin.close()
+            p.stdin = None
+        outs = _harvest(procs, timeout=180)
+        if divergent:
+            for pid, p in enumerate(procs):
+                assert p.returncode != 0, f"rank {pid} missed divergence"
+            assert "batch input" in "".join(outs)
+        else:
+            for pid, (p, out) in enumerate(zip(procs, outs)):
+                assert p.returncode == 0, f"rank {pid}:\n{out[-1500:]}"
+            assert "Tests Passed" in outs[0]
+
+
 def test_assert_same_detects_divergence():
     """The determinism checker must FAIL when hosts hold different values
     (a checker that can only pass proves nothing) — here under an uneven

@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "launch — pass an explicit count to limit)")
     p.add_argument("--halo", default="auto",
                    choices=("auto", "export", "gather"))
+    p.add_argument("--superstep", type=int, default=1, metavar="K",
+                   help="sharded offsets layout only: exchange a K*pad-"
+                        "wide ring halo once per K steps (communication-"
+                        "avoiding; refused where it cannot engage)")
     p.add_argument("--layout", default="auto",
                    choices=("auto", "offsets", "windowed", "ell", "edges"),
                    help="operator layout (single-device; auto prefers the "
@@ -128,7 +132,8 @@ def main(argv=None) -> int:
     print(f"nodes {n} (dim {pts.shape[1]}), edges {len(op.tgt)}, "
           f"eps {eps:.5g} ({eps / dh:.2f} dh), dt {op.dt:.3e}")
 
-    s = UnstructuredSolver(the_op, nt=args.nt, layout=args.layout)
+    s = UnstructuredSolver(the_op, nt=args.nt, layout=args.layout,
+                           superstep=args.superstep)
     if args.test:
         s.test_init()
     else:

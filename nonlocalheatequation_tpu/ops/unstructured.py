@@ -365,6 +365,25 @@ class UnstructuredNonlocalOp:
         return np.cos(2.0 * np.pi * (t * self.dt)) * self.spatial_profile()
 
 
+def _ring_exchange(mine, lo: int, hi: int, S: int):
+    """[left band | own block | right band] over the 1D shard ring via
+    ``lax.ppermute`` — the one exchange both the per-step offsets apply
+    and the superstep K-block use (any fix to direction/wrap handling
+    lands in both).  Ring wrap delivers garbage bands at the global
+    boundary; callers neutralize them (zero weights per-step, the
+    out-of-domain mask in the superstep)."""
+    B = mine.shape[0]
+    parts = []
+    if lo:  # band from the LEFT neighbor: everyone sends right
+        parts.append(jax.lax.ppermute(
+            mine[B - lo:], "p", [(i, (i + 1) % S) for i in range(S)]))
+    parts.append(mine)
+    if hi:  # band from the RIGHT neighbor: everyone sends left
+        parts.append(jax.lax.ppermute(
+            mine[:hi], "p", [(i, (i - 1) % S) for i in range(S)]))
+    return jnp.concatenate(parts) if len(parts) > 1 else mine
+
+
 class ShardedUnstructuredOp:
     """Multi-device evaluation of an UnstructuredNonlocalOp via shard_map.
 
@@ -588,19 +607,9 @@ class ShardedUnstructuredOp:
         self._c = put_global(blk(op.c), row)
         self._wsum = put_global(blk(op.wsum), row)
 
-        right_perm = [(i, (i + 1) % S) for i in range(S)]
-        left_perm = [(i, (i - 1) % S) for i in range(S)]
-
         def local_apply(u_blk, w3_, c_, wsum_):
             mine = u_blk[0]
-            parts = []
-            if pad_lo:  # band from the LEFT neighbor: everyone sends right
-                parts.append(jax.lax.ppermute(
-                    mine[B - pad_lo:], "p", right_perm))
-            parts.append(mine)
-            if pad_hi:  # band from the RIGHT neighbor: everyone sends left
-                parts.append(jax.lax.ppermute(mine[:pad_hi], "p", left_perm))
-            up = jnp.concatenate(parts) if len(parts) > 1 else mine
+            up = _ring_exchange(mine, pad_lo, pad_hi, S)
             acc = jnp.zeros_like(mine)
             for j, o in enumerate(offs):
                 start = pad_lo + o
@@ -647,6 +656,142 @@ class ShardedUnstructuredOp:
     def apply(self, u: jnp.ndarray) -> jnp.ndarray:
         return self.apply_with(u, self.apply_args())
 
+    def superstep_fits(self, ksteps: int) -> bool:
+        """Can the K-block program run?  Offsets layout only (residual
+        edges would need arbitrary cross-shard gathers), with the K-wide
+        bands still one-hop (K*pad <= block)."""
+        if self.layout != "offsets" or ksteps < 2:
+            return False
+        plan = self.inner.offset_plan()
+        return (ksteps * plan.pad_lo <= self.B
+                and ksteps * plan.pad_hi <= self.B)
+
+    def superstep_check(self, ksteps: int) -> None:
+        """The ONE refusal for an unfit K (constructors and builders share
+        it, so the early and late gates can never drift apart)."""
+        if self.superstep_fits(ksteps):
+            return
+        plan = (self.inner.offset_plan()
+                if self.layout == "offsets" else None)
+        raise ValueError(
+            f"superstep {ksteps} does not fit the sharded offsets form "
+            f"(layout={self.layout!r}, pads "
+            f"{getattr(plan, 'pad_lo', '?')}/"
+            f"{getattr(plan, 'pad_hi', '?')}, block {self.B}): needs "
+            "the offsets layout and K*pad <= block")
+
+    def make_superstep(self, ksteps: int, dtype, test: bool):
+        """Communication-avoiding K-block for the sharded offsets layout:
+        ONE (K*pad_lo, K*pad_hi)-wide ring ppermute exchange per K steps,
+        then K local levels on shrinking regions — the grid solvers'
+        superstep schedule (distributed2d.py ``_superstep`` /
+        gang.make_gang_run_superstep) in the 1D DIA domain.
+
+        Static fields (diagonal weights, c, wsum, sources) are globally
+        known on the host, so each shard's EXTENDED slices are cut once
+        here (no per-step exchange for them); only the state rides the
+        ring.  Out-of-domain positions (ring wrap garbage at the global
+        boundary, the block-padding tail) are masked to zero on entry and
+        after every intermediate level — the volumetric BC analog.
+        Intermediate levels are pinned with optimization_barrier, same
+        ulp discipline as the grid schedule.
+
+        Returns ``(args, block_fn)``: ``block_fn(u, t, args)`` advances
+        the global (n,) state K steps; ``args`` are device arrays passed
+        through the caller's jit as ARGUMENTS (multi-controller rule).
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax import shard_map
+
+        from nonlocalheatequation_tpu.ops.nonlocal_op import source_at
+
+        K = int(ksteps)
+        self.superstep_check(K)
+        plan = self.inner.offset_plan()
+        pad_lo, pad_hi, offs = plan.pad_lo, plan.pad_hi, plan.offs
+        S, B, n = self.S, self.B, self.n
+        PL, PH = K * pad_lo, K * pad_hi
+        n_pad = S * B
+        ext = PL + B + PH
+        np_dtype = np.dtype(jnp.dtype(dtype).name)
+
+        def ext_blocks(vec):
+            """(n,) global host field -> (S, ext) per-shard extended
+            slices, zero beyond the domain."""
+            vp = np.zeros(PL + n_pad + PH, np_dtype)
+            vp[PL: PL + n] = np.asarray(vec)
+            return np.stack([vp[s * B: s * B + ext] for s in range(S)])
+
+        Wg = np.zeros((len(offs), PL + n_pad + PH), np_dtype)
+        Wg[:, PL: PL + n] = plan.W
+        w3x = np.stack([Wg[:, s * B: s * B + ext] for s in range(S)])
+        host_args = [w3x, ext_blocks(self.inner.c),
+                     ext_blocks(self.inner.wsum)]
+        if test:
+            g, lg = self.inner.source_parts()
+            host_args += [ext_blocks(g), ext_blocks(lg)]
+        row = NamedSharding(self.mesh, P("p"))
+        args = tuple(put_global(a, row) for a in host_args)
+
+        dt = self.dt
+
+        def local_block(u_blk, w3x_, cx_, wsx_, *rest):
+            if test:
+                gx_, lgx_, t = rest
+                gx_, lgx_ = gx_[0], lgx_[0]
+            else:
+                (t,) = rest
+            mine = u_blk[0]
+            cur = _ring_exchange(mine, PL, PH, S)
+            # global index of cur[0] is s*B - PL; zero everything outside
+            # [0, n) — ring wrap garbage and the padding tail must not
+            # enter the intermediates
+            gpos0 = jax.lax.axis_index("p") * B - PL
+            idx = gpos0 + jax.lax.iota(jnp.int32, ext)
+            cur = jnp.where((idx >= 0) & (idx < n), cur,
+                            jnp.zeros_like(cur))
+            w3s, cs, wss = w3x_[0], cx_[0], wsx_[0]
+            for j in range(1, K + 1):
+                m_lo = (K - j) * pad_lo
+                m_hi = (K - j) * pad_hi
+                L = m_lo + B + m_hi
+                o0 = PL - m_lo  # static-slice offset for this level
+                acc = jnp.zeros((L,), cur.dtype)
+                for jo, o in enumerate(offs):
+                    acc = acc + (
+                        jax.lax.slice(w3s[jo], (o0,), (o0 + L,))
+                        * jax.lax.slice(cur, (pad_lo + o,),
+                                        (pad_lo + o + L,)))
+                center = jax.lax.slice(cur, (pad_lo,), (pad_lo + L,))
+                du = (jax.lax.slice(cs, (o0,), (o0 + L,))
+                      * (acc - jax.lax.slice(wss, (o0,), (o0 + L,))
+                         * center))
+                if test:
+                    du = du + source_at(
+                        jax.lax.slice(gx_, (o0,), (o0 + L,)),
+                        jax.lax.slice(lgx_, (o0,), (o0 + L,)),
+                        t + (j - 1), dt)
+                nxt = center + jnp.asarray(dt, cur.dtype) * du
+                if j < K:
+                    lidx = (gpos0 + o0) + jax.lax.iota(jnp.int32, L)
+                    nxt = jnp.where((lidx >= 0) & (lidx < n), nxt,
+                                    jnp.zeros_like(nxt))
+                    nxt = jax.lax.optimization_barrier(nxt)
+                cur = nxt
+            return cur[None]
+
+        p = P("p")
+        n_args = 5 if test else 3
+        sharded = shard_map(
+            local_block, mesh=self.mesh,
+            in_specs=(p,) * (1 + n_args) + (P(),), out_specs=p)
+
+        def block_fn(u, t, args_):
+            up = jnp.pad(u, (0, self.pad)).reshape(S, B)
+            return sharded(up, *args_, t).reshape(S * B)[: n]
+
+        return args, block_fn
+
 
 class UnstructuredSolver(CheckpointMixin):
     """Forward-Euler solver on a point cloud, same contract as the grid
@@ -654,7 +799,8 @@ class UnstructuredSolver(CheckpointMixin):
 
     def __init__(self, op: UnstructuredNonlocalOp, nt: int, backend="jit",
                  layout: str = "auto",
-                 checkpoint_path: str | None = None, ncheckpoint: int = 0):
+                 checkpoint_path: str | None = None, ncheckpoint: int = 0,
+                 superstep: int = 1):
         self.op = op
         self.nt = int(nt)
         self.backend = backend
@@ -667,6 +813,18 @@ class UnstructuredSolver(CheckpointMixin):
         self.u = None
         self.error_l2 = 0.0
         self.error_linf = 0.0
+        # superstep K > 1: one (K*pad)-wide ring exchange per K steps on
+        # the SHARDED offsets operator (ShardedUnstructuredOp
+        # .make_superstep) — refuse anywhere the schedule cannot engage
+        # rather than silently stepping one exchange at a time
+        self.ksteps = max(1, int(superstep))
+        if self.ksteps > 1:
+            if backend != "jit" or getattr(op, "superstep_check",
+                                           None) is None:
+                raise ValueError(
+                    "superstep > 1 needs the jit backend on a "
+                    "ShardedUnstructuredOp (offsets layout)")
+            op.superstep_check(self.ksteps)  # the shared fit refusal
 
     def _ckpt_params(self) -> dict:
         """Canonical params for the point cloud: eps is a per-point FIELD
@@ -762,21 +920,35 @@ class UnstructuredSolver(CheckpointMixin):
                     du = du + source_at(extras[0], extras[1], t, op.dt)
                 return u + op.dt * du, None
 
+            ss_args = ss_block = None
+            if self.ksteps > 1:
+                ss_args, ss_block = op.make_superstep(self.ksteps, dtype,
+                                                      test)
+            K = self.ksteps
+
             def make_runner(count):
                 @jax.jit
-                def run(u, t0, consts, extras):
-                    ts = t0 + jnp.arange(count)
+                def run(u, t0, consts, extras, ss):
                     if windowed:
                         u = u[ex.perm]
-                    u = jax.lax.scan(
-                        lambda c, t: step_with(c, t, consts, extras), u, ts
-                    )[0]
+                    nblocks = count // K if ss_block is not None else 0
+                    if nblocks:
+                        tb = t0 + K * jnp.arange(nblocks)
+                        u = jax.lax.scan(
+                            lambda c, t: (ss_block(c, t, ss), None),
+                            u, tb)[0]
+                    rem = count - nblocks * K
+                    if rem:
+                        ts = t0 + nblocks * K + jnp.arange(rem)
+                        u = jax.lax.scan(
+                            lambda c, t: step_with(c, t, consts, extras),
+                            u, ts)[0]
                     if windowed:
                         u = u[ex.rank]
                     return u
 
                 return lambda u, start: run(u, jnp.int32(start), consts,
-                                            extras)
+                                            extras, ss_args)
 
             if multiproc:
                 from nonlocalheatequation_tpu.parallel.multihost import (

@@ -186,6 +186,19 @@ if "unstructured" in LEGS:
     assert err_sol < 1e-12, f"solver deviates from oracle by {err_sol:.3e}"
     print(f"MH-OK p{pid} unstructured-solver err={err_sol:.2e}", flush=True)
 
+    # ...and the communication-avoiding superstep on the same sharded op,
+    # cross-process: one K*pad-wide ring exchange per K steps over the
+    # gloo transport (fits when K*pad <= block, i.e. few enough shards)
+    if sh.superstep_fits(2):
+        ss = UnstructuredSolver(sh, nt=3, backend="jit", superstep=2)
+        ss.test_init()
+        uss = ss.do_work()
+        multihost.assert_same_on_all_hosts(uss, "unstructured superstep")
+        err_ss = float(np.abs(uss - o_sol.u).max())
+        assert err_ss < 1e-12, f"superstep deviates by {err_ss:.3e}"
+        print(f"MH-OK p{pid} unstructured-superstep err={err_ss:.2e}",
+              flush=True)
+
 if "crash2d" in LEGS:
     # long checkpointed run the parent will SIGKILL mid-flight; nothing
     # after do_work() is expected to execute

@@ -170,6 +170,8 @@ def test_two_controller_loopback_solve():
         assert f"MH-OK p{pid} 3d eps=5" in out
         assert f"MH-OK p{pid} unstructured " in out
         assert f"MH-OK p{pid} unstructured-solver" in out
+        # 4 global devices: B=256 fits the K=2 ring superstep
+        assert f"MH-OK p{pid} unstructured-superstep" in out
 
 
 def test_four_controller_loopback_solve():
@@ -268,18 +270,27 @@ def test_cli_batch_multicontroller_verifies_token_stream():
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True, env=env, cwd=REPO_DIR,
             ))
-        for pid, p in enumerate(procs):
-            text = batch
-            if divergent and pid == 1:
-                text = "1\n25 25 2 2 45 5 1 0.0006 0.02\n"  # one token off
-            # close every rank's stdin NOW: the children block in
-            # stdin.read() until EOF, and a serialized close (communicate
-            # per proc) would leave rank 1 blocked while rank 0 enters the
-            # collective and trips gloo's 30s deadline.  stdin = None so
-            # _harvest's communicate() does not re-touch the closed pipe.
-            p.stdin.write(text)
-            p.stdin.close()
-            p.stdin = None
+        try:
+            for pid, p in enumerate(procs):
+                text = batch
+                if divergent and pid == 1:
+                    text = "1\n25 25 2 2 45 5 1 0.0006 0.02\n"  # one off
+                # close every rank's stdin NOW: the children block in
+                # stdin.read() until EOF, and a serialized close
+                # (communicate per proc) would leave rank 1 blocked while
+                # rank 0 enters the collective and trips gloo's 30s
+                # deadline.  stdin = None so _harvest's communicate() does
+                # not re-touch the closed pipe.
+                p.stdin.write(text)
+                p.stdin.close()
+                p.stdin = None
+        except BrokenPipeError:
+            # a rank died before reading (port clash, import error): kill
+            # the siblings rather than leaking them into later tests —
+            # _harvest below reaps and surfaces the output
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
         outs = _harvest(procs, timeout=180)
         if divergent:
             for pid, p in enumerate(procs):

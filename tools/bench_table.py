@@ -334,6 +334,28 @@ def bench_unstructured(steps: int):
                  nodes=op.n, edges=len(op.tgt), devices=len(jax.devices()),
                  comm_ratio=round(sh.halo_comm_ratio, 4))
 
+            # communication-avoiding superstep on the same sharded op:
+            # one 2*pad-wide ring exchange per 2 steps (fit-gated — at
+            # the bench cloud's pads it needs few enough shards)
+            if sh.superstep_fits(2):
+                ss_args, block = sh.make_superstep(2, u0.dtype, False)
+                nblocks = steps // 2
+
+                @jax.jit
+                def multi_ss(u, _args=ss_args):
+                    ts = 2 * jnp.arange(nblocks)
+                    return lax.scan(
+                        lambda c, t: (block(c, t, _args), None), u, ts)[0]
+
+                sec, _ = time_steps(multi_ss, u0, nblocks * 2)
+                emit("unstructured/sharded/offsets-superstep2", op.n,
+                     nblocks * 2, sec, nodes=op.n, edges=len(op.tgt),
+                     devices=len(jax.devices()), superstep=2,
+                     comm_ratio=round(sh.halo_comm_ratio, 4))
+            else:
+                log("    offsets-superstep2: does not fit "
+                    f"(pads x2 vs block {sh.B}); row skipped")
+
 
 def bench_elastic(steps: int):
     """Elastic executor vs SPMD on the same problem (VERDICT r2 #7): the

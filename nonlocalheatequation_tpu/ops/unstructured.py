@@ -671,6 +671,10 @@ class ShardedUnstructuredOp:
         it, so the early and late gates can never drift apart)."""
         if self.superstep_fits(ksteps):
             return
+        if ksteps < 2:
+            raise ValueError(
+                f"superstep needs K >= 2 (got {ksteps}); K=1 IS the "
+                "per-step path")
         plan = (self.inner.offset_plan()
                 if self.layout == "offsets" else None)
         raise ValueError(

@@ -92,6 +92,28 @@ def test_distributed_with_partition_map(tmp_path):
     assert "l2:" in r.stdout, r.stdout + r.stderr
 
 
+def test_flagship_chain_decompose_map_balance_superstep(tmp_path):
+    """The reference's full flagship chain, end to end through the CLI
+    surface: decompose a GMSH mesh into a partition map, then solve with
+    that placement + periodic rebalancing + the (r5) communication-
+    avoiding gang superstep, and report the balance acceptance."""
+    from nonlocalheatequation_tpu.cli import decompose
+
+    mapfile = str(tmp_path / "map.txt")
+    rc = decompose.main([os.path.join(REPO, "data/10x10.msh"), mapfile,
+                         "2", "--sx", "2", "--sy", "2"])
+    assert rc in (0, None) and os.path.exists(mapfile)
+    # 5x5 tiles of 2x2 -> eps=1 keeps K*eps <= tile for the K=2 superstep
+    r = run_cli("solve2d_distributed",
+                ["--file", mapfile, "--nt", "17", "--eps", "1",
+                 "--nbalance", "8", "--superstep", "2",
+                 "--test_load_balance", "--cmp", "false"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    l2 = float(r.stdout.split("l2:")[1].split()[0])
+    assert l2 / 100 <= 1e-6, f"L2/N contract violated: {l2 / 100}"
+    assert "balance" in r.stdout.lower()  # the acceptance report printed
+
+
 def test_1d_results_and_input_init():
     vals = " ".join(["0.5"] * 10)
     r = run_cli("solve1d", ["--nx", "10", "--nt", "3", "--results"], stdin=vals)

@@ -132,8 +132,14 @@ def main(argv=None) -> int:
     print(f"nodes {n} (dim {pts.shape[1]}), edges {len(op.tgt)}, "
           f"eps {eps:.5g} ({eps / dh:.2f} dh), dt {op.dt:.3e}")
 
-    s = UnstructuredSolver(the_op, nt=args.nt, layout=args.layout,
-                           superstep=args.superstep)
+    try:
+        s = UnstructuredSolver(the_op, nt=args.nt, layout=args.layout,
+                               superstep=args.superstep)
+    except ValueError as e:
+        # a misconfigured --superstep (single device, edges layout,
+        # K*pad > block) gets the same clean one-line refusal as the
+        # other CLI launch-mode checks, not a traceback
+        raise SystemExit(str(e))
     if args.test:
         s.test_init()
     else:

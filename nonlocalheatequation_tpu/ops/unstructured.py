@@ -193,6 +193,8 @@ class UnstructuredNonlocalOp:
         self._ell_arrays = None  # built lazily; see _ell()
         self._windowed_plan = None  # built lazily; see windowed_plan()
         self._windowed_stats = None  # cached (coverage, p_bytes) precheck
+        self._windowed_search = None  # the gate's ladder search, reused
+        # by the default-kwargs windowed_plan() build
         self._offset_plan = None  # built lazily; see offset_plan()
 
     # ELL (padded-row) layout of the same edges: neighbor column ids and
@@ -239,9 +241,13 @@ class UnstructuredNonlocalOp:
         if self._windowed_plan is None or self._windowed_plan[0] != key:
             from .windowed import build_plan
 
+            # default-kwargs builds reuse the worthwhileness gate's
+            # ladder search (computed with the real edge weights) so the
+            # accept path pays the O(E log E) search once, not twice
+            search = self._windowed_search if not kwargs else None
             self._windowed_plan = (key, build_plan(
                 self.points, self.eps, self.tgt, self.src, self.edge_w,
-                self.c, self.wsum, **kwargs,
+                self.c, self.wsum, search=search, **kwargs,
             ))
         return self._windowed_plan[1]
 
@@ -262,12 +268,19 @@ class UnstructuredNonlocalOp:
         # from the ladder search alone — the dense strips are only
         # materialized (by windowed_plan()) once the plan is accepted.
         # Cached: the edge set is immutable and the per-step auto path
-        # consults this gate on every apply
+        # consults this gate on every apply.  Run with the REAL edge
+        # weights so windowed_plan() can reuse the search on accept.
         if self._windowed_stats is None:
-            from .windowed import plan_stats
+            from .windowed import _plan_search
 
-            self._windowed_stats = plan_stats(self.points, self.eps,
-                                              self.tgt, self.src)
+            sr = _plan_search(self.points, self.eps, self.tgt, self.src,
+                              self.edge_w, bm=128, wmax=4096,
+                              max_overflow_frac=0.02, order="morton",
+                              windows=2)
+            self._windowed_search = sr
+            cov = 1.0 if sr["total"] == 0 else sr["covered"] / sr["total"]
+            self._windowed_stats = (
+                cov, sr["n_pad"] * sr["R"] * sr["we"] * 4)
         coverage, p_bytes = self._windowed_stats
         return (coverage >= self._WINDOWED_MIN_COVERAGE
                 and p_bytes <= self._windowed_budget_bytes())
@@ -926,6 +939,16 @@ class UnstructuredSolver(CheckpointMixin):
 
             ss_args = ss_block = None
             if self.ksteps > 1:
+                if not any(c >= self.ksteps
+                           for _, c in self._ckpt_chunks()):
+                    # every barrier segment is shorter than K: no K-block
+                    # could ever form and the flag would silently run
+                    # per-step — same honesty rule as the elastic gates
+                    raise RuntimeError(
+                        f"superstep {self.ksteps} cannot engage: every "
+                        "segment between checkpoint barriers is shorter "
+                        "than K (ncheckpoint/nt vs superstep); widen the "
+                        "cadence or drop superstep")
                 ss_args, ss_block = op.make_superstep(self.ksteps, dtype,
                                                       test)
             K = self.ksteps

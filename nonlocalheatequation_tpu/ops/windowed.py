@@ -339,7 +339,8 @@ def plan_stats(points, eps, tgt, src, *, bm: int = LANE, wmax: int = 4096,
 
 def build_plan(points, eps, tgt, src, edge_w, c, wsum, *, bm: int = LANE,
                wmax: int = 4096, max_overflow_frac: float = 0.02,
-               order: str = "morton", windows: int = 2) -> WindowedPlan:
+               order: str = "morton", windows: int = 2,
+               search=None) -> WindowedPlan:
     """Build the windowed layout for an edge set.
 
     ``order="morton"`` reorders nodes along a Z-curve over eps.max()-sized
@@ -347,11 +348,14 @@ def build_plan(points, eps, tgt, src, edge_w, c, wsum, *, bm: int = LANE,
     caller's ordering.  W walks the ladder until the residual edge fraction
     drops under ``max_overflow_frac`` (or the ladder ends — the plan is
     still exact then, just with a larger residual; callers judge
-    worthwhileness via ``plan.coverage``).
+    worthwhileness via ``plan.coverage``).  ``search`` accepts a
+    precomputed :func:`_plan_search` result (run with the SAME inputs and
+    the real ``edge_w``) so a worthwhileness gate that already paid the
+    O(E log E) search doesn't pay it twice on the accept path.
     """
-    sr = _plan_search(points, eps, tgt, src, edge_w, bm=bm, wmax=wmax,
-                      max_overflow_frac=max_overflow_frac, order=order,
-                      windows=windows)
+    sr = search if search is not None else _plan_search(
+        points, eps, tgt, src, edge_w, bm=bm, wmax=wmax,
+        max_overflow_frac=max_overflow_frac, order=order, windows=windows)
     n, n_pad, nb, R, we = sr["n"], sr["n_pad"], sr["nb"], sr["R"], sr["we"]
     perm, rank = sr["perm"], sr["rank"]
     tgt_s, src_s, w_s = sr["tgt_s"], sr["src_s"], sr["w_s"]

@@ -188,30 +188,45 @@ def assert_same_on_all_hosts(x, tag: str = "value") -> None:
     """Cross-host determinism check: every process must hold identical
     ``x`` (the multi-controller contract — divergent host values silently
     corrupt collectives).  No-op single-process; on multi-process runs
-    each process contributes its value on its own devices' shards of a
-    stacked array, the stack is all-gathered, and every row must match —
-    works for uneven per-process device counts (see ``_replicate``)."""
+    each process contributes a fixed-size DIGEST of (dtype, shape, bytes)
+    on its own device's shard of a stacked uint8 array, the stack is
+    all-gathered, and every row must match.
+
+    Digests, not raw values, because the exchange must be robust to
+    exactly the divergence it checks for: different per-rank SHAPES would
+    make a raw-value collective shape-mismatch and hang instead of
+    raising, and float rows would be silently canonicalized to f32 when
+    x64 is off (the on-TPU CLI default), comparing unequal for identical
+    f64 inputs.  uint8 is never canonicalized and the digest length is
+    fixed.  Works for uneven per-process device counts (see
+    ``_replicate``)."""
     if jax.process_count() == 1:
         return
+    import hashlib
+
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
     x = np.asarray(x)
+    h = hashlib.blake2b(digest_size=32)
+    h.update(str((x.dtype.str, x.shape)).encode())
+    h.update(np.ascontiguousarray(x).tobytes())
+    digest = np.frombuffer(h.digest(), dtype=np.uint8)
     # one row per PROCESS (not per device — same-process rows would be
     # identical copies), on a mesh of one representative device per
     # process; the callback materializes only ADDRESSABLE shards, so each
-    # row carries the value of the process owning that device
+    # row carries the digest of the process owning that device
     rep_dev = {}
     for d in jax.devices():
         rep_dev.setdefault(d.process_index, d)
     reps = [rep_dev[p] for p in sorted(rep_dev)]
     mesh = Mesh(np.asarray(reps), ("p",))
     stacked = jax.make_array_from_callback(
-        (len(reps),) + x.shape,
+        (len(reps), digest.size),
         NamedSharding(mesh, PartitionSpec("p")),
-        lambda idx: x[np.newaxis],  # every shard is one (local) row
+        lambda idx: digest[np.newaxis],  # every shard is one (local) row
     )
     rows = _replicate(stacked)
-    if not all(np.array_equal(rows[i], x) for i in range(len(reps))):
+    if not all(np.array_equal(rows[i], digest) for i in range(len(reps))):
         raise AssertionError(
             f"{tag} differs between hosts (process {jax.process_index()}): "
             "multi-controller programs must compute identical host values"

@@ -219,3 +219,32 @@ def test_sharded_superstep_honesty_gates():
     if shs.layout != "offsets":
         with pytest.raises(ValueError, match="does not fit"):
             UnstructuredSolver(shs, nt=4, superstep=2)
+
+
+def test_sharded_superstep_refuses_cadence_with_no_k_block(tmp_path):
+    """Checkpoint cadence shorter than K makes every segment too short for
+    a K-block: the run must refuse (same honesty rule as the elastic
+    gates), not silently step per-exchange under the flag."""
+    op, sh = _offsets_cloud_4dev(seed=9)
+    s = UnstructuredSolver(sh, nt=8, backend="jit", superstep=2,
+                           checkpoint_path=str(tmp_path / "c.npz"),
+                           ncheckpoint=1)
+    s.test_init()
+    with pytest.raises(RuntimeError, match="cannot engage"):
+        s.do_work()
+
+
+def test_plan_default_literals_match_build_plan_signature():
+    """The windowed worthwhileness gate calls _plan_search with literal
+    defaults so its search can be reused by the default windowed_plan()
+    build; those literals must track build_plan's signature defaults."""
+    import inspect
+
+    from nonlocalheatequation_tpu.ops.windowed import build_plan
+
+    sig = inspect.signature(build_plan)
+    assert sig.parameters["bm"].default == 128
+    assert sig.parameters["wmax"].default == 4096
+    assert sig.parameters["max_overflow_frac"].default == 0.02
+    assert sig.parameters["order"].default == "morton"
+    assert sig.parameters["windows"].default == 2

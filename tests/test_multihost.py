@@ -313,6 +313,12 @@ def test_assert_same_detects_divergence():
         "from nonlocalheatequation_tpu.parallel import multihost;"
         "multihost.init_from_env(sys.argv[1], int(sys.argv[2]),"
         " int(sys.argv[3]));"
+        # x64 is OFF in these children (only the platform is forced):
+        # identical f64 host values must STILL pass — the digest exchange
+        # must not let device-side f32 canonicalization corrupt the
+        # comparison
+        "multihost.assert_same_on_all_hosts(np.arange(3.0) + 0.123456789,"
+        " 'same-f64');"
         "x = np.arange(3.0) + jax.process_index();"
         "\ntry:\n"
         "    multihost.assert_same_on_all_hosts(x, 'divergent')\n"

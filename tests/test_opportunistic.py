@@ -18,10 +18,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "tools", "tpu_opportunistic.sh")
 
 ALL_STEPS = [
-    "bench4096", "resident512", "carried4096", "superstep2", "sanity",
-    "superstep2-tm128", "superstep3-tm96", "autotune", "tm160", "tm192",
-    "tm224", "tm256", "stretch8192", "table-a", "table-b", "table-c",
-    "profile",
+    "bench4096", "resident512", "carried4096", "superstep2", "autotune",
+    "table-unstructured", "table-elastic", "table-elastic-general",
+    "table-unstructured3d", "table-eps-sweep", "sanity",
+    "superstep2-tm128", "superstep3-tm96", "tm160", "tm192",
+    "tm224", "tm256", "stretch8192", "table-methods2d", "table-small2d",
+    "table-dist2d", "table-scaling", "table-3d", "profile",
 ]
 
 
@@ -36,7 +38,7 @@ def _run(tmp_path, leave_undone, extra_env, timeout=560):
     # (same hygiene as tests/test_bench_harness.py)
     for k in ("BENCH_PLATFORM", "BENCH_CARRIED", "BENCH_RESIDENT",
               "BENCH_FAULT", "BENCH_METHOD", "BENCH_GRID", "BENCH_LADDER",
-              "NLHEAT_TM"):
+              "BENCH_ACCURACY", "NLHEAT_TM"):
         env.pop(k, None)
     env.update(
         OPP_GATE_BACKEND="cpu",

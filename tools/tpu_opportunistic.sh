@@ -60,25 +60,36 @@ touch "$STATE"
 # superstep3+tm96 ~0.89 vs the carried ~2.2) — a clean Mosaic allocation
 # error just strikes the step.
 #
-# Order = VERDICT r4 priority: headline+accuracy (bench4096 runs the
-# on-device accuracy gate inside its ladder) -> copy-floor variant A/Bs
-# -> sanity -> forced-tm Mosaic probes -> autotune-default validation ->
-# unstructured/elastic TPU rows (table-c) -> tm fine sweep -> stretch ->
-# remaining tables -> profile.
+# Order = VERDICT r4 priority, re-cut 2026-08-02 after the first live
+# window measured ~15 min end to end: every step ahead of sanity is a
+# SHORT step (one or a few compiles), so a 15-min window always banks
+# whole steps instead of dying inside a 30-45-min bundle.  The old
+# table-a/b/c bundles are split into one step per bench_table group for
+# the same reason (the generic table-* case below).  headline+accuracy
+# (bench4096, banked 08-02) -> copy-floor variant A/Bs -> autotune-
+# default validation -> unstructured/elastic TPU rows -> sanity ->
+# forced-tm Mosaic probes -> tm fine sweep -> stretch -> remaining
+# tables -> profile.
 #
 # Window-budget classes (VERDICT r4 #8; the queue resumes mid-list, so a
 # short window banks the prefix that fits):
-#   ~90 s   : gate alone (compile ~25 s + 512^2 ladder) — always banked
+#   ~60 s   : gate alone (compile ~25 s + 512^2 ladder; accuracy pass
+#             skipped — banked once by bench4096) — always banked
 #   ~5 min  : + bench4096 (three-rung ladder, one compile per rung,
 #             accuracy gate at the end) — the round's headline
-#   ~15 min : + resident512/carried4096/superstep2 (one compile each,
+#   ~12 min : + resident512/carried4096/superstep2 (one compile each,
 #             ~2-4 min/step)
-#   ~45 min : + sanity (per-config subprocess sweep, 30-min internal cap)
-#   ~2 h    : + tm probes, autotune (4-5 probe compiles/shape), table-c
-#   beyond  : tm sweep, stretch8192 (compile headroom), table-a/b, profile
-STEPS="bench4096 resident512 carried4096 superstep2 sanity \
-superstep2-tm128 superstep3-tm96 autotune table-c tm160 tm192 tm224 tm256 \
-stretch8192 table-a table-b profile"
+#   ~30 min : + autotune (4-5 probe compiles/shape) and the first
+#             table-* groups (a few configs each)
+#   ~1.5 h  : + sanity (30-min internal cap), forced-tm probes
+#   beyond  : tm sweep, stretch8192 (compile headroom), remaining
+#             tables, profile
+STEPS="bench4096 resident512 carried4096 superstep2 autotune \
+table-unstructured table-elastic table-elastic-general \
+table-unstructured3d table-eps-sweep sanity \
+superstep2-tm128 superstep3-tm96 tm160 tm192 tm224 tm256 \
+stretch8192 table-methods2d table-small2d table-dist2d table-scaling \
+table-3d profile"
 
 log() { echo "[opp $(date -u +%H:%M:%S)] $*" | tee -a "$OUT"; }
 
@@ -131,13 +142,16 @@ run_step_cmd() {  # the queue's one name->command map
       bench_nofb BENCH_GRID=8192 BENCH_LADDER=8192 \
         BENCH_RUNG_TIMEOUT_S=300 BENCH_WATCHDOG_S=600 ;;
     sanity) python tools/tpu_sanity.py ;;
-    table-a) timeout -k 10 "$HARD_CAP_S" \
-      env BT_STEPS=200 python tools/bench_table.py methods2d small2d ;;
-    table-b) timeout -k 10 "$HARD_CAP_S" \
-      env BT_STEPS=200 python tools/bench_table.py dist2d scaling 3d ;;
-    table-c) timeout -k 10 "$HARD_CAP_S" \
-      env BT_STEPS=200 python tools/bench_table.py \
-        unstructured unstructured3d elastic elastic-general eps-sweep ;;
+    table-*)
+      # guard the wildcard: an unknown group must fail instantly (the old
+      # '*' branch behavior), not burn a heal window on re-gate + strikes
+      case " methods2d small2d dist2d scaling 3d unstructured \
+unstructured3d elastic elastic-general eps-sweep " in
+        *" ${1#table-} "*) ;;
+        *) log "unknown step $1"; return 2 ;;
+      esac
+      timeout -k 10 "$HARD_CAP_S" \
+        env BT_STEPS=200 python tools/bench_table.py "${1#table-}" ;;
     autotune) timeout -k 10 "$HARD_CAP_S" \
       env BT_STEPS=200 python tools/bench_table.py autotune ;;
     profile) bench_nofb BENCH_PROFILE=docs/bench/profile_r03b ;;
@@ -249,7 +263,10 @@ gate_window() {
   log "window gate: 512^2 no-fallback bench"
   local run
   run=$(mktemp)
-  bench_nofb BENCH_GRID=512 BENCH_LADDER=512 >"$run" 2>&1
+  # accuracy pass skipped: it costs ~2 min of host-side f64 oracle per
+  # gate (gates run at every window open AND after every step failure)
+  # and the on-device accuracy evidence is banked once by bench4096
+  bench_nofb BENCH_GRID=512 BENCH_LADDER=512 BENCH_ACCURACY=0 >"$run" 2>&1
   local rc=$?
   cat "$run" >>"$OUT"
   if [ $rc -eq 0 ] && grep -q "\"backend\": \"$GATE_BACKEND\"" "$run"; then

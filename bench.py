@@ -54,6 +54,7 @@ probe phase to N seconds instead of the default 45% of the watchdog —
 for hosts whose tunnel is known to fail fast, and the fault tests).
 """
 
+import glob
 import json
 import os
 import queue
@@ -101,6 +102,44 @@ _emit_once = threading.Lock()
 _emitted = False
 
 
+def _banked_tpu_evidence():
+    """Newest on-TPU artifact promoted by tools/tpu_opportunistic.sh.
+
+    The axon tunnel heals in short, unpredictable windows; the runner
+    banks driver-shaped no-fallback artifacts the moment one opens
+    (docs/bench/BENCH_live_r*-<stamp>.json).  When THIS run cannot reach
+    the TPU, the emitted line attaches that banked measurement — clearly
+    labeled as not-from-this-run — so the artifact of record points at
+    the real hardware evidence instead of silently reading as CPU-only.
+    Never raises (the one-JSON-line contract survives any artifact rot).
+    """
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        paths = glob.glob(os.path.join(here, "docs", "bench",
+                                       "BENCH_live_r*-*.json"))
+    except Exception:
+        return None
+    # promotion names embed STAMP=YYYYMMDD-HHMMSS after the first dash
+    for p in sorted(paths,
+                    key=lambda p: os.path.basename(p).split("-", 1)[-1],
+                    reverse=True):
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+            if rec.get("backend") == "tpu" and rec.get("value", 0) > 0:
+                keep = {k: rec[k] for k in (
+                    "value", "vs_baseline", "vs_baseline_basis", "grid",
+                    "ms_per_step", "device", "accuracy") if k in rec}
+                keep["source"] = "docs/bench/" + os.path.basename(p)
+                keep["note"] = ("on-device measurement banked by "
+                                "tools/tpu_opportunistic.sh during a "
+                                "tunnel heal window; NOT from this run")
+                return keep
+        except Exception:
+            continue  # one rotten artifact must not hide older good ones
+    return None
+
+
 def emit(value, vs_baseline, extra=None, error=None):
     """Print the JSON line once; returns True if this call was the one."""
     global _emitted
@@ -117,6 +156,10 @@ def emit(value, vs_baseline, extra=None, error=None):
             rec.update(extra)
         if error is not None:
             rec["error"] = error
+        if rec.get("backend") != "tpu":
+            banked = _banked_tpu_evidence()
+            if banked is not None:
+                rec["banked_tpu_evidence"] = banked
         # print under the lock: the watchdog must not observe _emitted=True
         # (and exit) before the line is actually flushed
         print(json.dumps(rec), flush=True)

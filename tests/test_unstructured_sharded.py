@@ -28,6 +28,15 @@ def jittered_cloud(m=16, seed=0):
     return pts, h
 
 
+def cloud_op(m=32, seed=0):
+    """The canonical multihost-test operator: every process (and the
+    parent) must build bit-identical physics from the same seed — the
+    multi-controller init contract.  One definition so the constants
+    cannot drift between the crash writer and the resume readers."""
+    pts, h = jittered_cloud(m=m, seed=seed)
+    return UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-6, vol=h * h)
+
+
 @pytest.mark.parametrize("ndev", [1, 8])
 def test_sharded_apply_matches_single_device(ndev):
     pts, h = jittered_cloud()

@@ -18,7 +18,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "tools", "tpu_opportunistic.sh")
 
 ALL_STEPS = [
-    "bench4096", "resident512", "carried4096", "superstep2", "autotune",
+    "bench4096", "resident512", "carried4096", "superstep2",
+    "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
     "superstep2-tm128", "superstep3-tm96", "tm160", "tm192",

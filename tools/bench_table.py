@@ -488,14 +488,26 @@ def bench_autotune(steps: int):
     n_sm = cfg("BT_AT_GRID2D_SM", 512, 64)
     n_lg = cfg("BT_AT_GRID2D", 4096, 128)
     n_3d = cfg("BT_AT_GRID3D", 256, 24)
-    shapes = [("2d", (n_sm, n_sm), 8), ("2d", (n_lg, n_lg), 8),
-              ("3d", (n_3d, n_3d, n_3d), 4)]
+    shapes = [("2d-sm", "2d", (n_sm, n_sm), 8),
+              ("2d-lg", "2d", (n_lg, n_lg), 8),
+              ("3d", "3d", (n_3d, n_3d, n_3d), 4)]
+    # BT_AT_SHAPES selects a subset (comma list of the keys above): the
+    # opportunistic queue runs one shape per step so a short heal window
+    # banks shapes individually instead of losing an all-or-nothing bundle
+    sel = os.environ.get("BT_AT_SHAPES")
+    if sel:
+        want = {s.strip() for s in sel.split(",") if s.strip()}
+        unknown = want - {key for key, _, _, _ in shapes}
+        if unknown:
+            raise ValueError(f"BT_AT_SHAPES unknown keys {sorted(unknown)}; "
+                             f"valid: {[key for key, _, _, _ in shapes]}")
+        shapes = [s for s in shapes if s[0] in want]
     # off-TPU the pallas candidates run interpreter-mode (slow but small
     # shapes above) — the smoke run still exercises the full probe+pick
     # machinery, which is the point
     method = "pallas"
     rng = np.random.default_rng(0)
-    for dim, shape, eps in shapes:
+    for _key, dim, shape, eps in shapes:
         mk = NonlocalOp2D if dim == "2d" else NonlocalOp3D
         op = mk(eps, k=1.0, dt=1.0, dh=1.0 / shape[0], method=method)
         op = mk(eps, k=1.0, dt=stable_dt(op), dh=1.0 / shape[0],

@@ -79,12 +79,13 @@ touch "$STATE"
 #             accuracy gate at the end) — the round's headline
 #   ~12 min : + resident512/carried4096/superstep2 (one compile each,
 #             ~2-4 min/step)
-#   ~30 min : + autotune (4-5 probe compiles/shape) and the first
-#             table-* groups (a few configs each)
+#   ~30 min : + autotune-* (one shape per step, 4-5 probe compiles
+#             each) and the first table-* groups (a few configs each)
 #   ~1.5 h  : + sanity (30-min internal cap), forced-tm probes
 #   beyond  : tm sweep, stretch8192 (compile headroom), remaining
 #             tables, profile
-STEPS="bench4096 resident512 carried4096 superstep2 autotune \
+STEPS="bench4096 resident512 carried4096 superstep2 \
+autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
 superstep2-tm128 superstep3-tm96 tm160 tm192 tm224 tm256 \
@@ -152,8 +153,15 @@ unstructured3d elastic elastic-general eps-sweep " in
       esac
       timeout -k 10 "$HARD_CAP_S" \
         env BT_STEPS=200 python tools/bench_table.py "${1#table-}" ;;
-    autotune) timeout -k 10 "$HARD_CAP_S" \
-      env BT_STEPS=200 python tools/bench_table.py autotune ;;
+    autotune-2d512) timeout -k 10 "$HARD_CAP_S" \
+      env BT_STEPS=200 BT_AT_SHAPES=2d-sm python tools/bench_table.py \
+        autotune ;;
+    autotune-2d4096) timeout -k 10 "$HARD_CAP_S" \
+      env BT_STEPS=200 BT_AT_SHAPES=2d-lg python tools/bench_table.py \
+        autotune ;;
+    autotune-3d256) timeout -k 10 "$HARD_CAP_S" \
+      env BT_STEPS=200 BT_AT_SHAPES=3d python tools/bench_table.py \
+        autotune ;;
     profile) bench_nofb BENCH_PROFILE=docs/bench/profile_r03b ;;
     *) log "unknown step $1"; return 2 ;;
   esac
@@ -179,7 +187,7 @@ step_variant_ok() {  # <name> <run-log>: opt-in kernel actually engaged?
   # numeric — a degenerate run where every candidate errored (winner
   # defaults to per-step with a null timing) must not bank the step.
   case $1 in
-    autotune) python - "$2" <<'PYEOF'
+    autotune-*) python - "$2" <<'PYEOF'
 import json, sys
 ok = False
 for line in open(sys.argv[1]):

@@ -121,27 +121,33 @@ run_step_cmd() {  # the queue's one name->command map
       fi
       rm -f "$live"
       return "$rc4" ;;
-    resident512) bench_nofb BENCH_RESIDENT=1 BENCH_GRID=512 BENCH_LADDER=512 ;;
+    # variant/tm/stretch steps pin BENCH_ACCURACY=0: the on-device
+    # accuracy evidence is banked ONCE by bench4096, and the gate ladder
+    # costs ~2-4 min per run — at ~15-min windows that halves (or worse)
+    # the A/B rows a window can bank
+    resident512) bench_nofb BENCH_RESIDENT=1 BENCH_GRID=512 \
+      BENCH_LADDER=512 BENCH_ACCURACY=0 ;;
     carried4096)
-      bench_nofb BENCH_CARRIED=1 BENCH_GRID="$GRID_LG" BENCH_LADDER="$GRID_LG" ;;
+      bench_nofb BENCH_CARRIED=1 BENCH_GRID="$GRID_LG" \
+        BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
     superstep2)
       bench_nofb BENCH_SUPERSTEP=2 BENCH_GRID="$GRID_LG" \
-        BENCH_LADDER="$GRID_LG" ;;
+        BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
     superstep2-tm128)
       bench_nofb BENCH_SUPERSTEP=2 NLHEAT_TM=128 BENCH_GRID="$GRID_LG" \
-        BENCH_LADDER="$GRID_LG" ;;
+        BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
     superstep3-tm96)
       bench_nofb BENCH_SUPERSTEP=3 NLHEAT_TM=96 BENCH_GRID="$GRID_LG" \
-        BENCH_LADDER="$GRID_LG" ;;
+        BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
     tm160 | tm192 | tm224 | tm256)
       bench_nofb "NLHEAT_TM=${1#tm}" BENCH_GRID="$GRID_LG" \
-        BENCH_LADDER="$GRID_LG" ;;
+        BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
     stretch8192)
       # 4x the headline's work per rung: give the silent-phase watchdog
       # compile headroom — a mid-compile kill is the documented wedge
       # deepener (docs/bench/README.md)
       bench_nofb BENCH_GRID=8192 BENCH_LADDER=8192 \
-        BENCH_RUNG_TIMEOUT_S=300 BENCH_WATCHDOG_S=600 ;;
+        BENCH_RUNG_TIMEOUT_S=300 BENCH_WATCHDOG_S=600 BENCH_ACCURACY=0 ;;
     sanity) python tools/tpu_sanity.py ;;
     table-*)
       # guard the wildcard: an unknown group must fail instantly (the old

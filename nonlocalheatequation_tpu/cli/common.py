@@ -66,6 +66,35 @@ def add_platform_flags(p: argparse.ArgumentParser):
     )
 
 
+def add_precision_flags(p: argparse.ArgumentParser):
+    """Precision-tier flags shared by the solve CLIs (ops/constants.py):
+    the default f32 tier is bit-identical to the pre-tier code; bf16
+    reads every operator operand at half the bytes with f32-or-better
+    accumulation and an f32 time-integration carry, under its own
+    measured accuracy contract (constants.BF16_L2_BUDGET)."""
+    p.add_argument(
+        "--precision",
+        default="f32",
+        choices=("f32", "bf16"),
+        help="operand-storage precision tier: f32 (default, exact legacy "
+             "behavior) or bf16 (half-bandwidth operand reads, f32 "
+             "accumulate + carry; relaxed, documented accuracy budget)",
+    )
+    p.add_argument(
+        "--resync",
+        type=int,
+        default=0,
+        metavar="R",
+        help="bf16 tier only: run a full-precision step every R steps "
+             "(0 = never) to bound operand-rounding drift",
+    )
+
+
+def precision_kwargs(args) -> dict:
+    """The solver kwargs for add_precision_flags' namespace."""
+    return {"precision": args.precision, "resync_every": args.resync}
+
+
 def apply_platform_config(args):
     """The config-only half of :func:`apply_platform`: safe to run before
     ``init_multihost`` because it never queries the backend (a query

@@ -11,6 +11,7 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
+    add_precision_flags,
     apply_platform,
     bool_flag,
     run_batch,
@@ -38,6 +39,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log", action="store_true",
                    help="write csv/vtu logs every nlog steps")
     add_platform_flags(p)
+    add_precision_flags(p)
     return p
 
 
@@ -45,7 +47,8 @@ def make_solver(args, nx, nt, eps, k, dt, dx):
     from nonlocalheatequation_tpu.models.solver1d import Solver1D
 
     return Solver1D(nx, nt, eps, nlog=args.nlog, k=k, dt=dt, dx=dx,
-                    backend=args.backend)
+                    backend=args.backend, precision=args.precision,
+                    resync_every=args.resync)
 
 
 def main(argv=None) -> int:

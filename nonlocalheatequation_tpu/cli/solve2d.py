@@ -12,6 +12,7 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
+    add_precision_flags,
     apply_platform,
     bool_flag,
     run_batch,
@@ -47,6 +48,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the solve into DIR")
     add_platform_flags(p)
+    add_precision_flags(p)
     return p
 
 
@@ -70,7 +72,9 @@ def main(argv=None) -> int:
         return Solver2D(nx, ny, nt, eps, nlog=args.nlog, k=k, dt=dt, dh=dh,
                         backend=args.backend, method=args.method,
                         checkpoint_path=args.checkpoint,
-                        ncheckpoint=args.ncheckpoint)
+                        ncheckpoint=args.ncheckpoint,
+                        precision=args.precision,
+                        resync_every=args.resync)
 
     if args.test_batch:
         # row: nx ny nt eps k dt dh  (tests/2d.txt)

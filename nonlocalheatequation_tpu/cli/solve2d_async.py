@@ -18,6 +18,7 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
+    add_precision_flags,
     apply_platform,
     bool_flag,
     run_batch,
@@ -48,6 +49,7 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("auto", "conv", "shift", "sat", "pallas"))
     p.add_argument("--log", action="store_true")
     add_platform_flags(p)
+    add_precision_flags(p)
     return p
 
 
@@ -61,7 +63,8 @@ def main(argv=None) -> int:
     def make_solver(nx, ny, np_parts, nt, eps, k, dt, dh):
         return Solver2D(nx * np_parts, ny * np_parts, nt, eps, nlog=args.nlog,
                         k=k, dt=dt, dh=dh, backend="jit", method=args.method,
-                        nd=args.nd)
+                        nd=args.nd, precision=args.precision,
+                        resync_every=args.resync)
 
     if args.test_batch:
         # row: nx ny np nt eps k dt dh  (tests/2d_async.txt)

@@ -17,6 +17,7 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
+    add_precision_flags,
     bool_flag,
     check_same_input_state,
     cli_startup,
@@ -67,6 +68,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the solve into DIR")
     add_platform_flags(p)
+    add_precision_flags(p)
     return p
 
 
@@ -117,6 +119,15 @@ def main(argv=None) -> int:
     # rebalancing.  The plain path stays on the fused SPMD program.
     use_elastic = (assignment is not None or args.nbalance > 0
                    or args.test_load_balance)
+    if args.resync:
+        # honesty rule: neither the SPMD scan nor the elastic executor has
+        # a per-step precision switch (Solver2DDistributed refuses the
+        # kwarg; ElasticSolver2D does not take it) — never swallow the
+        # flag and silently skip the full-precision steps it promises
+        print("--resync is not supported on the distributed/elastic "
+              "paths; run the serial solver, or --precision bf16 "
+              "without --resync", file=sys.stderr)
+        return 1
     # --superstep on the elastic path: gang stretches exchange one
     # K*eps-wide halo per K steps (gang.make_gang_run_superstep — the
     # communication-avoiding schedule under arbitrary placement); measured
@@ -150,6 +161,7 @@ def main(argv=None) -> int:
                 checkpoint_path=args.checkpoint,
                 ncheckpoint=args.ncheckpoint,
                 superstep=args.superstep,
+                precision=args.precision,
             )
             if args.test_load_balance:
                 s.measure = True  # report measured rates even without nbalance
@@ -166,7 +178,8 @@ def main(argv=None) -> int:
             nx, ny, npx, npy, nt, eps, nlog=args.nlog,
             k=k, dt=dt, dh=dh, mesh=mesh, method=args.method,
             checkpoint_path=args.checkpoint, ncheckpoint=args.ncheckpoint,
-            superstep=args.superstep,
+            superstep=args.superstep, precision=args.precision,
+            resync_every=args.resync,
         )
 
     if args.test_batch:

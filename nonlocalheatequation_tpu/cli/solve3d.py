@@ -15,6 +15,7 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
+    add_precision_flags,
     bool_flag,
     check_same_input_state,
     cli_startup,
@@ -53,6 +54,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="resume from the --checkpoint file before running")
     add_platform_flags(p)
+    add_precision_flags(p)
     return p
 
 
@@ -70,6 +72,14 @@ def main(argv=None) -> int:
         # path under a flag that claims the communication-avoiding schedule
         print("--superstep requires --distributed (the serial solvers have "
               "no halo exchange to avoid)", file=sys.stderr)
+        return 1
+    if args.distributed and args.resync:
+        # honesty rule: the distributed scan has no per-step precision
+        # switch (see Solver2DDistributed); accepting --resync and
+        # ignoring it would silently claim drift bounding that never runs
+        print("--resync is not supported with --distributed; run the "
+              "serial solver, or --precision bf16 without --resync",
+              file=sys.stderr)
         return 1
     if args.distributed and args.backend == "oracle":
         print("--distributed runs the SPMD jit solver; it has no oracle "
@@ -99,11 +109,14 @@ def main(argv=None) -> int:
                                        k=k, dt=dt, dh=dh, method=args.method,
                                        checkpoint_path=args.checkpoint,
                                        ncheckpoint=args.ncheckpoint,
-                                       superstep=args.superstep)
+                                       superstep=args.superstep,
+                                       precision=args.precision)
         return Solver3D(nx, ny, nz, nt, eps, nlog=args.nlog, k=k, dt=dt,
                         dh=dh, backend=args.backend, method=args.method,
                         checkpoint_path=args.checkpoint,
-                        ncheckpoint=args.ncheckpoint)
+                        ncheckpoint=args.ncheckpoint,
+                        precision=args.precision,
+                        resync_every=args.resync)
 
     if args.test_batch:
         # row: nx ny nz nt eps k dt dh

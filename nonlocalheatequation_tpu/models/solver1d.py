@@ -35,9 +35,12 @@ class Solver1D:
         backend: str = "oracle",
         logger=None,
         dtype=None,
+        precision: str = "f32",
+        resync_every: int = 0,
     ):
         self.nx, self.nt, self.eps, self.nlog = int(nx), int(nt), int(eps), int(nlog)
-        self.op = NonlocalOp1D(eps, k, dt, dx)
+        self.op = NonlocalOp1D(eps, k, dt, dx, precision=precision,
+                               resync_every=resync_every)
         self.backend = backend
         self.logger = logger
         self.dtype = dtype

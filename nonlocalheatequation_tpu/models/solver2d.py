@@ -50,10 +50,14 @@ class Solver2D(CheckpointMixin, ManufacturedMetrics2D):
         dtype=None,
         checkpoint_path: str | None = None,
         ncheckpoint: int = 0,
+        precision: str = "f32",
+        resync_every: int = 0,
     ):
         self.nx, self.ny = int(nx), int(ny)
         self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
-        self.op = NonlocalOp2D(eps, k, dt, dh, method=method)
+        self.op = NonlocalOp2D(eps, k, dt, dh, method=method,
+                               precision=precision,
+                               resync_every=resync_every)
         self.backend = backend
         self.nd = nd  # dispatch-ahead depth (async analog); None = unthrottled
         self.logger = logger

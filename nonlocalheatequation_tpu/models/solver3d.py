@@ -39,10 +39,14 @@ class Solver3D(CheckpointMixin, ManufacturedMetrics2D):
         dtype=None,
         checkpoint_path: str | None = None,
         ncheckpoint: int = 0,
+        precision: str = "f32",
+        resync_every: int = 0,
     ):
         self.nx, self.ny, self.nz = int(nx), int(ny), int(nz)
         self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
-        self.op = NonlocalOp3D(eps, k, dt, dh, method=method)
+        self.op = NonlocalOp3D(eps, k, dt, dh, method=method,
+                               precision=precision,
+                               resync_every=resync_every)
         self.backend = backend
         self.logger = logger
         self.dtype = dtype

@@ -25,6 +25,74 @@ These reproduce the *code's* constants, not the paper's (SURVEY.md section 0):
 
 import math
 
+# --------------------------------------------------------------------------
+# Precision tiers
+# --------------------------------------------------------------------------
+#
+# The reference is f64-only C++ (SURVEY.md section 0); precision tiers are a
+# capability of the reimplementation.  A tier names the STORAGE/OPERAND
+# precision of the neighbor-sum reads — the bandwidth-heavy side of the
+# memory-bound kernels — never the precision of the accumulation or of the
+# time-integration carry:
+#
+# * "f32" (default): the state dtype is used end to end.  Bit-identical to
+#   the pre-tier code by construction (no rounding is inserted anywhere).
+# * "bf16": every operator evaluation reads the bfloat16 ROUNDING of the
+#   state (operand windows at half the bytes), accumulates in the state
+#   dtype (f32 in production, f64 on the CPU oracle suite), and the forward-
+#   Euler carry u + dt*du stays in the state dtype — the classic mixed-
+#   precision shape (low-precision storage, high-precision accumulate+master).
+#   The center term Wsum*u uses the SAME rounded operand as the neighbor
+#   sum, so L(const) == 0 holds exactly in the tier too.
+#
+# Error model (documented; pinned by tests/test_precision_tier.py): bf16
+# carries an 8-bit mantissa, so rounding injects a relative perturbation
+# ~2^-9 into the OPERAND of L each step.  Because the carry is f32, the
+# perturbation enters the state only through dt*L(round(u)) — scaled by
+# dt*c*h^d*Wsum, which forward-Euler stability bounds by <= 1 — so per-step
+# state error is O(2^-9 * |u|) *damped by the diffusion dynamics*, not a
+# compounding rounding of the carry itself.  It still cannot meet the 1e-12
+# oracle-parity bar of the f32 fast paths (the operand rounding is real), so
+# the tier ships with its own measured-accuracy contract below instead of
+# pretending to bit-parity.  ``resync_every=R`` additionally evaluates every
+# R-th step's operator on the UNROUNDED state (a full-precision step) for
+# workloads that want to bound operand-rounding drift further.
+
+PRECISION_TIERS = ("f32", "bf16")
+
+# Manufactured-solution accuracy budget for the bf16 tier, at a STABLE
+# timestep.  Stability caveat (measured, not theoretical): several of the
+# reference's ctest parameter rows sit marginally past the forward-Euler
+# bound dt*c*h^d*Wsum <= 1 and only look stable because f32/f64 rounding
+# seeds the amplified modes at ~1e-7/1e-16 — the bf16 tier re-seeds them
+# at ~2^-9 every step, which those configs amplify into garbage.  The
+# tier is therefore contracted (and tested) at dt = 0.8x the stability
+# bound, the regime bench.py and any production run use.  Measured
+# error_l2/#points there: ~3.5e-7 across 48^2/eps4, 50^2/eps5, 64^2/eps8
+# at nt 40-45 (tests/test_precision_tier.py re-measures each run) — the
+# tier meets the reference's own 1e-6 bar at these scales, and the
+# pinned budget below adds ~6x margin so a real regression fails loudly
+# while backend jitter does not.  The f32 contract (1e-6) is NOT
+# relaxed — this budget exists only for paths that explicitly opted into
+# precision="bf16".
+BF16_L2_BUDGET = 2e-6
+
+# Autotuner gate for the precision dimension (utils/autotune.py): a bf16
+# candidate may only win a probe if its multi-step output stays within
+# this l2/#points of the f32 per-step program on the same probe state.
+# Probe states are O(1) random fields over PROBE_STEPS steps; the bound
+# is derived from the same 2^-9-per-step operand model with margin.
+BF16_TUNE_GATE = 1e-5
+
+
+def validate_precision(precision: str) -> str:
+    """Validate a precision-tier name (see PRECISION_TIERS above)."""
+    if precision not in PRECISION_TIERS:
+        raise ValueError(
+            f"unknown precision tier {precision!r}; valid: {PRECISION_TIERS}"
+        )
+    return precision
+
 
 def c_1d(k: float, eps: int, dx: float) -> float:
     """1D scaling constant, integer-truncated exactly like the reference.

@@ -39,6 +39,19 @@ identical up to float addition order):
 
 ``shift`` and ``conv`` are identical up to float addition order; ``sat``
 additionally reassociates across the whole column (see caveat above).
+
+Precision tiers (``precision=`` on the 1D/2D/3D ops; ops/constants.py):
+``"f32"`` (default) changes nothing — the pre-tier programs are produced
+bit for bit.  ``"bf16"`` evaluates every neighbor sum AND the matching
+``Wsum * u`` center term on the bfloat16 ROUNDING of the state (operand
+windows at half the bytes on the bandwidth-bound kernels), accumulated in
+the state dtype, while the forward-Euler carry ``u + dt * du`` stays in
+the state dtype — mixed precision with an f32 master.  ``resync_every=R``
+runs every R-th step's operator on the unrounded state (a full-precision
+step) to bound operand-rounding drift.  The tier holds a measured
+accuracy contract (constants.BF16_L2_BUDGET, tests/test_precision_tier),
+not the f32 paths' 1e-12 oracle parity — bf16 rounding of ``u`` makes
+that bar unreachable by construction, and we say so rather than fake it.
 """
 
 from __future__ import annotations
@@ -51,7 +64,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from nonlocalheatequation_tpu.ops.constants import c_1d, c_2d, c_3d
+from nonlocalheatequation_tpu.ops.constants import (
+    c_1d,
+    c_2d,
+    c_3d,
+    validate_precision,
+)
 from nonlocalheatequation_tpu.ops.stencil import (
     column_half_heights,
     horizon_mask_1d,
@@ -63,10 +81,46 @@ from nonlocalheatequation_tpu.ops.stencil import (
 TWO_PI = 2.0 * np.pi
 
 
-class NonlocalOp1D:
+def _bf16_round(x: jnp.ndarray) -> jnp.ndarray:
+    """bf16 storage rounding, upcast back to the accumulate dtype.
+
+    The round-trip IS the tier's semantic: values pass through bfloat16
+    (8-bit mantissa) exactly once, then every add runs in the original
+    (>= f32) dtype.  On TPU the compiled kernels read genuinely-bf16
+    operands instead; this form is the backend-independent reference the
+    CPU suite pins them against."""
+    return x.astype(jnp.bfloat16).astype(x.dtype)
+
+
+class _PrecisionPolicy:
+    """Shared precision-tier plumbing for the grid operators.
+
+    Sets ``self.precision`` / ``self.resync_every`` (validated) and
+    provides ``_operand`` — the tier's state-operand transform, applied to
+    every neighbor-sum input and center term so the operator stays
+    internally consistent (L(const) == 0 exactly in any tier).
+    """
+
+    def _init_precision(self, precision: str, resync_every: int) -> None:
+        self.precision = validate_precision(precision)
+        self.resync_every = int(resync_every)
+        if self.resync_every < 0:
+            raise ValueError(f"resync_every must be >= 0, got {resync_every}")
+        if self.resync_every and self.precision == "f32":
+            raise ValueError(
+                "resync_every is a bf16-tier knob; precision='f32' already "
+                "evaluates every step at full precision"
+            )
+
+    def _operand(self, x: jnp.ndarray) -> jnp.ndarray:
+        return _bf16_round(x) if self.precision == "bf16" else x
+
+
+class NonlocalOp1D(_PrecisionPolicy):
     """1D horizon operator (reference: src/1d_nonlocal_serial.cpp:198-206)."""
 
-    def __init__(self, eps: int, k: float, dt: float, dx: float, influence=None):
+    def __init__(self, eps: int, k: float, dt: float, dx: float, influence=None,
+                 precision: str = "f32", resync_every: int = 0):
         self.eps = int(eps)
         self.k = float(k)
         self.dt = float(dt)
@@ -74,6 +128,7 @@ class NonlocalOp1D:
         self.c = c_1d(k, eps, dx)
         self.weights = influence_weights(horizon_mask_1d(self.eps), influence, dx)
         self.wsum = float(self.weights.sum())
+        self._init_precision(precision, resync_every)
 
     # -- neighbor sum -------------------------------------------------------
     def neighbor_sum_np(self, u: np.ndarray) -> np.ndarray:
@@ -88,7 +143,7 @@ class NonlocalOp1D:
         return acc
 
     def neighbor_sum(self, u: jnp.ndarray) -> jnp.ndarray:
-        up = jnp.pad(u, (self.eps, self.eps))
+        up = self._operand(jnp.pad(u, (self.eps, self.eps)))
         nx = u.shape[0]
         acc = jnp.zeros_like(u)
         for o in range(2 * self.eps + 1):
@@ -102,7 +157,9 @@ class NonlocalOp1D:
         return self.c * self.dx * (self.neighbor_sum_np(u) - self.wsum * u)
 
     def apply(self, u: jnp.ndarray) -> jnp.ndarray:
-        return self.c * self.dx * (self.neighbor_sum(u) - self.wsum * u)
+        return self.c * self.dx * (
+            self.neighbor_sum(u) - self.wsum * self._operand(u)
+        )
 
     def spatial_profile(self, nx: int, x0: int = 0) -> np.ndarray:
         """G[x] = sin(2*pi*(x*dx)) for global positions x0..x0+nx."""
@@ -159,7 +216,7 @@ def _auto_method_3d(eps: int, nx: int, ny: int, nz: int, dtype, backend=None) ->
     )
 
 
-class NonlocalOp2D:
+class NonlocalOp2D(_PrecisionPolicy):
     """2D horizon operator (reference: src/2d_nonlocal_serial.cpp:256-270).
 
     Arrays are indexed [x, y] with shape (nx, ny), mirroring the reference's
@@ -174,6 +231,8 @@ class NonlocalOp2D:
         dh: float,
         influence=None,
         method: str = "conv",
+        precision: str = "f32",
+        resync_every: int = 0,
     ):
         self.eps = int(eps)
         self.k = float(k)
@@ -181,13 +240,24 @@ class NonlocalOp2D:
         self.dh = float(dh)
         self.c = c_2d(k, eps, dh)
         self.mask = horizon_mask_2d(self.eps)
+        self._influence = influence  # kept so with_precision can rebuild
         self.weights = influence_weights(self.mask, influence, dh)
         self.wsum = float(self.weights.sum())
         self.uniform = influence is None  # J == 1: sat/pallas paths are valid
         if method in ("sat", "pallas", "auto") and not self.uniform:
             method = "conv"
         self.method = method
+        self._init_precision(precision, resync_every)
         self._auto_cache: dict = {}
+
+    def with_precision(self, precision: str, resync_every: int = 0
+                       ) -> "NonlocalOp2D":
+        """Twin operator differing only in precision tier (autotune's
+        precision dimension and the resync full-precision step use it)."""
+        return NonlocalOp2D(
+            self.eps, self.k, self.dt, self.dh, influence=self._influence,
+            method=self.method, precision=precision,
+            resync_every=resync_every)
 
     def _resolve_method(self, nx: int, ny: int, dtype) -> str:
         """Concrete method for this (shape, dtype); 'auto' picks per backend:
@@ -247,6 +317,22 @@ class NonlocalOp2D:
         return self._neighbor_sum_shift(upad)
 
     def _neighbor_sum_conv(self, upad: jnp.ndarray) -> jnp.ndarray:
+        if self.precision == "bf16" and self.uniform and \
+                upad.dtype == jnp.float32:
+            # genuine mixed-precision conv: bf16 operand and 0/1 mask (both
+            # exact in bf16) accumulated in f32 via preferred_element_type —
+            # the MXU/VPU-native shape of the tier
+            out = lax.conv_general_dilated(
+                upad.astype(jnp.bfloat16)[None, None],
+                jnp.asarray(self.weights, jnp.bfloat16)[None, None],
+                window_strides=(1, 1),
+                padding="VALID",
+                preferred_element_type=jnp.float32,
+            )
+            return out[0, 0]
+        # general form: round the STATE operand only (weighted J masks keep
+        # their full-precision weights — the tier rounds u, not the physics)
+        upad = self._operand(upad)
         kern = jnp.asarray(self.weights, dtype=upad.dtype)[None, None]
         out = lax.conv_general_dilated(
             upad[None, None],
@@ -258,6 +344,7 @@ class NonlocalOp2D:
 
     def _neighbor_sum_shift(self, upad: jnp.ndarray) -> jnp.ndarray:
         e = self.eps
+        upad = self._operand(upad)
         nx, ny = upad.shape[0] - 2 * e, upad.shape[1] - 2 * e
         acc = jnp.zeros((nx, ny), upad.dtype)
         heights = column_half_heights(e)
@@ -276,7 +363,8 @@ class NonlocalOp2D:
 
         e = self.eps
         nx, ny = upad.shape[0] - 2 * e, upad.shape[1] - 2 * e
-        fn = build_neighbor_sum_2d(e, nx, ny, np.dtype(upad.dtype).name)
+        fn = build_neighbor_sum_2d(e, nx, ny, np.dtype(upad.dtype).name,
+                                   precision=self.precision)
         return fn(upad)
 
     def _neighbor_sum_sat(self, upad: jnp.ndarray) -> jnp.ndarray:
@@ -287,6 +375,7 @@ class NonlocalOp2D:
         at y is P[y + h_i + 1] - P[y - h_i] on the padded array.
         """
         e = self.eps
+        upad = self._operand(upad)
         nx, ny = upad.shape[0] - 2 * e, upad.shape[1] - 2 * e
         # exclusive prefix sum along y, length ny + 2e + 1
         p = jnp.concatenate(
@@ -306,14 +395,16 @@ class NonlocalOp2D:
         return self.c * self.dh * self.dh * (self.neighbor_sum_np(u) - self.wsum * u)
 
     def apply(self, u: jnp.ndarray) -> jnp.ndarray:
-        return self.c * self.dh * self.dh * (self.neighbor_sum(u) - self.wsum * u)
+        return self.c * self.dh * self.dh * (
+            self.neighbor_sum(u) - self.wsum * self._operand(u)
+        )
 
     def apply_padded(self, upad: jnp.ndarray) -> jnp.ndarray:
         """L(u) for a halo-padded block: returns the (nx, ny) interior result."""
         e = self.eps
-        center = lax.slice(
+        center = self._operand(lax.slice(
             upad, (e, e), (upad.shape[0] - e, upad.shape[1] - e)
-        )
+        ))
         return self.c * self.dh * self.dh * (
             self.neighbor_sum_padded(upad) - self.wsum * center
         )
@@ -423,6 +514,12 @@ def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
     ksup = int(os.environ.get("NLHEAT_SUPERSTEP", 0) or 0)
     resident_on = os.environ.get("NLHEAT_RESIDENT") == "1"
     tune_env = os.environ.get("NLHEAT_AUTOTUNE")
+    bf16 = getattr(op, "precision", "f32") == "bf16"
+    if bf16 and getattr(op, "resync_every", 0) > 0:
+        # the periodic full-precision step lives only on the base scan path
+        # (the frame variants would have to re-plumb it per kernel); the
+        # knob is an accuracy lever, not a throughput one
+        return make_multi_step_fn_base(op, nsteps, g, lg, dtype)
 
     def autotune_on():
         # evaluated only AFTER the structural gate: jax.default_backend()
@@ -476,10 +573,13 @@ def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
             fn = built.get(key)
             if fn is None:
                 dt_ = dtype or u.dtype
-                if (resident_on and ndim == 2
+                # residency has no bf16 tier (zero HBM traffic between
+                # steps leaves nothing for bf16 storage to halve) — the
+                # bf16 production path is per-step/carried/superstep only
+                if (resident_on and not bf16 and ndim == 2
                         and fits_resident(*u.shape, op.eps, dt_)):
                     fn = make_resident_multi_step_fn(op, nsteps, dtype)
-                elif (resident_on and ndim == 3
+                elif (resident_on and not bf16 and ndim == 3
                         and fits_resident_3d(*u.shape, op.eps, dt_)):
                     fn = make_resident_multi_step_fn_3d(op, nsteps, dtype)
                 elif (ksup >= 2 and ndim == 2
@@ -497,22 +597,42 @@ def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None):
 
 
 def make_multi_step_fn_base(op, nsteps: int, g=None, lg=None, dtype=None):
-    """The plain lax.scan form of make_multi_step_fn (always available)."""
+    """The plain lax.scan form of make_multi_step_fn (always available).
+
+    bf16 tier with ``resync_every=R``: every R-th step (absolute timestep
+    index — stable across checkpoint/resume segment boundaries) evaluates
+    the operator on the UNROUNDED state via an f32 twin op, bounding
+    operand-rounding drift; ``R=1`` degenerates to the f32 path exactly.
+    The state arg is donated to XLA on TPU (utils/donation.py) so the big
+    rungs stop double-buffering the input frame next to the output.
+    """
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
     step = make_step_fn(op, g, lg, dtype)
+    resync = (getattr(op, "precision", "f32") == "bf16"
+              and getattr(op, "resync_every", 0) > 0)
+    if resync:
+        step_hi = make_step_fn(op.with_precision("f32"), g, lg, dtype)
+        R = op.resync_every
 
-    def body(u, t):
-        return step(u, t), None
+        def body(u, t):
+            nxt = lax.cond((t + 1) % R == 0,
+                           lambda uu: step_hi(uu, t),
+                           lambda uu: step(uu, t), u)
+            return nxt, None
+    else:
+        def body(u, t):
+            return step(u, t), None
 
-    @jax.jit
     def multi(u, t0):
         ts = t0 + jnp.arange(nsteps)
         out, _ = lax.scan(body, u, ts)
         return out
 
-    return multi
+    return donated_jit(multi)
 
 
-class NonlocalOp3D:
+class NonlocalOp3D(_PrecisionPolicy):
     """3D horizon operator (extension: no 3D solver exists in the reference).
 
     Applies the reference's discretization recipe once more per axis: the
@@ -533,6 +653,8 @@ class NonlocalOp3D:
         dh: float,
         influence=None,
         method: str = "sat",
+        precision: str = "f32",
+        resync_every: int = 0,
     ):
         self.eps = int(eps)
         self.k = float(k)
@@ -540,18 +662,28 @@ class NonlocalOp3D:
         self.dh = float(dh)
         self.c = c_3d(k, eps, dh)
         self.mask = horizon_mask_3d(self.eps)
+        self._influence = influence  # kept so with_precision can rebuild
         self.weights = influence_weights(self.mask, influence, dh)
         self.wsum = float(self.weights.sum())
         self.uniform = influence is None
         if method in ("sat", "pallas", "auto") and not self.uniform:
             method = "shift"
         self.method = method
+        self._init_precision(precision, resync_every)
         self._auto_cache: dict = {}
         # column half-heights along z per (i, j) offset, derived from the
         # mask itself so the raster rule lives only in ops/stencil.py;
         # -1 = column outside the sphere
         colsum = self.mask.sum(axis=2).astype(np.int64)
         self._zh = np.where(colsum > 0, (colsum - 1) // 2, -1)
+
+    def with_precision(self, precision: str, resync_every: int = 0
+                       ) -> "NonlocalOp3D":
+        """Twin operator differing only in precision tier (see NonlocalOp2D)."""
+        return NonlocalOp3D(
+            self.eps, self.k, self.dt, self.dh, influence=self._influence,
+            method=self.method, precision=precision,
+            resync_every=resync_every)
 
     # -- neighbor sum -------------------------------------------------------
     def neighbor_sum_np(self, u: np.ndarray) -> np.ndarray:
@@ -598,8 +730,11 @@ class NonlocalOp3D:
                 build_neighbor_sum_3d,
             )
 
-            fn = build_neighbor_sum_3d(e, nx, ny, nz, np.dtype(upad.dtype).name)
+            fn = build_neighbor_sum_3d(e, nx, ny, nz,
+                                       np.dtype(upad.dtype).name,
+                                       precision=self.precision)
             return fn(upad)
+        upad = self._operand(upad)
         if method == "sat":
             # exclusive prefix along z: one window difference per (i, j)
             p = jnp.concatenate(
@@ -634,12 +769,14 @@ class NonlocalOp3D:
         return self.c * self.dh**3 * (self.neighbor_sum_np(u) - self.wsum * u)
 
     def apply(self, u: jnp.ndarray) -> jnp.ndarray:
-        return self.c * self.dh**3 * (self.neighbor_sum(u) - self.wsum * u)
+        return self.c * self.dh**3 * (
+            self.neighbor_sum(u) - self.wsum * self._operand(u)
+        )
 
     def apply_padded(self, upad: jnp.ndarray) -> jnp.ndarray:
         e = self.eps
-        center = lax.slice(
-            upad, (e, e, e), tuple(s - e for s in upad.shape))
+        center = self._operand(lax.slice(
+            upad, (e, e, e), tuple(s - e for s in upad.shape)))
         return self.c * self.dh**3 * (
             self.neighbor_sum_padded(upad) - self.wsum * center
         )

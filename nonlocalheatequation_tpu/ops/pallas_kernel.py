@@ -52,6 +52,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from nonlocalheatequation_tpu.ops.stencil import column_half_heights
+from nonlocalheatequation_tpu.utils.compat import array_vma, out_struct
 
 TWO_PI = 2.0 * np.pi
 
@@ -77,10 +78,28 @@ def _on_tpu() -> bool:
 
 def _kernel_params():
     if _on_tpu():
-        return dict(
-            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
-        )
+        # CompilerParams was TPUCompilerParams before the pallas rename
+        cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+        return dict(compiler_params=cls(vmem_limit_bytes=_VMEM_LIMIT))
     return dict(interpret=True)
+
+
+def _elem_spec(shape, index_map, memory_space):
+    """Element-indexed BlockSpec, API-portable.
+
+    The kernels below index every block in ELEMENTS (windows overlap by
+    the halo/chain pad, which block-unit indexing cannot express).
+    Modern pallas spells that ``pl.Element`` per dim; pre-Element pallas
+    (jaxlib 0.4.x) spells the identical semantics
+    ``indexing_mode=pl.unblocked`` — verified equivalent on overlapping
+    windows in interpreter mode.
+    """
+    if hasattr(pl, "Element"):
+        return pl.BlockSpec(
+            tuple(pl.Element(s) for s in shape), index_map,
+            memory_space=memory_space)
+    return pl.BlockSpec(tuple(shape), index_map, memory_space=memory_space,
+                        indexing_mode=pl.unblocked)
 
 
 def _window_pad(eps: int) -> int:
@@ -157,9 +176,14 @@ def _choose_tm(nx: int, ny: int, eps: int, itemsize: int, n_aux: int,
     return max(cap, 8)
 
 
-def _fits_carried(tm: int, nx: int, ny: int, eps: int, itemsize: int) -> bool:
+def _fits_carried(tm: int, nx: int, ny: int, eps: int, itemsize: int,
+                  bf16: bool = False) -> bool:
     """_fits for the carried frame: window is (D - eps) rows taller (rounded
-    to 8) and the output block spans the full Lc = ny + 2*eps lanes."""
+    to 8) and the output block spans the full Lc = ny + 2*eps lanes.  The
+    bf16 tier adds the f32 carry block, the upcast window copy and the
+    bf16 shadow output (conservatively one extra window + three blocks —
+    the bf16-sized buffers are counted at full itemsize like everything
+    else in this deliberately pessimistic model)."""
     D = _round_up(eps, 8)
     tmw = tm + _round_up((D - eps) + _window_pad(eps), 8)
     Lc = ny + 2 * eps
@@ -168,6 +192,8 @@ def _fits_carried(tm: int, nx: int, ny: int, eps: int, itemsize: int) -> bool:
     log_steps = max(1, int(np.ceil(np.log2(tmw))))
     lane_slots = _lane_slots({(h, L) for h, _j0, L in _lane_runs(eps)})
     stack = (2 * log_steps + 6 + lane_slots) * window + 3 * out
+    if bf16:
+        stack += window + 3 * out
     return stack <= _VMEM_BUDGET
 
 
@@ -409,38 +435,58 @@ def _reject_f64_on_tpu(dtype):
         )
 
 
+def _reject_bf16_variant(op, what: str) -> None:
+    """Variants without a bf16 tier must refuse a bf16-tier op loudly:
+    silently running the f32 function would break the tier's rule that
+    every dispatchable variant computes the identical (rounded-operand)
+    result — the invariant the autotuner's swaps rely on."""
+    if getattr(op, "precision", "f32") == "bf16":
+        raise ValueError(
+            f"the {what} has no bf16 precision tier; use the per-step, "
+            "carried, or superstep 2D paths (or precision='f32')"
+        )
+
+
 @functools.lru_cache(maxsize=None)
-def build_neighbor_sum_2d(eps: int, nx: int, ny: int, dtype_name: str):
-    """(upad: (nx+2e, ny+2e)) -> (nx, ny) masked-circle neighbor sum."""
+def build_neighbor_sum_2d(eps: int, nx: int, ny: int, dtype_name: str,
+                          precision: str = "f32"):
+    """(upad: (nx+2e, ny+2e)) -> (nx, ny) masked-circle neighbor sum.
+
+    ``precision="bf16"``: the operand window streams HBM->VMEM in
+    bfloat16 (half the bytes on the kernel's dominant read) and is upcast
+    to the compute dtype at load, so every add of the dyadic/NAF plan
+    still accumulates at full precision — the mixed-precision tier of
+    ops/nonlocal_op (bf16 storage reads, f32-or-better accumulate).
+    """
     dtype = jnp.dtype(dtype_name)
     _reject_f64_on_tpu(dtype)
+    bf16 = precision == "bf16"
     tm = _choose_tm(nx, ny, eps, dtype.itemsize, n_aux=0)
     tmw = tm + _window_pad(eps)
 
     def kernel(win_ref, out_ref):
-        out_ref[:] = _strip_neighbor_sum(win_ref[:], tm, ny, eps).astype(dtype)
+        w = win_ref[:]
+        if bf16:
+            w = w.astype(dtype)  # upcast once; the plan accumulates in dtype
+        out_ref[:] = _strip_neighbor_sum(w, tm, ny, eps).astype(dtype)
 
     def neighbor_sum(upad):
         # vma: propagate mesh-axis variance so the kernel works under
         # shard_map with check_vma (empty outside shard_map)
-        vma = jax.typeof(upad).vma
+        vma = array_vma(upad)
         upad, nxp = _pad_operand(upad, nx, tm, tmw, eps)
+        if bf16:
+            upad = upad.astype(jnp.bfloat16)
         out = pl.pallas_call(
             kernel,
             grid=(nxp // tm,),
             in_specs=[
-                pl.BlockSpec(
-                    (pl.Element(tmw), pl.Element(ny + 2 * eps)),
-                    lambda i: (i * tm, 0),
-                    memory_space=pltpu.VMEM,
-                )
+                _elem_spec((tmw, ny + 2 * eps), lambda i: (i * tm, 0),
+                           pltpu.VMEM)
             ],
-            out_specs=pl.BlockSpec(
-                (pl.Element(tm), pl.Element(ny)),
-                lambda i: (i * tm, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            out_shape=jax.ShapeDtypeStruct((nxp, ny), dtype, vma=vma),
+            out_specs=_elem_spec((tm, ny), lambda i: (i * tm, 0),
+                                 pltpu.VMEM),
+            out_shape=out_struct((nxp, ny), dtype, vma=vma),
             **_kernel_params(),
         )(upad)
         return out[:nx]
@@ -459,19 +505,31 @@ def _build_step_kernel(
     dt: float,
     wsum: float,
     test: bool,
+    precision: str = "f32",
 ):
+    """``precision="bf16"``: the overlapping window operand streams in
+    bfloat16 and is upcast at load (the operator — neighbor sum AND its
+    Wsum*center term — sees the rounded state, accumulated in ``dtype``),
+    while the Euler carry reads an exact-sized full-precision center
+    block, so ``u + dt*du`` never rounds the state through bf16."""
     dtype = jnp.dtype(dtype_name)
     _reject_f64_on_tpu(dtype)
-    tm = _choose_tm(nx, ny, eps, dtype.itemsize, n_aux=2 if test else 0)
+    bf16 = precision == "bf16"
+    n_aux = (2 if test else 0) + (1 if bf16 else 0)
+    tm = _choose_tm(nx, ny, eps, dtype.itemsize, n_aux=n_aux)
     tmw = tm + _window_pad(eps)
     scale = c * dh * dh
 
     def kernel(*refs):
+        refs = list(refs)
+        win_ref = refs.pop(0)
+        ctr_ref = refs.pop(0) if bf16 else None
         if test:
-            win_ref, g_ref, lg_ref, sc_ref, out_ref = refs
-        else:
-            win_ref, out_ref = refs
+            g_ref, lg_ref, sc_ref = refs[0], refs[1], refs[2]
+        out_ref = refs[-1]
         w = win_ref[:]
+        if bf16:
+            w = w.astype(dtype)
         acc = _strip_neighbor_sum(w, tm, ny, eps)
         center = w[eps : eps + tm, eps : eps + ny]
         du = scale * (acc - wsum * center)
@@ -480,30 +538,32 @@ def _build_step_kernel(
             sin_a = sc_ref[0, 0]
             cos_a = sc_ref[0, 1]
             du = du + (-TWO_PI * sin_a) * g_ref[:] + (-cos_a) * lg_ref[:]
-        nxt = center + dt * du
+        carry = ctr_ref[:] if bf16 else center
+        nxt = carry + dt * du
         # Rows past the true domain (strip padding, when tm does not divide
         # nx) are sliced off by the caller and re-zeroed by the next step's
         # pad — no masking needed here.
         out_ref[:] = nxt.astype(dtype)
 
-    elem = lambda *shape: pl.BlockSpec(  # noqa: E731
-        tuple(pl.Element(s) for s in shape),
-        (lambda i: (i * tm, 0)) if len(shape) == 2 else None,
-        memory_space=pltpu.VMEM,
+    elem = lambda *shape: _elem_spec(  # noqa: E731
+        shape, (lambda i: (i * tm, 0)) if len(shape) == 2 else None,
+        pltpu.VMEM,
     )
 
     def step_padded(upad, g, lg, sincos):
         """One fused Euler step; operands pre-padded to strip multiples."""
-        vma = jax.typeof(upad).vma
+        vma = array_vma(upad)
         nxp = upad.shape[0] - (tmw - tm)
         in_specs = [
-            pl.BlockSpec(
-                (pl.Element(tmw), pl.Element(ny + 2 * eps)),
-                lambda i: (i * tm, 0),
-                memory_space=pltpu.VMEM,
-            )
+            _elem_spec((tmw, ny + 2 * eps), lambda i: (i * tm, 0),
+                       pltpu.VMEM)
         ]
-        args = [upad]
+        args = [upad.astype(jnp.bfloat16) if bf16 else upad]
+        if bf16:
+            # full-precision Euler carry: the exact-sized center blocks of
+            # the same padded state, read alongside the bf16 window
+            in_specs.append(elem(tm, ny))
+            args.append(lax.slice(upad, (eps, eps), (eps + nxp, eps + ny)))
         if test:
             in_specs += [
                 elem(tm, ny),
@@ -515,12 +575,9 @@ def _build_step_kernel(
             kernel,
             grid=(nxp // tm,),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec(
-                (pl.Element(tm), pl.Element(ny)),
-                lambda i: (i * tm, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            out_shape=jax.ShapeDtypeStruct((nxp, ny), dtype, vma=vma),
+            out_specs=_elem_spec((tm, ny), lambda i: (i * tm, 0),
+                                 pltpu.VMEM),
+            out_shape=out_struct((nxp, ny), dtype, vma=vma),
             **_kernel_params(),
         )(*args)
         return out
@@ -712,10 +769,16 @@ def _choose_tiles_3d(nx: int, ny: int, nz: int, eps: int, itemsize: int,
 
 
 @functools.lru_cache(maxsize=None)
-def build_neighbor_sum_3d(eps: int, nx: int, ny: int, nz: int, dtype_name: str):
-    """(upad: (nx+2e, ny+2e, nz+2e)) -> (nx, ny, nz) masked-sphere sum."""
+def build_neighbor_sum_3d(eps: int, nx: int, ny: int, nz: int, dtype_name: str,
+                          precision: str = "f32"):
+    """(upad: (nx+2e, ny+2e, nz+2e)) -> (nx, ny, nz) masked-sphere sum.
+
+    ``precision="bf16"``: bf16 operand window, upcast at load, full-
+    precision accumulation — see build_neighbor_sum_2d.
+    """
     dtype = jnp.dtype(dtype_name)
     _reject_f64_on_tpu(dtype)
+    bf16 = precision == "bf16"
     tm, tn = _choose_tiles_3d(nx, ny, nz, eps, dtype.itemsize)
     pad = _strip_plan_3d(eps)[3]
     tmw = tm + pad
@@ -731,12 +794,16 @@ def build_neighbor_sum_3d(eps: int, nx: int, ny: int, nz: int, dtype_name: str):
 
     def kernel(win_ref, out_ref):
         w = win_ref[:, :ywin, :] if ywin_blk != ywin else win_ref[:]
+        if bf16:
+            w = w.astype(dtype)
         out_ref[:] = _block_neighbor_sum_3d(
             w, tm, tn, nz, eps
         ).astype(dtype)
 
     def neighbor_sum(upad):
-        vma = jax.typeof(upad).vma
+        vma = array_vma(upad)
+        if bf16:
+            upad = upad.astype(jnp.bfloat16)
         nxp, nyp = _round_up(nx, tm), _round_up(ny, tn)
         # pad x so every strip window is in range; pad y so the widened
         # y window of the last block stays in range
@@ -750,19 +817,13 @@ def build_neighbor_sum_3d(eps: int, nx: int, ny: int, nz: int, dtype_name: str):
             kernel,
             grid=(nxp // tm, nyp // tn),
             in_specs=[
-                pl.BlockSpec(
-                    (pl.Element(tmw), pl.Element(ywin_blk),
-                     pl.Element(nz + 2 * eps)),
-                    lambda i, j: (i * tm, j * tn, 0),
-                    memory_space=pltpu.VMEM,
-                )
+                _elem_spec((tmw, ywin_blk, nz + 2 * eps),
+                           lambda i, j: (i * tm, j * tn, 0), pltpu.VMEM)
             ],
-            out_specs=pl.BlockSpec(
-                (pl.Element(tm), pl.Element(tn), pl.Element(nz)),
-                lambda i, j: (i * tm, j * tn, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            out_shape=jax.ShapeDtypeStruct((nxp, nyp, nz), dtype, vma=vma),
+            out_specs=_elem_spec((tm, tn, nz),
+                                 lambda i, j: (i * tm, j * tn, 0),
+                                 pltpu.VMEM),
+            out_shape=out_struct((nxp, nyp, nz), dtype, vma=vma),
             **_kernel_params(),
         )(upad)
         return out[:nx, :ny]
@@ -772,7 +833,8 @@ def build_neighbor_sum_3d(eps: int, nx: int, ny: int, nz: int, dtype_name: str):
 
 @functools.lru_cache(maxsize=None)
 def _build_carried_kernel(eps: int, nx: int, ny: int, dtype_name: str,
-                          c: float, dh: float, dt: float, wsum: float):
+                          c: float, dh: float, dt: float, wsum: float,
+                          precision: str = "f32"):
     """Multi-step kernel that CARRIES the halo-padded state across steps.
 
     The per-step path pays a `jnp.pad` round-trip (read + write the whole
@@ -798,12 +860,22 @@ def _build_carried_kernel(eps: int, nx: int, ny: int, dtype_name: str,
     Numerics are IDENTICAL to the per-step kernel (same plan, same
     summation order); only the frame bookkeeping differs.  Production
     (source-free) path only — the timed bench rungs.
+
+    ``precision="bf16"``: the scan carries the PAIR (A_f32, A_b16) — the
+    full-precision master frame and its bf16 rounding.  Each step's
+    window streams from A_b16 (half the bytes on the overlapping read),
+    the Euler carry reads the exact-sized f32 center block of A_f32, and
+    the kernel emits both next frames (the bf16 shadow is just the
+    rounding of the masked f32 output, so the next step's operand equals
+    round(state) exactly — bit-identical to the per-step bf16 path).
     """
     dtype = jnp.dtype(dtype_name)
     _reject_f64_on_tpu(dtype)
+    bf16 = precision == "bf16"
     tm = _choose_tm(
         nx, ny, eps, dtype.itemsize, n_aux=0,
-        fits=lambda t: _fits_carried(t, nx, ny, eps, dtype.itemsize))
+        fits=lambda t: _fits_carried(t, nx, ny, eps, dtype.itemsize,
+                                     bf16=bf16))
     D = _round_up(eps, 8)
     tmw = tm + _round_up((D - eps) + _window_pad(eps), 8)
     Lc = ny + 2 * eps
@@ -811,40 +883,61 @@ def _build_carried_kernel(eps: int, nx: int, ny: int, dtype_name: str,
     Rc = max(D + G * tm, (G - 1) * tm + tmw)
     scale = c * dh * dh
 
-    def kernel(win_ref, out_ref):
+    def kernel(*refs):
+        if bf16:
+            win_ref, ctr_ref, out_ref, outb_ref = refs
+        else:
+            (win_ref, out_ref), ctr_ref, outb_ref = refs, None, None
         w = win_ref[:]
+        if bf16:
+            w = w.astype(dtype)
         acc = _strip_neighbor_sum(w, tm, ny, eps, row0=D)
         center = w[D : D + tm, eps : eps + ny]
         du = scale * (acc - wsum * center)
-        nxt = center + dt * du
+        carry = ctr_ref[:, eps : eps + ny] if bf16 else center
+        nxt = carry + dt * du
         i = pl.program_id(0)
         rows = D + i * tm + jax.lax.broadcasted_iota(jnp.int32, (tm, ny), 0)
         ok = (rows >= D + eps) & (rows < D + eps + nx)
-        out_ref[:, eps : eps + ny] = jnp.where(ok, nxt, 0).astype(dtype)
+        val = jnp.where(ok, nxt, 0).astype(dtype)
+        out_ref[:, eps : eps + ny] = val
         out_ref[:, :eps] = jnp.zeros((tm, eps), dtype)
         out_ref[:, eps + ny :] = jnp.zeros((tm, eps), dtype)
+        if bf16:
+            outb_ref[:, eps : eps + ny] = val.astype(jnp.bfloat16)
+            outb_ref[:, :eps] = jnp.zeros((tm, eps), jnp.bfloat16)
+            outb_ref[:, eps + ny :] = jnp.zeros((tm, eps), jnp.bfloat16)
+
+    out_block = _elem_spec(
+        (tm, Lc), lambda i: ((i * (tm // 8) + D // 8) * 8, 0), pltpu.VMEM)
 
     def step(A):
         return pl.pallas_call(
             kernel,
             grid=(G,),
             in_specs=[
-                pl.BlockSpec(
-                    (pl.Element(tmw), pl.Element(Lc)),
-                    lambda i: (i * tm, 0),
-                    memory_space=pltpu.VMEM,
-                )
+                _elem_spec((tmw, Lc), lambda i: (i * tm, 0), pltpu.VMEM)
             ],
-            out_specs=pl.BlockSpec(
-                (pl.Element(tm), pl.Element(Lc)),
-                lambda i: ((i * (tm // 8) + D // 8) * 8, 0),
-                memory_space=pltpu.VMEM,
-            ),
+            out_specs=out_block,
             out_shape=jax.ShapeDtypeStruct((Rc, Lc), dtype),
             **_kernel_params(),
         )(A)
 
-    return step, Rc, Lc, D
+    def step_bf16(Af, Ab):
+        return pl.pallas_call(
+            kernel,
+            grid=(G,),
+            in_specs=[
+                _elem_spec((tmw, Lc), lambda i: (i * tm, 0), pltpu.VMEM),
+                out_block,  # f32 carry blocks, same offsets as the outputs
+            ],
+            out_specs=[out_block, out_block],
+            out_shape=[jax.ShapeDtypeStruct((Rc, Lc), dtype),
+                       jax.ShapeDtypeStruct((Rc, Lc), jnp.bfloat16)],
+            **_kernel_params(),
+        )(Ab, Af)
+
+    return (step_bf16 if bf16 else step), Rc, Lc, D
 
 
 def make_carried_multi_step_fn(op, nsteps: int, dtype=None):
@@ -853,29 +946,39 @@ def make_carried_multi_step_fn(op, nsteps: int, dtype=None):
     Drop-in for ops.nonlocal_op.make_multi_step_fn on the production
     (source-free) path when op.method == 'pallas'; see
     _build_carried_kernel.  The t0 argument is accepted for signature
-    parity (the uniform-J production step is time-independent).
+    parity (the uniform-J production step is time-independent).  The
+    state arg is donated on TPU (utils/donation.py).
     """
-    eps = op.eps
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
 
-    @jax.jit
+    eps = op.eps
+    precision = getattr(op, "precision", "f32")
+
     def multi(u, t0):
         del t0
         dt_ = dtype or u.dtype
         nx, ny = u.shape
         step, Rc, Lc, D = _build_carried_kernel(
-            eps, nx, ny, jnp.dtype(dt_).name, op.c, op.dh, op.dt, op.wsum)
+            eps, nx, ny, jnp.dtype(dt_).name, op.c, op.dh, op.dt, op.wsum,
+            precision)
         C0 = (jnp.zeros((Rc, Lc), dt_)
               .at[D + eps : D + eps + nx, eps : eps + ny]
               .set(u.astype(dt_)))
 
-        A, _ = lax.scan(lambda A, _: (step(A), None), C0, None, length=nsteps)
+        if precision == "bf16":
+            (A, _B), _ = lax.scan(
+                lambda AB, _: (step(AB[0], AB[1]), None),
+                (C0, C0.astype(jnp.bfloat16)), None, length=nsteps)
+        else:
+            A, _ = lax.scan(
+                lambda A, _: (step(A), None), C0, None, length=nsteps)
         return A[D + eps : D + eps + nx, eps : eps + ny]
 
-    return multi
+    return donated_jit(multi)
 
 
 def _fits_superstep(tm: int, nx: int, ny: int, eps: int, itemsize: int,
-                    ksteps: int) -> bool:
+                    ksteps: int, bf16: bool = False) -> bool:
     """_fits for the temporally blocked frame (see
     _build_superstep_kernel): the window is ~K*eps rows taller than the
     carried window and the kernel instantiates K sequential band levels,
@@ -889,12 +992,17 @@ def _fits_superstep(tm: int, nx: int, ny: int, eps: int, itemsize: int,
     log_steps = max(1, int(np.ceil(np.log2(tmw))))
     lane_slots = _lane_slots({(h, L) for h, _j0, L in _lane_runs(eps)})
     stack = ksteps * (2 * log_steps + 6 + lane_slots) * window + 3 * out
+    if bf16:
+        # per-level rounded-operand copy + the f32 carry band + the bf16
+        # shadow output (full-itemsize accounting, like the rest)
+        stack += (ksteps + 1) * window + 3 * out
     return stack <= _VMEM_BUDGET
 
 
 def _build_superstep_kernel(eps: int, nx: int, ny: int, dtype_name: str,
                             c: float, dh: float, dt: float, wsum: float,
-                            ksteps: int, tm: int, D: int, Rc: int):
+                            ksteps: int, tm: int, D: int, Rc: int,
+                            precision: str = "f32"):
     """K-step temporally blocked kernel over the carried frame layout.
 
     The carried kernel still moves ~2 full frames of HBM traffic per step
@@ -922,28 +1030,60 @@ def _build_superstep_kernel(eps: int, nx: int, ny: int, dtype_name: str,
     pins this).  Production (source-free) path only — the timed bench
     rungs.  ``ksteps`` may be smaller than the frame was sized for (the
     remainder kernel reuses the same D/Rc so scan carries stay compatible).
+
+    ``precision="bf16"``: the scan carries the (A_f32, A_b16) pair like
+    the carried kernel.  Level 1's operator reads the bf16 window (half
+    the bytes on the K*eps-expanded read) and its Euler carry reads an
+    aligned f32 band block of the master frame; levels >= 2 advance in
+    f32 VMEM bands, each level rounding ONLY its operator operand to
+    bf16 (matching the per-step bf16 path's round-per-step semantics bit
+    for bit) while the carry adds stay f32 — the time integration never
+    accumulates in bf16 at any level.
     """
     dtype = jnp.dtype(dtype_name)
     _reject_f64_on_tpu(dtype)
+    bf16 = precision == "bf16"
     pad = _window_pad(eps)
     tmw = tm + D + _round_up((ksteps - 1) * eps, 8) + pad
     Lc = ny + 2 * eps
     G = -(-(nx + 2 * eps) // tm)  # out rows [D, D+G*tm) cover halo+real
     scale = c * dh * dh
+    # f32 carry band for level 1 (bf16 tier): rows [D1, D1+H1) of the
+    # master frame per strip, 8-aligned (Mosaic divisibility) with the
+    # band's true start o1 rows into the block
+    lvl1 = D - (ksteps - 1) * eps  # frame row of level 1's band, strip 0
+    D1 = (lvl1 // 8) * 8
+    o1 = lvl1 - D1
+    H1 = _round_up(o1 + tm + 2 * (ksteps - 1) * eps, 8)
 
-    def kernel(win_ref, out_ref):
+    def kernel(*refs):
+        if bf16:
+            win_ref, ctr_ref, out_ref, outb_ref = refs
+        else:
+            (win_ref, out_ref), ctr_ref, outb_ref = refs, None, None
         i = pl.program_id(0)
         state = win_ref[:]
+        if bf16:
+            state = state.astype(dtype)  # rounded OPERAND, f32 compute
         for j in range(1, ksteps + 1):
             bh = tm + 2 * (ksteps - j) * eps
             # window row of this band's first row inside `state`: the
             # level-0 window starts D-(K-1)*eps above the final band;
             # each constructed band array starts exactly at its band
             row0 = (D - (ksteps - 1) * eps) if j == 1 else eps
-            acc = _strip_neighbor_sum(state, bh, ny, eps, row0=row0)
-            center = state[row0 : row0 + bh, eps : eps + ny]
+            opnd = (state.astype(jnp.bfloat16).astype(dtype)
+                    if bf16 and j > 1 else state)
+            acc = _strip_neighbor_sum(opnd, bh, ny, eps, row0=row0)
+            center = opnd[row0 : row0 + bh, eps : eps + ny]
             du = scale * (acc - wsum * center)
-            nxt = center + dt * du
+            if bf16:
+                # f32 Euler carry: level 1 reads the master-frame band,
+                # later levels the f32 state advanced in VMEM
+                carry = (ctr_ref[o1 : o1 + bh, eps : eps + ny] if j == 1
+                         else state[row0 : row0 + bh, eps : eps + ny])
+            else:
+                carry = center
+            nxt = carry + dt * du
             start = i * tm + D - (ksteps - j) * eps  # frame row of band[0]
             rows = start + jax.lax.broadcasted_iota(jnp.int32, (bh, ny), 0)
             ok = (rows >= D + eps) & (rows < D + eps + nx)
@@ -952,6 +1092,11 @@ def _build_superstep_kernel(eps: int, nx: int, ny: int, dtype_name: str,
                 out_ref[:, eps : eps + ny] = nxt
                 out_ref[:, :eps] = jnp.zeros((tm, eps), dtype)
                 out_ref[:, eps + ny :] = jnp.zeros((tm, eps), dtype)
+                if bf16:
+                    outb_ref[:, eps : eps + ny] = nxt.astype(jnp.bfloat16)
+                    outb_ref[:, :eps] = jnp.zeros((tm, eps), jnp.bfloat16)
+                    outb_ref[:, eps + ny :] = jnp.zeros((tm, eps),
+                                                        jnp.bfloat16)
             else:
                 # re-glue the zero lane halo (volumetric BC on the lane
                 # axis) and pad slack rows below for the next level's roll
@@ -971,31 +1116,42 @@ def _build_superstep_kernel(eps: int, nx: int, ny: int, dtype_name: str,
                 # bit-identity; the opaque state does.
                 state = jax.lax.optimization_barrier(state)
 
+    out_block = _elem_spec(
+        (tm, Lc), lambda i: ((i * (tm // 8) + D // 8) * 8, 0), pltpu.VMEM)
+
     def step(A):
         return pl.pallas_call(
             kernel,
             grid=(G,),
             in_specs=[
-                pl.BlockSpec(
-                    (pl.Element(tmw), pl.Element(Lc)),
-                    lambda i: (i * tm, 0),
-                    memory_space=pltpu.VMEM,
-                )
+                _elem_spec((tmw, Lc), lambda i: (i * tm, 0), pltpu.VMEM)
             ],
-            out_specs=pl.BlockSpec(
-                (pl.Element(tm), pl.Element(Lc)),
-                lambda i: ((i * (tm // 8) + D // 8) * 8, 0),
-                memory_space=pltpu.VMEM,
-            ),
+            out_specs=out_block,
             out_shape=jax.ShapeDtypeStruct((Rc, Lc), dtype),
             **_kernel_params(),
         )(A)
 
-    return step
+    def step_bf16(Af, Ab):
+        return pl.pallas_call(
+            kernel,
+            grid=(G,),
+            in_specs=[
+                _elem_spec((tmw, Lc), lambda i: (i * tm, 0), pltpu.VMEM),
+                _elem_spec(
+                    (H1, Lc), lambda i: ((i * (tm // 8) + D1 // 8) * 8, 0),
+                    pltpu.VMEM),
+            ],
+            out_specs=[out_block, out_block],
+            out_shape=[jax.ShapeDtypeStruct((Rc, Lc), dtype),
+                       jax.ShapeDtypeStruct((Rc, Lc), jnp.bfloat16)],
+            **_kernel_params(),
+        )(Ab, Af)
+
+    return step_bf16 if bf16 else step
 
 
 def fits_superstep(nx: int, ny: int, eps: int, ksteps: int,
-                   dtype=jnp.float32) -> bool:
+                   dtype=jnp.float32, precision: str = "f32") -> bool:
     """Whether the K-step temporally blocked kernel is buildable for this
     grid — i.e. even the minimum 8-row strip fits the VMEM stack model.
     The production dispatch (nonlocal_op.make_multi_step_fn) uses this to
@@ -1005,7 +1161,7 @@ def fits_superstep(nx: int, ny: int, eps: int, ksteps: int,
     if forced_tm():
         return True  # the knob bypasses the stack model by contract
     return _fits_superstep(8, nx, ny, eps, jnp.dtype(dtype).itemsize,
-                           max(1, int(ksteps)))
+                           max(1, int(ksteps)), bf16=precision == "bf16")
 
 
 def superstep_k(ksteps: int, nsteps: int) -> int:
@@ -1024,11 +1180,15 @@ def make_superstep_multi_step_fn(op, nsteps: int, ksteps: int = 2,
     _build_superstep_kernel.  A remainder of nsteps % ksteps runs one
     shallower superstep call on the same frame.  The t0 argument is
     accepted for signature parity (the production step is
-    time-independent).
+    time-independent).  The state arg is donated on TPU
+    (utils/donation.py).
     """
-    eps = op.eps
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
 
-    @jax.jit
+    eps = op.eps
+    precision = getattr(op, "precision", "f32")
+    bf16 = precision == "bf16"
+
     def multi(u, t0):
         del t0
         dt_ = dtype or u.dtype
@@ -1037,7 +1197,8 @@ def make_superstep_multi_step_fn(op, nsteps: int, ksteps: int = 2,
         itemsize = jnp.dtype(dt_).itemsize
         tm = _choose_tm(
             nx, ny, eps, itemsize, n_aux=0,
-            fits=lambda t: _fits_superstep(t, nx, ny, eps, itemsize, K))
+            fits=lambda t: _fits_superstep(t, nx, ny, eps, itemsize, K,
+                                           bf16=bf16))
         D = _round_up(K * eps, 8)
         tmw = tm + D + _round_up((K - 1) * eps, 8) + _window_pad(eps)
         Lc = ny + 2 * eps
@@ -1045,19 +1206,32 @@ def make_superstep_multi_step_fn(op, nsteps: int, ksteps: int = 2,
         Rc = max(D + G * tm, (G - 1) * tm + tmw)
         name = jnp.dtype(dt_).name
         step_K = _build_superstep_kernel(
-            eps, nx, ny, name, op.c, op.dh, op.dt, op.wsum, K, tm, D, Rc)
+            eps, nx, ny, name, op.c, op.dh, op.dt, op.wsum, K, tm, D, Rc,
+            precision)
         C0 = (jnp.zeros((Rc, Lc), dt_)
               .at[D + eps : D + eps + nx, eps : eps + ny]
               .set(u.astype(dt_)))
         q, r = divmod(nsteps, K)
-        A, _ = lax.scan(lambda A, _: (step_K(A), None), C0, None, length=q)
-        if r:
-            step_r = _build_superstep_kernel(
-                eps, nx, ny, name, op.c, op.dh, op.dt, op.wsum, r, tm, D, Rc)
-            A = step_r(A)
+        if bf16:
+            (A, B), _ = lax.scan(
+                lambda AB, _: (step_K(AB[0], AB[1]), None),
+                (C0, C0.astype(jnp.bfloat16)), None, length=q)
+            if r:
+                step_r = _build_superstep_kernel(
+                    eps, nx, ny, name, op.c, op.dh, op.dt, op.wsum, r, tm,
+                    D, Rc, precision)
+                A, B = step_r(A, B)
+        else:
+            A, _ = lax.scan(
+                lambda A, _: (step_K(A), None), C0, None, length=q)
+            if r:
+                step_r = _build_superstep_kernel(
+                    eps, nx, ny, name, op.c, op.dh, op.dt, op.wsum, r, tm,
+                    D, Rc)
+                A = step_r(A)
         return A[D + eps : D + eps + nx, eps : eps + ny]
 
-    return multi
+    return donated_jit(multi)
 
 
 def _fits_resident(nx: int, ny: int, eps: int, itemsize: int) -> bool:
@@ -1162,11 +1336,17 @@ def make_resident_multi_step_fn(op, nsteps: int, dtype=None):
 
     Drop-in for make_multi_step_fn on the production path when the grid
     fits VMEM (see _fits_resident; raises otherwise).  The t0 argument is
-    accepted for signature parity.
+    accepted for signature parity.  The state arg is donated on TPU
+    (utils/donation.py).  No bf16 tier: the resident kernel has zero HBM
+    traffic between steps, so there is nothing for bf16 storage to halve
+    — and silently computing the f32 function under a bf16-tier op would
+    break the tier's cross-variant equality contract.
     """
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
+    _reject_bf16_variant(op, "resident kernel")
     eps = op.eps
 
-    @jax.jit
     def multi(u, t0):
         del t0
         dt_ = dtype or u.dtype
@@ -1179,7 +1359,7 @@ def make_resident_multi_step_fn(op, nsteps: int, dtype=None):
         out = run(frame)
         return out[eps : eps + nx, eps : eps + ny]
 
-    return multi
+    return donated_jit(multi)
 
 
 def _fits_resident_3d(nx: int, ny: int, nz: int, eps: int,
@@ -1266,9 +1446,11 @@ def fits_resident_3d(nx: int, ny: int, nz: int, eps: int,
 def make_resident_multi_step_fn_3d(op, nsteps: int, dtype=None):
     """(u, t0) -> u after ``nsteps`` 3D steps, entire run in one
     pallas_call; see make_resident_multi_step_fn."""
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
+    _reject_bf16_variant(op, "resident 3D kernel")
     eps = op.eps
 
-    @jax.jit
     def multi(u, t0):
         del t0
         dt_ = dtype or u.dtype
@@ -1282,7 +1464,7 @@ def make_resident_multi_step_fn_3d(op, nsteps: int, dtype=None):
         out = run(frame)
         return out[eps : eps + nx, eps : eps + ny, eps : eps + nz]
 
-    return multi
+    return donated_jit(multi)
 
 
 @functools.lru_cache(maxsize=None)
@@ -1333,18 +1515,14 @@ def _build_carried_kernel_3d(eps: int, nx: int, ny: int, nz: int,
             kernel,
             grid=(Gx, Gy),
             in_specs=[
-                pl.BlockSpec(
-                    (pl.Element(tmw), pl.Element(ywin), pl.Element(Lz)),
-                    lambda i, j: (i * tm, j * tn, 0),
-                    memory_space=pltpu.VMEM,
-                )
+                _elem_spec((tmw, ywin, Lz),
+                           lambda i, j: (i * tm, j * tn, 0), pltpu.VMEM)
             ],
-            out_specs=pl.BlockSpec(
-                (pl.Element(tm), pl.Element(tn), pl.Element(Lz)),
+            out_specs=_elem_spec(
+                (tm, tn, Lz),
                 lambda i, j: ((i * (tm // 8) + D // 8) * 8,
                               (j * (tn // 8) + D // 8) * 8, 0),
-                memory_space=pltpu.VMEM,
-            ),
+                pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((Rx, Ry, Lz), dtype),
             **_kernel_params(),
         )(A)
@@ -1356,10 +1534,15 @@ def make_carried_multi_step_fn_3d(op, nsteps: int, dtype=None):
     """(u, t0) -> u after ``nsteps`` 3D steps, state carried in padded form.
 
     Drop-in for make_multi_step_fn on the production path when
-    op.method == 'pallas'; see _build_carried_kernel_3d."""
+    op.method == 'pallas'; see _build_carried_kernel_3d.  The state arg
+    is donated on TPU (utils/donation.py).  No bf16 tier yet: the 3D
+    bf16 production path is the per-step kernel (build_neighbor_sum_3d
+    reads bf16 windows); a bf16-tier op is refused loudly here."""
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
+    _reject_bf16_variant(op, "carried 3D kernel")
     eps = op.eps
 
-    @jax.jit
     def multi(u, t0):
         del t0
         dt_ = dtype or u.dtype
@@ -1376,7 +1559,7 @@ def make_carried_multi_step_fn_3d(op, nsteps: int, dtype=None):
         return A[D + eps : D + eps + nx, D + eps : D + eps + ny,
                  eps : eps + nz]
 
-    return multi
+    return donated_jit(multi)
 
 
 def make_pallas_step_fn(op, g=None, lg=None, dtype=None):
@@ -1395,7 +1578,7 @@ def make_pallas_step_fn(op, g=None, lg=None, dtype=None):
         nx, ny = u.shape
         step_padded, tm, tmw = _build_step_kernel(
             eps, nx, ny, np.dtype(u.dtype).name, op.c, op.dh, op.dt,
-            op.wsum, test,
+            op.wsum, test, precision=getattr(op, "precision", "f32"),
         )
         nxp = _round_up(nx, tm)
         upad = jnp.pad(u, ((eps, tmw - tm - eps + (nxp - nx)), (eps, eps)))

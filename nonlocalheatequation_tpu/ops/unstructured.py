@@ -554,7 +554,7 @@ class ShardedUnstructuredOp:
         if halo == "export":
             self._exp_idx = put_global(exp_idx, row)
 
-        from jax import shard_map
+        from nonlocalheatequation_tpu.utils.compat import shard_map
 
         B_ = B
 
@@ -597,7 +597,7 @@ class ShardedUnstructuredOp:
         global boundary, which is exact anyway: no edge crosses the
         boundary, so the corresponding weights are zero."""
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from jax import shard_map
+        from nonlocalheatequation_tpu.utils.compat import shard_map
 
         op = self.inner
         self.layout = "offsets"
@@ -718,7 +718,7 @@ class ShardedUnstructuredOp:
         through the caller's jit as ARGUMENTS (multi-controller rule).
         """
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from jax import shard_map
+        from nonlocalheatequation_tpu.utils.compat import shard_map
 
         from nonlocalheatequation_tpu.ops.nonlocal_op import source_at
 

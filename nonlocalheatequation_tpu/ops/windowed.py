@@ -66,7 +66,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_kernel import _kernel_params, _reject_f64_on_tpu
+from .pallas_kernel import _elem_spec, _kernel_params, _reject_f64_on_tpu
 
 LANE = 128
 
@@ -184,24 +184,13 @@ def _build_windowed_matvec(nb: int, bm: int, we: int, R: int, u_rows: int,
         num_scalar_prefetch=1,
         grid=(nb,),
         in_specs=[
-            pl.BlockSpec(
-                (pl.Element(bm), pl.Element(R * we)),
-                lambda i, s: (i * bm, 0),
-                memory_space=pltpu.VMEM,
-            ),
+            _elem_spec((bm, R * we), lambda i, s: (i * bm, 0), pltpu.VMEM),
         ] + [
-            pl.BlockSpec(
-                (pl.Element(we // LANE), pl.Element(LANE)),
-                lambda i, s, r=r: (s[i, r], 0),
-                memory_space=pltpu.VMEM,
-            )
+            _elem_spec((we // LANE, LANE),
+                       lambda i, s, r=r: (s[i, r], 0), pltpu.VMEM)
             for r in range(R)
         ],
-        out_specs=pl.BlockSpec(
-            (pl.Element(bm), pl.Element(1)),
-            lambda i, s: (i * bm, 0),
-            memory_space=pltpu.VMEM,
-        ),
+        out_specs=_elem_spec((bm, 1), lambda i, s: (i * bm, 0), pltpu.VMEM),
     )
 
     def matvec(s128, P, u2d):

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from nonlocalheatequation_tpu.utils.compat import shard_map
 
 from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, source_at
@@ -81,6 +81,8 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         checkpoint_path: str | None = None,
         ncheckpoint: int = 0,
         superstep: int = 1,
+        precision: str = "f32",
+        resync_every: int = 0,
     ):
         self.nx, self.ny, self.npx, self.npy = int(nx), int(ny), int(npx), int(npy)
         self.NX, self.NY = self.nx * self.npx, self.ny * self.npy
@@ -109,7 +111,18 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         # that agree to the 1e-12 contract but not bitwise (with K == 1
         # segmentation is numerics-neutral).
         self.ksteps = max(1, int(superstep))
-        self.op = NonlocalOp2D(eps, k, dt, dh, method=method)
+        if resync_every:
+            # the distributed scan builds its own step program from
+            # op.apply_padded with no per-step precision switch; accepting
+            # the knob and ignoring it would be a silent lie
+            raise ValueError(
+                "resync_every is not supported on the distributed path; "
+                "run the serial solver, or precision='bf16' without resync"
+            )
+        # the precision tier rides entirely on the op: every shard-local
+        # apply_padded/neighbor_sum_padded call rounds its operand there
+        self.op = NonlocalOp2D(eps, k, dt, dh, method=method,
+                               precision=precision)
         self.mesh = mesh if mesh is not None else choose_mesh_for_grid(self.NX, self.NY)
         self.logger = logger
         self.dtype = dtype

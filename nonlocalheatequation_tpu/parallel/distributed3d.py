@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from nonlocalheatequation_tpu.utils.compat import shard_map
 
 from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D, source_at
@@ -76,6 +76,7 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         checkpoint_path: str | None = None,
         ncheckpoint: int = 0,
         superstep: int = 1,
+        precision: str = "f32",
     ):
         self.NX, self.NY, self.NZ = int(NX), int(NY), int(NZ)
         self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
@@ -83,7 +84,8 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         # communication-avoiding schedule; see Solver2DDistributed, incl.
         # the note that segment boundaries reset the K-grouping)
         self.ksteps = max(1, int(superstep))
-        self.op = NonlocalOp3D(eps, k, dt, dh, method=method)
+        self.op = NonlocalOp3D(eps, k, dt, dh, method=method,
+                               precision=precision)
         self.mesh = (
             mesh if mesh is not None
             else choose_mesh_for_grid_3d(self.NX, self.NY, self.NZ)

@@ -98,12 +98,16 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         ncheckpoint: int = 0,
         measure_window: int | None = None,
         superstep: int = 1,
+        precision: str = "f32",
     ):
         self.nx, self.ny, self.npx, self.npy = int(nx), int(ny), int(npx), int(npy)
         self.NX, self.NY = self.nx * self.npx, self.ny * self.npy
         self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
         self.nbalance = int(nbalance) if nbalance else None
-        self.op = NonlocalOp2D(eps, k, dt, dh, method=method)
+        # the precision tier rides on the op (every tile update goes
+        # through op.apply_padded); no resync on the tiled schedules
+        self.op = NonlocalOp2D(eps, k, dt, dh, method=method,
+                               precision=precision)
         self.devices = list(devices if devices is not None else jax.devices())
         nl = len(self.devices)
         if assignment is None:

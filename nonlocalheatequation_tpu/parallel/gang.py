@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from nonlocalheatequation_tpu.utils.compat import shard_map
 
 # the assembly-order contract: gang halo assembly must mirror the batched
 # bstep band-for-band (the bit-identical guarantee), so share its offsets

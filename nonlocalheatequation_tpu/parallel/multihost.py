@@ -100,6 +100,11 @@ def init_from_env(coordinator: str | None = None,
         kwargs["process_id"] = int(process_id)
     elif os.environ.get("JAX_PROCESS_ID") is not None:
         kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    from nonlocalheatequation_tpu.utils.compat import (
+        enable_cpu_multiprocess_collectives,
+    )
+
+    enable_cpu_multiprocess_collectives()
     jax.distributed.initialize(**kwargs)
     return True
 

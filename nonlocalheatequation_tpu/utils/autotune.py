@@ -11,6 +11,17 @@ the candidates that fit once per (device kind, shape, eps, dtype) and
 runs the winner; because every candidate computes the identical
 function, the swap can never change results.
 
+Precision is a tuned dimension too (opt-in: ``NLHEAT_TUNE_PRECISION=1``
+on an f32-tier op): the probe additionally measures the bf16-tier twins
+of the 2D variants (names suffixed ``+bf16``).  Those candidates compute
+the TIER's function — rounded operand windows, f32 carry — not the f32
+one, so a bf16 winner is only eligible when its probe output passes the
+accuracy gate (l2/#points vs the f32 per-step program within
+constants.BF16_TUNE_GATE); a gated-out tier is recorded in the entry and
+the fastest f32 candidate wins instead.  Within either tier every
+candidate still computes that tier's identical function, so the swap
+cannot change results beyond the gate the caller opted into.
+
 The measurement cache is in-process by default; set
 ``NLHEAT_AUTOTUNE_CACHE=/path/file.json`` to persist winners across
 processes (the file records the measured ms/step per candidate, so it
@@ -92,7 +103,9 @@ def candidates(op, shape, nsteps: int, dtype):
 
     2D tunes per-step/carried/superstep/resident; 3D tunes
     per-step/carried3d/resident3d (no 3D superstep — see docs/round3.md
-    for why temporal blocking loses at 3D block sizes).
+    for why temporal blocking loses at 3D block sizes).  A bf16-tier op
+    excludes the variants with no bf16 implementation (resident 2D/3D,
+    carried3d — they would refuse the op at build time anyway).
     """
     from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn_base
     from nonlocalheatequation_tpu.ops.pallas_kernel import (
@@ -107,43 +120,50 @@ def candidates(op, shape, nsteps: int, dtype):
         superstep_k,
     )
 
+    precision = getattr(op, "precision", "f32")
+    bf16 = precision == "bf16"
     out = [("per-step", lambda o, n, d: make_multi_step_fn_base(o, n, dtype=d))]
     if len(shape) == 3:
         # 3D: carried + resident only (no superstep — temporal blocking
         # read-amplifies ~6x at the 3D kernels' tiny hardware-optimal
         # blocks, docs/round3.md)
-        out.append(("carried3d",
-                    lambda o, n, d: make_carried_multi_step_fn_3d(
-                        o, n, dtype=d)))
-        if fits_resident_3d(*shape, op.eps, dtype):
-            out.append(("resident3d",
-                        lambda o, n, d: make_resident_multi_step_fn_3d(
+        if not bf16:
+            out.append(("carried3d",
+                        lambda o, n, d: make_carried_multi_step_fn_3d(
                             o, n, dtype=d)))
+            if fits_resident_3d(*shape, op.eps, dtype):
+                out.append(("resident3d",
+                            lambda o, n, d: make_resident_multi_step_fn_3d(
+                                o, n, dtype=d)))
         return out
     if len(shape) != 2:
         return out
     out.append(
         ("carried", lambda o, n, d: make_carried_multi_step_fn(o, n, dtype=d)))
     for k in (2, 3):
-        if superstep_k(k, nsteps) == k and fits_superstep(*shape, op.eps, k,
-                                                          dtype):
+        if superstep_k(k, nsteps) == k and fits_superstep(
+                *shape, op.eps, k, dtype, precision=precision):
             out.append(
                 (f"superstep{k}",
                  lambda o, n, d, k=k: make_superstep_multi_step_fn(
                      o, n, ksteps=k, dtype=d)))
-    if fits_resident(*shape, op.eps, dtype):
+    if not bf16 and fits_resident(*shape, op.eps, dtype):
         out.append(
             ("resident",
              lambda o, n, d: make_resident_multi_step_fn(o, n, dtype=d)))
     return out
 
 
+def _probe_state(shape, dtype):
+    return jnp.asarray(
+        np.random.default_rng(0).normal(size=shape).astype(
+            np.dtype(jnp.dtype(dtype).name)))
+
+
 def _measure(maker, op, shape, dtype) -> float:
     """Best seconds/step of a PROBE_STEPS program (compile excluded)."""
     fn = maker(op, PROBE_STEPS, dtype)
-    u = jnp.asarray(
-        np.random.default_rng(0).normal(size=shape).astype(
-            np.dtype(jnp.dtype(dtype).name)))
+    u = _probe_state(shape, dtype)
     t0 = jnp.int32(0)
     out = fn(u, t0)
     float(jnp.sum(out))  # fence (block_until_ready lies over the tunnel)
@@ -154,6 +174,24 @@ def _measure(maker, op, shape, dtype) -> float:
         float(jnp.sum(out))
         best = min(best, time.perf_counter() - t)
     return best / PROBE_STEPS
+
+
+def _bf16_gate(op, op_bf16, shape, dtype) -> dict:
+    """Accuracy gate for the precision dimension: l2/#points between the
+    bf16-tier and f32 per-step programs over the probe run, asserted
+    against constants.BF16_TUNE_GATE.  Fresh device arrays per call —
+    the multi-step entry points donate their state arg on TPU."""
+    from nonlocalheatequation_tpu.ops.constants import BF16_TUNE_GATE
+    from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn_base
+
+    t0 = jnp.int32(0)
+    a = make_multi_step_fn_base(op, PROBE_STEPS, dtype=dtype)(
+        _probe_state(shape, dtype), t0)
+    b = make_multi_step_fn_base(op_bf16, PROBE_STEPS, dtype=dtype)(
+        _probe_state(shape, dtype), t0)
+    l2 = float(jnp.sum((a - b) ** 2)) / float(np.prod(shape))
+    return {"l2_per_n": l2, "budget": BF16_TUNE_GATE,
+            "ok": bool(l2 <= BF16_TUNE_GATE)}
 
 
 def pick_multi_step_fn(op, nsteps: int, shape, dtype):
@@ -175,12 +213,26 @@ def pick_multi_step_fn(op, nsteps: int, shape, dtype):
     # the package version is part of the key: a kernel change can flip the
     # crossovers, and a persistent cache must not serve winners measured
     # under older code forever
+    # precision tier in the key ONLY when non-default: a bf16-tier op's
+    # rates and candidate set differ, but f32 keys keep their historical
+    # format so winners already banked on the live chip stay reusable
+    precision = getattr(op, "precision", "f32")
     key = "/".join([
         f"v{__version__}",
         jax.devices()[0].device_kind, getattr(op, "method", "?"),
         "x".join(map(str, shape)), f"eps{op.eps}", dtype.name,
-    ])
+    ] + ([f"prec-{precision}"] if precision != "f32" else []))
     cands = dict(candidates(op, shape, nsteps, dtype))
+    op_bf16 = None
+    if (os.environ.get("NLHEAT_TUNE_PRECISION") == "1"
+            and getattr(op, "precision", "f32") == "f32"
+            and hasattr(op, "with_precision")):
+        # precision as a tuned dimension: probe the bf16-tier twins too;
+        # a bf16 winner must additionally pass the accuracy gate below
+        op_bf16 = op.with_precision("bf16")
+        for name, maker in candidates(op_bf16, shape, nsteps, dtype):
+            cands[f"{name}+bf16"] = (
+                lambda _o, n, d, m=maker, ob=op_bf16: m(ob, n, d))
 
     def covers(e) -> bool:
         # The key deliberately omits nsteps: every candidate is probed at
@@ -244,10 +296,25 @@ def pick_multi_step_fn(op, nsteps: int, shape, dtype):
             recorded.update({
                 n: (t * 1e3 if isinstance(t, float) else t)
                 for n, t in timings.items()})
+            gate = ((entry or {}).get("bf16_gate")
+                    or (partial or {}).get("bf16_gate"))
+            if (op_bf16 is not None and gate is None
+                    and any(n.endswith("+bf16") for n in cands)):
+                try:
+                    gate = _bf16_gate(op, op_bf16, shape, dtype)
+                except Exception as e:  # noqa: BLE001 — a gate that cannot
+                    # run must fail CLOSED (tier ineligible), not open
+                    gate = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"[:200]}
             valid = {n: t for n, t in recorded.items()
                      if isinstance(t, (int, float)) and not isinstance(t, bool)}
+            if not (gate or {}).get("ok"):
+                valid = {n: t for n, t in valid.items()
+                         if not n.endswith("+bf16")}
             winner = min(valid, key=valid.get) if valid else "per-step"
             entry = {"winner": winner, "ms_per_step": recorded}
+            if gate is not None:
+                entry["bf16_gate"] = gate
             file_cache[key] = entry
             _store_file_cache(file_cache)
         _memory_cache[key] = entry
@@ -256,8 +323,12 @@ def pick_multi_step_fn(op, nsteps: int, shape, dtype):
         # the cached winner doesn't fit THIS nsteps (e.g. superstep3 won
         # on a long segment, this segment has 2 steps): the entry already
         # holds every candidate's measured rate — run the fastest one
-        # that fits now, not the slowest
+        # that fits now, not the slowest.  The bf16 gate applies here too:
+        # a gated-out tier must not sneak back in through the re-pick.
         rates = {n: t for n, t in entry.get("ms_per_step", {}).items()
                  if n in cands and isinstance(t, float)}
+        if not (entry.get("bf16_gate") or {}).get("ok"):
+            rates = {n: t for n, t in rates.items()
+                     if not n.endswith("+bf16")}
         winner = min(rates, key=rates.get) if rates else "per-step"
     return cands[winner](op, nsteps, dtype), winner

@@ -1,0 +1,89 @@
+"""JAX API compatibility shims (single source of truth).
+
+The framework targets the current JAX surface — ``jax.shard_map`` with
+``check_vma=``, ``jax.typeof(x).vma`` and ``ShapeDtypeStruct(...,
+vma=...)`` for varying-manual-axes propagation out of ``pallas_call``
+under ``shard_map``.  Older jaxlib pins (this container ships 0.4.37)
+spell those ``jax.experimental.shard_map.shard_map`` with ``check_rep=``
+and have no vma tracking at all.  Every call site imports from here so
+the version split lives in exactly one place and the suite runs green on
+both sides of it.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # modern: jax.shard_map(f, mesh, in_specs, out_specs, check_vma=...)
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # 0.4.x: experimental module, check_rep= spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the replication/vma check under its
+    version-correct keyword (check_vma today, check_rep on 0.4.x)."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: check_vma})
+
+
+def _ensure_barrier_batching() -> None:
+    """Pre-vma JAX ships no vmap batching rule for optimization_barrier,
+    which the gang executor's per-tile superstep levels hit (vmap over
+    tiles with the level barrier inside).  The barrier is semantically an
+    identity over its flat operands, so the rule is: bind and pass the
+    batch dims through unchanged.  No-op where JAX already has one."""
+    try:
+        from jax._src.lax import lax as _lax_internal
+        from jax.interpreters import batching
+
+        prim = getattr(_lax_internal, "optimization_barrier_p", None)
+        if prim is not None and prim not in batching.primitive_batchers:
+            def _rule(args, dims):
+                return prim.bind(*args), dims
+
+            batching.primitive_batchers[prim] = _rule
+    except Exception:  # pragma: no cover — a private-API move must not
+        pass  # break import; the modern path never needs this shim
+
+
+_ensure_barrier_batching()
+
+
+def enable_cpu_multiprocess_collectives() -> None:
+    """On jaxlib 0.4.x the CPU backend refuses multi-process collectives
+    unless ``jax_cpu_collectives_implementation`` is flipped to gloo
+    (newer JAX selects gloo automatically and dropped the option).  Call
+    BEFORE ``jax.distributed.initialize`` — the loopback multihost suite
+    and any srun-style CPU launch need it."""
+    try:
+        if jax.config.values.get(
+                "jax_cpu_collectives_implementation") == "none":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover — option removed on modern JAX
+        pass
+
+
+def array_vma(x):
+    """``jax.typeof(x).vma`` where the API exists; None (no vma tracking)
+    on pre-typeof JAX — callers treat None as 'not varying'."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    return typeof(x).vma
+
+
+def out_struct(shape, dtype, vma=None) -> jax.ShapeDtypeStruct:
+    """``ShapeDtypeStruct`` carrying ``vma`` when both the value and the
+    constructor support it (a pallas_call out_shape under shard_map must
+    propagate the mesh-axis variance of its operand on vma-aware JAX)."""
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:  # pre-vma ShapeDtypeStruct
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)

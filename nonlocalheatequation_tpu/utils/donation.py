@@ -1,0 +1,64 @@
+"""Buffer donation for the multi-step jit entry points.
+
+The production multi-step programs (per-step scan, carried, superstep,
+resident — ops/nonlocal_op.make_multi_step_fn_base and the
+ops/pallas_kernel makers) take the state ``u`` and return the advanced
+state; without donation XLA must keep the input frame alive next to the
+output, double-buffering the big rungs in HBM (64 MiB per 4096^2 f32
+frame).  ``donate_argnums=(0,)`` lets XLA alias them.
+
+Donation invalidates the caller's input buffer, and this JAX/jaxlib
+ENFORCES that on CPU too (probed at PR time: reusing a donated CPU buffer
+raises RuntimeError) — which would break the oracle suite's
+call-the-same-u-twice comparison pattern.  So donation is applied only
+where it pays (TPU), decided LAZILY at first call rather than at maker
+time: querying ``jax.default_backend()`` initializes the backend, which
+the wedge discipline forbids at build time (a 1D/sat/test build must
+never touch — and possibly hang on — the tunnel), but by the time the
+returned callable runs, the caller is about to execute on the backend
+anyway.
+
+``NLHEAT_DONATE=1`` forces donation on any backend (the CPU equality
+tests use it with fresh per-call arrays), ``NLHEAT_DONATE=0`` pins it
+off (e.g. to A/B the HBM effect on hardware).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def donation_on() -> bool:
+    """Whether the state arg should be donated on THIS backend, now.
+
+    Initializes the backend when the env knob is unset — only call on the
+    execution path (see module docstring).
+    """
+    env = os.environ.get("NLHEAT_DONATE")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def donated_jit(fn):
+    """jax.jit(fn) donating argument 0 (the state) per donation_on().
+
+    The donate decision is made at first call and cached per truth value,
+    so a process that flips NLHEAT_DONATE mid-run (tests) gets the right
+    program either way without recompiling the other.
+    """
+    cache: dict = {}
+
+    def wrapper(u, t0):
+        donate = donation_on()
+        jitted = cache.get(donate)
+        if jitted is None:
+            jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
+            cache[donate] = jitted
+        return jitted(u, t0)
+
+    return wrapper

@@ -15,7 +15,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 # kernel experiment knobs leaked from a developer shell must not silently
 # switch the paths the suite compares (e.g. the resident-vs-scan oracles)
 for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
-              "NLHEAT_LANE_RUNS", "NLHEAT_TM"):
+              "NLHEAT_LANE_RUNS", "NLHEAT_TM", "NLHEAT_DONATE",
+              "NLHEAT_TUNE_PRECISION", "BENCH_PRECISION"):
     os.environ.pop(_knob, None)
 # "" DISABLES autotune-cache persistence (unset means the per-user default
 # file since tuning became the on-TPU default): the suite must neither read

@@ -174,6 +174,8 @@ def test_two_controller_loopback_solve():
         assert f"MH-OK p{pid} unstructured-superstep" in out
 
 
+@pytest.mark.slow  # multi-controller depth coverage: the 2-controller
+# loopback and the unstructured kill-resume stay in the tier-1 budget
 def test_four_controller_loopback_solve():
     """VERDICT r4 #6: beyond the 2-process loopback.  Four controllers
     (2 devices each, 8 global), meshes (2,4) / (2,2,2) spanning all four
@@ -192,6 +194,8 @@ def test_four_controller_loopback_solve():
         assert f"MH-OK p{pid} unstructured-solver" in out
 
 
+@pytest.mark.slow  # multi-controller depth coverage: the 2-controller
+# loopback and the unstructured kill-resume stay in the tier-1 budget
 def test_uneven_device_split_loopback():
     """VERDICT r4 #6: processes need not own equal device counts (a real
     cluster can expose asymmetric slices).  Process 0 owns 3 devices,
@@ -341,6 +345,8 @@ def test_assert_same_detects_divergence():
         assert "NO-RAISE" not in out
 
 
+@pytest.mark.slow  # multi-controller depth coverage: the 2-controller
+# loopback and the unstructured kill-resume stay in the tier-1 budget
 def test_kill_one_then_resume_on_different_process_counts(tmp_path):
     """VERDICT r4 #6: kill-one + checkpoint-resume across a different
     process count.  A 2-controller checkpointed run is SIGKILLed
@@ -412,12 +418,9 @@ def test_kill_one_then_resume_unstructured(tmp_path):
     import signal
     import time
 
-    from tests.test_unstructured_sharded import jittered_cloud
+    from tests.test_unstructured_sharded import cloud_op
 
-    from nonlocalheatequation_tpu.ops.unstructured import (
-        UnstructuredNonlocalOp,
-        UnstructuredSolver,
-    )
+    from nonlocalheatequation_tpu.ops.unstructured import UnstructuredSolver
     from nonlocalheatequation_tpu.utils.checkpoint import load_state
 
     ck = tmp_path / "mh-crashu.npz"
@@ -444,9 +447,11 @@ def test_kill_one_then_resume_unstructured(tmp_path):
     nt_total = t + 4
 
     # resume leg 1: single process (count 2 -> 1), the UNSHARDED op —
-    # the checkpoint is the global node vector, portable across wrappers
-    pts, h = jittered_cloud(m=32, seed=0)
-    uop = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-6, vol=h * h)
+    # the checkpoint is the global node vector, portable across wrappers.
+    # cloud_op is the ONE definition of this operator's physics (shared
+    # with the multihost children); rebuilding it here from hand-copied
+    # constants let the legs drift apart silently (advisor finding r5)
+    uop = cloud_op(m=32, seed=0)
     s = UnstructuredSolver(uop, nt=nt_total, backend="jit")
     s.test_init()
     s.resume(str(ck))

@@ -14,11 +14,14 @@ tests/test_sanity_harness.py).
 import os
 import subprocess
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "tools", "tpu_opportunistic.sh")
 
 ALL_STEPS = [
     "bench4096", "resident512", "carried4096", "superstep2",
+    "bf16-4096", "bf16-carried4096",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
@@ -69,6 +72,9 @@ def test_success_path_resident_variant(tmp_path):
     assert '"variant": "resident"' in table
 
 
+@pytest.mark.slow  # ~73 s: two strike rounds, each a full bench child plus
+# a re-gate bench — the queue's success path above stays in the tier-1
+# budget; run `pytest -m slow tests/test_opportunistic.py` for this one
 def test_strike_path_unlabelable_step(tmp_path):
     # with the sat method the artifact can never carry a "tm" label, and
     # the backend stays healthy, so the step must strike twice (classified

@@ -78,6 +78,10 @@ def emit(name: str, points: int, steps: int, seconds: float, **extra):
         "ms_per_step": seconds / steps * 1e3,
         "points_steps_per_sec": points * steps / seconds,
         "backend": jax.default_backend(),
+        # precision column: rows are f32 unless the config says otherwise
+        # (the bf16-tier A/B rows override) — keeps every row
+        # self-describing now that precision is a tuned dimension
+        "precision": "f32",
         **extra,
     }
     print(json.dumps(rec), flush=True)
@@ -133,6 +137,19 @@ def bench_methods2d(steps: int):
             sec, _ = time_steps(lambda u, m=fn: m(u, 0), u0, steps)
             emit("2d/autotuned", n * n, steps, sec, grid=n, eps=8,
                  winner=winner)
+
+            # bf16 precision-tier A/B partners (ops/constants.py): the
+            # per-step and carried paths with bf16 operand windows + f32
+            # carry, against the f32 rows above
+            op_b = op.with_precision("bf16")
+            multi = make_multi_step_fn(op_b, steps)
+            sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
+            emit("2d/pallas-bf16", n * n, steps, sec, grid=n, eps=8,
+                 precision="bf16")
+            multi = make_carried_multi_step_fn(op_b, steps)
+            sec, _ = time_steps(lambda u, m=multi: m(u, 0), u0, steps)
+            emit("2d/pallas-carried-bf16", n * n, steps, sec, grid=n,
+                 eps=8, precision="bf16")
 
 
 def _time_dist_solver(s, steps: int) -> float:
@@ -624,6 +641,13 @@ def main() -> int:
     # measured THIS run, not one recorded under older kernel code
     os.environ["NLHEAT_AUTOTUNE"] = "0"
     os.environ["NLHEAT_AUTOTUNE_CACHE"] = ""
+    # the table reuses one u0 across every row of a config; the multi-step
+    # entry points donate their state arg on TPU by default
+    # (utils/donation), which would invalidate u0 after the first row —
+    # pin donation off so every row times the same program shape (rows
+    # stay mutually comparable; bench.py measures the donating
+    # production default)
+    os.environ["NLHEAT_DONATE"] = "0"
     steps = int(os.environ.get("BT_STEPS", 20))
     names = [a for a in sys.argv[1:] if not a.startswith("-")] or list(BENCHES)
     log(f"backend={jax.default_backend()} devices={len(jax.devices())} "

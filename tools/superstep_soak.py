@@ -7,9 +7,19 @@ The three communication-avoiding superstep families (grid SPMD
 the 1e-12 contract under ANY valid configuration — random tile shapes,
 placements, device counts, step counts (incl. K-remainders), both init
 modes.  This tool draws random valid configs, runs superstep vs
-per-step, and reports max deviation + bitwise-equality counts; invalid
-draws must be REFUSED loudly by the constructors (counted, re-drawn),
-never silently degraded.
+per-step, and reports max deviation + bitwise-equality counts.
+
+Refusal coverage (advisor finding r5): the equivalence draws are
+PRE-FILTERED into the valid ranges, so on their own they never exercise
+the constructors' refuse-loudly contract (the refusals earlier rounds
+counted came from this tool's own pre-checks, e.g. the unstructured
+layout/fit probe below — not from the constructors).  Each family
+therefore also injects KNOWN-INVALID draws at a fixed rate
+(~1-in-6 per family) — gang tile edge < K*eps, unstructured
+K*pad > block, spmd nbalance on the uniform-shard solver — and ASSERTS
+the constructor raises ValueError; a constructor that silently accepts
+one fails the soak.  Pre-check refusals and asserted constructor
+refusals are counted separately in the summary line.
 
 The reference has no analog schedule (its halo exchange is per-step
 dataflow, /root/reference/src/2d_nonlocal_distributed.cpp:1146-1262);
@@ -148,8 +158,76 @@ def run_unstructured(rng):
     return cfg, float(np.abs(ua - ub).max()), bool((ua == ub).all())
 
 
+class RefusalMissing(AssertionError):
+    """A known-invalid config was ACCEPTED by a constructor."""
+
+
+def _assert_refused(label: str, build):
+    try:
+        build()
+    except ValueError:
+        return f"{label}: constructor refused (ValueError) as required"
+    raise RefusalMissing(
+        f"{label}: constructor ACCEPTED a known-invalid config — the "
+        "refuse-loudly contract is broken")
+
+
+def invalid_spmd(rng):
+    """nbalance on the uniform-shard SPMD solver (documented refusal)."""
+    from nonlocalheatequation_tpu.parallel.distributed2d import (
+        Solver2DDistributed,
+    )
+    from nonlocalheatequation_tpu.parallel.mesh import make_mesh
+
+    nb = int(rng.integers(1, 10))
+    return _assert_refused(
+        f"spmd nbalance={nb}",
+        lambda: Solver2DDistributed(
+            8, 8, 1, 1, nt=3, eps=2, k=1.0, dt=1e-4, dh=0.125, nbalance=nb,
+            mesh=make_mesh(2, 2, jax.devices("cpu")[:4])))
+
+
+def invalid_gang(rng):
+    """Gang superstep with K*eps > tile edge (band assembly cannot draw
+    the halo from the 8 immediate neighbors)."""
+    from nonlocalheatequation_tpu.parallel.elastic import ElasticSolver2D
+
+    eps = int(rng.integers(2, 4))
+    K = int(rng.integers(2, 4))
+    tile = int(rng.integers(2, K * eps))  # strictly below K*eps
+    return _assert_refused(
+        f"gang tile={tile} < K*eps={K * eps}",
+        lambda: ElasticSolver2D(
+            tile, tile, 2, 2, nt=3, eps=eps, k=1.0, dt=1e-4, dh=0.02,
+            devices=jax.devices("cpu")[:2], nlog=10 ** 9, superstep=K))
+
+
+def invalid_unstructured(rng):
+    """Sharded-offsets superstep with K*pad > block (cannot fit)."""
+    from nonlocalheatequation_tpu.ops.unstructured import (
+        ShardedUnstructuredOp,
+        UnstructuredNonlocalOp,
+        UnstructuredSolver,
+    )
+
+    m = int(rng.integers(24, 33))
+    h = 1.0 / m
+    xs, ys = np.meshgrid(np.arange(m) * h, np.arange(m) * h, indexing="ij")
+    pts = np.stack([xs.ravel(), ys.ravel()], axis=1)
+    pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
+    uop = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-6, vol=h * h)
+    sh = ShardedUnstructuredOp(uop, devices=jax.devices("cpu")[:4])
+    K = int(rng.integers(50, 100))  # K*pad > block at every drawn m
+    assert not sh.superstep_fits(K)
+    return _assert_refused(
+        f"unstructured m={m} K={K} (K*pad > block)",
+        lambda: UnstructuredSolver(sh, nt=3, backend="jit", superstep=K))
+
+
 FAMILIES = {"spmd": run_spmd, "gang": run_gang,
             "unstructured": run_unstructured}
+INVALID = {"spmd": invalid_spmd, "gang": invalid_gang,
+           "unstructured": invalid_unstructured}
 
 
 def main() -> int:
@@ -159,15 +237,27 @@ def main() -> int:
     ap.add_argument("--families", default="spmd,gang,unstructured")
     args = ap.parse_args()
     rng = np.random.default_rng(args.seed)
-    fams = [FAMILIES[f] for f in args.families.split(",")]
-    worst, bitwise, refused, ran = 0.0, 0, 0, 0
+    names = args.families.split(",")
+    fams = [FAMILIES[f] for f in names]
+    worst, bitwise, refused, ran, asserted = 0.0, 0, 0, 0, 0
     while ran < args.configs:
-        fam = fams[ran % len(fams)]
+        fam_name = names[ran % len(fams)]
+        if int(rng.integers(0, 6)) == 0:
+            # adversarial injection: a KNOWN-invalid config of the same
+            # family must be refused by the constructor itself
+            try:
+                msg = INVALID[fam_name](rng)
+            except RefusalMissing as e:
+                print(json.dumps({"soak": "FAIL", "refusal": str(e)}),
+                      flush=True)
+                return 1
+            asserted += 1
+            print(f"  {msg}", flush=True)
         try:
-            cfg, err, bit = fam(rng)
+            cfg, err, bit = fams[ran % len(fams)](rng)
         except ValueError as e:
             refused += 1
-            print(f"  refused: {e}", flush=True)
+            print(f"  refused (pre-check): {e}", flush=True)
             if refused > 10 * args.configs:
                 print("too many refusals; parameter ranges are wrong",
                       flush=True)
@@ -184,7 +274,8 @@ def main() -> int:
             return 1
     print(json.dumps({
         "soak": "ok", "configs": ran, "bitwise": bitwise,
-        "worst_err": worst, "refused_draws": refused, "seed": args.seed,
+        "worst_err": worst, "precheck_refusals": refused,
+        "asserted_constructor_refusals": asserted, "seed": args.seed,
     }), flush=True)
     return 0
 

@@ -30,6 +30,9 @@
 set -u
 cd "$(dirname "$0")/.."
 STAMP=$(date +%Y%m%d-%H%M%S)
+ROUND=${OPP_ROUND:-r6}  # round tag for promoted headline artifacts —
+  # parameterized so attribution tracks the actual round instead of a
+  # hardcoded literal drifting further each round (advisor finding r5)
 OUT=${OPP_OUT:-docs/bench/opp-$STAMP.log}
 TABLE=${OPP_TABLE:-docs/bench/BENCH_TABLE_r03.jsonl}
 STATE=${OPP_STATE:-/tmp/opp-queue-$(date +%Y%m%d).state}  # dated: a rerun
@@ -52,6 +55,14 @@ if [ "$GATE_BACKEND" = cpu ]; then
 fi
 touch "$STATE"
 
+# Persistent XLA compilation cache for every child (bench.py enables its
+# own via BENCH_COMPILE_CACHE; the env vars cover bench_table/sanity too):
+# the 4096^2 compile costs ~7 s per rung on the chip (BENCH_r05.json), and
+# short heal windows cannot afford to re-pay it every window.
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-$PWD/docs/bench/xla_cache}
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-0}
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
 # one list drives both execution order and the done check.  The VMEM
 # stack model picks tm=32 for superstep2 at 4096^2 and rejects K=3
 # outright; the model is known-conservative (the tm sweep exists to probe
@@ -66,7 +77,9 @@ touch "$STATE"
 # whole steps instead of dying inside a 30-45-min bundle.  The old
 # table-a/b/c bundles are split into one step per bench_table group for
 # the same reason (the generic table-* case below).  headline+accuracy
-# (bench4096, banked 08-02) -> copy-floor variant A/Bs -> autotune-
+# (bench4096, banked 08-02) -> copy-floor variant A/Bs -> bf16-vs-f32
+# precision-tier A/Bs (r6: on-device evidence for the half-bytes operand
+# claim, judged against the tier's own accuracy budget) -> autotune-
 # default validation -> unstructured/elastic TPU rows -> sanity ->
 # forced-tm Mosaic probes -> tm fine sweep -> stretch -> remaining
 # tables -> profile.
@@ -85,6 +98,7 @@ touch "$STATE"
 #   beyond  : tm sweep, stretch8192 (compile headroom), remaining
 #             tables, profile
 STEPS="bench4096 resident512 carried4096 superstep2 \
+bf16-4096 bf16-carried4096 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -117,7 +131,7 @@ run_step_cmd() {  # the queue's one name->command map
       if [ "$rc4" -eq 0 ] && [ "$GATE_BACKEND" = tpu ] \
           && grep -q '"backend": "tpu"' "$live" \
           && ! grep -q '"backend": "cpu"' "$live"; then
-        cp "$live" "docs/bench/BENCH_live_r4-$STAMP.json"
+        cp "$live" "docs/bench/BENCH_live_$ROUND-$STAMP.json"
       fi
       rm -f "$live"
       return "$rc4" ;;
@@ -129,6 +143,18 @@ run_step_cmd() {  # the queue's one name->command map
       BENCH_LADDER=512 BENCH_ACCURACY=0 ;;
     carried4096)
       bench_nofb BENCH_CARRIED=1 BENCH_GRID="$GRID_LG" \
+        BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
+    bf16-4096)
+      # bf16-vs-f32 A/B, per-step path: the f32 partner is the bench4096
+      # headline banked earlier in this same queue.  Accuracy gate kept ON
+      # (the tier's on-device error evidence has never been banked; it is
+      # judged against its own documented budget, ops/constants.py)
+      bench_nofb BENCH_PRECISION=bf16 BENCH_GRID="$GRID_LG" \
+        BENCH_LADDER="$GRID_LG" ;;
+    bf16-carried4096)
+      # bf16-vs-f32 A/B, carried frame (the ~2x-bytes storage claim lives
+      # here: bf16 window read + bf16 shadow write vs two f32 frames)
+      bench_nofb BENCH_PRECISION=bf16 BENCH_CARRIED=1 BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
     superstep2)
       bench_nofb BENCH_SUPERSTEP=2 BENCH_GRID="$GRID_LG" \
@@ -213,6 +239,10 @@ PYEOF
       ;;
     resident512) grep -q '"variant": "resident"' "$2" ;;
     carried4096) grep -q '"variant": "carried"' "$2" ;;
+    bf16-4096) grep -q '"precision": "bf16"' "$2" ;;
+    bf16-carried4096)
+      grep -q '"precision": "bf16"' "$2" \
+        && grep -q '"variant": "carried"' "$2" ;;
     superstep2) grep -q '"variant": "superstep2"' "$2" ;;
     superstep2-tm128)
       grep -q '"variant": "superstep2"' "$2" && grep -q '"tm": 128' "$2" ;;

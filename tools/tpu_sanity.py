@@ -73,6 +73,11 @@ def _setup():
     # so BENCH_PLATFORM=cpu is the only reliable way to smoke this off-TPU
     if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    # the checks call two multi-step programs on the SAME state array;
+    # the entry points donate that arg on TPU by default (utils/donation),
+    # which would invalidate it between the ref and new runs — pin off
+    # (donation is orthogonal to the variant-equality question asked here)
+    os.environ.setdefault("NLHEAT_DONATE", "0")
     return np, jax
 
 

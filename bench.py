@@ -52,7 +52,12 @@ opt-in until measured on hardware), BENCH_RESIDENT=1 (pallas: whole run
 in one pallas_call for grids that fit VMEM residency — opt-in, rung
 labeled "variant"), BENCH_SUPERSTEP=K (pallas: K steps fused per
 pallas_call, temporal blocking of the copy-floor-bound kernel — opt-in,
-rung labeled "variant": "superstepK"), BENCH_ALLOW_CPU_FALLBACK (default 1:
+rung labeled "variant": "superstepK"), BENCH_ENSEMBLE=B (B >= 2: each
+rung advances B same-shape production cases as ONE batched program —
+the ensemble engine's ops layer, serve/ensemble.py scheduling — and the
+JSON line gains "cases" plus the aggregate "cases*points*steps/s"
+field; "value" is then that aggregate, which is still honest
+points*steps/s across the whole batch), BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
 budget above this re-probes the TPU once — the wedge cycle often heals
@@ -253,6 +258,11 @@ class Best:
             **({"tm": rung["tm"]} if "tm" in rung else {}),
             **({"compile_s": rung["compile_s"]} if "compile_s" in rung
                else {}),
+            # ensemble rungs: case count + the aggregate-throughput field
+            # the amortization A/B banks (equal to "value" by design)
+            **({"cases": rung["cases"]} if "cases" in rung else {}),
+            **({"cases*points*steps/s": rung["cases*points*steps/s"]}
+               if "cases*points*steps/s" in rung else {}),
             **baseline_basis(base),
             **meta,
         }
@@ -753,6 +763,13 @@ def child_measure():
     rng = np.random.default_rng(0)
     last_op = None
     any_rung = False
+    ens = int(os.environ.get("BENCH_ENSEMBLE", 0) or 0)
+    if ens == 1:
+        ens = 0  # 0/1 mean off, like the sibling variant knobs
+    if ens and any(os.environ.get(k) for k in
+                   ("BENCH_CARRIED", "BENCH_RESIDENT", "BENCH_SUPERSTEP")):
+        log("BENCH_ENSEMBLE set: ignoring BENCH_CARRIED/RESIDENT/"
+            "SUPERSTEP — the ensemble rung is its own labeled variant")
     for grid in ladder():
         # later rungs respect the budget, but the FIRST rung is always
         # attempted — a late start must degrade the result, never zero it
@@ -766,7 +783,29 @@ def child_measure():
             op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / grid, method=method,
                               precision=PRECISION)
             variant = None
-            if method == "pallas" and os.environ.get("BENCH_CARRIED") == "1":
+            if ens:
+                # B same-shape production cases advanced by ONE batched
+                # program (the ensemble ops layer): over the tunnel the
+                # sequential form pays B dispatch+fence tolls per
+                # segment, this pays one — the A/B partner is the plain
+                # rung at the same grid (tools/tpu_opportunistic.sh
+                # ensemble8x1024 banks the measured ratio)
+                if method == "pallas":
+                    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+                        make_batched_pallas_multi_step_fn,
+                    )
+
+                    multi = make_batched_pallas_multi_step_fn(
+                        [op] * ens, steps)
+                else:
+                    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+                        make_batched_multi_step_fn_vmap,
+                    )
+
+                    multi = make_batched_multi_step_fn_vmap([op] * ens,
+                                                            steps)
+                variant = f"ensemble{ens}"
+            elif method == "pallas" and os.environ.get("BENCH_CARRIED") == "1":
                 # opt-in: halo-padded state carried across the scan (skips
                 # the per-step pad round-trip); bit-identical to the
                 # per-step path (tests/test_pallas.py)
@@ -819,7 +858,8 @@ def child_measure():
                     multi = make_multi_step_fn(op, steps)
             else:
                 multi = make_multi_step_fn(op, steps)
-            u = jnp.asarray(rng.normal(size=(grid, grid)), jnp.float32)
+            shape = (ens, grid, grid) if ens else (grid, grid)
+            u = jnp.asarray(rng.normal(size=shape), jnp.float32)
 
             t0 = time.perf_counter()
             u = multi(u, 0)
@@ -852,15 +892,18 @@ def child_measure():
                 tm_label = forced_tm()
             else:
                 tm_label = None
+            value = (ens or 1) * grid * grid * steps / best
             event(
                 event="rung",
                 grid=grid,
                 steps=steps,
                 best_s=best,
                 ms_per_step=best / steps * 1e3,
-                value=grid * grid * steps / best,
+                value=value,
                 compile_s=round(compile_s, 3),
-                **({"variant": variant} if variant else {}),
+                **({"variant": variant, "cases": ens,
+                    "cases*points*steps/s": value} if ens else {}),
+                **({"variant": variant} if variant and not ens else {}),
                 **({"tm": tm_label} if tm_label else {}),
             )
             last_op = op

@@ -187,14 +187,76 @@ def check_same_input_state(multi: bool, u0) -> None:
         multihost.assert_same_on_all_hosts(u0, "input state")
 
 
-def run_batch(read_case, run_case, threshold=1e-6, multi=False):
+def add_ensemble_flag(p: argparse.ArgumentParser):
+    """--ensemble: batch-test cases scheduled through the batched ensemble
+    engine (serve/ensemble.py) instead of the sequential case loop."""
+    p.add_argument(
+        "--ensemble",
+        action="store_true",
+        help="with --test_batch: group the cases into shape buckets and "
+             "run each bucket as ONE batched multi-step program "
+             "(serve/ensemble.py) — one dispatch per bucket instead of "
+             "one per case; pass criterion and output are unchanged",
+    )
+
+
+def parse_batch_cases(read_case, tokens, row_tokens=None):
+    """Parse the batch_tester token stream up front, refusing loudly.
+
+    The old lazy loop died with a bare IndexError on a truncated or
+    malformed stream; here every row is validated before any solve runs,
+    and the refusal names the case index and the expected token count
+    (the reference's ctest discipline: a check that cannot run is a
+    failed check with a reason, not a stack trace).
+    """
+    if not tokens:
+        raise SystemExit(
+            "batch input is empty: expected 'num_tests' followed by one "
+            "parameter row per test")
+    try:
+        num_tests = int(tokens[0])
+    except ValueError:
+        raise SystemExit(
+            f"batch input header {tokens[0]!r} is not an integer test "
+            "count") from None
+    if num_tests < 0:
+        raise SystemExit(f"batch input declares {num_tests} tests")
+    pos = 1
+    cases = []
+    for i in range(num_tests):
+        if row_tokens is not None and len(tokens) - pos < row_tokens:
+            raise SystemExit(
+                f"batch case {i}: truncated input — expected "
+                f"{row_tokens} tokens per case, found only "
+                f"{len(tokens) - pos} of the declared {num_tests} cases' "
+                "tokens remaining")
+        try:
+            case, pos = read_case(tokens, pos)
+        except (IndexError, ValueError) as e:
+            raise SystemExit(
+                f"batch case {i}: malformed parameter row"
+                + (f" (expected {row_tokens} numeric tokens)"
+                   if row_tokens else "")
+                + f": {e}") from None
+        cases.append(case)
+    return cases
+
+
+def run_batch(read_case, run_case, threshold=1e-6, multi=False,
+              row_tokens=None, run_ensemble=None):
     """The reference's batch_tester protocol (1d_nonlocal_serial.cpp:239-266):
     stdin = num_tests then one parameter row per test; prints "Tests Passed"
     or "Tests Failed" (the ctest pass/fail regex).
 
     ``read_case(tokens)`` parses one row; ``run_case(case) -> (error_l2, n)``.
-    Under a multi-process launch (``multi=True``) the stdin rules apply:
-    tty refusal, and the token stream must be identical on every rank.
+    ``row_tokens`` (the row's column count) lets a truncated/malformed
+    stream be refused loudly with the case index and expected token count
+    instead of a bare IndexError.  With ``run_ensemble`` (a callable
+    ``cases -> [(error_l2, n)]``) the parsed cases go to the batched
+    ensemble engine as one submission — same pass criterion, same output
+    — instead of the sequential per-case loop.  Under a multi-process
+    launch (``multi=True``) the stdin rules apply: tty refusal, and the
+    token stream must be identical on every rank.
     """
     guard_multihost_stdin(multi)
     tokens = sys.stdin.read().split()
@@ -206,14 +268,16 @@ def run_batch(read_case, run_case, threshold=1e-6, multi=False):
         multihost.assert_same_on_all_hosts(
             np.frombuffer(" ".join(tokens).encode(), dtype=np.uint8),
             "batch input")
-    num_tests = int(tokens[0])
-    pos = 1
-    failed = False
-    for _ in range(num_tests):
-        case, pos = read_case(tokens, pos)
-        error_l2, n = run_case(case)
-        if error_l2 / n > threshold:
-            failed = True
-            break
+    cases = parse_batch_cases(read_case, tokens, row_tokens)
+    if run_ensemble is not None:
+        failed = any(error_l2 / n > threshold
+                     for error_l2, n in run_ensemble(cases))
+    else:
+        failed = False
+        for case in cases:
+            error_l2, n = run_case(case)
+            if error_l2 / n > threshold:
+                failed = True
+                break
     print("Tests Failed" if failed else "Tests Passed")
     return 1 if failed else 0

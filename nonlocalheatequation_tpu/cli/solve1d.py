@@ -10,6 +10,7 @@ import time
 import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
+    add_ensemble_flag,
     add_platform_flags,
     add_precision_flags,
     apply_platform,
@@ -40,6 +41,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write csv/vtu logs every nlog steps")
     add_platform_flags(p)
     add_precision_flags(p)
+    add_ensemble_flag(p)
     return p
 
 
@@ -53,6 +55,14 @@ def make_solver(args, nx, nt, eps, k, dt, dx):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.ensemble and not args.test_batch:
+        print("--ensemble schedules batch-test cases; it requires "
+              "--test_batch", file=sys.stderr)
+        return 1
+    if args.ensemble and args.resync:
+        print("--resync is not supported with --ensemble (the batched "
+              "paths have no per-step precision switch)", file=sys.stderr)
+        return 1
     version_banner("1d_nonlocal")
     apply_platform(args)
 
@@ -70,7 +80,30 @@ def main(argv=None) -> int:
             s.do_work()
             return s.error_l2, nx
 
-        return run_batch(read_case, run_case)
+        run_ensemble = None
+        if args.ensemble:
+            def run_ensemble(cases):
+                from nonlocalheatequation_tpu.serve.ensemble import (
+                    EnsembleEngine,
+                )
+
+                solvers = []
+                for case in cases:
+                    s = make_solver(args, *case)
+                    s.test_init()
+                    solvers.append(s)
+                engine = EnsembleEngine(precision=args.precision)
+                states = engine.run([s.ensemble_case() for s in solvers])
+                print(f"ensemble: {engine.report.summary()}",
+                      file=sys.stderr)
+                out = []
+                for s, u in zip(solvers, states):
+                    s.u = u
+                    out.append((s.compute_l2(s.nt), s.nx))
+                return out
+
+        return run_batch(read_case, run_case, row_tokens=6,
+                         run_ensemble=run_ensemble)
 
     s = make_solver(args, args.nx, args.nt, args.eps, args.k, args.dt, args.dx)
     if args.log:

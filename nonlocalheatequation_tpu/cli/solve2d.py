@@ -11,6 +11,7 @@ import time
 import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
+    add_ensemble_flag,
     add_platform_flags,
     add_precision_flags,
     apply_platform,
@@ -49,6 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of the solve into DIR")
     add_platform_flags(p)
     add_precision_flags(p)
+    add_ensemble_flag(p)
     return p
 
 
@@ -61,6 +63,17 @@ def main(argv=None) -> int:
         # batch cases would all share the single --checkpoint path (each case
         # overwriting the last) and --resume would be silently ignored
         print("--checkpoint/--resume cannot be combined with --test_batch",
+              file=sys.stderr)
+        return 1
+    if args.ensemble and not args.test_batch:
+        print("--ensemble schedules batch-test cases; it requires "
+              "--test_batch", file=sys.stderr)
+        return 1
+    if args.ensemble and args.resync:
+        # honesty rule: the batched paths have no per-step precision
+        # switch (check_bucket_ops refuses it at the ops layer too)
+        print("--resync is not supported with --ensemble; run the "
+              "sequential batch, or --precision bf16 without --resync",
               file=sys.stderr)
         return 1
     version_banner("2d_nonlocal")
@@ -90,7 +103,31 @@ def main(argv=None) -> int:
             s.do_work()
             return s.error_l2, nx * ny
 
-        return run_batch(read_case, run_case)
+        run_ensemble = None
+        if args.ensemble:
+            def run_ensemble(cases):
+                from nonlocalheatequation_tpu.serve.ensemble import (
+                    EnsembleEngine,
+                )
+
+                solvers = []
+                for case in cases:
+                    s = make_solver(*case)
+                    s.test_init()
+                    solvers.append(s)
+                engine = EnsembleEngine(method=args.method,
+                                        precision=args.precision)
+                states = engine.run([s.ensemble_case() for s in solvers])
+                print(f"ensemble: {engine.report.summary()}",
+                      file=sys.stderr)
+                out = []
+                for s, u in zip(solvers, states):
+                    s.u = u
+                    out.append((s.compute_l2(s.nt), s.nx * s.ny))
+                return out
+
+        return run_batch(read_case, run_case, row_tokens=7,
+                         run_ensemble=run_ensemble)
 
     s = make_solver(args.nx, args.ny, args.nt, args.eps, args.k, args.dt, args.dh)
     if args.log:

@@ -80,7 +80,7 @@ def main(argv=None) -> int:
             s.do_work()
             return s.error_l2, nx * ny * np_parts * np_parts
 
-        return run_batch(read_case, run_case)
+        return run_batch(read_case, run_case, row_tokens=8)
 
     s = make_solver(args.nx, args.ny, args.np_parts, args.nt, args.eps,
                     args.k, args.dt, args.dh)

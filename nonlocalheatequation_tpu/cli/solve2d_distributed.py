@@ -196,7 +196,8 @@ def main(argv=None) -> int:
             s.do_work()
             return s.error_l2, cnx * cny * cnpx * cnpy
 
-        return run_batch(read_case, run_case, multi=multi)
+        return run_batch(read_case, run_case, multi=multi,
+                         row_tokens=9)
 
     s = make_solver(nx, ny, npx, npy, args.nt, args.eps, args.k, args.dt, dh)
     if args.log:

@@ -14,6 +14,7 @@ import time
 import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
+    add_ensemble_flag,
     add_platform_flags,
     add_precision_flags,
     bool_flag,
@@ -55,6 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resume from the --checkpoint file before running")
     add_platform_flags(p)
     add_precision_flags(p)
+    add_ensemble_flag(p)
     return p
 
 
@@ -85,6 +87,14 @@ def main(argv=None) -> int:
         print("--distributed runs the SPMD jit solver; it has no oracle "
               "backend (use the serial oracle for ground truth)",
               file=sys.stderr)
+        return 1
+    if args.ensemble and not args.test_batch:
+        print("--ensemble schedules batch-test cases; it requires "
+              "--test_batch", file=sys.stderr)
+        return 1
+    if args.ensemble and (args.distributed or args.resync):
+        print("--ensemble runs the serial batched engine; it cannot be "
+              "combined with --distributed or --resync", file=sys.stderr)
         return 1
     # the srun analog (cli_startup holds the load-bearing ordering); the
     # launch-mode check runs via the hook so a misconfigured launch dies
@@ -132,7 +142,31 @@ def main(argv=None) -> int:
             s.do_work()
             return s.error_l2, nx * ny * nz
 
-        return run_batch(read_case, run_case, multi=multi)
+        run_ensemble = None
+        if args.ensemble:
+            def run_ensemble(cases):
+                from nonlocalheatequation_tpu.serve.ensemble import (
+                    EnsembleEngine,
+                )
+
+                solvers = []
+                for case in cases:
+                    s = make_solver(*case)
+                    s.test_init()
+                    solvers.append(s)
+                engine = EnsembleEngine(method=args.method,
+                                        precision=args.precision)
+                states = engine.run([s.ensemble_case() for s in solvers])
+                print(f"ensemble: {engine.report.summary()}",
+                      file=sys.stderr)
+                out = []
+                for s, u in zip(solvers, states):
+                    s.u = u
+                    out.append((s.compute_l2(s.nt), s.nx * s.ny * s.nz))
+                return out
+
+        return run_batch(read_case, run_case, multi=multi, row_tokens=8,
+                         run_ensemble=run_ensemble)
 
     s = make_solver(args.nx, args.ny, args.nz, args.nt, args.eps, args.k,
                     args.dt, args.dh)

@@ -59,6 +59,15 @@ class Solver1D:
         self.test = False
         self.u0 = np.asarray(values, dtype=np.float64).reshape(self.nx)
 
+    def ensemble_case(self):
+        """This solve as a serve/ensemble batch case (the case's ``dh``
+        field carries the 1D dx); see Solver2D.ensemble_case."""
+        from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+
+        return EnsembleCase(shape=(self.nx,), nt=self.nt, eps=self.op.eps,
+                            k=self.op.k, dt=self.op.dt, dh=self.op.dx,
+                            test=self.test, u0=self.u0)
+
     # -- time loop (1d_nonlocal_serial.cpp:209-236) -------------------------
     def do_work(self) -> np.ndarray:
         if self.test:

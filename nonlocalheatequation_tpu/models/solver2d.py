@@ -84,6 +84,21 @@ class Solver2D(CheckpointMixin, ManufacturedMetrics2D):
     # checkpoint/resume: CheckpointMixin (canonical params, portable between
     # the serial, distributed, and elastic solvers on the same global grid)
 
+    def ensemble_case(self):
+        """This solve as a serve/ensemble batch case.  The CLI's
+        --ensemble mode collects one per solver, runs the batched engine,
+        then feeds each returned state back via ``self.u`` so the error
+        metrics are computed by exactly the code the solo path uses."""
+        from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+
+        if self.t0:
+            raise ValueError(
+                "ensemble scheduling starts every case at t0=0; resume a "
+                "checkpointed solve on the solo path")
+        return EnsembleCase(shape=(self.nx, self.ny), nt=self.nt,
+                            eps=self.op.eps, k=self.op.k, dt=self.op.dt,
+                            dh=self.op.dh, test=self.test, u0=self.u0)
+
     # -- time loop (2d_nonlocal_serial.cpp:273-303) -------------------------
     def do_work(self) -> np.ndarray:
         g, lg = self.op.source_parts(self.nx, self.ny) if self.test else (None, None)

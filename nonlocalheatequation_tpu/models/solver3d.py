@@ -69,6 +69,19 @@ class Solver3D(CheckpointMixin, ManufacturedMetrics2D):
             self.nx, self.ny, self.nz
         )
 
+    def ensemble_case(self):
+        """This solve as a serve/ensemble batch case; see
+        Solver2D.ensemble_case."""
+        from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+
+        if self.t0:
+            raise ValueError(
+                "ensemble scheduling starts every case at t0=0; resume a "
+                "checkpointed solve on the solo path")
+        return EnsembleCase(shape=(self.nx, self.ny, self.nz), nt=self.nt,
+                            eps=self.op.eps, k=self.op.k, dt=self.op.dt,
+                            dh=self.op.dh, test=self.test, u0=self.u0)
+
     def do_work(self) -> np.ndarray:
         if self.test:
             g, lg = self.op.source_parts(self.nx, self.ny, self.nz)

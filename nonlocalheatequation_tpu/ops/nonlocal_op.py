@@ -608,6 +608,14 @@ def make_multi_step_fn_base(op, nsteps: int, g=None, lg=None, dtype=None):
     """
     from nonlocalheatequation_tpu.utils.donation import donated_jit
 
+    return donated_jit(multi_step_fn_base_unjit(op, nsteps, g, lg, dtype))
+
+
+def multi_step_fn_base_unjit(op, nsteps: int, g=None, lg=None, dtype=None):
+    """make_multi_step_fn_base WITHOUT the jit/donation wrapper: the exact
+    per-case trace the batched 'stacked' ensemble composition inlines per
+    case inside one program (serve/ensemble.py) — nesting the donated jit
+    there would only warn about unusable donations."""
     step = make_step_fn(op, g, lg, dtype)
     resync = (getattr(op, "precision", "f32") == "bf16"
               and getattr(op, "resync_every", 0) > 0)
@@ -628,6 +636,138 @@ def make_multi_step_fn_base(op, nsteps: int, g=None, lg=None, dtype=None):
         ts = t0 + jnp.arange(nsteps)
         out, _ = lax.scan(body, u, ts)
         return out
+
+    return multi
+
+
+def case_scale(op) -> float:
+    """The operator's node-volume scale c*h^d as one host float, evaluated
+    with the same Python expression order as apply() so the value is
+    bit-equal to the solo path's baked constant (the ensemble engine and
+    the batched kernels multiply by this instead)."""
+    d = op.weights.ndim
+    if d == 1:
+        return op.c * op.dx
+    if d == 2:
+        return op.c * op.dh * op.dh
+    return op.c * op.dh ** 3
+
+
+def check_bucket_ops(ops) -> None:
+    """Validate that a batched-ensemble bucket's operators are batchable
+    together: same class, same eps (hence same mask/wsum for the uniform
+    J the batched paths serve), same precision tier, no resync (the
+    per-step precision switch lives on the solo base path only)."""
+    op0 = ops[0]
+    for i, op in enumerate(ops):
+        if type(op) is not type(op0) or op.eps != op0.eps:
+            raise ValueError(
+                f"ensemble bucket mixes operators (case {i}: "
+                f"{type(op).__name__}/eps={op.eps} vs "
+                f"{type(op0).__name__}/eps={op0.eps}); bucket keys must "
+                "pin (shape, eps)")
+        if not getattr(op, "uniform", True):
+            raise ValueError(
+                "the batched ensemble paths serve the uniform influence "
+                f"function only (case {i} has a weighted J)")
+        if getattr(op, "precision", "f32") != \
+                getattr(op0, "precision", "f32"):
+            raise ValueError(
+                f"ensemble bucket mixes precision tiers (case {i}); the "
+                "bucket key must pin the tier")
+        if getattr(op, "resync_every", 0):
+            raise ValueError(
+                "resync_every is a solo base-scan knob; the batched "
+                f"ensemble paths refuse it (case {i}) rather than "
+                "silently dropping the full-precision steps")
+
+
+def make_batched_multi_step_fn_vmap(ops, nsteps: int, dtype=None,
+                                    test: bool = False, gs=None, lgs=None):
+    """(U: (B, *shape), t0) -> U after ``nsteps`` steps, B = len(ops).
+
+    The ensemble engine's always-available batched fallback and parity
+    oracle: ``jax.vmap`` of the solo forward-Euler step over a leading
+    case axis.  ``ops[0]`` serves as the bucket's prototype — eps,
+    weights, wsum, method, and precision machinery are shared within a
+    shape bucket by construction (:func:`check_bucket_ops`) — while the
+    per-case physics (:func:`case_scale`, dt) and manufactured-source
+    arrays (``test=True``: gs/lgs stacked) are baked at maker time,
+    matching the solo paths' baked constants (ops/pallas_kernel.py
+    section comment: traced scalars flip XLA's FMA formation and cost
+    the last ulp).  Works for the 1D/2D/3D operators and every method:
+    the XLA methods (shift/conv/sat) batch natively; the pallas neighbor
+    sums batch through pallas_call's own vmap rule.  The op sequence per
+    case is exactly the solo step's (``du = scale*(ns -
+    wsum*operand(u))``, then the source, then ``u + dt*du``).
+    """
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
+    check_bucket_ops(ops)
+    op = ops[0]
+    wsum = op.wsum
+    scales = np.array([case_scale(o) for o in ops], np.float64)
+    dts = np.array([o.dt for o in ops], np.float64)
+
+    def one_step(u, t, scale, dt_, g, lg):
+        du = scale * (op.neighbor_sum(u) - wsum * op._operand(u))
+        if test:
+            ang = TWO_PI * (t * dt_)
+            du = du + (-TWO_PI * jnp.sin(ang) * g - jnp.cos(ang) * lg)
+        return u + dt_ * du
+
+    step_v = jax.vmap(
+        one_step,
+        in_axes=(0, None, 0, 0, 0 if test else None, 0 if test else None))
+
+    def multi(U, t0):
+        dt_ = dtype or U.dtype
+        sc = jnp.asarray(scales, dt_)
+        dtv = jnp.asarray(dts, dt_)
+        gd = jnp.asarray(np.asarray(gs), dt_) if test else None
+        lgd = jnp.asarray(np.asarray(lgs), dt_) if test else None
+
+        def body(Ucur, t):
+            return step_v(Ucur, t, sc, dtv, gd, lgd), None
+
+        ts = t0 + jnp.arange(nsteps)
+        out, _ = lax.scan(body, U.astype(dt_), ts)
+        return out
+
+    return donated_jit(multi)
+
+
+def make_batched_multi_step_fn_stacked(ops, nsteps: int, dtype=None,
+                                       test: bool = False, gs=None,
+                                       lgs=None):
+    """(U: (B, *shape), t0) -> U after ``nsteps`` steps, B = len(ops) —
+    each case's SOLO per-step trace (multi_step_fn_base_unjit, baked
+    constants and all) inlined into ONE jitted program.
+
+    This is the mixed-physics composition: when a bucket's cases differ
+    in (k, dt, dh) the grid-axis batched kernels cannot bake one scalar
+    set, and probing showed ref-loaded scalars cost the last ulp of the
+    bit-identity contract — so instead the program simply contains B
+    solo jaxprs side by side.  Still one compile and one dispatch per
+    scan segment (the whole point of the ensemble engine: the ~64 ms
+    tunnel dispatch+fence toll is paid once per segment, not per case),
+    and bit-identical to the sequential solves by construction.  The
+    state arg is donated on TPU (utils/donation.py).
+    """
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
+    check_bucket_ops(ops)
+    inner = [
+        multi_step_fn_base_unjit(
+            op, nsteps,
+            gs[i] if test else None, lgs[i] if test else None, dtype)
+        for i, op in enumerate(ops)
+    ]
+
+    def multi(U, t0):
+        dt_ = dtype or U.dtype
+        U = U.astype(dt_)
+        return jnp.stack([m(U[i], t0) for i, m in enumerate(inner)])
 
     return donated_jit(multi)
 

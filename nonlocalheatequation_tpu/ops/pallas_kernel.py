@@ -106,7 +106,8 @@ def _window_pad(eps: int) -> int:
     return _strip_plan(eps)[3]
 
 
-def _fits(tm: int, ny: int, eps: int, itemsize: int, n_aux: int) -> bool:
+def _fits(tm: int, ny: int, eps: int, itemsize: int, n_aux: int,
+          batch: int = 1) -> bool:
     tmw = tm + _window_pad(eps)
     window = tmw * (ny + 2 * eps) * itemsize
     out = tm * ny * itemsize
@@ -114,6 +115,11 @@ def _fits(tm: int, ny: int, eps: int, itemsize: int, n_aux: int) -> bool:
     log_steps = max(1, int(np.ceil(np.log2(tmw))))
     lane_slots = _lane_slots({(h, L) for h, _j0, L in _lane_runs(eps)})
     stack = (2 * log_steps + 6 + lane_slots) * window + 3 * (out + aux)
+    if batch > 1:
+        # batched ensemble grid (case axis ahead of the strip axis): one
+        # more level of pipeline double-buffering across the case
+        # boundary — conservative, like the rest of the stack model
+        stack += 2 * window + 2 * (out + aux)
     return stack <= _VMEM_BUDGET
 
 
@@ -177,13 +183,14 @@ def _choose_tm(nx: int, ny: int, eps: int, itemsize: int, n_aux: int,
 
 
 def _fits_carried(tm: int, nx: int, ny: int, eps: int, itemsize: int,
-                  bf16: bool = False) -> bool:
+                  bf16: bool = False, batch: int = 1) -> bool:
     """_fits for the carried frame: window is (D - eps) rows taller (rounded
     to 8) and the output block spans the full Lc = ny + 2*eps lanes.  The
     bf16 tier adds the f32 carry block, the upcast window copy and the
     bf16 shadow output (conservatively one extra window + three blocks —
     the bf16-sized buffers are counted at full itemsize like everything
-    else in this deliberately pessimistic model)."""
+    else in this deliberately pessimistic model).  ``batch > 1`` adds the
+    case-axis pipeline margin (see _fits)."""
     D = _round_up(eps, 8)
     tmw = tm + _round_up((D - eps) + _window_pad(eps), 8)
     Lc = ny + 2 * eps
@@ -194,6 +201,8 @@ def _fits_carried(tm: int, nx: int, ny: int, eps: int, itemsize: int,
     stack = (2 * log_steps + 6 + lane_slots) * window + 3 * out
     if bf16:
         stack += window + 3 * out
+    if batch > 1:
+        stack += 2 * window + 2 * out
     return stack <= _VMEM_BUDGET
 
 
@@ -951,6 +960,12 @@ def make_carried_multi_step_fn(op, nsteps: int, dtype=None):
     """
     from nonlocalheatequation_tpu.utils.donation import donated_jit
 
+    return donated_jit(_carried_multi_unjit(op, nsteps, dtype))
+
+
+def _carried_multi_unjit(op, nsteps: int, dtype=None):
+    """make_carried_multi_step_fn without the jit/donation wrapper — the
+    per-case trace the batched 'stacked' composition inlines."""
     eps = op.eps
     precision = getattr(op, "precision", "f32")
 
@@ -974,16 +989,17 @@ def make_carried_multi_step_fn(op, nsteps: int, dtype=None):
                 lambda A, _: (step(A), None), C0, None, length=nsteps)
         return A[D + eps : D + eps + nx, eps : eps + ny]
 
-    return donated_jit(multi)
+    return multi
 
 
 def _fits_superstep(tm: int, nx: int, ny: int, eps: int, itemsize: int,
-                    ksteps: int, bf16: bool = False) -> bool:
+                    ksteps: int, bf16: bool = False, batch: int = 1) -> bool:
     """_fits for the temporally blocked frame (see
     _build_superstep_kernel): the window is ~K*eps rows taller than the
     carried window and the kernel instantiates K sequential band levels,
     each with its own roll chains and band temporaries (no cross-level
-    reuse assumed — conservative, like the rest of the stack model)."""
+    reuse assumed — conservative, like the rest of the stack model).
+    ``batch > 1`` adds the case-axis pipeline margin (see _fits)."""
     D = _round_up(ksteps * eps, 8)
     tmw = tm + D + _round_up((ksteps - 1) * eps, 8) + _window_pad(eps)
     Lc = ny + 2 * eps
@@ -996,6 +1012,8 @@ def _fits_superstep(tm: int, nx: int, ny: int, eps: int, itemsize: int,
         # per-level rounded-operand copy + the f32 carry band + the bf16
         # shadow output (full-itemsize accounting, like the rest)
         stack += (ksteps + 1) * window + 3 * out
+    if batch > 1:
+        stack += 2 * window + 2 * out
     return stack <= _VMEM_BUDGET
 
 
@@ -1185,6 +1203,12 @@ def make_superstep_multi_step_fn(op, nsteps: int, ksteps: int = 2,
     """
     from nonlocalheatequation_tpu.utils.donation import donated_jit
 
+    return donated_jit(_superstep_multi_unjit(op, nsteps, ksteps, dtype))
+
+
+def _superstep_multi_unjit(op, nsteps: int, ksteps: int = 2, dtype=None):
+    """make_superstep_multi_step_fn without the jit/donation wrapper — the
+    per-case trace the batched 'stacked' composition inlines."""
     eps = op.eps
     precision = getattr(op, "precision", "f32")
     bf16 = precision == "bf16"
@@ -1231,7 +1255,7 @@ def make_superstep_multi_step_fn(op, nsteps: int, ksteps: int = 2,
                 A = step_r(A)
         return A[D + eps : D + eps + nx, eps : eps + ny]
 
-    return donated_jit(multi)
+    return multi
 
 
 def _fits_resident(nx: int, ny: int, eps: int, itemsize: int) -> bool:
@@ -1598,3 +1622,506 @@ def make_pallas_step_fn(op, g=None, lg=None, dtype=None):
         return out[:nx]
 
     return step
+
+
+
+
+# ---------------------------------------------------------------------------
+# Batched ensemble kernels: a leading case axis on the 2D kernel stack
+# ---------------------------------------------------------------------------
+#
+# The ensemble engine (serve/ensemble.py) runs B independent solves that
+# share (shape, eps, dtype, precision) as ONE compiled program, so the
+# axon tunnel's ~64 ms dispatch+fence toll is paid once per scan segment
+# instead of once per case.  Two compositions, picked per bucket chunk:
+#
+# * physics-UNIFORM chunks (every case has the same (scale = c*dh^2, dt)
+#   — the common serving shape: one workload, many inputs): the pallas
+#   grid gains a leading case axis (grid (B, strips)), every block spec a
+#   leading size-1 dim indexed by the case id, and scale/dt stay BAKED
+#   Python-float constants exactly like the solo kernels.  Probed at PR
+#   time: baking is load-bearing — routing the scalars through an SMEM
+#   ref (or a traced argument) flips XLA's FMA formation in the Euler
+#   update and costs the last ulp of the bit-identity contract, while the
+#   baked grid-axis kernel is bit-identical to the solo kernels per case.
+# * physics-MIXED chunks: each case's SOLO trace (baked constants and
+#   all) is inlined side by side into one jitted program ("stacked"
+#   composition, ops/nonlocal_op.make_batched_multi_step_fn_stacked is
+#   the per-step form).  Still one compile and one dispatch per segment,
+#   and bit-identical to the sequential solves by construction.
+#
+# The public makers below take the bucket's operator LIST and dispatch
+# between the two compositions themselves; jax.vmap over the solo step
+# (ops/nonlocal_op.make_batched_multi_step_fn_vmap) remains the
+# always-available fallback and parity oracle.
+
+
+def _uniform_physics(ops) -> bool:
+    """Whether one (scale, dt) scalar pair serves every case — the gate
+    for the grid-axis kernels (baked constants; see section comment)."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import case_scale
+
+    return len({(case_scale(op), op.dt) for op in ops}) == 1
+
+
+def _stack_cases(inners, dtype=None):
+    """One jitted program inlining per-case solo multi-step traces —
+    the mixed-physics composition (see section comment).  The state arg
+    is donated on TPU (utils/donation.py)."""
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
+    def multi(U, t0):
+        if dtype is not None:
+            U = U.astype(dtype)
+        return jnp.stack([m(U[i], t0) for i, m in enumerate(inners)])
+
+    return donated_jit(multi)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_batched_step_kernel(eps: int, nx: int, ny: int, dtype_name: str,
+                               batch: int, scale: float, dt: float,
+                               wsum: float, test: bool,
+                               precision: str = "f32"):
+    """Leading-case-axis twin of _build_step_kernel (production AND test
+    source paths), physics-uniform chunks only: scale/dt are baked
+    constants, the manufactured source's per-case g/lg ride as (1, tm,
+    ny) case blocks and its sincos as the solo kernel's shared SMEM row
+    (dt is uniform, so the angle is too)."""
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    bf16 = precision == "bf16"
+    n_aux = (2 if test else 0) + (1 if bf16 else 0)
+    tm = _choose_tm(
+        nx, ny, eps, dtype.itemsize, n_aux=n_aux,
+        fits=lambda t: _fits(t, ny, eps, dtype.itemsize, n_aux, batch=batch))
+    tmw = tm + _window_pad(eps)
+
+    def kernel(*refs):
+        refs = list(refs)
+        win_ref = refs.pop(0)
+        ctr_ref = refs.pop(0) if bf16 else None
+        if test:
+            g_ref, lg_ref, sc_ref = refs[0], refs[1], refs[2]
+        out_ref = refs[-1]
+        w = win_ref[0]
+        if bf16:
+            w = w.astype(dtype)
+        acc = _strip_neighbor_sum(w, tm, ny, eps)
+        center = w[eps : eps + tm, eps : eps + ny]
+        du = scale * (acc - wsum * center)
+        if test:
+            sin_a = sc_ref[0, 0]
+            cos_a = sc_ref[0, 1]
+            du = du + (-TWO_PI * sin_a) * g_ref[0] + (-cos_a) * lg_ref[0]
+        carry = ctr_ref[0] if bf16 else center
+        out_ref[0] = (carry + dt * du).astype(dtype)
+
+    case_block = lambda: _elem_spec(  # noqa: E731
+        (1, tm, ny), lambda b, i: (b, i * tm, 0), pltpu.VMEM)
+
+    def step_padded(Upad, g, lg, sincos):
+        """One fused Euler step over the case stack; operands pre-padded."""
+        vma = array_vma(Upad)
+        nxp = Upad.shape[1] - (tmw - tm)
+        in_specs = [
+            _elem_spec((1, tmw, ny + 2 * eps), lambda b, i: (b, i * tm, 0),
+                       pltpu.VMEM)
+        ]
+        args = [Upad.astype(jnp.bfloat16) if bf16 else Upad]
+        if bf16:
+            in_specs.append(case_block())
+            args.append(lax.slice(Upad, (0, eps, eps),
+                                  (batch, eps + nxp, eps + ny)))
+        if test:
+            in_specs += [case_block(), case_block(),
+                         pl.BlockSpec(memory_space=pltpu.SMEM)]
+            args += [g, lg, sincos]
+        out = pl.pallas_call(
+            kernel,
+            grid=(batch, nxp // tm),
+            in_specs=in_specs,
+            out_specs=case_block(),
+            out_shape=out_struct((batch, nxp, ny), dtype, vma=vma),
+            **_kernel_params(),
+        )(*args)
+        return out
+
+    return step_padded, tm, tmw
+
+
+def make_batched_pallas_multi_step_fn(ops, nsteps: int, dtype=None,
+                                      test: bool = False, gs=None,
+                                      lgs=None):
+    """(U: (B, nx, ny), t0) -> U after ``nsteps`` forward-Euler steps,
+    all B = len(ops) cases advanced by ONE program.
+
+    The batched twin of the per-step pallas path (make_pallas_step_fn
+    under make_multi_step_fn_base): physics-uniform chunks pad the case
+    stack once per scan step and run one fused grid-axis kernel;
+    physics-mixed chunks inline the per-case solo traces (see the
+    section comment).  ``test=True`` adds the manufactured source; gs/lgs
+    are the per-case (G, L(G)) stacks.  Production outputs are
+    bit-identical to the solo solves; the test-source grid-axis path is
+    last-ulp-close (~1e-16: the fused source multiply-add regionalizes
+    differently against the case-blocked g/lg reads — measured, inside
+    the 1e-12 contract; the stacked composition is the bit-exact form).
+    The state arg is donated on TPU (utils/donation.py)."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        case_scale,
+        check_bucket_ops,
+        make_batched_multi_step_fn_stacked,
+    )
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
+    check_bucket_ops(ops)
+    if not _uniform_physics(ops):
+        return make_batched_multi_step_fn_stacked(
+            ops, nsteps, dtype=dtype, test=test, gs=gs, lgs=lgs)
+    op0 = ops[0]
+    eps = op0.eps
+    wsum = op0.wsum
+    scale = case_scale(op0)
+    dt = op0.dt
+    precision = getattr(op0, "precision", "f32")
+    batch = len(ops)
+
+    def multi(U, t0):
+        dt_ = dtype or U.dtype
+        _B, nx, ny = U.shape
+        step_padded, tm, tmw = _build_batched_step_kernel(
+            eps, nx, ny, jnp.dtype(dt_).name, batch, scale, dt, wsum, test,
+            precision)
+        nxp = _round_up(nx, tm)
+        if test:
+            gd = jnp.asarray(np.asarray(gs), dt_)
+            lgd = jnp.asarray(np.asarray(lgs), dt_)
+            if nxp != nx:
+                gd = jnp.pad(gd, ((0, 0), (0, nxp - nx), (0, 0)))
+                lgd = jnp.pad(lgd, ((0, 0), (0, nxp - nx), (0, 0)))
+        else:
+            gd = lgd = None
+
+        def body(Ucur, t):
+            Upad = jnp.pad(
+                Ucur,
+                ((0, 0), (eps, tmw - tm - eps + (nxp - nx)), (eps, eps)))
+            if test:
+                ang = TWO_PI * (t * dt)
+                sincos = jnp.stack(
+                    [jnp.sin(ang), jnp.cos(ang)]
+                ).reshape(1, 2).astype(dt_)
+                out = step_padded(Upad, gd, lgd, sincos)
+            else:
+                out = step_padded(Upad, None, None, None)
+            return out[:, :nx, :], None
+
+        ts = t0 + jnp.arange(nsteps)
+        out, _ = lax.scan(body, U.astype(dt_), ts)
+        return out
+
+    return donated_jit(multi)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_batched_carried_kernel(eps: int, nx: int, ny: int,
+                                  dtype_name: str, batch: int, scale: float,
+                                  dt: float, wsum: float,
+                                  precision: str = "f32"):
+    """Leading-case-axis twin of _build_carried_kernel (physics-uniform
+    chunks): the frame becomes (B, Rc, Lc), the grid (B, G), scale/dt
+    stay baked.  Same plan, same op order, same masks per case ->
+    bit-identical to the solo carried kernel (see section comment)."""
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    bf16 = precision == "bf16"
+    tm = _choose_tm(
+        nx, ny, eps, dtype.itemsize, n_aux=0,
+        fits=lambda t: _fits_carried(t, nx, ny, eps, dtype.itemsize,
+                                     bf16=bf16, batch=batch))
+    D = _round_up(eps, 8)
+    tmw = tm + _round_up((D - eps) + _window_pad(eps), 8)
+    Lc = ny + 2 * eps
+    G = -(-(nx + 2 * eps) // tm)
+    Rc = max(D + G * tm, (G - 1) * tm + tmw)
+
+    def kernel(*refs):
+        if bf16:
+            win_ref, ctr_ref, out_ref, outb_ref = refs
+        else:
+            (win_ref, out_ref), ctr_ref, outb_ref = refs, None, None
+        w = win_ref[0]
+        if bf16:
+            w = w.astype(dtype)
+        acc = _strip_neighbor_sum(w, tm, ny, eps, row0=D)
+        center = w[D : D + tm, eps : eps + ny]
+        du = scale * (acc - wsum * center)
+        carry = ctr_ref[0, :, eps : eps + ny] if bf16 else center
+        nxt = carry + dt * du
+        i = pl.program_id(1)
+        rows = D + i * tm + jax.lax.broadcasted_iota(jnp.int32, (tm, ny), 0)
+        ok = (rows >= D + eps) & (rows < D + eps + nx)
+        val = jnp.where(ok, nxt, 0).astype(dtype)
+        out_ref[0, :, eps : eps + ny] = val
+        out_ref[0, :, :eps] = jnp.zeros((tm, eps), dtype)
+        out_ref[0, :, eps + ny :] = jnp.zeros((tm, eps), dtype)
+        if bf16:
+            outb_ref[0, :, eps : eps + ny] = val.astype(jnp.bfloat16)
+            outb_ref[0, :, :eps] = jnp.zeros((tm, eps), jnp.bfloat16)
+            outb_ref[0, :, eps + ny :] = jnp.zeros((tm, eps), jnp.bfloat16)
+
+    out_block = _elem_spec(
+        (1, tm, Lc),
+        lambda b, i: (b, (i * (tm // 8) + D // 8) * 8, 0), pltpu.VMEM)
+    win_spec = _elem_spec(
+        (1, tmw, Lc), lambda b, i: (b, i * tm, 0), pltpu.VMEM)
+
+    def step(A):
+        return pl.pallas_call(
+            kernel,
+            grid=(batch, G),
+            in_specs=[win_spec],
+            out_specs=out_block,
+            out_shape=jax.ShapeDtypeStruct((batch, Rc, Lc), dtype),
+            **_kernel_params(),
+        )(A)
+
+    def step_bf16(Af, Ab):
+        return pl.pallas_call(
+            kernel,
+            grid=(batch, G),
+            in_specs=[win_spec, out_block],
+            out_specs=[out_block, out_block],
+            out_shape=[jax.ShapeDtypeStruct((batch, Rc, Lc), dtype),
+                       jax.ShapeDtypeStruct((batch, Rc, Lc), jnp.bfloat16)],
+            **_kernel_params(),
+        )(Ab, Af)
+
+    return (step_bf16 if bf16 else step), Rc, Lc, D
+
+
+def make_batched_carried_multi_step_fn(ops, nsteps: int, dtype=None):
+    """(U: (B, nx, ny), t0) -> U after ``nsteps`` steps, the whole
+    B = len(ops) case stack carried in ONE padded frame across a single
+    scan — the batched twin of make_carried_multi_step_fn (production/
+    source-free path only).  Physics-mixed chunks stack the per-case solo
+    carried traces instead (see section comment).  The state arg is
+    donated on TPU (utils/donation.py)."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        case_scale,
+        check_bucket_ops,
+    )
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
+    check_bucket_ops(ops)
+    if not _uniform_physics(ops):
+        return _stack_cases(
+            [_carried_multi_unjit(op, nsteps, dtype) for op in ops], dtype)
+    op0 = ops[0]
+    eps = op0.eps
+    wsum = op0.wsum
+    scale = case_scale(op0)
+    dt = op0.dt
+    precision = getattr(op0, "precision", "f32")
+    batch = len(ops)
+
+    def multi(U, t0):
+        del t0
+        dt_ = dtype or U.dtype
+        _B, nx, ny = U.shape
+        step, Rc, Lc, D = _build_batched_carried_kernel(
+            eps, nx, ny, jnp.dtype(dt_).name, batch, scale, dt, wsum,
+            precision)
+        C0 = (jnp.zeros((batch, Rc, Lc), dt_)
+              .at[:, D + eps : D + eps + nx, eps : eps + ny]
+              .set(U.astype(dt_)))
+        if precision == "bf16":
+            (A, _Bb), _ = lax.scan(
+                lambda AB, _: (step(AB[0], AB[1]), None),
+                (C0, C0.astype(jnp.bfloat16)), None, length=nsteps)
+        else:
+            A, _ = lax.scan(
+                lambda A, _: (step(A), None), C0, None, length=nsteps)
+        return A[:, D + eps : D + eps + nx, eps : eps + ny]
+
+    return donated_jit(multi)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_batched_superstep_kernel(eps: int, nx: int, ny: int,
+                                    dtype_name: str, batch: int,
+                                    scale: float, dt: float, wsum: float,
+                                    ksteps: int, tm: int, D: int, Rc: int,
+                                    precision: str = "f32"):
+    """Leading-case-axis twin of _build_superstep_kernel (K-step temporal
+    blocking over the carried frame layout; physics-uniform chunks).
+    Level structure, masks, and the inter-level optimization_barrier are
+    identical per case; only the frame/grid gain the case axis."""
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    bf16 = precision == "bf16"
+    pad = _window_pad(eps)
+    tmw = tm + D + _round_up((ksteps - 1) * eps, 8) + pad
+    Lc = ny + 2 * eps
+    G = -(-(nx + 2 * eps) // tm)
+    lvl1 = D - (ksteps - 1) * eps
+    D1 = (lvl1 // 8) * 8
+    o1 = lvl1 - D1
+    H1 = _round_up(o1 + tm + 2 * (ksteps - 1) * eps, 8)
+
+    def kernel(*refs):
+        if bf16:
+            win_ref, ctr_ref, out_ref, outb_ref = refs
+        else:
+            (win_ref, out_ref), ctr_ref, outb_ref = refs, None, None
+        i = pl.program_id(1)
+        state = win_ref[0]
+        if bf16:
+            state = state.astype(dtype)  # rounded OPERAND, f32 compute
+        for j in range(1, ksteps + 1):
+            bh = tm + 2 * (ksteps - j) * eps
+            row0 = (D - (ksteps - 1) * eps) if j == 1 else eps
+            opnd = (state.astype(jnp.bfloat16).astype(dtype)
+                    if bf16 and j > 1 else state)
+            acc = _strip_neighbor_sum(opnd, bh, ny, eps, row0=row0)
+            center = opnd[row0 : row0 + bh, eps : eps + ny]
+            du = scale * (acc - wsum * center)
+            if bf16:
+                carry = (ctr_ref[0, o1 : o1 + bh, eps : eps + ny] if j == 1
+                         else state[row0 : row0 + bh, eps : eps + ny])
+            else:
+                carry = center
+            nxt = carry + dt * du
+            start = i * tm + D - (ksteps - j) * eps
+            rows = start + jax.lax.broadcasted_iota(jnp.int32, (bh, ny), 0)
+            ok = (rows >= D + eps) & (rows < D + eps + nx)
+            nxt = jnp.where(ok, nxt, 0).astype(dtype)
+            if j == ksteps:
+                out_ref[0, :, eps : eps + ny] = nxt
+                out_ref[0, :, :eps] = jnp.zeros((tm, eps), dtype)
+                out_ref[0, :, eps + ny :] = jnp.zeros((tm, eps), dtype)
+                if bf16:
+                    outb_ref[0, :, eps : eps + ny] = \
+                        nxt.astype(jnp.bfloat16)
+                    outb_ref[0, :, :eps] = jnp.zeros((tm, eps),
+                                                     jnp.bfloat16)
+                    outb_ref[0, :, eps + ny :] = jnp.zeros((tm, eps),
+                                                           jnp.bfloat16)
+            else:
+                zl = jnp.zeros((bh, eps), dtype)
+                band = jnp.concatenate([zl, nxt, zl], axis=1)
+                state = jnp.concatenate(
+                    [band, jnp.zeros((pad, Lc), dtype)], axis=0)
+                # same materialization boundary as the solo kernel (see
+                # _build_superstep_kernel): pins the per-step fusion
+                # context so bit-identity survives XLA regionalization
+                state = jax.lax.optimization_barrier(state)
+
+    out_block = _elem_spec(
+        (1, tm, Lc),
+        lambda b, i: (b, (i * (tm // 8) + D // 8) * 8, 0), pltpu.VMEM)
+    win_spec = _elem_spec(
+        (1, tmw, Lc), lambda b, i: (b, i * tm, 0), pltpu.VMEM)
+
+    def step(A):
+        return pl.pallas_call(
+            kernel,
+            grid=(batch, G),
+            in_specs=[win_spec],
+            out_specs=out_block,
+            out_shape=jax.ShapeDtypeStruct((batch, Rc, Lc), dtype),
+            **_kernel_params(),
+        )(A)
+
+    def step_bf16(Af, Ab):
+        return pl.pallas_call(
+            kernel,
+            grid=(batch, G),
+            in_specs=[
+                win_spec,
+                _elem_spec((1, H1, Lc),
+                           lambda b, i: (b, (i * (tm // 8) + D1 // 8) * 8,
+                                         0),
+                           pltpu.VMEM),
+            ],
+            out_specs=[out_block, out_block],
+            out_shape=[jax.ShapeDtypeStruct((batch, Rc, Lc), dtype),
+                       jax.ShapeDtypeStruct((batch, Rc, Lc), jnp.bfloat16)],
+            **_kernel_params(),
+        )(Ab, Af)
+
+    return step_bf16 if bf16 else step
+
+
+def make_batched_superstep_multi_step_fn(ops, nsteps: int, ksteps: int = 2,
+                                         dtype=None):
+    """(U: (B, nx, ny), t0) -> U after ``nsteps`` steps, ``ksteps`` fused
+    per pallas_call over the whole B = len(ops) case stack — the batched
+    twin of make_superstep_multi_step_fn (production path only;
+    remainder steps run a shallower superstep on the same frame).
+    Physics-mixed chunks stack the per-case solo superstep traces
+    instead (see section comment).  The state arg is donated on TPU
+    (utils/donation.py)."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        case_scale,
+        check_bucket_ops,
+    )
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
+    check_bucket_ops(ops)
+    if not _uniform_physics(ops):
+        return _stack_cases(
+            [_superstep_multi_unjit(op, nsteps, ksteps, dtype)
+             for op in ops], dtype)
+    op0 = ops[0]
+    eps = op0.eps
+    wsum = op0.wsum
+    scale = case_scale(op0)
+    dt = op0.dt
+    precision = getattr(op0, "precision", "f32")
+    bf16 = precision == "bf16"
+    batch = len(ops)
+
+    def multi(U, t0):
+        del t0
+        dt_ = dtype or U.dtype
+        _B, nx, ny = U.shape
+        K = superstep_k(ksteps, nsteps)
+        itemsize = jnp.dtype(dt_).itemsize
+        tm = _choose_tm(
+            nx, ny, eps, itemsize, n_aux=0,
+            fits=lambda t: _fits_superstep(t, nx, ny, eps, itemsize, K,
+                                           bf16=bf16, batch=batch))
+        D = _round_up(K * eps, 8)
+        tmw = tm + D + _round_up((K - 1) * eps, 8) + _window_pad(eps)
+        Lc = ny + 2 * eps
+        G = -(-(nx + 2 * eps) // tm)
+        Rc = max(D + G * tm, (G - 1) * tm + tmw)
+        name = jnp.dtype(dt_).name
+        step_K = _build_batched_superstep_kernel(
+            eps, nx, ny, name, batch, scale, dt, wsum, K, tm, D, Rc,
+            precision)
+        C0 = (jnp.zeros((batch, Rc, Lc), dt_)
+              .at[:, D + eps : D + eps + nx, eps : eps + ny]
+              .set(U.astype(dt_)))
+        q, r = divmod(nsteps, K)
+        if bf16:
+            (A, Bb), _ = lax.scan(
+                lambda AB, _: (step_K(AB[0], AB[1]), None),
+                (C0, C0.astype(jnp.bfloat16)), None, length=q)
+            if r:
+                step_r = _build_batched_superstep_kernel(
+                    eps, nx, ny, name, batch, scale, dt, wsum, r, tm, D,
+                    Rc, precision)
+                A, Bb = step_r(A, Bb)
+        else:
+            A, _ = lax.scan(
+                lambda A, _: (step_K(A), None), C0, None, length=q)
+            if r:
+                step_r = _build_batched_superstep_kernel(
+                    eps, nx, ny, name, batch, scale, dt, wsum, r, tm, D,
+                    Rc)
+                A = step_r(A)
+        return A[:, D + eps : D + eps + nx, eps : eps + ny]
+
+    return donated_jit(multi)

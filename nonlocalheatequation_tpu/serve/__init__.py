@@ -1,0 +1,6 @@
+"""Serving-side machinery: batch many independent solves into few
+compiled programs (serve/ensemble.py).  The reference's batch_tester
+(src/1d_nonlocal_serial.cpp:239-266) treats N cases as one job but runs
+them strictly sequentially; on the tunneled TPU each solve pays a ~64 ms
+dispatch+fence toll, so the serving-scale answer is to schedule cases
+into shape buckets and advance each bucket as ONE program."""

@@ -1,0 +1,329 @@
+"""Shape-bucketed ensemble scheduler: N independent solves, few programs.
+
+The reference's batch_tester protocol (src/1d_nonlocal_serial.cpp:239-266)
+runs its parameter rows strictly sequentially, and so did ``run_batch``
+(cli/common.py) — N cases pay N dispatch+fence roundtrips at ~64 ms each
+over the axon tunnel (CLAUDE.md), plus N compiles on a cold cache.  This
+module is the scheduler half of the batched ensemble engine:
+
+* **Bucketing** — submitted :class:`EnsembleCase` rows group by
+  ``(shape, nt, eps, test)``; the engine-level settings (dtype,
+  precision tier, method, superstep depth) complete the key.  ``nt``
+  joins the issue's ``(grid, eps, dtype, precision, ksteps)`` key
+  because the scan length is part of the compiled program.  Cases in one
+  bucket may differ in physics (k, dt, dh): the ops-layer makers bake a
+  single scalar set when the chunk is physics-uniform (the grid-axis
+  kernels) and fall back to inlining per-case solo traces when it is not
+  (``make_batched_multi_step_fn_stacked``) — both are one compile and
+  one dispatch per scan segment (ops/pallas_kernel.py section comment).
+* **Padding** — each bucket is chunked to the largest allowed batch size
+  and the final chunk is padded UP to the smallest allowed size that
+  fits (default sizes 1/2/4/8), by duplicating the last real case.  A
+  small, fixed set of batch shapes keeps the per-(shape, B) kernel set
+  tiny, so the persistent XLA compile cache (bench.py, PR 1) hits across
+  runs instead of compiling one program per case count.  Padding lanes
+  are dropped before results are returned.
+* **Dispatch** — one multi-step scan program per chunk: per chunk, the
+  tunnel's dispatch toll is paid once, not once per case
+  (``report.dispatches`` counts them; tests assert an 8-case bucket is
+  ONE program and ONE dispatch).
+
+Per-case results are unpadded and returned in submission order; the
+caller computes ``error_l2`` exactly as the solo path does (the CLIs
+feed the states back into their Solver objects — the oracle contract
+``error_l2/#points <= 1e-6`` is unchanged, and the production/batched
+outputs are bit-identical to the sequential solves on the f64 CPU suite,
+tests/test_ensemble.py).
+
+``NLHEAT_TUNE_BATCH=1`` adds the batch dimension to the autotuner: 2D
+pallas production buckets probe the batched per-step/carried/superstep
+variants plus the vmap fallback once per (shape, B) and run the winner
+(utils/autotune.pick_batched_multi_step_fn).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+#: Allowed chunk sizes, ascending.  Buckets larger than the top size are
+#: split into top-size chunks; the remainder pads up to the smallest
+#: size that fits.
+BATCH_SIZES = (1, 2, 4, 8)
+
+
+@dataclass
+class EnsembleCase:
+    """One solve submitted to the engine.
+
+    ``shape`` is the grid ((nx,), (nx, ny) or (nx, ny, nz)); ``dh`` holds
+    the 1D operator's dx for rank-1 cases.  ``test=True`` runs the
+    manufactured-solution source (the batch_tester protocol);
+    ``u0=None`` with ``test=True`` defaults to the spatial profile G,
+    matching Solver*.test_init.
+    """
+
+    shape: tuple
+    nt: int
+    eps: int
+    k: float
+    dt: float
+    dh: float
+    test: bool = True
+    u0: np.ndarray | None = None
+
+    def bucket_key(self):
+        return (tuple(int(s) for s in self.shape), int(self.nt),
+                int(self.eps), bool(self.test))
+
+    def physics(self):
+        return (float(self.k), float(self.dt), float(self.dh))
+
+
+@dataclass
+class EnsembleReport:
+    """Observability counters for one engine lifetime (tests assert on
+    them: an 8-case same-shape bucket must be 1 program / 1 dispatch)."""
+
+    cases: int = 0
+    buckets: int = 0
+    dispatches: int = 0
+    programs_built: int = 0
+    padded_cases: int = 0
+    strategies: dict = field(default_factory=dict)
+
+    def summary(self) -> str:
+        return (f"{self.cases} cases -> {self.buckets} buckets, "
+                f"{self.dispatches} dispatches, "
+                f"{self.programs_built} programs "
+                f"({self.padded_cases} padding lanes)")
+
+
+class EnsembleEngine:
+    """Run a list of :class:`EnsembleCase` as few batched programs.
+
+    ``variant`` selects the multi-step composition for 2D pallas
+    production buckets: ``per-step`` (default), ``carried``,
+    ``superstep`` (needs ``ksteps >= 2``), ``stacked`` (per-case solo
+    traces in one program), ``vmap`` (the parity oracle), or ``auto``
+    (per-step, or the autotuner's batched winner under
+    ``NLHEAT_TUNE_BATCH=1``).  Non-pallas methods, 1D/3D cases, and
+    manufactured-source buckets under ``carried``/``superstep`` refuse
+    loudly rather than silently running a different schedule.
+    """
+
+    VARIANTS = ("auto", "per-step", "carried", "superstep", "stacked",
+                "vmap")
+
+    def __init__(self, method: str = "auto", precision: str = "f32",
+                 dtype=None, variant: str = "auto", ksteps: int = 0,
+                 batch_sizes=BATCH_SIZES):
+        if variant not in self.VARIANTS:
+            raise ValueError(
+                f"unknown ensemble variant {variant!r}; one of "
+                f"{self.VARIANTS}")
+        if variant == "superstep" and ksteps < 2:
+            raise ValueError("variant='superstep' needs ksteps >= 2")
+        sizes = tuple(sorted({int(b) for b in batch_sizes}))
+        if not sizes or sizes[0] < 1:
+            raise ValueError(f"bad batch_sizes {batch_sizes!r}")
+        self.method = method
+        self.precision = precision
+        self.dtype = dtype
+        self.variant = variant
+        self.ksteps = int(ksteps)
+        self.batch_sizes = sizes
+        self.report = EnsembleReport()
+        self._programs: dict = {}
+
+    # -- case -> operator ---------------------------------------------------
+    def _make_op(self, case: EnsembleCase):
+        from nonlocalheatequation_tpu.ops.nonlocal_op import (
+            NonlocalOp1D,
+            NonlocalOp2D,
+            NonlocalOp3D,
+        )
+
+        dim = len(case.shape)
+        if dim == 1:
+            return NonlocalOp1D(case.eps, case.k, case.dt, case.dh,
+                                precision=self.precision)
+        cls = NonlocalOp2D if dim == 2 else NonlocalOp3D
+        return cls(case.eps, case.k, case.dt, case.dh, method=self.method,
+                   precision=self.precision)
+
+    def _dtype(self):
+        if self.dtype is not None:
+            return jnp.dtype(self.dtype)
+        return jnp.dtype(
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+
+    # -- scheduling ---------------------------------------------------------
+    def _chunks(self, idxs):
+        """Split a bucket's case indices into (real_indices, padded_B)."""
+        top = self.batch_sizes[-1]
+        for start in range(0, len(idxs), top):
+            part = idxs[start : start + top]
+            B = next(b for b in self.batch_sizes if b >= len(part))
+            yield part, B
+
+    def run(self, cases) -> list:
+        """Solve every case; returns final states (np arrays, f64-exact
+        casts of the engine dtype) in submission order."""
+        cases = list(cases)
+        self.report.cases += len(cases)
+        results: list = [None] * len(cases)
+        buckets: dict = {}
+        for i, case in enumerate(cases):
+            buckets.setdefault(case.bucket_key(), []).append(i)
+        self.report.buckets += len(buckets)
+        for key, idxs in buckets.items():
+            for part, B in self._chunks(idxs):
+                chunk = [cases[i] for i in part]
+                pad = B - len(chunk)
+                if pad:
+                    chunk = chunk + [chunk[-1]] * pad
+                    self.report.padded_cases += pad
+                out = self._run_chunk(key, chunk)
+                for j, i in enumerate(part):
+                    results[i] = np.asarray(out[j])
+        return results
+
+    # -- one chunk = one program, one dispatch ------------------------------
+    def _run_chunk(self, key, chunk):
+        test = key[3]
+        dtype = self._dtype()
+        prog_key = (key, len(chunk), self.variant,
+                    tuple(c.physics() for c in chunk), dtype.name)
+        multi = self._programs.get(prog_key)
+        if multi is None:
+            # operators are only needed to BUILD a program (and for the
+            # u0 test-mode default below); a cache hit skips them
+            ops = [self._make_op(c) for c in chunk]
+            multi = self._build_program(key, chunk, ops, test, dtype)
+            self._programs[prog_key] = multi
+            self.report.programs_built += 1
+        U0 = jnp.asarray(np.stack([self._u0(c) for c in chunk]), dtype)
+        out = multi(U0, 0)
+        self.report.dispatches += 1
+        return np.asarray(out)
+
+    def _u0(self, case: EnsembleCase) -> np.ndarray:
+        if case.u0 is not None:
+            return np.asarray(case.u0, np.float64).reshape(case.shape)
+        if not case.test:
+            raise ValueError(
+                "a production (test=False) EnsembleCase needs an initial "
+                "state u0")
+        return self._make_op(case).spatial_profile(*case.shape)
+
+    def _build_program(self, key, chunk, ops, test, dtype):
+        from nonlocalheatequation_tpu.ops.nonlocal_op import (
+            make_batched_multi_step_fn_stacked,
+            make_batched_multi_step_fn_vmap,
+        )
+
+        shape, nt = key[0], key[1]
+        dim = len(shape)
+        op0 = ops[0]
+        gs = lgs = None
+        if test:
+            parts = [op.source_parts(*shape) for op in ops]
+            gs = [g for g, _ in parts]
+            lgs = [lg for _, lg in parts]
+        resolved = self.method
+        if dim == 2 and resolved == "auto":
+            resolved = op0._resolve_method(shape[0], shape[1], dtype)
+        elif dim == 3 and resolved == "auto":
+            resolved = op0._resolve_method(*shape, dtype)
+        pallas2d = dim == 2 and resolved == "pallas" and op0.uniform
+        variant = self.variant
+        if variant in ("carried", "superstep"):
+            # honesty rule: these are 2D pallas production schedules; a
+            # request that cannot engage is refused, never silently
+            # downgraded (the same policy as --superstep on the CLIs)
+            if not pallas2d:
+                raise ValueError(
+                    f"ensemble variant {variant!r} needs the 2D pallas "
+                    f"method (bucket resolved to {resolved!r}, dim {dim})")
+            if test:
+                raise ValueError(
+                    f"ensemble variant {variant!r} is production-only "
+                    "(the carried/superstep kernels carry no manufactured "
+                    "source); use per-step/stacked/vmap for --test_batch "
+                    "solves")
+        if variant == "auto":
+            if (pallas2d and not test
+                    and os.environ.get("NLHEAT_TUNE_BATCH") == "1"):
+                from nonlocalheatequation_tpu.utils.autotune import (
+                    pick_batched_multi_step_fn,
+                )
+
+                fn, winner = pick_batched_multi_step_fn(
+                    ops, nt, shape, dtype, ksteps=self.ksteps)
+                self.report.strategies[key] = f"tuned:{winner}"
+                return fn
+            variant = "per-step" if pallas2d else "vmap"
+        self.report.strategies[key] = self._label(variant, ops, pallas2d)
+        if variant == "vmap":
+            gsa = np.stack(gs) if test else None
+            lgsa = np.stack(lgs) if test else None
+            return make_batched_multi_step_fn_vmap(
+                ops, nt, dtype=dtype, test=test, gs=gsa, lgs=lgsa)
+        if variant == "stacked":
+            return make_batched_multi_step_fn_stacked(
+                ops, nt, dtype=dtype, test=test, gs=gs, lgs=lgs)
+        if not pallas2d:
+            # per-step requested on a non-pallas bucket: the stacked
+            # composition IS the per-step schedule there (each case's
+            # solo scan, one program)
+            return make_batched_multi_step_fn_stacked(
+                ops, nt, dtype=dtype, test=test, gs=gs, lgs=lgs)
+        from nonlocalheatequation_tpu.ops import pallas_kernel as pk
+
+        if variant == "carried":
+            return pk.make_batched_carried_multi_step_fn(ops, nt,
+                                                         dtype=dtype)
+        if variant == "superstep":
+            return pk.make_batched_superstep_multi_step_fn(
+                ops, nt, ksteps=self.ksteps, dtype=dtype)
+        gsa = np.stack(gs) if test else None
+        lgsa = np.stack(lgs) if test else None
+        return pk.make_batched_pallas_multi_step_fn(
+            ops, nt, dtype=dtype, test=test, gs=gsa, lgs=lgsa)
+
+    @staticmethod
+    def _label(variant, ops, pallas2d) -> str:
+        if variant in ("vmap", "stacked") or not pallas2d:
+            return variant
+        from nonlocalheatequation_tpu.ops.pallas_kernel import (
+            _uniform_physics,
+        )
+
+        form = "grid" if _uniform_physics(ops) else "stacked"
+        return f"{variant}[{form}]"
+
+
+def run_test_cases(cases, **engine_kwargs):
+    """Convenience wrapper for the batch_tester protocol: run manufactured
+    test cases through one engine; returns [(error_l2, n_points)] in
+    submission order.  The error is computed exactly as the solvers do —
+    f64 manufactured solution at t = nt vs the final state (the CLIs
+    prefer feeding states back into their Solver objects; this helper
+    serves bench/tooling callers with no Solver at hand)."""
+    engine = EnsembleEngine(**engine_kwargs)
+    cases = list(cases)
+    states = engine.run(cases)
+    out = []
+    for case, u in zip(cases, states):
+        op = engine._make_op(case)
+        want = (np.cos(2.0 * np.pi * (case.nt * case.dt))
+                * op.spatial_profile(*case.shape))
+        d = np.asarray(u, np.float64) - want
+        out.append((float(np.sum(d * d)), int(np.prod(case.shape))))
+    return out

@@ -16,7 +16,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 # switch the paths the suite compares (e.g. the resident-vs-scan oracles)
 for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
               "NLHEAT_LANE_RUNS", "NLHEAT_TM", "NLHEAT_DONATE",
-              "NLHEAT_TUNE_PRECISION", "BENCH_PRECISION"):
+              "NLHEAT_TUNE_PRECISION", "NLHEAT_TUNE_BATCH",
+              "BENCH_PRECISION", "BENCH_ENSEMBLE"):
     os.environ.pop(_knob, None)
 # "" DISABLES autotune-cache persistence (unset means the per-user default
 # file since tuning became the on-TPU default): the suite must neither read

@@ -89,6 +89,21 @@ def test_accuracy_optout_skips_gate_but_still_measures():
     assert "accuracy gate skipped" in proc.stderr + proc.stdout
 
 
+def test_bench_ensemble_mode_emits_cases_field():
+    # BENCH_ENSEMBLE=B: each rung advances B same-shape cases as one
+    # batched program; the JSON line must carry the case count and the
+    # aggregate cases*points*steps/s field on the same one-line rc=0
+    # contract — here exercised on the CPU fallback ladder
+    proc, rec = run_bench({"BENCH_ENSEMBLE": "4"})
+    assert proc.returncode == 0
+    assert rec["value"] > 0
+    assert rec["cases"] == 4
+    assert rec["variant"] == "ensemble4"
+    assert rec["cases*points*steps/s"] == rec["value"]
+    assert rec["partial"] is False
+    assert rec["accuracy"]["ok"] is True  # the solo gate still runs
+
+
 def test_tight_deadline_emits_partial_not_zero():
     # Budget long enough for probe + first rung, short enough to cut the
     # ladder; grid 512 on CPU forces a multi-second second rung.
@@ -141,6 +156,10 @@ if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-v"]))
 
 
+@pytest.mark.slow  # ~60 s: a full tpu_refresh.sh gate run.  Marked slow
+# (PR 2) to hold the 870 s tier-1 budget — the refresh runbook is the
+# LEGACY known-healthy-chip path (tools/tpu_opportunistic.sh is the live
+# runner, policy-tested in tier-1); run `pytest -m slow` for this one.
 def test_tpu_refresh_aborts_on_unhealthy_backend(tmp_path):
     """The refresh runbook must gate the unprotected measurement tools on
     bench.py's hang-proof probe: a CPU-fallback artifact aborts the run."""

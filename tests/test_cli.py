@@ -38,6 +38,64 @@ def test_2d_batch_failure_detected():
     assert r.returncode == 1
 
 
+def test_2d_batch_ensemble_mode():
+    # --ensemble schedules the cases through serve/ensemble.py: same
+    # pass criterion and output, one batched program per shape bucket
+    # (the two same-shape cases here share one dispatch)
+    r = run_cli("solve2d", ["--test_batch", "--ensemble"],
+                stdin="3\n40 40 20 3 0.2 0.001 0.02\n"
+                      "40 40 20 3 0.2 0.001 0.02\n"
+                      "50 50 20 5 1 0.0005 0.02\n")
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+    assert r.returncode == 0
+    assert "2 buckets" in r.stderr and "2 dispatches" in r.stderr
+    # a blow-up case still fails the batch under the engine
+    r = run_cli("solve2d", ["--test_batch", "--ensemble"],
+                stdin="1\n20 20 40 5 1 5.0 0.02\n")
+    assert "Tests Failed" in r.stdout
+    assert r.returncode == 1
+    # honesty: --ensemble outside --test_batch is refused
+    r = run_cli("solve2d", ["--ensemble", "--test"])
+    assert r.returncode == 1
+    assert "requires" in r.stderr
+
+
+def test_batch_malformed_stdin_refused_loudly():
+    # ISSUE 2 satellite: a truncated/malformed token stream used to die
+    # with a bare IndexError; it must refuse with the case index and the
+    # expected token count, before any solve runs
+    r = run_cli("solve2d", ["--test_batch"],
+                stdin="2\n50 50 45 5 1 0.0005\n")
+    assert r.returncode == 1
+    assert "batch case 0" in r.stderr and "7 tokens" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
+def test_parse_batch_cases_refusal_shapes():
+    # the in-process shapes of the same refusal (parse_batch_cases is
+    # what every batch CLI now routes through)
+    import pytest
+
+    from nonlocalheatequation_tpu.cli.common import parse_batch_cases
+
+    def read7(toks, pos):
+        v = toks[pos:pos + 7]
+        return tuple(float(x) for x in v), pos + 7
+
+    ok = parse_batch_cases(read7, "1 1 2 3 4 5 6 7".split(), row_tokens=7)
+    assert len(ok) == 1
+    with pytest.raises(SystemExit, match="empty"):
+        parse_batch_cases(read7, [], row_tokens=7)
+    with pytest.raises(SystemExit, match="not an integer"):
+        parse_batch_cases(read7, ["lots"], row_tokens=7)
+    with pytest.raises(SystemExit, match="case 1.*truncated"):
+        parse_batch_cases(
+            read7, "2 1 2 3 4 5 6 7 8 9".split(), row_tokens=7)
+    with pytest.raises(SystemExit, match="case 0.*malformed"):
+        parse_batch_cases(
+            read7, "1 1 2 xx 4 5 6 7".split(), row_tokens=7)
+
+
 def test_async_batch_degenerate_tiles():
     # np=20 with nx=1: tile smaller than horizon (reference case row 9)
     r = run_cli("solve2d_async", ["--test_batch"], stdin="1\n1 1 20 40 5 0.2 0.001 0.02\n")

@@ -1,0 +1,313 @@
+"""Batched ensemble engine (serve/ensemble.py + the batched ops layer).
+
+What these tests pin, on the CPU/f64 interpreter suite:
+
+* an 8-case same-shape bucket compiles ONE program (trace counter on
+  pallas_call for the grid-axis kernel; engine report counters for the
+  general case) and issues ONE dispatch per scan segment;
+* every case of a mixed-physics bucket is bit-identical to its solo
+  solve across the per-step, carried, and superstep compositions, and
+  under the bf16 precision tier;
+* mixed grids land in separate buckets and padding lanes are dropped;
+* the vmap parity oracle stays 1e-12-close; the manufactured-source
+  grid-axis path stays inside the documented last-ulp bound;
+* honesty refusals: production-only variants on test buckets, resync
+  ops, production cases without u0.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nonlocalheatequation_tpu.ops import pallas_kernel as pk
+from nonlocalheatequation_tpu.ops.nonlocal_op import (
+    NonlocalOp1D,
+    NonlocalOp2D,
+    NonlocalOp3D,
+    make_batched_multi_step_fn_stacked,
+    make_batched_multi_step_fn_vmap,
+    make_multi_step_fn_base,
+)
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase,
+    EnsembleEngine,
+)
+
+NX, NY, EPS, NSTEPS = 40, 36, 3, 5
+MIXED = [(1.0, 1e-4, 0.02), (0.5, 2e-4, 0.02), (0.2, 1e-4, 0.01),
+         (1.0, 5e-5, 0.03)]
+
+
+def _cases(n, params, rng, shape=(NX, NY), nt=NSTEPS, test=False):
+    out = []
+    for i in range(n):
+        k, dt, dh = params[i % len(params)]
+        out.append(EnsembleCase(shape=shape, nt=nt, eps=EPS, k=k, dt=dt,
+                                dh=dh, test=test,
+                                u0=rng.normal(size=shape)))
+    return out
+
+
+def _superstep2_maker(op, nsteps):
+    return pk.make_superstep_multi_step_fn(op, nsteps, ksteps=2)
+
+
+_SOLO_MAKERS: dict = {}
+
+
+def _solo(case, maker=make_multi_step_fn_base, **kw):
+    # one jitted solo program per (maker, physics, nt) reused across every
+    # case/u0 — per-case re-tracing of identical reference programs was
+    # the suite's dominant cost (the jit cache serves repeat calls)
+    key = (getattr(maker, "__name__", id(maker)), case.k, case.dt, case.dh,
+           case.eps, case.nt, tuple(sorted(kw.items())))
+    fn = _SOLO_MAKERS.get(key)
+    if fn is None:
+        op = NonlocalOp2D(case.eps, case.k, case.dt, case.dh,
+                          method="pallas", **kw)
+        fn = _SOLO_MAKERS[key] = maker(op, case.nt)
+    return np.asarray(fn(jnp.asarray(case.u0), 0))
+
+
+def test_uniform_8case_bucket_one_trace_one_dispatch(monkeypatch):
+    # physics-uniform bucket -> the grid-axis kernel: the pallas kernel
+    # is traced ONCE for the whole 8-case bucket (the compile/trace
+    # counter of the acceptance criteria), dispatched once, and each
+    # lane is bit-identical to its solo solve
+    rng = np.random.default_rng(0)
+    cases = _cases(8, MIXED[:1], rng)
+    solos = [_solo(c) for c in cases]
+    calls = []
+    real = pk.pl.pallas_call
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pk.pl, "pallas_call", spy)
+    pk._build_batched_step_kernel.cache_clear()
+    engine = EnsembleEngine(method="pallas")
+    res = engine.run(cases)
+    assert len(calls) == 1, f"expected ONE kernel trace, saw {len(calls)}"
+    assert engine.report.buckets == 1
+    assert engine.report.programs_built == 1
+    assert engine.report.dispatches == 1
+    assert engine.report.strategies.popitem()[1] == "per-step[grid]"
+    for got, want in zip(res, solos):
+        assert np.array_equal(got, want)
+
+
+def test_mixed_8case_bucket_bit_identical_per_step():
+    rng = np.random.default_rng(1)
+    cases = _cases(8, MIXED, rng)
+    engine = EnsembleEngine(method="pallas")
+    res = engine.run(cases)
+    assert engine.report.buckets == 1
+    assert engine.report.programs_built == 1
+    assert engine.report.dispatches == 1
+    assert engine.report.strategies.popitem()[1] == "per-step[stacked]"
+    for case, got in zip(cases, res):
+        assert np.array_equal(got, _solo(case))
+
+
+@pytest.mark.parametrize("params", [MIXED[:1], MIXED],
+                         ids=["uniform", "mixed"])
+def test_carried_and_superstep_bit_identical(params):
+    rng = np.random.default_rng(2)
+    cases = _cases(2, params, rng)
+    resc = EnsembleEngine(method="pallas", variant="carried").run(cases)
+    ress = EnsembleEngine(method="pallas", variant="superstep",
+                          ksteps=2).run(cases)
+    for case, gc, gs in zip(cases, resc, ress):
+        assert np.array_equal(
+            gc, _solo(case, pk.make_carried_multi_step_fn))
+        assert np.array_equal(gs, _solo(case, _superstep2_maker))
+
+
+@pytest.mark.parametrize("params", [MIXED[:1], MIXED],
+                         ids=["uniform", "mixed"])
+def test_bf16_tier_bit_identical(params):
+    rng = np.random.default_rng(3)
+    cases = _cases(2, params, rng)
+    engine = EnsembleEngine(method="pallas", precision="bf16")
+    res = engine.run(cases)
+    for case, got in zip(cases, res):
+        assert np.array_equal(got, _solo(case, precision="bf16"))
+    # the carried bf16 pair-frame path too
+    resc = EnsembleEngine(method="pallas", precision="bf16",
+                          variant="carried").run(cases)
+    for case, got in zip(cases, resc):
+        assert np.array_equal(
+            got, _solo(case, pk.make_carried_multi_step_fn,
+                       precision="bf16"))
+
+
+def test_bucket_boundary_mixed_grids_and_padding():
+    # mixed grids land in separate buckets; 3 cases pad to batch size 4
+    # and the padding lane is dropped from the output
+    rng = np.random.default_rng(4)
+    cases = _cases(3, MIXED[:1], rng, shape=(NX, NY))
+    cases += _cases(2, MIXED[:1], rng, shape=(48, 48))
+    engine = EnsembleEngine(method="pallas")
+    res = engine.run(cases)
+    assert engine.report.buckets == 2
+    assert engine.report.dispatches == 2
+    assert engine.report.padded_cases == 1  # 3 -> 4
+    assert len(res) == 5
+    assert res[0].shape == (NX, NY) and res[3].shape == (48, 48)
+    for case, got in zip(cases, res):
+        assert np.array_equal(got, _solo(case))
+
+
+def test_manufactured_source_bucket_matches_solo():
+    # the batch_tester shape: test=True cases (G init, manufactured
+    # source).  The uniform grid-axis source path is documented
+    # last-ulp-close; the mixed (stacked) path is bit-exact.
+    rng = np.random.default_rng(5)
+    for params, exact in ((MIXED[:1], False), (MIXED[:3], True)):
+        cases = _cases(3, params, rng, test=True)
+        for c in cases:
+            op = NonlocalOp2D(c.eps, c.k, c.dt, c.dh)
+            c.u0 = op.spatial_profile(*c.shape)
+        engine = EnsembleEngine(method="pallas")
+        res = engine.run(cases)
+        for case, got in zip(cases, res):
+            op = NonlocalOp2D(case.eps, case.k, case.dt, case.dh,
+                              method="pallas")
+            g, lg = op.source_parts(*case.shape)
+            solo = np.asarray(make_multi_step_fn_base(
+                op, case.nt, g, lg)(jnp.asarray(case.u0), 0))
+            if exact:
+                assert np.array_equal(got, solo)
+            else:
+                assert float(np.max(np.abs(got - solo))) < 1e-12
+
+
+def test_vmap_oracle_and_stacked_parity():
+    rng = np.random.default_rng(6)
+    cases = _cases(4, MIXED, rng)
+    ops = [NonlocalOp2D(c.eps, c.k, c.dt, c.dh, method="pallas")
+           for c in cases]
+    U = jnp.asarray(np.stack([c.u0 for c in cases]))
+    got_v = np.asarray(make_batched_multi_step_fn_vmap(ops, NSTEPS)(U, 0))
+    got_s = np.asarray(
+        make_batched_multi_step_fn_stacked(ops, NSTEPS)(U, 0))
+    for i, case in enumerate(cases):
+        solo = _solo(case)
+        assert float(np.max(np.abs(got_v[i] - solo))) < 1e-12
+        assert np.array_equal(got_s[i], solo)
+
+
+def test_1d_and_3d_buckets():
+    rng = np.random.default_rng(7)
+    c1 = [EnsembleCase(shape=(50,), nt=6, eps=5, k=k, dt=dt, dh=0.02,
+                       test=False, u0=rng.normal(size=50))
+          for k, dt in [(1.0, 1e-3), (0.5, 2e-3), (1.0, 1e-3)]]
+    res1 = EnsembleEngine().run(c1)
+    for case, got in zip(c1, res1):
+        op = NonlocalOp1D(case.eps, case.k, case.dt, case.dh)
+        solo = np.asarray(
+            make_multi_step_fn_base(op, case.nt)(jnp.asarray(case.u0), 0))
+        assert float(np.max(np.abs(got - solo))) < 1e-12
+    c3 = [EnsembleCase(shape=(12, 12, 12), nt=4, eps=2, k=k, dt=dt,
+                       dh=0.05, test=False, u0=rng.normal(size=(12,) * 3))
+          for k, dt in [(1.0, 1e-5), (0.5, 2e-5)]]
+    eng3 = EnsembleEngine(method="sat")
+    res3 = eng3.run(c3)
+    for case, got in zip(c3, res3):
+        op = NonlocalOp3D(case.eps, case.k, case.dt, case.dh, method="sat")
+        solo = np.asarray(
+            make_multi_step_fn_base(op, case.nt)(jnp.asarray(case.u0), 0))
+        assert float(np.max(np.abs(got - solo))) < 1e-12
+
+
+def test_tune_batch_dimension(monkeypatch):
+    from nonlocalheatequation_tpu.utils import autotune
+
+    monkeypatch.setattr(autotune, "_memory_cache", {})
+    monkeypatch.setenv("NLHEAT_AUTOTUNE_CACHE", "")
+    monkeypatch.setattr(autotune, "PROBE_STEPS", 2)
+    monkeypatch.setattr(autotune, "PROBE_ITERS", 1)
+    monkeypatch.setenv("NLHEAT_TUNE_BATCH", "1")
+    rng = np.random.default_rng(8)
+    cases = _cases(4, MIXED[:1], rng, shape=(40, 40))
+    engine = EnsembleEngine(method="pallas", variant="auto")
+    res = engine.run(cases)
+    label = engine.report.strategies.popitem()[1]
+    assert label.startswith("tuned:"), label
+    for case, got in zip(cases, res):
+        assert float(np.max(np.abs(got - _solo(case)))) < 1e-12
+
+
+def test_tune_batch_errored_probe_retry_and_all_errored_fallback(
+        monkeypatch, tmp_path):
+    # review findings r7: (a) an errored (None) probe persisted by
+    # another process must be retried once per process, not pin the
+    # variant out for the version key's lifetime; (b) if EVERY batched
+    # probe errors, the pick must fall back to the always-available
+    # stacked composition instead of rebuilding a known-failing variant
+    import json
+
+    from nonlocalheatequation_tpu.utils import autotune
+
+    monkeypatch.setattr(autotune, "_memory_cache", {})
+    cache_file = tmp_path / "autotune.json"
+    monkeypatch.setenv("NLHEAT_AUTOTUNE_CACHE", str(cache_file))
+    monkeypatch.setattr(autotune, "PROBE_STEPS", 2)
+    monkeypatch.setattr(autotune, "PROBE_ITERS", 1)
+    ops = [NonlocalOp2D(EPS, 1.0, 1e-4, 0.02, method="pallas")] * 2
+    _fn, w = autotune.pick_batched_multi_step_fn(ops, 4, (NX, NY),
+                                                 jnp.float64)
+    rec = json.load(open(cache_file))
+    key = next(iter(rec))
+    rec[key]["ms_per_step"]["batched-carried"] = None
+    rec[key]["winner"] = "batched-carried"
+    json.dump(rec, open(cache_file, "w"))
+    autotune._memory_cache.clear()
+    calls = []
+    real = autotune._measure_batched
+    monkeypatch.setattr(
+        autotune, "_measure_batched",
+        lambda *a: calls.append(1) or real(*a))
+    _fn2, w2 = autotune.pick_batched_multi_step_fn(ops, 4, (NX, NY),
+                                                   jnp.float64)
+    assert calls, "errored file-cache probe was not retried"
+    assert w2 in dict(autotune.batched_candidates(ops, (NX, NY), 4,
+                                                  jnp.float64))
+
+    autotune._memory_cache.clear()
+    cache_file.unlink()
+
+    def boom(*a):
+        raise RuntimeError("probe boom")
+
+    monkeypatch.setattr(autotune, "_measure_batched", boom)
+    fn3, w3 = autotune.pick_batched_multi_step_fn(ops, 4, (NX, NY),
+                                                  jnp.float64)
+    assert "stacked" in w3
+    rng = np.random.default_rng(0)
+    out = fn3(jnp.asarray(rng.normal(size=(2, NX, NY))), 0)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_honesty_refusals():
+    rng = np.random.default_rng(9)
+    test_cases = _cases(1, MIXED[:1], rng, test=True)
+    with pytest.raises(ValueError, match="production-only"):
+        EnsembleEngine(method="pallas", variant="carried").run(test_cases)
+    with pytest.raises(ValueError, match="needs ksteps"):
+        EnsembleEngine(method="pallas", variant="superstep")
+    with pytest.raises(ValueError, match="needs an initial state"):
+        EnsembleEngine(method="pallas").run(
+            [EnsembleCase(shape=(NX, NY), nt=2, eps=EPS, k=1.0, dt=1e-4,
+                          dh=0.02, test=False)])
+    # a resync-tier op cannot slip through the batched paths
+    ops = [NonlocalOp2D(EPS, 1.0, 1e-4, 0.02, precision="bf16",
+                        resync_every=3)]
+    with pytest.raises(ValueError, match="resync"):
+        make_batched_multi_step_fn_vmap(ops, 2)
+    # carried/superstep need the 2D pallas method
+    with pytest.raises(ValueError, match="pallas"):
+        EnsembleEngine(method="conv", variant="carried").run(
+            _cases(1, MIXED[:1], rng))

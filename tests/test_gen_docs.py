@@ -1,0 +1,57 @@
+"""tools/gen_docs.py --check must actually FAIL on a stale page.
+
+The tier-1 flow trusts --check to guard the generated docs/api tree, but
+a checker is only as good as its last proven failure (ISSUE 2 satellite):
+these tests build the pages into a scratch tree (GEN_DOCS_OUT) and
+assert rc=1 for a corrupted page, a deleted page, and an orphan page —
+and rc=0 again after a regen.  Runs in-process (the module is importable
+and OUT is env-overridable) so the suite pays no extra interpreter
+startups.
+"""
+
+import importlib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _gen_docs(tmp_path, monkeypatch, *argv):
+    monkeypatch.setenv("GEN_DOCS_OUT", str(tmp_path))
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import gen_docs
+
+        gen_docs = importlib.reload(gen_docs)  # re-read GEN_DOCS_OUT
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setattr(sys, "argv", ["gen_docs.py", *argv])
+    return gen_docs.main()
+
+
+def test_check_fails_on_stale_deleted_and_orphan_pages(
+        tmp_path, monkeypatch, capsys):
+    out = tmp_path / "api"
+    assert _gen_docs(out, monkeypatch) == 0  # fresh build
+    assert _gen_docs(out, monkeypatch, "--check") == 0  # clean tree passes
+    pages = sorted(p for p in out.iterdir() if p.suffix == ".md")
+    assert len(pages) > 10  # the whole package rendered
+
+    # stale: corrupt one page
+    victim = next(p for p in pages if "ensemble" in p.name)
+    victim.write_text("# stale\n")
+    assert _gen_docs(out, monkeypatch, "--check") == 1
+    assert victim.name in capsys.readouterr().out
+
+    # regen heals it
+    assert _gen_docs(out, monkeypatch) == 0
+    assert _gen_docs(out, monkeypatch, "--check") == 0
+
+    # deleted page
+    victim.unlink()
+    assert _gen_docs(out, monkeypatch, "--check") == 1
+
+    _gen_docs(out, monkeypatch)
+    # orphan page (a module that no longer exists)
+    (out / "nonlocalheatequation_tpu_gone.md").write_text("# orphan\n")
+    assert _gen_docs(out, monkeypatch, "--check") == 1

@@ -69,6 +69,9 @@ def test_check_phase_hang_waits_past_budget_then_hard_cap_kills():
     assert "HANG 2d 50^2 eps=5 (compile/run > 18s hard cap)" in proc.stdout
 
 
+@pytest.mark.slow  # ~32 s: the full interpreted sweep end to end.  Marked
+# slow (PR 2) to hold the 870 s tier-1 budget; the kill/abort policy
+# tests above stay in tier-1.  Run `pytest -m slow` for this one.
 def test_healthy_interpreted_sweep_is_labeled():
     # no faults: first check passes and the off-TPU disclaimer is printed
     # (run just past the first check, then the backend note must be there)

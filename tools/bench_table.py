@@ -11,7 +11,8 @@ Usage:
 Env:
     BT_STEPS (default 20), BT_GRID2D (4096 on tpu / 512 off),
     BT_GRID3D (256 / 48), BT_DIST_GRID (2048 / 256), BT_UNSTRUCT_M (512 / 64),
-    BT_SCALE_BLOCK (2048 / 256, per-device block edge of the scaling sweep)
+    BT_SCALE_BLOCK (2048 / 256, per-device block edge of the scaling sweep),
+    BT_ENS_GRID (1024 / 64) + BT_ENS_CASES (8, the ensemble A/B bucket)
 """
 
 from __future__ import annotations
@@ -618,6 +619,60 @@ def bench_unstructured3d(steps: int):
              edges=len(op.tgt), kmax=op.kmax, **extra)
 
 
+def bench_ensemble(steps: int):
+    """Dispatch-amortization A/B (ISSUE 2): B same-shape production
+    solves run case by case — B dispatch+fence roundtrips per timed
+    segment, the run_batch shape, ~64 ms each over the tunnel — vs ONE
+    B-case batched program (the ensemble ops layer; serve/ensemble.py
+    schedules this shape).  The batched row records the measured ratio
+    as ``dispatch_amortization``; off-TPU both halves are compiled CPU
+    programs, so the smoke ratio only exercises the machinery."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp2D,
+        make_batched_multi_step_fn_vmap,
+        make_multi_step_fn_base,
+    )
+    from nonlocalheatequation_tpu.ops.pallas_kernel import (
+        make_batched_pallas_multi_step_fn,
+    )
+
+    B = int(os.environ.get("BT_ENS_CASES", 8))
+    n = cfg("BT_ENS_GRID", 1024, 64)
+    method = "pallas" if on_tpu() else "sat"
+    op = NonlocalOp2D(8, k=1.0, dt=1.0, dh=1.0 / n, method=method)
+    op = NonlocalOp2D(8, k=1.0, dt=stable_dt(op), dh=1.0 / n, method=method)
+    rng = np.random.default_rng(0)
+    U0 = jnp.asarray(rng.normal(size=(B, n, n)), jnp.float32)
+
+    # sequential half: one solo program dispatched (and fenced) per case,
+    # exactly the sequential run_batch loop's dispatch pattern
+    solo = make_multi_step_fn_base(op, steps, dtype=jnp.float32)
+    t0 = time.perf_counter()
+    for b in range(B):
+        fence(solo(U0[b], 0))
+    log(f"    sequential compile+first: {time.perf_counter() - t0:.2f}s")
+    seq_sec = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for b in range(B):
+            fence(solo(U0[b], 0))
+        seq_sec = min(seq_sec, time.perf_counter() - t0)
+    emit(f"ensemble/sequential{B}", B * n * n, steps, seq_sec, grid=n,
+         eps=8, cases=B)
+
+    # batched half: one program, one dispatch+fence for the whole bucket
+    ops = [op] * B
+    if method == "pallas":
+        batched = make_batched_pallas_multi_step_fn(ops, steps,
+                                                    dtype=jnp.float32)
+    else:
+        batched = make_batched_multi_step_fn_vmap(ops, steps,
+                                                  dtype=jnp.float32)
+    sec, _ = time_steps(lambda U, m=batched: m(U, 0), U0, steps)
+    emit(f"ensemble/batched{B}", B * n * n, steps, sec, grid=n, eps=8,
+         cases=B, dispatch_amortization=seq_sec / sec)
+
+
 BENCHES = {
     "methods2d": bench_methods2d,
     "small2d": bench_small2d,
@@ -630,6 +685,7 @@ BENCHES = {
     "elastic-general": bench_elastic_general,
     "eps-sweep": bench_eps_sweep,
     "autotune": bench_autotune,
+    "ensemble": bench_ensemble,
 }
 
 

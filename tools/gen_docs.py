@@ -9,6 +9,11 @@ per module under docs/api/ plus an index.  Dependency-free (stdlib only).
 Usage:
     python tools/gen_docs.py            # (re)write docs/api/
     python tools/gen_docs.py --check    # exit 1 if docs/api/ is stale (CI)
+
+``GEN_DOCS_OUT`` relocates the output tree — the hook that lets
+tests/test_gen_docs.py PROVE the --check mode actually fails on a stale
+or orphaned page (a checker that silently passes is worse than none;
+the self-test corrupts a page in a scratch tree and asserts rc=1).
 """
 
 from __future__ import annotations
@@ -29,7 +34,7 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")  # never touch the TPU from a doc build
 
 PACKAGE = "nonlocalheatequation_tpu"
-OUT = os.path.join(REPO, "docs", "api")
+OUT = os.environ.get("GEN_DOCS_OUT") or os.path.join(REPO, "docs", "api")
 
 
 def iter_modules():

@@ -98,7 +98,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 #   beyond  : tm sweep, stretch8192 (compile headroom), remaining
 #             tables, profile
 STEPS="bench4096 resident512 carried4096 superstep2 \
-bf16-4096 bf16-carried4096 \
+bf16-4096 bf16-carried4096 ensemble8x1024 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -159,6 +159,18 @@ run_step_cmd() {  # the queue's one name->command map
     superstep2)
       bench_nofb BENCH_SUPERSTEP=2 BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
+    ensemble8x1024)
+      # dispatch-amortization A/B (ISSUE 2): 8 sequential 1024^2 solves
+      # pay 8 dispatch+fence tolls (~64 ms each over the tunnel) per
+      # timed segment; ONE 8-case ensemble bucket pays one.  Both halves
+      # land their JSON rows in the table; the ensemble half must carry
+      # "cases": 8 (step_variant_ok) so a silently-degraded run cannot
+      # bank the step.  Grid pinned by the step name (OPP_GRID_ENS for
+      # the CI smoke harness).
+      bench_nofb BENCH_GRID="${OPP_GRID_ENS:-1024}" \
+        BENCH_LADDER="${OPP_GRID_ENS:-1024}" BENCH_ACCURACY=0 \
+        && bench_nofb BENCH_ENSEMBLE=8 BENCH_GRID="${OPP_GRID_ENS:-1024}" \
+          BENCH_LADDER="${OPP_GRID_ENS:-1024}" BENCH_ACCURACY=0 ;;
     superstep2-tm128)
       bench_nofb BENCH_SUPERSTEP=2 NLHEAT_TM=128 BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
@@ -244,6 +256,8 @@ PYEOF
       grep -q '"precision": "bf16"' "$2" \
         && grep -q '"variant": "carried"' "$2" ;;
     superstep2) grep -q '"variant": "superstep2"' "$2" ;;
+    ensemble8x1024)
+      grep -q '"variant": "ensemble8"' "$2" && grep -q '"cases": 8' "$2" ;;
     superstep2-tm128)
       grep -q '"variant": "superstep2"' "$2" && grep -q '"tm": 128' "$2" ;;
     superstep3-tm96)

@@ -57,7 +57,16 @@ rung advances B same-shape production cases as ONE batched program —
 the ensemble engine's ops layer, serve/ensemble.py scheduling — and the
 JSON line gains "cases" plus the aggregate "cases*points*steps/s"
 field; "value" is then that aggregate, which is still honest
-points*steps/s across the whole batch), BENCH_ALLOW_CPU_FALLBACK (default 1:
+points*steps/s across the whole batch), BENCH_SERVE=D (D >= 2: the
+serving-pipeline A/B — BENCH_SERVE_CASES single-case production chunks
+(default 8) scheduled through serve/server.py twice, fenced (depth 1:
+every dispatch+fence roundtrip paid in line, the run_batch shape) vs
+pipelined (depth D: up to D chunks in flight, fence only on retire);
+the JSON line carries "variant": "serveD", per-request "latency_ms"
+percentiles from the pipelined half, and "fence_amortization" =
+fenced/pipelined wall ratio — over the tunnel the fenced half pays
+C x ~64 ms of fence tolls the pipeline overlaps away),
+BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
 budget above this re-probes the TPU once — the wedge cycle often heals
@@ -263,6 +272,10 @@ class Best:
             **({"cases": rung["cases"]} if "cases" in rung else {}),
             **({"cases*points*steps/s": rung["cases*points*steps/s"]}
                if "cases*points*steps/s" in rung else {}),
+            # serve rungs: the pipelined-vs-fenced evidence fields
+            **{k: rung[k] for k in
+               ("fence_amortization", "latency_ms", "occupancy")
+               if k in rung},
             **baseline_basis(base),
             **meta,
         }
@@ -766,6 +779,15 @@ def child_measure():
     ens = int(os.environ.get("BENCH_ENSEMBLE", 0) or 0)
     if ens == 1:
         ens = 0  # 0/1 mean off, like the sibling variant knobs
+    srv = int(os.environ.get("BENCH_SERVE", 0) or 0)
+    if srv == 1:
+        srv = 0  # the A/B needs a pipelined depth; 0/1 mean off
+    if srv and (ens or any(os.environ.get(k) for k in
+                           ("BENCH_CARRIED", "BENCH_RESIDENT",
+                            "BENCH_SUPERSTEP"))):
+        log("BENCH_SERVE set: ignoring BENCH_ENSEMBLE/CARRIED/RESIDENT/"
+            "SUPERSTEP — the serve rung is its own labeled variant")
+        ens = 0
     if ens and any(os.environ.get(k) for k in
                    ("BENCH_CARRIED", "BENCH_RESIDENT", "BENCH_SUPERSTEP")):
         log("BENCH_ENSEMBLE set: ignoring BENCH_CARRIED/RESIDENT/"
@@ -782,6 +804,65 @@ def child_measure():
             dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
             op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / grid, method=method,
                               precision=PRECISION)
+            if srv:
+                # pipelined-vs-fenced serving A/B: C single-case chunks
+                # (batch_sizes=(1,) pins one dispatch per case, the
+                # overlap-able unit) scheduled twice through the SAME
+                # engine (shared program cache — the A/B times schedules,
+                # not compiles).  The fenced half is the run_batch shape:
+                # every chunk pays its dispatch+fence roundtrip in line;
+                # the pipelined half keeps D in flight and fences only on
+                # retire.  Served results are bit-identical either way
+                # (serve/server.py), so only wall clock differs.
+                from nonlocalheatequation_tpu.serve.ensemble import (
+                    EnsembleCase,
+                    EnsembleEngine,
+                )
+                from nonlocalheatequation_tpu.serve.server import (
+                    serve_fence_ab,
+                )
+
+                if os.environ.get("NLHEAT_DONATE") != "0":
+                    # the pipeline pins donation off past depth 1; pin it
+                    # for the depth-1 half too so the A/B halves differ
+                    # ONLY in schedule (bench_table pins it globally for
+                    # the same reason)
+                    os.environ["NLHEAT_DONATE"] = "0"
+                    log("serve rung: NLHEAT_DONATE=0 pinned for a "
+                        "schedule-only A/B")
+                C = int(os.environ.get("BENCH_SERVE_CASES", 8))
+                cases = [EnsembleCase(shape=(grid, grid), nt=steps, eps=EPS,
+                                      k=1.0, dt=dt, dh=1.0 / grid,
+                                      test=False,
+                                      u0=rng.normal(size=(grid, grid)))
+                         for _ in range(C)]
+                engine = EnsembleEngine(method=method, precision=PRECISION,
+                                        batch_sizes=(1,))
+                compile_s, fenced_best, pipe_best, pipe_rep = \
+                    serve_fence_ab(engine, cases, srv)
+                log(f"rung {grid}^2 serve compile+first: {compile_s:.2f}s "
+                    f"(stable dt {dt:.3e}); fenced {fenced_best * 1e3:.1f} "
+                    f"ms vs depth-{srv} {pipe_best * 1e3:.1f} ms")
+                lat = pipe_rep.metrics()["request_latency_ms"]
+                value = C * grid * grid * steps / pipe_best
+                event(
+                    event="rung",
+                    grid=grid,
+                    steps=steps,
+                    best_s=pipe_best,
+                    ms_per_step=pipe_best / steps * 1e3,
+                    value=value,
+                    compile_s=round(compile_s, 3),
+                    variant=f"serve{srv}",
+                    cases=C,
+                    fence_amortization=round(fenced_best / pipe_best, 4),
+                    latency_ms={k: round(lat[k], 3)
+                                for k in ("p50", "p90", "p99")},
+                    occupancy=pipe_rep.occupancy(),
+                )
+                last_op = op
+                any_rung = True
+                continue
             variant = None
             if ens:
                 # B same-shape production cases advanced by ONE batched

@@ -200,6 +200,147 @@ def add_ensemble_flag(p: argparse.ArgumentParser):
     )
 
 
+def iter_batch_cases(read_case, row_tokens, stream=None):
+    """Incremental batch_tester intake: yield cases AS LINES ARRIVE.
+
+    The streaming twin of :func:`parse_batch_cases` — the serving
+    pipeline's intake path (``--serve``), where a case must enter the
+    scheduler the moment its row is readable, not at EOF.  The loud
+    refusals are parse_batch_cases' VERBATIM: empty input, a non-integer
+    or negative header, a truncated stream (case index + expected token
+    count), and a malformed row all SystemExit with the same messages —
+    they just fire at the failing row instead of up front.  Requires
+    ``row_tokens`` (every batch CLI knows its column count); trailing
+    tokens beyond the declared cases are ignored, as before.
+    """
+    if row_tokens is None or row_tokens < 1:
+        raise ValueError("iter_batch_cases needs the row's token count")
+    stream = sys.stdin if stream is None else stream
+    buf: list[str] = []
+    eof = False
+
+    def fill(need: int):
+        nonlocal eof
+        while len(buf) < need and not eof:
+            line = stream.readline()
+            if not line:
+                eof = True
+            else:
+                buf.extend(line.split())
+
+    fill(1)
+    if not buf:
+        raise SystemExit(
+            "batch input is empty: expected 'num_tests' followed by one "
+            "parameter row per test")
+    head = buf.pop(0)
+    try:
+        num_tests = int(head)
+    except ValueError:
+        raise SystemExit(
+            f"batch input header {head!r} is not an integer test "
+            "count") from None
+    if num_tests < 0:
+        raise SystemExit(f"batch input declares {num_tests} tests")
+    for i in range(num_tests):
+        fill(row_tokens)
+        if len(buf) < row_tokens:
+            raise SystemExit(
+                f"batch case {i}: truncated input — expected "
+                f"{row_tokens} tokens per case, found only "
+                f"{len(buf)} of the declared {num_tests} cases' "
+                "tokens remaining")
+        try:
+            case, _pos = read_case(buf[:row_tokens], 0)
+        except (IndexError, ValueError) as e:
+            raise SystemExit(
+                f"batch case {i}: malformed parameter row "
+                f"(expected {row_tokens} numeric tokens): {e}") from None
+        del buf[:row_tokens]
+        yield case
+
+
+def add_serve_flags(p: argparse.ArgumentParser):
+    """--serve D: batch-test cases streamed through the async serving
+    pipeline (serve/server.py) with D chunks in flight."""
+    p.add_argument(
+        "--serve",
+        type=int,
+        default=0,
+        metavar="D",
+        help="with --test_batch: stream cases from stdin into the "
+             "continuous-batching serving pipeline (serve/server.py) "
+             "with D chunks of dispatches in flight (D >= 1; 0 = off).  "
+             "Cases are scheduled the moment their row arrives; results "
+             "are bit-identical to --ensemble, only the schedule "
+             "overlaps.  D=1 is the fenced A/B schedule.",
+    )
+    p.add_argument(
+        "--serve-window-ms",
+        dest="serve_window_ms",
+        type=float,
+        default=50.0,
+        metavar="T",
+        help="--serve microbatch window: a chunk closes at the engine's "
+             "batch size or after T ms, whichever first (default 50)",
+    )
+
+
+def serve_batch(case_iter, make_solver, engine_kwargs, depth, window_ms):
+    """The --serve driver shared by the batch CLIs: stream parsed rows
+    into a :class:`~nonlocalheatequation_tpu.serve.server.ServePipeline`,
+    drain, then feed each returned state back through its Solver's
+    metrics — the same state-feedback contract as --ensemble (the oracle
+    criterion ``error_l2/#points <= threshold`` is computed by exactly
+    the solo path's code).  Prints the pipeline summary and the one-line
+    JSON metrics dump to stderr.  Returns ``[(error_l2, n)]`` in
+    submission order."""
+    import numpy as np
+
+    from nonlocalheatequation_tpu.serve.server import ServePipeline
+
+    with ServePipeline(depth=depth, window_ms=window_ms,
+                       **engine_kwargs) as pipe:
+        pairs = []
+        for row in case_iter:
+            s = make_solver(*row)
+            s.test_init()
+            pairs.append((s, pipe.submit(s.ensemble_case())))
+        pipe.drain()
+        print(f"serve: {pipe.report.summary()}", file=sys.stderr)
+        print(pipe.metrics_json(), file=sys.stderr)
+        out = []
+        for s, h in pairs:
+            s.u = h.result
+            out.append((s.compute_l2(s.nt), int(np.prod(h.case.shape))))
+        return out
+
+
+def validate_serve_args(args, extra_refusals=()) -> str | None:
+    """The batch CLIs' shared --serve honesty checks; returns an error
+    string (caller prints + exits 1) or None.  ``extra_refusals`` is a
+    list of (condition, message) pairs for CLI-specific conflicts."""
+    if not args.serve:
+        return None
+    if args.serve < 1:
+        return f"--serve needs D >= 1 chunks in flight (got {args.serve})"
+    if args.serve_window_ms < 0:
+        return (f"--serve-window-ms must be >= 0 (got "
+                f"{args.serve_window_ms:g})")
+    if not args.test_batch:
+        return "--serve streams batch-test cases; it requires --test_batch"
+    if args.ensemble:
+        return ("--serve already schedules through the ensemble engine "
+                "(overlapped); drop --ensemble")
+    if args.resync:
+        return ("--resync is not supported with --serve (the batched "
+                "paths have no per-step precision switch)")
+    for cond, msg in extra_refusals:
+        if cond:
+            return msg
+    return None
+
+
 def parse_batch_cases(read_case, tokens, row_tokens=None):
     """Parse the batch_tester token stream up front, refusing loudly.
 
@@ -243,7 +384,7 @@ def parse_batch_cases(read_case, tokens, row_tokens=None):
 
 
 def run_batch(read_case, run_case, threshold=1e-6, multi=False,
-              row_tokens=None, run_ensemble=None):
+              row_tokens=None, run_ensemble=None, run_serve=None):
     """The reference's batch_tester protocol (1d_nonlocal_serial.cpp:239-266):
     stdin = num_tests then one parameter row per test; prints "Tests Passed"
     or "Tests Failed" (the ctest pass/fail regex).
@@ -254,21 +395,44 @@ def run_batch(read_case, run_case, threshold=1e-6, multi=False,
     instead of a bare IndexError.  With ``run_ensemble`` (a callable
     ``cases -> [(error_l2, n)]``) the parsed cases go to the batched
     ensemble engine as one submission — same pass criterion, same output
-    — instead of the sequential per-case loop.  Under a multi-process
+    — instead of the sequential per-case loop.  With ``run_serve`` (a
+    callable ``case_iter -> [(error_l2, n)]``) the cases STREAM: rows are
+    parsed as stdin lines arrive (:func:`iter_batch_cases`) and handed to
+    the serving pipeline incrementally — the only mode that does not
+    validate the whole stream before work starts, because starting work
+    before EOF is its point (a malformed later row still refuses loudly,
+    after the earlier cases were scheduled).  Under a multi-process
     launch (``multi=True``) the stdin rules apply: tty refusal, and the
-    token stream must be identical on every rank.
+    token stream must be identical on every rank — which requires the
+    whole stream up front, so streaming modes refuse multi-process runs.
     """
     guard_multihost_stdin(multi)
-    tokens = sys.stdin.read().split()
-    if multi:
-        import numpy as np
+    if run_serve is not None:
+        if multi:
+            raise SystemExit(
+                "--serve streams stdin incrementally and cannot verify "
+                "rank-identical input; run serving single-process")
+        results = run_serve(iter_batch_cases(read_case, row_tokens))
+        failed = any(error_l2 / n > threshold for error_l2, n in results)
+        print("Tests Failed" if failed else "Tests Passed")
+        return 1 if failed else 0
+    if multi or row_tokens is None:
+        tokens = sys.stdin.read().split()
+        if multi:
+            import numpy as np
 
-        from nonlocalheatequation_tpu.parallel import multihost
+            from nonlocalheatequation_tpu.parallel import multihost
 
-        multihost.assert_same_on_all_hosts(
-            np.frombuffer(" ".join(tokens).encode(), dtype=np.uint8),
-            "batch input")
-    cases = parse_batch_cases(read_case, tokens, row_tokens)
+            multihost.assert_same_on_all_hosts(
+                np.frombuffer(" ".join(tokens).encode(), dtype=np.uint8),
+                "batch input")
+        cases = parse_batch_cases(read_case, tokens, row_tokens)
+    else:
+        # single-process full-batch modes share the streaming parser
+        # (one tokenizer, one set of refusal messages); collecting the
+        # whole iterator first preserves the validate-every-row-before-
+        # any-solve-runs contract of parse_batch_cases
+        cases = list(iter_batch_cases(read_case, row_tokens))
     if run_ensemble is not None:
         failed = any(error_l2 / n > threshold
                      for error_l2, n in run_ensemble(cases))

@@ -13,9 +13,12 @@ from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
     add_platform_flags,
     add_precision_flags,
+    add_serve_flags,
     apply_platform,
     bool_flag,
     run_batch,
+    serve_batch,
+    validate_serve_args,
     version_banner,
 )
 
@@ -42,6 +45,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_platform_flags(p)
     add_precision_flags(p)
     add_ensemble_flag(p)
+    add_serve_flags(p)
     return p
 
 
@@ -62,6 +66,10 @@ def main(argv=None) -> int:
     if args.ensemble and args.resync:
         print("--resync is not supported with --ensemble (the batched "
               "paths have no per-step precision switch)", file=sys.stderr)
+        return 1
+    err = validate_serve_args(args)
+    if err:
+        print(err, file=sys.stderr)
         return 1
     version_banner("1d_nonlocal")
     apply_platform(args)
@@ -102,8 +110,17 @@ def main(argv=None) -> int:
                     out.append((s.compute_l2(s.nt), s.nx))
                 return out
 
+        run_serve = None
+        if args.serve:
+            def run_serve(case_iter):
+                return serve_batch(
+                    case_iter,
+                    lambda *row: make_solver(args, *row),
+                    {"precision": args.precision},
+                    args.serve, args.serve_window_ms)
+
         return run_batch(read_case, run_case, row_tokens=6,
-                         run_ensemble=run_ensemble)
+                         run_ensemble=run_ensemble, run_serve=run_serve)
 
     s = make_solver(args, args.nx, args.nt, args.eps, args.k, args.dt, args.dx)
     if args.log:

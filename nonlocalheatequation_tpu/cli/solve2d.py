@@ -14,9 +14,12 @@ from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
     add_platform_flags,
     add_precision_flags,
+    add_serve_flags,
     apply_platform,
     bool_flag,
     run_batch,
+    serve_batch,
+    validate_serve_args,
     version_banner,
 )
 
@@ -51,6 +54,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_platform_flags(p)
     add_precision_flags(p)
     add_ensemble_flag(p)
+    add_serve_flags(p)
     return p
 
 
@@ -75,6 +79,12 @@ def main(argv=None) -> int:
         print("--resync is not supported with --ensemble; run the "
               "sequential batch, or --precision bf16 without --resync",
               file=sys.stderr)
+        return 1
+    err = validate_serve_args(args, [
+        (args.serve and (args.checkpoint or args.resume),
+         "--checkpoint/--resume cannot be combined with --serve")])
+    if err:
+        print(err, file=sys.stderr)
         return 1
     version_banner("2d_nonlocal")
     apply_platform(args)
@@ -126,8 +136,17 @@ def main(argv=None) -> int:
                     out.append((s.compute_l2(s.nt), s.nx * s.ny))
                 return out
 
+        run_serve = None
+        if args.serve:
+            def run_serve(case_iter):
+                return serve_batch(
+                    case_iter,
+                    make_solver,
+                    {"method": args.method, "precision": args.precision},
+                    args.serve, args.serve_window_ms)
+
         return run_batch(read_case, run_case, row_tokens=7,
-                         run_ensemble=run_ensemble)
+                         run_ensemble=run_ensemble, run_serve=run_serve)
 
     s = make_solver(args.nx, args.ny, args.nt, args.eps, args.k, args.dt, args.dh)
     if args.log:

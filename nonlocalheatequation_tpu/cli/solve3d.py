@@ -17,11 +17,14 @@ from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
     add_platform_flags,
     add_precision_flags,
+    add_serve_flags,
     bool_flag,
     check_same_input_state,
     cli_startup,
     guard_multihost_stdin,
     run_batch,
+    serve_batch,
+    validate_serve_args,
 )
 
 
@@ -57,6 +60,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_platform_flags(p)
     add_precision_flags(p)
     add_ensemble_flag(p)
+    add_serve_flags(p)
     return p
 
 
@@ -95,6 +99,13 @@ def main(argv=None) -> int:
     if args.ensemble and (args.distributed or args.resync):
         print("--ensemble runs the serial batched engine; it cannot be "
               "combined with --distributed or --resync", file=sys.stderr)
+        return 1
+    err = validate_serve_args(args, [
+        (args.serve and args.distributed,
+         "--serve runs the serial batched engine; it cannot be combined "
+         "with --distributed")])
+    if err:
+        print(err, file=sys.stderr)
         return 1
     # the srun analog (cli_startup holds the load-bearing ordering); the
     # launch-mode check runs via the hook so a misconfigured launch dies
@@ -165,8 +176,17 @@ def main(argv=None) -> int:
                     out.append((s.compute_l2(s.nt), s.nx * s.ny * s.nz))
                 return out
 
+        run_serve = None
+        if args.serve:
+            def run_serve(case_iter):
+                return serve_batch(
+                    case_iter,
+                    make_solver,
+                    {"method": args.method, "precision": args.precision},
+                    args.serve, args.serve_window_ms)
+
         return run_batch(read_case, run_case, multi=multi, row_tokens=8,
-                         run_ensemble=run_ensemble)
+                         run_ensemble=run_ensemble, run_serve=run_serve)
 
     s = make_solver(args.nx, args.ny, args.nz, args.nt, args.eps, args.k,
                     args.dt, args.dh)

@@ -1,6 +1,15 @@
 """Serving-side machinery: batch many independent solves into few
-compiled programs (serve/ensemble.py).  The reference's batch_tester
-(src/1d_nonlocal_serial.cpp:239-266) treats N cases as one job but runs
-them strictly sequentially; on the tunneled TPU each solve pays a ~64 ms
-dispatch+fence toll, so the serving-scale answer is to schedule cases
-into shape buckets and advance each bucket as ONE program."""
+compiled programs, and overlap their dispatches.
+
+``serve/ensemble.py`` is the offline scheduler: cases bucket by shape
+and each bucket advances as ONE batched program — the reference's
+batch_tester (src/1d_nonlocal_serial.cpp:239-266) treats N cases as one
+job but runs them strictly sequentially, paying the tunneled TPU's
+~64 ms dispatch+fence toll N times.
+
+``serve/server.py`` is the request path: a continuous-batching pipeline
+(microbatch windows, per-case deadlines) that keeps up to D chunks in
+flight and fences only when a result is due — the reference's HPX
+futures-and-dataflow overlap (README.md:12-14) applied to serving, with
+served results bit-identical to the offline engine.
+"""

@@ -165,12 +165,28 @@ class EnsembleEngine:
 
     # -- scheduling ---------------------------------------------------------
     def _chunks(self, idxs):
-        """Split a bucket's case indices into (real_indices, padded_B)."""
+        """Split a bucket's case indices into top-batch-size runs; the
+        padded size of each run is pad_chunk's decision alone."""
         top = self.batch_sizes[-1]
         for start in range(0, len(idxs), top):
-            part = idxs[start : start + top]
-            B = next(b for b in self.batch_sizes if b >= len(part))
-            yield part, B
+            yield idxs[start : start + top]
+
+    def pad_chunk(self, chunk: list) -> list:
+        """Pad a closed chunk UP to the smallest allowed batch size that
+        fits, duplicating the last real case (the scheduler padding rule —
+        callers drop the padding lanes from the output)."""
+        B = next((b for b in self.batch_sizes if b >= len(chunk)),
+                 self.batch_sizes[-1])
+        if len(chunk) > self.batch_sizes[-1]:
+            raise ValueError(
+                f"chunk of {len(chunk)} cases exceeds the top batch size "
+                f"{self.batch_sizes[-1]}; split it first (engine._chunks / "
+                "the serving window do)")
+        pad = B - len(chunk)
+        if pad:
+            self.report.padded_cases += pad
+            return chunk + [chunk[-1]] * pad
+        return chunk
 
     def run(self, cases) -> list:
         """Solve every case; returns final states (np arrays, f64-exact
@@ -183,19 +199,25 @@ class EnsembleEngine:
             buckets.setdefault(case.bucket_key(), []).append(i)
         self.report.buckets += len(buckets)
         for key, idxs in buckets.items():
-            for part, B in self._chunks(idxs):
-                chunk = [cases[i] for i in part]
-                pad = B - len(chunk)
-                if pad:
-                    chunk = chunk + [chunk[-1]] * pad
-                    self.report.padded_cases += pad
+            for part in self._chunks(idxs):
+                chunk = self.pad_chunk([cases[i] for i in part])
                 out = self._run_chunk(key, chunk)
                 for j, i in enumerate(part):
                     results[i] = np.asarray(out[j])
         return results
 
     # -- one chunk = one program, one dispatch ------------------------------
-    def _run_chunk(self, key, chunk):
+    # The chunk lifecycle is split into named stages so the offline run()
+    # above and the async serving pipeline (serve/server.py) share the
+    # SAME program construction and dispatch code — serving changes only
+    # the schedule (when chunks close, how many dispatches are in flight,
+    # when the fence happens), never the programs, which is what makes
+    # served results bit-identical to run() on the same case set.
+    def build_program(self, key, chunk):
+        """Stage 1 (host): the chunk's compiled multi-step callable,
+        cached per (bucket, size, variant, physics, dtype) — a cache hit
+        costs nothing, so a pipeline can build chunk N+2's program while
+        chunk N computes on the device."""
         test = key[3]
         dtype = self._dtype()
         prog_key = (key, len(chunk), self.variant,
@@ -208,9 +230,30 @@ class EnsembleEngine:
             multi = self._build_program(key, chunk, ops, test, dtype)
             self._programs[prog_key] = multi
             self.report.programs_built += 1
-        U0 = jnp.asarray(np.stack([self._u0(c) for c in chunk]), dtype)
+        return multi
+
+    def stage_inputs(self, chunk):
+        """Stage 2 (host->device): the stacked initial state, a FRESH
+        device buffer per chunk (each dispatch owns its input; nothing
+        aliases an in-flight chunk's buffers)."""
+        return jnp.asarray(np.stack([self._u0(c) for c in chunk]),
+                           self._dtype())
+
+    def dispatch_chunk(self, multi, U0):
+        """Stage 3 (async): launch the chunk's program.  JAX dispatch is
+        asynchronous — this returns a device future immediately; no fence
+        happens here."""
         out = multi(U0, 0)
         self.report.dispatches += 1
+        return out
+
+    def _run_chunk(self, key, chunk):
+        multi = self.build_program(key, chunk)
+        out = self.dispatch_chunk(multi, self.stage_inputs(chunk))
+        # stage 4, fused for the offline path: np.asarray is a full-value
+        # fetch (a true fence even over the tunnel — the one host round
+        # trip this schedule needs); the pipeline instead fences with a
+        # scalar first so device and fetch time are observable separately
         return np.asarray(out)
 
     def _u0(self, case: EnsembleCase) -> np.ndarray:

@@ -1,0 +1,494 @@
+"""Async serving runtime: continuous batching + overlapped chunk dispatch.
+
+The reference earns its scaling from HPX's asynchronous many-task model —
+futures and dataflow overlapping communication, computation, and task
+launch (README.md:12-14; the interior/boundary overlap at
+src/2d_nonlocal_distributed.cpp:1156-1261).  The offline
+:class:`~nonlocalheatequation_tpu.serve.ensemble.EnsembleEngine` is the
+opposite schedule: ``run()`` builds, dispatches, and fences one chunk at
+a time, so every chunk pays the full ~64 ms tunnel dispatch+fence round
+trip (docs/bench/README.md) and the host idles while the device computes.
+This module applies the reference's execution model to the request path:
+
+* **Request lifecycle** — cases are :meth:`ServePipeline.submit`-ted
+  incrementally (streaming stdin, a socket loop, a test harness), NOT as
+  one pre-read batch.  Each request joins its bucket's OPEN chunk (the
+  ensemble engine's ``(shape, nt, eps, test) x engine`` keys); the chunk
+  closes at size B (``window_size``, default the engine's top batch
+  size) or after T ms (``window_ms``) — whichever first — so late
+  arrivals join in-flight-adjacent chunks instead of waiting for EOF.
+* **Overlapped dispatch** — up to D (``depth``) chunks stay in flight.
+  Dispatch is JAX-async: launching chunk N+1 (and building chunk N+2's
+  program — a host-side trace) proceeds while chunk N computes.  The
+  host fences ONLY when a result is actually due (the pipe is full and
+  more work waits, a caller waits on a request, or ``drain()``), via the
+  scalar :func:`fence_scalar` fetch — ``block_until_ready`` lies over
+  the axon tunnel (docs/bench/README.md) — and NEVER between dispatches.
+* **Deadline-aware scheduling** — ``submit(deadline_ms=...)`` bounds a
+  case's microbatch wait: the earliest deadline in an open chunk pulls
+  the close forward (an aging case forces a partial chunk out,
+  starvation-free — the window T is an upper bound for every case);
+  ``priority`` orders READY chunks at equal dispatch capacity.
+  ``drain()`` flushes all partial chunks and in-flight work.
+* **Observability** — :class:`ServeReport` extends the engine's report
+  with per-request and per-chunk timing (queue wait, program build,
+  dispatch->fence wall, fetch), an occupancy trace (chunks in flight
+  over time), forced-close counts, and a one-call JSON dump
+  (:meth:`ServePipeline.metrics_json`) — the overlap is measured, not
+  assumed.
+
+Served results are **bit-identical** to ``EnsembleEngine.run()`` on the
+same case set: the pipeline reuses the engine's chunk stages
+(``build_program`` / ``stage_inputs`` / ``dispatch_chunk``) and padding
+rule verbatim — only the schedule changes (tests/test_serve.py pins
+this, plus the no-fence-between-dispatches discipline via spy counters).
+
+Buffer donation (utils/donation.py) is pipeline-UNSAFE past depth 1: the
+pipeline declares its depth via ``donation.set_pipeline_depth``, which
+pins the lazy donate decision off and refuses an explicit
+``NLHEAT_DONATE=1`` loudly at construction.
+
+Threading note: the pipeline is single-threaded by design — the overlap
+lives in the DEVICE queue (async dispatch), not in host threads, so it
+is wedge-safe under the tunnel discipline (no client is ever killed
+mid-compile; the only blocking calls are the fences it would need
+anyway).  Corollary: window/deadline bounds are enforced at scheduler
+EVENTS (``submit``/``pump``/``wait``/``drain``) — the T-ms bound holds
+whenever events keep arriving (the streaming CLIs submit per stdin row
+and drain at EOF); an intake that can stall for long stretches between
+submissions should call ``pump()`` on its own cadence, because no
+background thread fires the window for it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+#: Bound on every observability window (per-chunk log, latency/queue-wait
+#: samples, occupancy trace): a long-lived serving process must not grow
+#: host memory with its request count, so percentiles, stage totals, and
+#: the metrics dump cover the most recent LOG_CAP entries (the counters —
+#: cases/dispatches/... — remain lifetime-exact).
+LOG_CAP = 4096
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase,
+    EnsembleEngine,
+    EnsembleReport,
+)
+from nonlocalheatequation_tpu.utils import donation
+
+
+def fence_scalar(x) -> float:
+    """The device fence: a scalar device->host fetch.  On the axon tunnel
+    ``block_until_ready()`` returns before execution finishes; fetching a
+    reduced scalar is the only reliable completion barrier
+    (docs/bench/README.md).  Module-level on purpose — the no-fence-
+    between-dispatches tests spy on exactly this symbol.  Non-finite sums
+    are legal here (a diverged solve is a legitimate served result; the
+    caller's accuracy contract judges it)."""
+    return float(jnp.sum(x))
+
+
+@dataclass
+class ServeRequest:
+    """One submitted case: the caller's handle (a future).  ``result`` is
+    populated when the request's chunk retires; ``wait()`` forces it."""
+
+    case: EnsembleCase
+    seq: int
+    submit_t: float
+    priority: int = 0
+    deadline_t: float | None = None
+    result: np.ndarray | None = None
+    queue_wait_s: float | None = None  # submit -> dispatch
+    latency_s: float | None = None  # submit -> result
+    _chunk: "_Chunk | None" = None
+    _pipe: "ServePipeline | None" = None
+
+    def wait(self) -> np.ndarray:
+        return self._pipe.wait(self)
+
+
+class _OpenChunk:
+    """A bucket's accumulating chunk (not yet closed)."""
+
+    def __init__(self, key, opened_t):
+        self.key = key
+        self.opened_t = opened_t
+        self.requests: list[ServeRequest] = []
+        self.deadline_t: float | None = None
+        self.priority = 0
+
+    def due(self, now, window_s):
+        if self.deadline_t is not None and now >= self.deadline_t:
+            return "deadline"
+        if now >= self.opened_t + window_s:
+            return "window"
+        return None
+
+
+class _Chunk:
+    """A closed chunk moving through ready -> inflight -> done."""
+
+    def __init__(self, chunk_id, key, requests, priority, closed_by):
+        self.chunk_id = chunk_id
+        self.key = key
+        self.requests = requests
+        self.priority = priority
+        self.closed_by = closed_by
+        self.state = "ready"
+        self.out = None  # device future once dispatched
+        self.dispatch_t = None
+        self.build_s = 0.0
+
+
+@dataclass
+class ServeReport(EnsembleReport):
+    """EnsembleReport extended with the serving pipeline's observability:
+    per-chunk and per-request timing, occupancy, forced-close reasons.
+    The engine counters (cases/buckets/dispatches/programs_built/
+    padded_cases) keep their offline meaning — the pipeline routes the
+    engine's own stages, so the same counters measure the same events."""
+
+    depth: int = 1
+    window_ms: float = 0.0
+    window_size: int = 0
+    # bounded windows (LOG_CAP most recent entries; see the constant)
+    chunk_log: deque = field(default_factory=lambda: deque(maxlen=LOG_CAP))
+    request_latency_ms: deque = field(
+        default_factory=lambda: deque(maxlen=LOG_CAP))
+    queue_wait_ms: deque = field(
+        default_factory=lambda: deque(maxlen=LOG_CAP))
+    occupancy_samples: deque = field(  # (t, in_flight)
+        default_factory=lambda: deque(maxlen=LOG_CAP))
+    forced_closes: dict = field(default_factory=dict)
+    max_inflight: int = 0
+
+    @staticmethod
+    def _pct(xs) -> dict:
+        if not xs:
+            return {}
+        a = np.asarray(xs, np.float64)
+        return {
+            "p50": float(np.percentile(a, 50)),
+            "p90": float(np.percentile(a, 90)),
+            "p99": float(np.percentile(a, 99)),
+            "mean": float(a.mean()),
+            "max": float(a.max()),
+        }
+
+    def occupancy(self) -> dict:
+        """Max and time-weighted mean chunks in flight over the sampled
+        span (each sample is the in-flight count right after a dispatch
+        or retire event)."""
+        s = list(self.occupancy_samples)
+        if not s:
+            return {"max": 0, "time_weighted_mean": 0.0}
+        span = s[-1][0] - s[0][0]
+        if span <= 0:
+            return {"max": self.max_inflight,
+                    "time_weighted_mean": float(self.max_inflight)}
+        area = sum(n * (s[i + 1][0] - s[i][0])
+                   for i, (_t, n) in enumerate(s[:-1]))
+        return {"max": self.max_inflight,
+                "time_weighted_mean": float(area / span)}
+
+    def metrics(self) -> dict:
+        """The one-call dump: engine counters (lifetime-exact) + pipeline
+        knobs + latency percentiles + stage totals + occupancy + the
+        per-chunk log, the latter four over the most recent ``LOG_CAP``
+        entries (``log_window`` in the dump)."""
+        return {
+            "log_window": LOG_CAP,
+            "cases": self.cases,
+            "buckets": self.buckets,
+            # lifetime-exact (every chunk was closed exactly once; the
+            # windowed chunk_log may hold fewer)
+            "chunks": sum(self.forced_closes.values()),
+            "dispatches": self.dispatches,
+            "programs_built": self.programs_built,
+            "padded_cases": self.padded_cases,
+            "depth": self.depth,
+            "window_ms": self.window_ms,
+            "window_size": self.window_size,
+            "forced_closes": dict(self.forced_closes),
+            "request_latency_ms": self._pct(self.request_latency_ms),
+            "queue_wait_ms": self._pct(self.queue_wait_ms),
+            "build_ms_total": round(
+                sum(c["build_ms"] for c in self.chunk_log), 3),
+            "device_ms_total": round(
+                sum(c["device_ms"] for c in self.chunk_log), 3),
+            "fetch_ms_total": round(
+                sum(c["fetch_ms"] for c in self.chunk_log), 3),
+            "occupancy": self.occupancy(),
+            "chunk_log": list(self.chunk_log),
+        }
+
+    def metrics_json(self) -> str:
+        return json.dumps(self.metrics())
+
+
+class ServePipeline:
+    """Continuous-batching scheduler with up to ``depth`` chunks in
+    flight over one :class:`EnsembleEngine`.
+
+    Parameters: ``depth`` D (in-flight dispatch cap, >= 1; 1 is the
+    fenced A/B schedule), ``window_ms`` T (microbatch wait bound),
+    ``window_size`` B (size trigger; defaults to the engine's top batch
+    size so chunk partitioning matches the offline ``run()`` exactly),
+    ``clock`` (injectable for deterministic scheduler tests).  Remaining
+    kwargs construct the engine (method/precision/variant/...).
+    """
+
+    def __init__(self, engine: EnsembleEngine | None = None, *,
+                 depth: int = 2, window_ms: float = 5.0,
+                 window_size: int | None = None, clock=time.monotonic,
+                 **engine_kwargs):
+        if engine is None:
+            engine = EnsembleEngine(**engine_kwargs)
+        elif engine_kwargs:
+            raise ValueError(
+                f"pass engine kwargs {sorted(engine_kwargs)} OR a built "
+                "engine, not both")
+        depth = int(depth)
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        if window_ms < 0:
+            raise ValueError(f"window_ms must be >= 0, got {window_ms}")
+        ws = int(window_size if window_size is not None
+                 else engine.batch_sizes[-1])
+        if not 1 <= ws <= engine.batch_sizes[-1]:
+            raise ValueError(
+                f"window_size {ws} outside the engine batch sizes "
+                f"{engine.batch_sizes} (max {engine.batch_sizes[-1]})")
+        # refuses loudly on NLHEAT_DONATE=1 with depth > 1 — donation is
+        # not pipeline-safe (module docstring); restored by close()
+        self._prev_depth = donation.set_pipeline_depth(depth)
+        self.engine = engine
+        self.depth = depth
+        self.window_s = window_ms / 1e3
+        self.window_size = ws
+        self._clock = clock
+        self.report = engine.report = ServeReport(
+            depth=depth, window_ms=window_ms, window_size=ws)
+        self._open: dict = {}
+        self._ready: list[_Chunk] = []
+        self._inflight: deque[_Chunk] = deque()
+        self._seen_keys: set = set()
+        self._next_seq = 0
+        self._next_chunk = 0
+        self._closed = False
+
+    # -- intake -------------------------------------------------------------
+    def submit(self, case: EnsembleCase, *, deadline_ms: float | None = None,
+               priority: int = 0) -> ServeRequest:
+        """Queue one case; returns its handle.  ``deadline_ms`` (relative
+        to now) pulls the case's chunk close forward; ``priority`` orders
+        ready chunks competing for a dispatch slot."""
+        if self._closed:
+            raise RuntimeError("pipeline is closed")
+        now = self._clock()
+        req = ServeRequest(case=case, seq=self._next_seq, submit_t=now,
+                           priority=int(priority), _pipe=self)
+        self._next_seq += 1
+        self.report.cases += 1
+        key = case.bucket_key()
+        if key not in self._seen_keys:
+            self._seen_keys.add(key)
+            self.report.buckets += 1
+        oc = self._open.get(key)
+        if oc is None:
+            oc = self._open[key] = _OpenChunk(key, now)
+        oc.requests.append(req)
+        oc.priority = max(oc.priority, req.priority)
+        if deadline_ms is not None:
+            req.deadline_t = now + deadline_ms / 1e3
+            oc.deadline_t = (req.deadline_t if oc.deadline_t is None
+                             else min(oc.deadline_t, req.deadline_t))
+        if len(oc.requests) >= self.window_size:
+            self._close(key, "size")
+        self.pump()
+        return req
+
+    # -- scheduling ---------------------------------------------------------
+    def pump(self) -> None:
+        """Advance the pipeline: close chunks whose window or deadline is
+        due, then dispatch while capacity lasts.  When the pipe is full
+        AND more work waits, the oldest in-flight chunk's result is due —
+        that retire is the ONLY fence this schedule ever takes outside
+        wait()/drain()."""
+        now = self._clock()
+        for key in list(self._open):
+            why = self._open[key].due(now, self.window_s)
+            if why:
+                self._close(key, why)
+        while self._ready:
+            if len(self._inflight) < self.depth:
+                self._dispatch(self._pop_ready())
+            else:
+                self._retire(self._inflight[0])
+
+    def _close(self, key, why: str) -> _Chunk:
+        oc = self._open.pop(key)
+        chunk = _Chunk(self._next_chunk, key, oc.requests, oc.priority, why)
+        self._next_chunk += 1
+        for r in oc.requests:
+            r._chunk = chunk
+        self._ready.append(chunk)
+        fc = self.report.forced_closes
+        fc[why] = fc.get(why, 0) + 1
+        return chunk
+
+    def _pop_ready(self) -> _Chunk:
+        # highest priority first; FIFO (chunk_id) within a priority —
+        # starvation-free because every chunk's CLOSE is window-bounded
+        # and the dispatch loop drains _ready completely
+        best = min(self._ready, key=lambda c: (-c.priority, c.chunk_id))
+        self._ready.remove(best)
+        return best
+
+    def _dispatch(self, chunk: _Chunk) -> None:
+        t0 = self._clock()
+        padded = self.engine.pad_chunk([r.case for r in chunk.requests])
+        multi = self.engine.build_program(chunk.key, padded)
+        U0 = self.engine.stage_inputs(padded)
+        chunk.build_s = self._clock() - t0
+        chunk.dispatch_t = self._clock()
+        chunk.out = self.engine.dispatch_chunk(multi, U0)  # async, no fence
+        chunk.state = "inflight"
+        self._inflight.append(chunk)
+        for r in chunk.requests:
+            r.queue_wait_s = chunk.dispatch_t - r.submit_t
+            self.report.queue_wait_ms.append(r.queue_wait_s * 1e3)
+        n = len(self._inflight)
+        self.report.max_inflight = max(self.report.max_inflight, n)
+        self.report.occupancy_samples.append((chunk.dispatch_t, n))
+
+    def _retire(self, chunk: _Chunk) -> None:
+        """Fence + fetch one in-flight chunk and distribute its lanes."""
+        self._inflight.remove(chunk)
+        t0 = self._clock()
+        fence_scalar(chunk.out)  # device completion barrier
+        t1 = self._clock()
+        vals = np.asarray(chunk.out)  # host fetch; padding lanes dropped
+        t2 = self._clock()
+        for j, r in enumerate(chunk.requests):
+            r.result = np.asarray(vals[j])
+            r.latency_s = t2 - r.submit_t
+            self.report.request_latency_ms.append(r.latency_s * 1e3)
+        chunk.state = "done"
+        chunk.out = None
+        self.report.chunk_log.append({
+            "chunk": chunk.chunk_id,
+            "cases": len(chunk.requests),
+            "closed_by": chunk.closed_by,
+            "build_ms": round(chunk.build_s * 1e3, 3),
+            "device_ms": round((t1 - chunk.dispatch_t) * 1e3, 3),
+            "fetch_ms": round((t2 - t1) * 1e3, 3),
+        })
+        self.report.occupancy_samples.append((t2, len(self._inflight)))
+
+    # -- completion ---------------------------------------------------------
+    def wait(self, req: ServeRequest) -> np.ndarray:
+        """Force one request to completion (an implicit immediate
+        deadline): close its open chunk if still accumulating, dispatch
+        through the normal capacity discipline, fence its chunk."""
+        while req.result is None:
+            if req._chunk is None:
+                self._close(req.case.bucket_key(), "wait")
+            elif req._chunk.state == "ready":
+                if len(self._inflight) >= self.depth:
+                    self._retire(self._inflight[0])
+                else:
+                    self._dispatch(self._pop_ready())
+            else:  # inflight
+                self._retire(req._chunk)
+        return req.result
+
+    def drain(self) -> None:
+        """Flush everything: close all partial chunks, dispatch them
+        (retiring as capacity demands), then retire all in-flight work."""
+        for key in list(self._open):
+            self._close(key, "drain")
+        while self._ready:
+            if len(self._inflight) >= self.depth:
+                self._retire(self._inflight[0])
+            else:
+                self._dispatch(self._pop_ready())
+        while self._inflight:
+            self._retire(self._inflight[0])
+
+    def serve_cases(self, cases) -> list:
+        """Convenience: submit every case, drain, return results in
+        submission order — the schedule-changed twin of
+        ``EnsembleEngine.run()`` (bit-identical output)."""
+        handles = [self.submit(c) for c in cases]
+        self.drain()
+        return [h.result for h in handles]
+
+    def close(self) -> None:
+        """Drain and release the pipeline.  The process-wide donation
+        depth declared at construction is restored even if the final
+        drain raises (a failed serve run must not leave donation pinned
+        for the rest of the process)."""
+        if not self._closed:
+            try:
+                self.drain()
+            finally:
+                donation.set_pipeline_depth(self._prev_depth)
+                self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- observability ------------------------------------------------------
+    def metrics(self) -> dict:
+        return self.report.metrics()
+
+    def metrics_json(self) -> str:
+        return self.report.metrics_json()
+
+
+def serve_fence_ab(engine: EnsembleEngine, cases, depth: int,
+                   iters: int = 2):
+    """The pipelined-vs-fenced measurement shared by bench.py
+    (``BENCH_SERVE``) and tools/bench_table.py (``serve`` group): time the
+    fenced (depth 1 — a dispatch+fence roundtrip per chunk, run_batch's
+    schedule) and pipelined (``depth`` in flight, fence only on retire)
+    schedules of the SAME case set over ONE engine, so the shared program
+    cache makes this an A/B of schedules, not compiles.  The first
+    pipelined pass warms the cache and its wall is returned as the
+    compile time.  Callers pin donation off themselves (the halves must
+    differ only in schedule).  Returns ``(compile_s, fenced_best_s,
+    pipelined_best_s, best_pipelined_report)``."""
+
+    def run_schedule(d):
+        pipe = ServePipeline(engine=engine, depth=d, window_ms=0.0)
+        try:
+            t0 = time.perf_counter()
+            pipe.serve_cases(cases)
+            return time.perf_counter() - t0, pipe.report
+        finally:
+            pipe.close()
+
+    compile_s, _ = run_schedule(depth)
+    fenced_best = float("inf")
+    pipe_best, pipe_rep = float("inf"), None
+    for _ in range(iters):
+        sec_f, _ = run_schedule(1)
+        fenced_best = min(fenced_best, sec_f)
+        sec_p, rep = run_schedule(depth)
+        if sec_p < pipe_best:
+            pipe_best, pipe_rep = sec_p, rep
+    return compile_s, fenced_best, pipe_best, pipe_rep

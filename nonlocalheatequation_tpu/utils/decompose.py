@@ -3,8 +3,12 @@
 Pipeline parity with src/domain_decomposition.cpp:52-195, redesigned to be
 dependency-free: the GMSH C++ API becomes utils/gmsh.py, and METIS's
 ``METIS_PartMeshDual`` becomes the native RCB + dual-graph-refinement library
-(native/partition.cc, loaded via ctypes) with a pure-NumPy RCB fallback of
-identical semantics.
+(native/partition.cc, loaded via ctypes) with a pure-NumPy fallback of
+identical semantics — BOTH halves: :func:`rcb_numpy` mirrors the native RCB
+and :func:`refine_cut_numpy` mirrors the native ``refine_cut`` move/swap
+passes element for element, so an unbuilt ``native/`` tree degrades only in
+speed, never in cut quality (the shipped-mesh cut-quality contract in
+tests/test_decompose.py holds on either path).
 
 Steps (mirroring the reference):
   1. read the .msh, find the quad elements (type 3),
@@ -82,6 +86,64 @@ def rcb_numpy(xy: np.ndarray, nparts: int) -> np.ndarray:
     return parts
 
 
+def refine_cut_numpy(xadj: np.ndarray, adj: np.ndarray, nparts: int,
+                     parts: np.ndarray, npasses: int = 8) -> int:
+    """Greedy edge-cut refinement: the NumPy port of ``refine_cut``
+    (native/partition.cc), bit-for-bit the same iteration order, donor
+    guard, and tie-breaks — the two paths produce IDENTICAL partitions
+    (pinned by test), so the cut-quality contract no longer depends on
+    whether ``make -C native`` has run.  Mutates ``parts`` in place and
+    returns moves + swaps made."""
+    n = len(parts)
+    size = np.bincount(parts, minlength=nparts).astype(np.int64)
+    cap = n // nparts + 1
+    floor = n // nparts
+    moves = 0
+
+    def local_cut(i):
+        return int(np.sum(parts[adj[xadj[i]:xadj[i + 1]]] != parts[i]))
+
+    for _ in range(npasses):
+        pass_moves = 0
+        # MOVE phase: relocate a boundary element to the neighboring part
+        # with the most adjacent elements (strict gain, balance kept)
+        for i in range(n):
+            cur = parts[i]
+            if size[cur] - 1 < floor:  # donor guard: never empty a part
+                continue
+            gain = np.bincount(parts[adj[xadj[i]:xadj[i + 1]]],
+                               minlength=nparts)
+            best = cur
+            for q in range(nparts):
+                if q != cur and size[q] < cap and gain[q] > gain[best]:
+                    best = q
+            if best != cur and gain[best] > gain[cur]:
+                parts[i] = best
+                size[cur] -= 1
+                size[best] += 1
+                moves += 1
+                pass_moves += 1
+        # SWAP phase: exchange adjacent cross-part pairs when the combined
+        # cut strictly drops (lives at exact balance, where the move
+        # phase's donor guard blocks everything)
+        for i in range(n):
+            for e in range(xadj[i], xadj[i + 1]):
+                j = adj[e]
+                if j <= i or parts[i] == parts[j]:
+                    continue
+                before = local_cut(i) + local_cut(j)
+                parts[i], parts[j] = parts[j], parts[i]
+                after = local_cut(i) + local_cut(j)
+                if after < before:
+                    moves += 1
+                    pass_moves += 1
+                else:
+                    parts[i], parts[j] = parts[j], parts[i]
+        if not pass_moves:
+            break
+    return moves
+
+
 def dual_graph_csr(npx: int, npy: int) -> tuple[np.ndarray, np.ndarray]:
     """CSR adjacency of the coarse-grid dual graph with METIS ncommon=1
     semantics: tiles sharing at least one node are adjacent (8-neighbor)."""
@@ -121,6 +183,8 @@ def partition_coarse_grid(npx: int, npy: int, nparts: int) -> np.ndarray:
         _native_lib.refine_cut(npx * npy, xadj, adj, nparts, parts, 8)
     else:
         parts = rcb_numpy(xy, nparts)
+        xadj, adj = dual_graph_csr(npx, npy)
+        refine_cut_numpy(xadj, adj, nparts, parts)
     assignment[ids % npx, ids // npx] = parts
     return assignment
 

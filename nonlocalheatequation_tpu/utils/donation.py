@@ -21,6 +21,18 @@ anyway.
 ``NLHEAT_DONATE=1`` forces donation on any backend (the CPU equality
 tests use it with fresh per-call arrays), ``NLHEAT_DONATE=0`` pins it
 off (e.g. to A/B the HBM effect on hardware).
+
+Pipeline safety (serve/server.py): with D > 1 chunks in flight, donation
+would let XLA alias an input buffer into an output while an EARLIER
+dispatch may still be reading from the same program's buffers under
+retry/replay, and — more practically — it invalidates host-side
+references the scheduler may still hold for a queued re-dispatch.  The
+serving pipeline therefore declares its depth via
+:func:`set_pipeline_depth`; at depth > 1 the lazy donate decision is
+pinned OFF, and an EXPLICIT ``NLHEAT_DONATE=1`` is refused loudly rather
+than silently ignored (double-buffering donated frames across D
+in-flight chunks is future work; until then the combination is an
+error, not a degraded mode).
 """
 
 from __future__ import annotations
@@ -29,14 +41,50 @@ import os
 
 import jax
 
+#: In-flight dispatch depth declared by the serving pipeline; 1 (the
+#: sequential schedule) everywhere else.  Module state, set via
+#: set_pipeline_depth — the donated_jit wrappers read it lazily at call
+#: time, exactly like the backend query.
+_pipeline_depth = 1
+
+
+def set_pipeline_depth(depth: int) -> int:
+    """Declare how many dispatches may be in flight; returns the previous
+    value (callers restore it when the pipeline drains/closes).  Depth > 1
+    with an explicit ``NLHEAT_DONATE=1`` refuses immediately — the caller
+    finds out at pipeline construction, not mid-flight."""
+    global _pipeline_depth
+    if depth < 1:
+        raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+    if depth > 1 and os.environ.get("NLHEAT_DONATE") == "1":
+        raise ValueError(
+            "NLHEAT_DONATE=1 is unsafe with more than one chunk in flight "
+            f"(requested depth {depth}): a donated input may be aliased "
+            "while an earlier dispatch is still outstanding.  Unset "
+            "NLHEAT_DONATE (the pipeline pins donation off itself) or run "
+            "with depth 1.")
+    prev = _pipeline_depth
+    _pipeline_depth = depth
+    return prev
+
 
 def donation_on() -> bool:
     """Whether the state arg should be donated on THIS backend, now.
 
     Initializes the backend when the env knob is unset — only call on the
-    execution path (see module docstring).
+    execution path (see module docstring).  Under a declared pipeline
+    depth > 1 donation is pinned off (and an explicit NLHEAT_DONATE=1
+    raises — belt to set_pipeline_depth's suspenders, for callers that
+    flip the env var after the pipeline was built).
     """
     env = os.environ.get("NLHEAT_DONATE")
+    if _pipeline_depth > 1:
+        if env == "1":
+            raise RuntimeError(
+                "NLHEAT_DONATE=1 flipped on while a serving pipeline has "
+                f"{_pipeline_depth} chunks in flight; donation cannot "
+                "engage mid-pipeline")
+        return False
     if env == "1":
         return True
     if env == "0":

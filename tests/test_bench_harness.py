@@ -104,6 +104,25 @@ def test_bench_ensemble_mode_emits_cases_field():
     assert rec["accuracy"]["ok"] is True  # the solo gate still runs
 
 
+def test_bench_serve_mode_emits_amortization_and_latency():
+    # BENCH_SERVE=D: the serving-pipeline A/B — fenced (depth 1) vs
+    # pipelined (depth D) schedules of C single-case chunks in one rung.
+    # The JSON line must carry the serveD variant label, the case count,
+    # the fenced/pipelined fence_amortization ratio, per-request latency
+    # percentiles, and the measured occupancy, on the same one-line rc=0
+    # contract — here exercised on the CPU fallback ladder
+    proc, rec = run_bench({"BENCH_SERVE": "3", "BENCH_ACCURACY": "0"})
+    assert proc.returncode == 0
+    assert rec["value"] > 0
+    assert rec["variant"] == "serve3"
+    assert rec["cases"] == 8
+    assert rec["fence_amortization"] > 0
+    assert {"p50", "p90", "p99"} <= set(rec["latency_ms"])
+    # the pipelined half genuinely overlapped: depth was reached
+    assert rec["occupancy"]["max"] == 3
+    assert rec["partial"] is False
+
+
 def test_tight_deadline_emits_partial_not_zero():
     # Budget long enough for probe + first rung, short enough to cut the
     # ladder; grid 512 on CPU forces a multi-second second rung.

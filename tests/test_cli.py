@@ -60,6 +60,89 @@ def test_2d_batch_ensemble_mode():
     assert "requires" in r.stderr
 
 
+def test_2d_batch_serve_mode():
+    # --serve D streams the cases through the async serving pipeline
+    # (serve/server.py): same pass criterion and output as --ensemble,
+    # stderr carries the pipeline summary + one-line JSON metrics dump
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2"],
+                stdin="3\n40 40 20 3 0.2 0.001 0.02\n"
+                      "40 40 20 3 0.2 0.001 0.02\n"
+                      "50 50 20 5 1 0.0005 0.02\n")
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+    assert r.returncode == 0
+    assert "serve: 3 cases -> 2 buckets" in r.stderr
+    metrics = [ln for ln in r.stderr.splitlines()
+               if ln.startswith("{") and '"depth"' in ln]
+    assert metrics, r.stderr
+    import json
+
+    m = json.loads(metrics[0])
+    assert m["depth"] == 2 and m["cases"] == 3
+    assert "request_latency_ms" in m and "occupancy" in m
+    # a blow-up case still fails the batch under the pipeline
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2"],
+                stdin="1\n20 20 40 5 1 5.0 0.02\n")
+    assert "Tests Failed" in r.stdout
+    assert r.returncode == 1
+    # honesty refusals: --serve outside --test_batch; --serve + --ensemble
+    r = run_cli("solve2d", ["--serve", "2", "--test"])
+    assert r.returncode == 1 and "requires --test_batch" in r.stderr
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2", "--ensemble"])
+    assert r.returncode == 1 and "drop --ensemble" in r.stderr
+
+
+def test_serve_truncated_stream_still_refused_loudly():
+    # the streaming intake (iter_batch_cases) must keep PR 2's refusal
+    # verbatim: case index + expected token count, no stack trace
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2"],
+                stdin="2\n40 40 20 3 0.2 0.001 0.02\n40 40 20\n")
+    assert r.returncode == 1
+    assert "batch case 1" in r.stderr and "7 tokens" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
+def test_iter_batch_cases_refusal_shapes():
+    # in-process shapes of the streaming parser's refusals — verbatim
+    # parse_batch_cases messages, fired at the failing row
+    import io
+
+    import pytest
+
+    from nonlocalheatequation_tpu.cli.common import iter_batch_cases
+
+    def read7(toks, pos):
+        v = toks[pos:pos + 7]
+        return tuple(float(x) for x in v), pos + 7
+
+    ok = list(iter_batch_cases(read7, 7,
+                               io.StringIO("1\n1 2 3 4 5 6 7\n")))
+    assert len(ok) == 1
+    # tokens may span lines arbitrarily, like the EOF tokenizer
+    ok = list(iter_batch_cases(read7, 7,
+                               io.StringIO("2 1 2 3\n4 5 6 7 8\n"
+                                           "9 10 11 12 13 14\n")))
+    assert len(ok) == 2
+    with pytest.raises(SystemExit, match="empty"):
+        list(iter_batch_cases(read7, 7, io.StringIO("")))
+    with pytest.raises(SystemExit, match="not an integer"):
+        list(iter_batch_cases(read7, 7, io.StringIO("lots\n")))
+    with pytest.raises(SystemExit, match="declares -1"):
+        list(iter_batch_cases(read7, 7, io.StringIO("-1\n")))
+    with pytest.raises(SystemExit, match="case 1.*truncated"):
+        list(iter_batch_cases(read7, 7,
+                              io.StringIO("2 1 2 3 4 5 6 7 8 9\n")))
+    with pytest.raises(SystemExit, match="case 0.*malformed"):
+        list(iter_batch_cases(read7, 7,
+                              io.StringIO("1 1 2 xx 4 5 6 7\n")))
+    # streaming semantics: earlier good rows are yielded BEFORE a later
+    # bad row refuses (the serving pipeline has already scheduled them)
+    it = iter_batch_cases(read7, 7,
+                          io.StringIO("2 1 2 3 4 5 6 7 8 9\n"))
+    assert next(it) == (1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0)
+    with pytest.raises(SystemExit, match="truncated"):
+        next(it)
+
+
 def test_batch_malformed_stdin_refused_loudly():
     # ISSUE 2 satellite: a truncated/malformed token stream used to die
     # with a bare IndexError; it must refuse with the case index and the

@@ -170,11 +170,9 @@ def test_shipped_data_pipeline(tmp_path):
                                             (4, 2, 8), (5, 5, 2)])
 def test_partition_all_parts_present_and_balanced(npx, npy, nparts):
     """Regression: refine_cut must never empty a part (it used to merge
-    singleton parts away, e.g. 2x2 into 4 -> owners {1,3})."""
-    if dc._native_lib is None:
-        # the NumPy fallback never runs refine_cut; this test would pass
-        # vacuously
-        pytest.skip("native partition library not built (refine_cut untested)")
+    singleton parts away, e.g. 2x2 into 4 -> owners {1,3}).  No native
+    gate anymore: the NumPy fallback runs refine_cut_numpy, so both
+    paths exercise the donor guard."""
     a = dc.partition_coarse_grid(npx, npy, nparts)
     counts = np.bincount(a.ravel(), minlength=nparts)
     assert (counts > 0).all(), counts
@@ -249,7 +247,14 @@ def test_cut_at_most_block_layout(n, k):
 
 def test_cut_quality_on_shipped_meshes():
     # the reference's own fixtures end-to-end: infer the structured grid,
-    # partition a 5x5 coarse grid into 4, compare against the quadrant cut
+    # partition a 5x5 coarse grid into 4, compare against the quadrant cut.
+    # Root-caused r7: this failed (27 > 24+2) whenever native/ was not
+    # built — the NumPy fallback ran raw RCB with NO refinement pass.
+    # refine_cut_numpy (the exact port of the native move/swap passes)
+    # closes that: both paths now land cut 26, which a balanced-partition
+    # search shows is the {7,6,6,6} optimum under 8-neighbor adjacency
+    # (the 24-cut quadrant reference is UNbalanced, 9/6/6/4 — the +2
+    # margin is exactly the measured balance premium)
     data = os.path.join(os.path.dirname(__file__), "..", "data")
     for name in ("10x10.msh", "50x50.msh", "100x100.msh"):
         path = os.path.join(data, name)
@@ -266,19 +271,48 @@ def test_cut_quality_on_shipped_meshes():
         assert dc.edge_cut(parts) <= dc.edge_cut(np.asarray(quad, int)) + 2
 
 
-def test_refine_pass_improves_a_bad_start():
-    if dc._native_lib is None:
+@pytest.mark.parametrize("refine", ["native", "numpy"])
+def test_refine_pass_improves_a_bad_start(refine):
+    if refine == "native" and dc._native_lib is None:
         pytest.skip("native partition library not built")
     # interleaved stripes: balanced but maximally cut; refine must improve
     n, k = 8, 2
     parts = (np.arange(n * n) % k).astype(np.int32)
     xadj, adj = dc.dual_graph_csr(n, n)
     before = dc.edge_cut(parts.reshape(n, n))
-    dc._native_lib.refine_cut(n * n, xadj, adj, k, parts, 8)
+    if refine == "native":
+        dc._native_lib.refine_cut(n * n, xadj, adj, k, parts, 8)
+    else:
+        dc.refine_cut_numpy(xadj, adj, k, parts, 8)
     after = dc.edge_cut(parts.reshape(n, n))
     assert after < before
     counts = np.bincount(parts, minlength=k)
     assert counts.max() - counts.min() <= 1
+
+
+def test_refine_numpy_matches_native_exactly():
+    # refine_cut_numpy claims bit-for-bit the native iteration order and
+    # tie-breaks; prove it on RCB starts AND on adversarial (interleaved)
+    # starts where the swap phase does real work
+    if dc._native_lib is None:
+        pytest.skip("native partition library not built")
+    for npx, npy, k in [(5, 5, 4), (4, 4, 2), (8, 8, 4), (10, 10, 4),
+                        (20, 20, 8), (6, 4, 3), (7, 5, 4)]:
+        n = npx * npy
+        ids = np.arange(n)
+        xy = np.stack([(ids % npx) + 0.5, (ids // npx) + 0.5],
+                      1).astype(np.float64)
+        start = np.zeros(n, dtype=np.int32)
+        assert dc._native_lib.partition_rcb(
+            n, np.ascontiguousarray(xy), k, start) == 0
+        stripes = (np.arange(n) % k).astype(np.int32)
+        xadj, adj = dc.dual_graph_csr(npx, npy)
+        for s in (start, stripes):
+            p_nat, p_np = s.copy(), s.copy()
+            m_nat = dc._native_lib.refine_cut(n, xadj, adj, k, p_nat, 8)
+            m_np = dc.refine_cut_numpy(xadj, adj, k, p_np, 8)
+            assert m_nat == m_np
+            assert np.array_equal(p_nat, p_np), (npx, npy, k)
 
 
 # -- binary .msh (VERDICT r3 C8 gap: the reference's GMSH API linkage also

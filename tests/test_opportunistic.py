@@ -21,7 +21,7 @@ SCRIPT = os.path.join(REPO, "tools", "tpu_opportunistic.sh")
 
 ALL_STEPS = [
     "bench4096", "resident512", "carried4096", "superstep2",
-    "bf16-4096", "bf16-carried4096", "ensemble8x1024",
+    "bf16-4096", "bf16-carried4096", "ensemble8x1024", "serve8x1024",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",

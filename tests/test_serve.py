@@ -1,0 +1,272 @@
+"""Async serving pipeline (serve/server.py).
+
+What these tests pin, on the CPU/f64 suite:
+
+* microbatch window closes by SIZE (the engine's top batch size) and by
+  TIME (window_ms, via an injected clock — no wall-clock racing);
+* a per-case deadline forces its bucket's chunk closed early (partial,
+  padded) — the starvation bound;
+* ``drain()`` flushes open chunks, ready chunks, and in-flight work;
+* the in-flight cap D is respected (occupancy never exceeds D and
+  genuinely reaches it — the overlap is real, not nominal);
+* donation refuses loudly at D > 1 under NLHEAT_DONATE=1
+  (utils/donation.py pipeline guard), both at pipeline construction and
+  at the lazy donate decision;
+* the fence discipline: >= 2 chunks in flight with ZERO host fences
+  between their dispatches (spy counters on the module-level
+  fence_scalar and the engine dispatch stage), one fence per retire;
+* served results are BIT-IDENTICAL to the offline
+  ``EnsembleEngine.run()`` on the same case set — same bucketing, same
+  chunk programs, only the schedule changes.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from nonlocalheatequation_tpu.serve import server as server_mod
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase,
+    EnsembleEngine,
+)
+from nonlocalheatequation_tpu.serve.server import ServePipeline
+from nonlocalheatequation_tpu.utils import donation
+
+NX, NY, EPS, NSTEPS = 16, 16, 2, 2
+MIXED = [(1.0, 1e-4, 0.02), (0.5, 2e-4, 0.02), (0.2, 1e-4, 0.01)]
+
+
+def _cases(n, rng, shape=(NX, NY), nt=NSTEPS):
+    out = []
+    for i in range(n):
+        k, dt, dh = MIXED[i % len(MIXED)]
+        out.append(EnsembleCase(shape=shape, nt=nt, eps=EPS, k=k, dt=dt,
+                                dh=dh, test=False,
+                                u0=rng.normal(size=shape)))
+    return out
+
+
+class FakeClock:
+    """Injected scheduler clock: window/deadline tests advance time
+    explicitly instead of racing host load."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _spies(pipe, monkeypatch):
+    """Event log of (kind,) for every dispatch and every fence."""
+    events = []
+    real_fence = server_mod.fence_scalar
+    monkeypatch.setattr(
+        server_mod, "fence_scalar",
+        lambda x: (events.append("fence"), real_fence(x))[1])
+    real_dispatch = pipe.engine.dispatch_chunk
+    pipe.engine.dispatch_chunk = (
+        lambda multi, U0: (events.append("dispatch"),
+                           real_dispatch(multi, U0))[1])
+    return events
+
+
+def test_size_triggered_close_and_single_fence(monkeypatch):
+    rng = np.random.default_rng(0)
+    with ServePipeline(depth=1, window_ms=10_000.0) as pipe:
+        events = _spies(pipe, monkeypatch)
+        handles = [pipe.submit(c) for c in _cases(8, rng)]
+        # the 8th submit hit the size trigger: closed + dispatched, but
+        # NOT fenced — no result is due yet
+        assert pipe.report.dispatches == 1
+        assert pipe.report.forced_closes == {"size": 1}
+        assert events == ["dispatch"]
+        assert all(h.result is None for h in handles)
+        pipe.drain()
+        assert events == ["dispatch", "fence"]
+        assert all(h.result is not None for h in handles)
+
+
+def test_time_triggered_close_with_injected_clock():
+    rng = np.random.default_rng(1)
+    clock = FakeClock()
+    with ServePipeline(depth=1, window_ms=10.0, clock=clock) as pipe:
+        for c in _cases(3, rng):
+            pipe.submit(c)
+        assert pipe.report.dispatches == 0  # 3 < size trigger, window open
+        clock.advance(0.005)
+        pipe.pump()
+        assert pipe.report.dispatches == 0  # still inside the window
+        clock.advance(0.006)  # past 10 ms
+        pipe.pump()
+        assert pipe.report.dispatches == 1
+        assert pipe.report.forced_closes == {"window": 1}
+        assert pipe.report.padded_cases == 1  # 3 real lanes pad up to 4
+        pipe.drain()
+    assert pipe.report.cases == 3
+
+
+def test_deadline_forces_partial_chunk():
+    rng = np.random.default_rng(2)
+    clock = FakeClock()
+    with ServePipeline(depth=1, window_ms=10_000.0, clock=clock) as pipe:
+        a, b = _cases(2, rng)
+        pipe.submit(a)
+        pipe.submit(b, deadline_ms=5.0)  # far inside the huge window
+        assert pipe.report.dispatches == 0
+        clock.advance(0.006)
+        pipe.pump()
+        # the aging case forced the whole bucket's chunk out early
+        assert pipe.report.dispatches == 1
+        assert pipe.report.forced_closes == {"deadline": 1}
+        pipe.drain()
+        assert pipe.report.chunk_log[0]["cases"] == 2
+        assert pipe.report.chunk_log[0]["closed_by"] == "deadline"
+
+
+def test_drain_flushes_open_ready_and_inflight():
+    rng = np.random.default_rng(3)
+    cases = _cases(3, rng) + _cases(2, rng, shape=(20, 16))
+    with ServePipeline(depth=2, window_ms=10_000.0) as pipe:
+        handles = [pipe.submit(c) for c in cases]
+        assert pipe.report.dispatches == 0  # everything still accumulating
+        pipe.drain()
+        assert all(h.result is not None for h in handles)
+        assert pipe.report.buckets == 2
+        assert pipe.report.dispatches == 2
+        assert pipe.report.forced_closes == {"drain": 2}
+        assert len(pipe._inflight) == 0 and not pipe._ready
+
+
+def test_inflight_cap_respected_and_reached():
+    rng = np.random.default_rng(4)
+    # batch size 1: every case is its own chunk -> 6 dispatches compete
+    # for 2 in-flight slots
+    with ServePipeline(depth=2, window_ms=0.0, batch_sizes=(1,)) as pipe:
+        pipe.serve_cases(_cases(6, rng))
+        occ = [n for _t, n in pipe.report.occupancy_samples]
+        assert max(occ) == 2  # cap reached (real overlap)...
+        assert all(n <= 2 for n in occ)  # ...and never exceeded
+        assert pipe.report.dispatches == 6
+    m = pipe.metrics()
+    assert m["occupancy"]["max"] == 2
+
+
+def test_donation_refused_loudly_when_pipelined(monkeypatch):
+    monkeypatch.setenv("NLHEAT_DONATE", "1")
+    with pytest.raises(ValueError, match="NLHEAT_DONATE"):
+        ServePipeline(depth=2)
+    # depth 1 (the fenced schedule) still accepts forced donation
+    with ServePipeline(depth=1, window_ms=0.0) as pipe:
+        assert pipe.depth == 1
+    # belt at the lazy decision too: a depth declared after construction
+    # cannot be combined with a flipped-on env knob
+    prev = donation.set_pipeline_depth(1)
+    monkeypatch.delenv("NLHEAT_DONATE")
+    donation.set_pipeline_depth(3)
+    try:
+        assert donation.donation_on() is False  # pinned off, no backend query
+        monkeypatch.setenv("NLHEAT_DONATE", "1")
+        with pytest.raises(RuntimeError, match="in flight"):
+            donation.donation_on()
+    finally:
+        donation.set_pipeline_depth(prev)
+
+
+def test_no_fence_between_dispatches_and_bit_identity(monkeypatch):
+    # the acceptance spy: with D=3 and single-case chunks, the pipeline
+    # must put >= 2 chunks in flight with ZERO host fences between their
+    # dispatches, then retire with exactly one fence per chunk — and the
+    # served results must be bit-identical to the offline engine
+    rng = np.random.default_rng(5)
+    cases = _cases(5, rng)
+    offline = EnsembleEngine(batch_sizes=(1,)).run(cases)
+    with ServePipeline(depth=3, window_ms=0.0, batch_sizes=(1,)) as pipe:
+        events = _spies(pipe, monkeypatch)
+        served = pipe.serve_cases(cases)
+    # pipe fill: the first D dispatches are back to back, no fence between
+    assert events[:3] == ["dispatch"] * 3
+    assert events.count("dispatch") == 5
+    assert events.count("fence") == 5  # one per retire, none elsewhere
+    assert max(n for _t, n in pipe.report.occupancy_samples) >= 2
+    for got, want in zip(served, offline):
+        assert np.array_equal(got, want)
+
+
+def test_bit_identity_mixed_buckets_vs_offline():
+    # mixed physics AND mixed shapes, chunk padding engaged: the served
+    # set must reproduce run() bit for bit with the same padding count
+    rng = np.random.default_rng(6)
+    cases = _cases(6, rng) + _cases(3, rng, shape=(20, 16))
+    offline_engine = EnsembleEngine()
+    offline = offline_engine.run(cases)
+    with ServePipeline(depth=3, window_ms=10_000.0) as pipe:
+        served = pipe.serve_cases(cases)
+    for got, want in zip(served, offline):
+        assert np.array_equal(got, want)
+    assert pipe.report.padded_cases == offline_engine.report.padded_cases
+    assert pipe.report.buckets == offline_engine.report.buckets
+    assert pipe.report.dispatches == offline_engine.report.dispatches
+
+
+def test_wait_forces_one_request():
+    rng = np.random.default_rng(7)
+    with ServePipeline(depth=2, window_ms=10_000.0) as pipe:
+        h = pipe.submit(_cases(1, rng)[0])
+        assert h.result is None
+        out = h.wait()  # implicit immediate deadline for its chunk
+        assert out is not None and out.shape == (NX, NY)
+        assert pipe.report.forced_closes == {"wait": 1}
+        assert h.latency_s is not None and h.queue_wait_s is not None
+
+
+def test_priority_orders_ready_chunks():
+    rng = np.random.default_rng(8)
+    clock = FakeClock()
+    with ServePipeline(depth=1, window_ms=5.0, clock=clock) as pipe:
+        pipe.submit(_cases(1, rng)[0], priority=0)
+        for c in _cases(2, rng, shape=(20, 16)):
+            pipe.submit(c, priority=5)
+        clock.advance(0.01)
+        pipe.pump()  # both buckets close; the prio-5 chunk dispatches first
+        pipe.drain()
+        assert [c["cases"] for c in pipe.report.chunk_log] == [2, 1]
+
+
+def test_metrics_json_one_call_dump():
+    rng = np.random.default_rng(9)
+    with ServePipeline(depth=2, window_ms=0.0, batch_sizes=(1, 2)) as pipe:
+        pipe.serve_cases(_cases(4, rng))
+        line = pipe.metrics_json()
+    m = json.loads(line)
+    for key in ("cases", "chunks", "dispatches", "depth", "window_ms",
+                "request_latency_ms", "queue_wait_ms", "occupancy",
+                "forced_closes", "chunk_log", "build_ms_total",
+                "device_ms_total", "fetch_ms_total"):
+        assert key in m, key
+    assert m["cases"] == 4 and m["depth"] == 2
+    assert {"p50", "p90", "p99", "mean", "max"} <= set(
+        m["request_latency_ms"])
+    for c in m["chunk_log"]:
+        assert {"build_ms", "device_ms", "fetch_ms", "closed_by"} <= set(c)
+
+
+def test_pipeline_validation_refusals():
+    with pytest.raises(ValueError, match="depth"):
+        ServePipeline(depth=0)
+    with pytest.raises(ValueError, match="window_size"):
+        ServePipeline(window_size=16)  # above the top batch size
+    with pytest.raises(ValueError, match="window_ms"):
+        ServePipeline(window_ms=-1.0)
+    with pytest.raises(ValueError, match="not both"):
+        ServePipeline(EnsembleEngine(), method="sat")
+    pipe = ServePipeline(depth=1)
+    pipe.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        pipe.submit(EnsembleCase(shape=(NX, NY), nt=1, eps=EPS, k=1.0,
+                                 dt=1e-4, dh=0.02, test=False,
+                                 u0=np.zeros((NX, NY))))

@@ -12,7 +12,8 @@ Env:
     BT_STEPS (default 20), BT_GRID2D (4096 on tpu / 512 off),
     BT_GRID3D (256 / 48), BT_DIST_GRID (2048 / 256), BT_UNSTRUCT_M (512 / 64),
     BT_SCALE_BLOCK (2048 / 256, per-device block edge of the scaling sweep),
-    BT_ENS_GRID (1024 / 64) + BT_ENS_CASES (8, the ensemble A/B bucket)
+    BT_ENS_GRID (1024 / 64) + BT_ENS_CASES (8, the ensemble/serve A/B
+    bucket), BT_SERVE_DEPTH (4, the serve group's pipelined in-flight cap)
 """
 
 from __future__ import annotations
@@ -673,6 +674,50 @@ def bench_ensemble(steps: int):
          cases=B, dispatch_amortization=seq_sec / sec)
 
 
+def bench_serve(steps: int):
+    """Fence-amortization A/B (ISSUE 3): C single-case production chunks
+    scheduled through serve/server.py fenced (depth 1 — every chunk pays
+    its dispatch+fence roundtrip in line, run_batch's schedule) vs
+    pipelined (depth D — up to D chunks in flight, fence only on
+    retire).  Over the tunnel the fenced half pays C x ~64 ms of tolls
+    the pipeline overlaps away; off-TPU both halves are compiled CPU
+    programs and the ratio mostly exercises the machinery (host-side
+    staging still overlaps device compute, so pipelined >= fenced).  The
+    pipelined row records ``fence_amortization`` = fenced/pipelined wall
+    plus the per-request latency percentiles."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+    from nonlocalheatequation_tpu.serve.ensemble import (
+        EnsembleCase,
+        EnsembleEngine,
+    )
+    from nonlocalheatequation_tpu.serve.server import serve_fence_ab
+
+    D = int(os.environ.get("BT_SERVE_DEPTH", 4))
+    C = int(os.environ.get("BT_ENS_CASES", 8))
+    n = cfg("BT_ENS_GRID", 1024, 64)
+    method = "pallas" if on_tpu() else "sat"
+    op = NonlocalOp2D(8, k=1.0, dt=1.0, dh=1.0 / n, method=method)
+    dt = stable_dt(op)
+    rng = np.random.default_rng(0)
+    cases = [EnsembleCase(shape=(n, n), nt=steps, eps=8, k=1.0, dt=dt,
+                          dh=1.0 / n, test=False,
+                          u0=rng.normal(size=(n, n))) for _ in range(C)]
+    # one engine for both halves (shared program cache -> schedule-only
+    # A/B); donation is pinned off globally by main()
+    engine = EnsembleEngine(method=method, batch_sizes=(1,))
+    compile_s, fenced_best, pipe_best, pipe_rep = serve_fence_ab(
+        engine, cases, D, iters=3)
+    log(f"    serve compile+first: {compile_s:.2f}s")
+    emit(f"serve/fenced{C}", C * n * n, steps, fenced_best, grid=n, eps=8,
+         cases=C, depth=1)
+    lat = pipe_rep.metrics()["request_latency_ms"]
+    emit(f"serve/pipelined{C}", C * n * n, steps, pipe_best, grid=n, eps=8,
+         cases=C, depth=D,
+         fence_amortization=round(fenced_best / pipe_best, 4),
+         latency_ms={k: round(lat[k], 3) for k in ("p50", "p90", "p99")},
+         occupancy=pipe_rep.occupancy())
+
+
 BENCHES = {
     "methods2d": bench_methods2d,
     "small2d": bench_small2d,
@@ -686,6 +731,7 @@ BENCHES = {
     "eps-sweep": bench_eps_sweep,
     "autotune": bench_autotune,
     "ensemble": bench_ensemble,
+    "serve": bench_serve,
 }
 
 

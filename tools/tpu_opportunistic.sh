@@ -98,7 +98,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 #   beyond  : tm sweep, stretch8192 (compile headroom), remaining
 #             tables, profile
 STEPS="bench4096 resident512 carried4096 superstep2 \
-bf16-4096 bf16-carried4096 ensemble8x1024 \
+bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -171,6 +171,15 @@ run_step_cmd() {  # the queue's one name->command map
         BENCH_LADDER="${OPP_GRID_ENS:-1024}" BENCH_ACCURACY=0 \
         && bench_nofb BENCH_ENSEMBLE=8 BENCH_GRID="${OPP_GRID_ENS:-1024}" \
           BENCH_LADDER="${OPP_GRID_ENS:-1024}" BENCH_ACCURACY=0 ;;
+    serve8x1024)
+      # serving-pipeline A/B (ISSUE 3): 8 single-case chunks, fenced
+      # (depth 1, a dispatch+fence toll per chunk) vs pipelined (depth 4,
+      # fence only on retire) in ONE bench run — the ~64 ms/dispatch
+      # saving lands as the "fence_amortization" field of the same JSON
+      # row, judged by step_variant_ok so a silently-degraded run cannot
+      # bank the step.  Short-window class: one compile, two schedules.
+      bench_nofb BENCH_SERVE=4 BENCH_GRID="${OPP_GRID_ENS:-1024}" \
+        BENCH_LADDER="${OPP_GRID_ENS:-1024}" BENCH_ACCURACY=0 ;;
     superstep2-tm128)
       bench_nofb BENCH_SUPERSTEP=2 NLHEAT_TM=128 BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
@@ -258,6 +267,9 @@ PYEOF
     superstep2) grep -q '"variant": "superstep2"' "$2" ;;
     ensemble8x1024)
       grep -q '"variant": "ensemble8"' "$2" && grep -q '"cases": 8' "$2" ;;
+    serve8x1024)
+      grep -q '"variant": "serve4"' "$2" \
+        && grep -q '"fence_amortization"' "$2" ;;
     superstep2-tm128)
       grep -q '"variant": "superstep2"' "$2" && grep -q '"tm": 128' "$2" ;;
     superstep3-tm96)

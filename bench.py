@@ -66,6 +66,15 @@ the JSON line carries "variant": "serveD", per-request "latency_ms"
 percentiles from the pipelined half, and "fence_amortization" =
 fenced/pipelined wall ratio — over the tunnel the fenced half pays
 C x ~64 ms of fence tolls the pipeline overlaps away),
+BENCH_SERVE_FAULTS (with BENCH_SERVE=D: run the pipelined schedule
+ONCE under a deterministic injected fault plan — utils/faults.py
+grammar, e.g. "raise@1x2" — through the fully supervised pipeline
+with a first-failure breaker and the CPU-fallback route; the rung is
+labeled "variant": "servefaultD" and carries "served"/"poison"/
+"fallback_chunks"/"retries_total"/"breaker_transitions" so the
+servefault queue step can gate on all-non-poison-served +
+fallback_chunks >= 1; a leaked ambient NLHEAT_FAULT_PLAN is scrubbed
+— only this knob injects faults into a bench run),
 BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
@@ -272,9 +281,12 @@ class Best:
             **({"cases": rung["cases"]} if "cases" in rung else {}),
             **({"cases*points*steps/s": rung["cases*points*steps/s"]}
                if "cases*points*steps/s" in rung else {}),
-            # serve rungs: the pipelined-vs-fenced evidence fields
+            # serve rungs: the pipelined-vs-fenced evidence fields, plus
+            # the servefault chaos rung's resilience evidence
             **{k: rung[k] for k in
-               ("fence_amortization", "latency_ms", "occupancy")
+               ("fence_amortization", "latency_ms", "occupancy",
+                "served", "poison", "fallback_chunks", "retries_total",
+                "fault_plan", "breaker_transitions")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -506,7 +518,11 @@ def main():
     # scrub must PIN it off, not just delete it — a bench rung must run
     # exactly the variant its label claims
     os.environ["NLHEAT_AUTOTUNE"] = "0"
-    for knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP"):
+    # NLHEAT_FAULT_PLAN joins the scrub: a fault plan leaked from a chaos
+    # shell would inject failures into a headline measurement; the serve
+    # fault rung re-injects deliberately via BENCH_SERVE_FAULTS only
+    for knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP",
+                 "NLHEAT_FAULT_PLAN"):
         if os.environ.pop(knob, None) is not None:
             log(f"scrubbed leaked {knob} from the bench environment")
     try:
@@ -838,6 +854,48 @@ def child_measure():
                          for _ in range(C)]
                 engine = EnsembleEngine(method=method, precision=PRECISION,
                                         batch_sizes=(1,))
+                plan_spec = os.environ.get("BENCH_SERVE_FAULTS")
+                if plan_spec:
+                    # chaos rung: the SAME pipelined schedule, once, with
+                    # the deterministic plan injected and the supervised
+                    # machinery live (retries, first-failure breaker, CPU
+                    # fallback) — the evidence is that every non-poison
+                    # request is served and the fallback route engaged
+                    from nonlocalheatequation_tpu.serve.server import (
+                        serve_chaos,
+                    )
+
+                    wall, results, rep = serve_chaos(
+                        engine, cases, srv, plan_spec,
+                        fetch_deadline_ms=float(os.environ.get(
+                            "BENCH_SERVE_DEADLINE_MS", 2000)))
+                    res = rep.resilience()
+                    served = sum(1 for r in results if r is not None)
+                    log(f"rung {grid}^2 servefault: {served}/{C} served, "
+                        f"{len(res['quarantined'])} poison, "
+                        f"{res['fallback_chunks']} fallback chunks, "
+                        f"wall {wall * 1e3:.1f} ms (plan {plan_spec!r})")
+                    value = served * grid * grid * steps / wall
+                    event(
+                        event="rung",
+                        grid=grid,
+                        steps=steps,
+                        best_s=wall,
+                        ms_per_step=wall / steps * 1e3,
+                        value=value,
+                        variant=f"servefault{srv}",
+                        cases=C,
+                        served=served,
+                        poison=len(res["quarantined"]),
+                        fallback_chunks=res["fallback_chunks"],
+                        retries_total=res["retries"],
+                        fault_plan=plan_spec,
+                        breaker_transitions=res["breaker"][
+                            "transition_count"],
+                    )
+                    last_op = op
+                    any_rung = True
+                    continue
                 compile_s, fenced_best, pipe_best, pipe_rep = \
                     serve_fence_ab(engine, cases, srv)
                 log(f"rung {grid}^2 serve compile+first: {compile_s:.2f}s "

@@ -132,11 +132,24 @@ def apply_platform(args):
     jax.config.update("jax_enable_x64", bool(x64))
 
 
+def _bool_flag(s: str) -> bool:
+    """argparse ``type=`` for boost-program_options-style bools.  An
+    unrecognized token is a loud rc-2 refusal, never a silent False (a
+    typo must not quietly disable what it meant to enable)."""
+    v = s.strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(
+        f"expected one of 0/1/true/false/yes/no/on/off, got {s!r}")
+
+
 def bool_flag(p: argparse.ArgumentParser, name: str, default: bool, help: str):
     """Boost-program_options-style bool: --name true|false|0|1."""
     p.add_argument(
         f"--{name}",
-        type=lambda s: s.lower() in ("1", "true", "yes"),
+        type=_bool_flag,
         default=default,
         help=help,
     )
@@ -284,22 +297,75 @@ def add_serve_flags(p: argparse.ArgumentParser):
         help="--serve microbatch window: a chunk closes at the engine's "
              "batch size or after T ms, whichever first (default 50)",
     )
+    p.add_argument(
+        "--serve-retries",
+        dest="serve_retries",
+        type=int,
+        default=2,
+        metavar="R",
+        help="--serve supervision: re-dispatch a failed chunk up to R "
+             "times with exponential backoff before bisecting it to "
+             "isolate the poison case (default 2; the isolated case "
+             "fails its test instead of killing the batch)",
+    )
+    p.add_argument(
+        "--serve-fallback",
+        dest="serve_fallback",
+        type=_bool_flag,
+        default=True,
+        metavar="0|1",
+        help="--serve supervision: after K consecutive device-path "
+             "failures open a circuit breaker and route chunks through "
+             "an equivalent CPU-backend program until a half-open probe "
+             "re-closes it (default 1; 0 keeps retry+quarantine only)",
+    )
+    p.add_argument(
+        "--serve-deadline-ms",
+        dest="serve_deadline_ms",
+        type=float,
+        default=0.0,
+        metavar="MS",
+        help="--serve supervision: per-chunk fence/fetch deadline — a "
+             "fetch that misses it is classified a hang and retried "
+             "(0 = no watchdog, the default; the watchdog thread is "
+             "abandoned on a miss, never killed, per the tunnel "
+             "discipline)",
+    )
+    p.add_argument(
+        "--serve-nan-policy",
+        dest="serve_nan_policy",
+        default="quarantine",
+        choices=("quarantine", "serve"),
+        help="--serve supervision: what a non-finite fetched result "
+             "means — 'quarantine' (default) classifies it a corrupt "
+             "fault (retried, then bisected to the poison case); "
+             "'serve' restores the a-diverged-solve-is-a-legitimate-"
+             "result contract, leaving the oracle criterion to judge it",
+    )
 
 
-def serve_batch(case_iter, make_solver, engine_kwargs, depth, window_ms):
+def serve_batch(case_iter, make_solver, engine_kwargs, args):
     """The --serve driver shared by the batch CLIs: stream parsed rows
     into a :class:`~nonlocalheatequation_tpu.serve.server.ServePipeline`,
     drain, then feed each returned state back through its Solver's
     metrics — the same state-feedback contract as --ensemble (the oracle
     criterion ``error_l2/#points <= threshold`` is computed by exactly
-    the solo path's code).  Prints the pipeline summary and the one-line
-    JSON metrics dump to stderr.  Returns ``[(error_l2, n)]`` in
-    submission order."""
+    the solo path's code).  Supervision knobs ride along
+    (``--serve-retries/--serve-fallback/--serve-deadline-ms``); a
+    QUARANTINED case is reported loudly to stderr and scored as a failed
+    test (error inf) instead of killing the batch — the whole point of
+    the fault-tolerance layer.  Prints the pipeline summary and the
+    one-line JSON metrics dump (failure telemetry included) to stderr.
+    Returns ``[(error_l2, n)]`` in submission order."""
     import numpy as np
 
     from nonlocalheatequation_tpu.serve.server import ServePipeline
 
-    with ServePipeline(depth=depth, window_ms=window_ms,
+    with ServePipeline(depth=args.serve, window_ms=args.serve_window_ms,
+                       retries=args.serve_retries,
+                       fallback=args.serve_fallback,
+                       fetch_deadline_ms=args.serve_deadline_ms or None,
+                       nan_policy=args.serve_nan_policy,
                        **engine_kwargs) as pipe:
         pairs = []
         for row in case_iter:
@@ -311,6 +377,11 @@ def serve_batch(case_iter, make_solver, engine_kwargs, depth, window_ms):
         print(pipe.metrics_json(), file=sys.stderr)
         out = []
         for s, h in pairs:
+            if h.error is not None:
+                print(f"serve: case {h.seq} QUARANTINED: {h.error}",
+                      file=sys.stderr)
+                out.append((float("inf"), 1))
+                continue
             s.u = h.result
             out.append((s.compute_l2(s.nt), int(np.prod(h.case.shape))))
         return out
@@ -327,6 +398,11 @@ def validate_serve_args(args, extra_refusals=()) -> str | None:
     if args.serve_window_ms < 0:
         return (f"--serve-window-ms must be >= 0 (got "
                 f"{args.serve_window_ms:g})")
+    if args.serve_retries < 0:
+        return f"--serve-retries must be >= 0 (got {args.serve_retries})"
+    if args.serve_deadline_ms < 0:
+        return (f"--serve-deadline-ms must be >= 0 (got "
+                f"{args.serve_deadline_ms:g})")
     if not args.test_batch:
         return "--serve streams batch-test cases; it requires --test_batch"
     if args.ensemble:

@@ -117,7 +117,7 @@ def main(argv=None) -> int:
                     case_iter,
                     lambda *row: make_solver(args, *row),
                     {"precision": args.precision},
-                    args.serve, args.serve_window_ms)
+                    args)
 
         return run_batch(read_case, run_case, row_tokens=6,
                          run_ensemble=run_ensemble, run_serve=run_serve)

@@ -143,7 +143,7 @@ def main(argv=None) -> int:
                     case_iter,
                     make_solver,
                     {"method": args.method, "precision": args.precision},
-                    args.serve, args.serve_window_ms)
+                    args)
 
         return run_batch(read_case, run_case, row_tokens=7,
                          run_ensemble=run_ensemble, run_serve=run_serve)

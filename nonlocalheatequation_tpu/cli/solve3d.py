@@ -183,7 +183,7 @@ def main(argv=None) -> int:
                     case_iter,
                     make_solver,
                     {"method": args.method, "precision": args.precision},
-                    args.serve, args.serve_window_ms)
+                    args)
 
         return run_batch(read_case, run_case, multi=multi, row_tokens=8,
                          run_ensemble=run_ensemble, run_serve=run_serve)

@@ -122,6 +122,11 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         # idle-rate counters, never a model); WorkTelemetry remains available
         # as an injectable test fixture for deterministic scenarios.
         self.telemetry = telemetry or MeasuredTelemetry(nl)
+        # The measurement clock is injectable: busy-rate TESTS swap in a
+        # virtual clock advanced by the tile hook, so their assertions on
+        # measured rates stop racing host load (the suite's one recurring
+        # mid-suite flake); production always measures real wall-clock.
+        self._measure_clock = time.perf_counter
         # Measurement serializes device groups (see _step_all_measured), so
         # only pay for it when something consumes the rates: rebalancing, or
         # a caller that flips this on (e.g. --test_load_balance reporting).
@@ -499,7 +504,7 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
                     if owner == d]
             if not keys:
                 continue
-            t0 = time.perf_counter()
+            t0 = self._measure_clock()
             outs = []
             for key in keys:
                 out = self._step_tile(key, t)
@@ -507,7 +512,7 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
                 outs.append(out)
             for o in outs:
                 o.block_until_ready()
-            self.telemetry.record(d, time.perf_counter() - t0)
+            self.telemetry.record(d, self._measure_clock() - t0)
         self._tiles = new_tiles
         if self._use_fused:
             self._batch_tiles(state_only=True)

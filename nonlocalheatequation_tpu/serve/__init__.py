@@ -12,4 +12,11 @@ job but runs them strictly sequentially, paying the tunneled TPU's
 flight and fences only when a result is due — the reference's HPX
 futures-and-dataflow overlap (README.md:12-14) applied to serving, with
 served results bit-identical to the offline engine.
+
+``serve/resilience.py`` is the fault-tolerance layer under it: the
+typed ``ServeError`` a quarantined request raises, the circuit breaker
+(closed -> open on K consecutive device failures -> half-open probe ->
+closed), and the CPU-backend fallback chunk runner — bench.py's
+ladder/watchdog discipline applied to the request path, proven by the
+deterministic injector in utils/faults.py with no real TPU.
 """

@@ -141,6 +141,20 @@ class EnsembleEngine:
         self.report = EnsembleReport()
         self._programs: dict = {}
 
+    def sibling(self, **overrides) -> "EnsembleEngine":
+        """A fresh engine carrying this engine's settings (method /
+        precision / dtype / variant / ksteps / batch_sizes) except
+        ``overrides`` — with its OWN program cache and report.  The
+        serving fault-tolerance layer (serve/resilience.py) builds its
+        CPU-backend twin this way, so fallback programs never collide
+        with the device engine's cache and fallback dispatches never
+        perturb the device counters."""
+        kw = dict(method=self.method, precision=self.precision,
+                  dtype=self.dtype, variant=self.variant,
+                  ksteps=self.ksteps, batch_sizes=self.batch_sizes)
+        kw.update(overrides)
+        return EnsembleEngine(**kw)
+
     # -- case -> operator ---------------------------------------------------
     def _make_op(self, case: EnsembleCase):
         from nonlocalheatequation_tpu.ops.nonlocal_op import (

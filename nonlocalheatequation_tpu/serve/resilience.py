@@ -1,0 +1,223 @@
+"""Fault-tolerance primitives for the serving pipeline.
+
+The reference rides an HPX runtime that keeps work flowing around
+imbalance (SURVEY.md section 0); our production path instead crosses the
+flaky axon tunnel, where the failure modes are a dispatch that raises,
+a fetch that never returns, and a buffer that comes back corrupted
+(docs/bench/README.md "Wedge trigger").  bench.py survives all three via
+its subprocess ladder + watchdog + CPU fallback; this module gives the
+REQUEST path (serve/server.py) the same three answers, in-process:
+
+* :class:`ServeError` — the typed exception a poisoned request's
+  ``wait()`` raises, carrying the fault classification
+  ("error" / "hang" / "corrupt"), the case seq, and the attempt count.
+* :class:`CircuitBreaker` — the health state machine: ``closed`` ->
+  ``open`` after K consecutive device-path failures -> ``half-open``
+  probe once a cooldown elapses -> ``closed`` again on probe success
+  (or straight back to ``open`` on probe failure).  While open, the
+  pipeline routes chunks through the CPU fallback below — the serving
+  analogue of bench.py's BENCH_ALLOW_CPU_FALLBACK ladder.  The clock is
+  injectable, so the chaos suite drives every transition with a virtual
+  timer.
+* :class:`CpuFallback` — an equivalent CPU-backend chunk runner reusing
+  the engine's stage split (pad/build/stage/dispatch): a sibling
+  :class:`~nonlocalheatequation_tpu.serve.ensemble.EnsembleEngine` per
+  bucket dimensionality, pinned to the XLA CPU lowering of the same
+  operator (conv for 2D, sat for 3D — `_auto_method_*`'s own off-TPU
+  picks; an explicit XLA method is kept verbatim), executing under
+  ``jax.default_device(cpu)``.  Results are oracle-close by the
+  accuracy contract; when the engine's method is an XLA method
+  available on both backends (the chaos suite pins one) they are
+  bit-identical to the device path, which is how the CPU chaos suite
+  asserts exactness end to end.
+
+Threading note: like the pipeline itself, everything here runs on the
+scheduler thread; the only thread ever created is the supervisor's
+fetch watchdog (serve/server.py), and no JAX client is ever killed —
+a genuinely hung fetch is ABANDONED (daemon thread), exactly the
+wedge discipline bench.py follows with its killable probe children.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+import numpy as np
+
+#: Fault classifications the supervisor assigns to a failed attempt.
+CLASS_ERROR = "error"  # dispatch/fetch raised
+CLASS_HANG = "hang"  # fetch missed its deadline
+CLASS_CORRUPT = "corrupt"  # fetched buffer failed the finite scan
+
+#: Breaker states.
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+#: Bound on the retained transition trail (mirrors server.LOG_CAP, which
+#: cannot be imported here — server.py imports this module).  A breaker
+#: flapping open/half-open/open against a persistently dead device makes
+#: one transition pair per cooldown forever; the metrics dump keeps the
+#: most recent window plus a lifetime-exact ``transition_count``.
+TRANSITION_CAP = 4096
+
+
+class ServeError(RuntimeError):
+    """A request that completed exceptionally: its case was isolated as
+    the poison member of a failing chunk (or failed alone) after the
+    retry budget.  ``classification`` is one of CLASS_ERROR/HANG/CORRUPT;
+    ``detail`` carries the last underlying exception's text, if any."""
+
+    def __init__(self, classification: str, case_seq: int, chunk_id: int,
+                 attempts: int, detail: str = ""):
+        msg = (f"case {case_seq} quarantined after {attempts} attempts "
+               f"(chunk {chunk_id}, classified {classification!r}")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg + ")")
+        self.classification = classification
+        self.case_seq = case_seq
+        self.chunk_id = chunk_id
+        self.attempts = attempts
+        self.detail = detail
+
+
+class CircuitBreaker:
+    """closed -> open on K consecutive device-path failures -> half-open
+    probe after ``cooldown_ms`` -> closed on probe success.
+
+    ``route()`` answers "device" or "fallback" for the NEXT chunk
+    execution; in half-open exactly ONE probe is routed to the device
+    (others keep the fallback until the probe's outcome lands — the
+    pipeline may have several chunks in motion between a probe's
+    dispatch and its retire).  When the device route IS the probe,
+    ``routed_probe`` is True until the next ``route()`` call — the
+    caller tags that chunk and passes ``probe=`` back to the outcome
+    recorders, so a STALE device chunk (dispatched before the breaker
+    opened, retiring while half-open) can never settle the probe for
+    it.  ``transitions`` is the timestamped audit trail
+    ServeReport.metrics() surfaces — the most recent
+    :data:`TRANSITION_CAP` entries; ``transition_count`` is
+    lifetime-exact.
+    """
+
+    def __init__(self, threshold: int = 3, cooldown_ms: float = 5000.0,
+                 clock=time.monotonic):
+        threshold = int(threshold)
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1, got "
+                             f"{threshold}")
+        if cooldown_ms < 0:
+            raise ValueError(f"breaker cooldown_ms must be >= 0, got "
+                             f"{cooldown_ms}")
+        self.threshold = threshold
+        self.cooldown_s = cooldown_ms / 1e3
+        self._clock = clock
+        self.state = CLOSED
+        self.failures = 0  # consecutive device-path failures
+        self.opened_t: float | None = None
+        self.probe_inflight = False
+        self.routed_probe = False  # last route() handed out the probe
+        self.transitions: deque = deque(maxlen=TRANSITION_CAP)
+        self.transition_count = 0  # lifetime-exact
+
+    def _move(self, to: str) -> None:
+        self.transitions.append(
+            {"t": self._clock(), "from": self.state, "to": to})
+        self.transition_count += 1
+        self.state = to
+
+    def route(self) -> str:
+        self.routed_probe = False
+        if self.state == CLOSED:
+            return "device"
+        if self.state == OPEN:
+            if self._clock() >= self.opened_t + self.cooldown_s:
+                self._move(HALF_OPEN)
+                self.probe_inflight = True
+                self.routed_probe = True
+                return "device"  # the probe
+            return "fallback"
+        # half-open: one probe at a time
+        if not self.probe_inflight:
+            self.probe_inflight = True
+            self.routed_probe = True
+            return "device"
+        return "fallback"
+
+    def record_success(self, probe: bool = True) -> None:
+        """A device-path attempt completed ok.  ``probe=False`` marks a
+        stale chunk's outcome (device-routed before the breaker opened):
+        it clears the failure streak but never settles a half-open
+        probe."""
+        self.failures = 0
+        if self.state == HALF_OPEN and probe:
+            self.probe_inflight = False
+            self._move(CLOSED)
+
+    def record_failure(self, probe: bool = True) -> None:
+        """A device-path attempt failed in a way that attests to device
+        ill-health (the pipeline reports error/hang here; corrupt is
+        data-shaped and never reaches the breaker).  ``probe=False``
+        marks a stale chunk's outcome: it feeds the failure streak but
+        only the probe's own failure re-opens a half-open breaker."""
+        self.failures += 1
+        if self.state == HALF_OPEN:
+            if probe:
+                self.probe_inflight = False
+                self.opened_t = self._clock()
+                self._move(OPEN)
+        elif self.state == CLOSED and self.failures >= self.threshold:
+            self.opened_t = self._clock()
+            self._move(OPEN)
+
+
+class CpuFallback:
+    """Run a padded chunk on the CPU backend via the engine's own stage
+    split.  Built lazily by the pipeline (the happy path never pays for
+    it); keeps its own per-method sibling engines so fallback program
+    caches never collide with the device engine's."""
+
+    #: `_auto_method_{2,3}d`'s off-TPU picks (ops/nonlocal_op.py): the
+    #: fast XLA CPU lowering per dimensionality.  Pallas and "auto" must
+    #: not leak into the fallback — under an ambient TPU backend "auto"
+    #: resolves to the Mosaic kernel, which cannot execute on CPU.
+    _SAFE = {2: "conv", 3: "sat"}
+    _XLA_METHODS = ("conv", "shift", "sat")
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._engines: dict = {}
+        self._device = None
+
+    def _cpu_device(self):
+        import jax
+
+        if self._device is None:
+            self._device = jax.devices("cpu")[0]
+        return self._device
+
+    def _sibling(self, dim: int):
+        e = self.engine
+        method = (e.method if e.method in self._XLA_METHODS
+                  else self._SAFE.get(dim, "auto"))
+        sib = self._engines.get(method)
+        if sib is None:
+            # variant pinned to "auto": the carried/superstep pallas
+            # schedules cannot engage off-TPU and would refuse; auto
+            # resolves to the vmap/stacked XLA compositions here
+            sib = self._engines[method] = e.sibling(method=method,
+                                                    variant="auto")
+        return sib
+
+    def run_chunk(self, key, padded) -> np.ndarray:
+        """Build + stage + dispatch + fetch the chunk on CPU.  The fetch
+        IS the fence here (np.asarray of a CPU buffer), so a fallback
+        chunk completes synchronously — there is nothing to overlap and
+        nothing that can wedge."""
+        import jax
+
+        sib = self._sibling(len(key[0]))
+        with jax.default_device(self._cpu_device()):
+            multi = sib.build_program(key, padded)
+            U0 = sib.stage_inputs(padded)
+            return np.asarray(sib.dispatch_chunk(multi, U0))

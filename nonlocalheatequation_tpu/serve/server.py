@@ -30,39 +30,70 @@ This module applies the reference's execution model to the request path:
   starvation-free — the window T is an upper bound for every case);
   ``priority`` orders READY chunks at equal dispatch capacity.
   ``drain()`` flushes all partial chunks and in-flight work.
+* **Fault tolerance** (serve/resilience.py) — every chunk execution is
+  SUPERVISED: the dispatch stage is guarded, the fence/fetch runs under
+  a per-chunk deadline (``fetch_deadline_ms``: a watchdog thread joins
+  the fetch and classifies a miss as a hang, ABANDONING the blocked
+  thread — the wedge discipline forbids killing the client), and the
+  fetched buffer is finite-scanned (``nan_policy``).  A failed attempt
+  (classified ``error``/``hang``/``corrupt``) retries with exponential
+  backoff up to ``retries`` times; a chunk that exhausts its budget is
+  BISECTED — split in half, both halves re-dispatched with fresh
+  budgets — until the failing case is isolated, which then completes
+  exceptionally (:meth:`ServeRequest.wait` raises a typed
+  :class:`~nonlocalheatequation_tpu.serve.resilience.ServeError`) while
+  its chunk-mates are re-bucketed and served normally.  K consecutive
+  device-path failures open a circuit breaker that routes chunks
+  through an equivalent CPU-backend program (the serving analogue of
+  bench.py's ladder; oracle-close, bit-identical when the method is an
+  XLA method) until a half-open probe re-closes it.  All of it is
+  provable with no real TPU via the deterministic injector in
+  utils/faults.py (env ``NLHEAT_FAULT_PLAN`` or the ``faults=`` hook).
 * **Observability** — :class:`ServeReport` extends the engine's report
   with per-request and per-chunk timing (queue wait, program build,
   dispatch->fence wall, fetch), an occupancy trace (chunks in flight
-  over time), forced-close counts, and a one-call JSON dump
-  (:meth:`ServePipeline.metrics_json`) — the overlap is measured, not
-  assumed.
+  over time), forced-close counts, the failure telemetry (retries,
+  backoff, fault classifications, quarantined case ids, breaker
+  transitions with timestamps, fallback-served chunk count), and a
+  one-call JSON dump (:meth:`ServePipeline.metrics_json`) — the overlap
+  is measured, not assumed, and so is the degradation.
 
 Served results are **bit-identical** to ``EnsembleEngine.run()`` on the
 same case set: the pipeline reuses the engine's chunk stages
 (``build_program`` / ``stage_inputs`` / ``dispatch_chunk``) and padding
 rule verbatim — only the schedule changes (tests/test_serve.py pins
-this, plus the no-fence-between-dispatches discipline via spy counters).
+this, plus the no-fence-between-dispatches discipline via spy counters;
+supervision adds NO schedule change on the happy path — the inline
+fence path is PR 3's, byte for byte).
 
 Buffer donation (utils/donation.py) is pipeline-UNSAFE past depth 1: the
 pipeline declares its depth via ``donation.set_pipeline_depth``, which
 pins the lazy donate decision off and refuses an explicit
-``NLHEAT_DONATE=1`` loudly at construction.
+``NLHEAT_DONATE=1`` loudly at construction.  On the depth-1 donating
+schedule, retries are safe because every attempt RE-STAGES its input
+(``stage_inputs`` allocates a fresh device buffer per dispatch — a
+donated-away frame is never re-read).
 
 Threading note: the pipeline is single-threaded by design — the overlap
 lives in the DEVICE queue (async dispatch), not in host threads, so it
 is wedge-safe under the tunnel discipline (no client is ever killed
 mid-compile; the only blocking calls are the fences it would need
-anyway).  Corollary: window/deadline bounds are enforced at scheduler
-EVENTS (``submit``/``pump``/``wait``/``drain``) — the T-ms bound holds
-whenever events keep arriving (the streaming CLIs submit per stdin row
-and drain at EOF); an intake that can stall for long stretches between
-submissions should call ``pump()`` on its own cadence, because no
-background thread fires the window for it.
+anyway).  The one exception is the supervised fetch watchdog: a daemon
+thread that runs the fence the scheduler would otherwise run inline,
+joined with the per-chunk deadline — on a miss the thread is abandoned,
+never killed.  Corollary: window/deadline bounds are enforced at
+scheduler EVENTS (``submit``/``pump``/``wait``/``drain``) — the T-ms
+bound holds whenever events keep arriving (the streaming CLIs submit
+per stdin row and drain at EOF); an intake that can stall for long
+stretches between submissions should call ``pump()`` on its own
+cadence, because no background thread fires the window for it.
 """
 
 from __future__ import annotations
 
 import json
+import sys
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -83,7 +114,20 @@ from nonlocalheatequation_tpu.serve.ensemble import (
     EnsembleEngine,
     EnsembleReport,
 )
+from nonlocalheatequation_tpu.serve.resilience import (
+    CLASS_CORRUPT,
+    CLASS_ERROR,
+    CLASS_HANG,
+    CircuitBreaker,
+    CpuFallback,
+    ServeError,
+)
 from nonlocalheatequation_tpu.utils import donation
+from nonlocalheatequation_tpu.utils.faults import (
+    NO_FAULTS,
+    FaultPlan,
+    InjectedFault,
+)
 
 
 def fence_scalar(x) -> float:
@@ -92,15 +136,19 @@ def fence_scalar(x) -> float:
     reduced scalar is the only reliable completion barrier
     (docs/bench/README.md).  Module-level on purpose — the no-fence-
     between-dispatches tests spy on exactly this symbol.  Non-finite sums
-    are legal here (a diverged solve is a legitimate served result; the
-    caller's accuracy contract judges it)."""
+    are legal HERE (the fence only orders; it never judges) — what the
+    supervised retire does with a non-finite FETCHED buffer is
+    ``nan_policy``'s call (quarantine by default, ``"serve"`` restores
+    the a-diverged-solve-is-a-legitimate-result behavior)."""
     return float(jnp.sum(x))
 
 
 @dataclass
 class ServeRequest:
     """One submitted case: the caller's handle (a future).  ``result`` is
-    populated when the request's chunk retires; ``wait()`` forces it."""
+    populated when the request's chunk retires; ``wait()`` forces it and
+    raises the typed ``ServeError`` if the case was quarantined
+    (``error`` holds it either way)."""
 
     case: EnsembleCase
     seq: int
@@ -108,6 +156,7 @@ class ServeRequest:
     priority: int = 0
     deadline_t: float | None = None
     result: np.ndarray | None = None
+    error: ServeError | None = None
     queue_wait_s: float | None = None  # submit -> dispatch
     latency_s: float | None = None  # submit -> result
     _chunk: "_Chunk | None" = None
@@ -136,7 +185,9 @@ class _OpenChunk:
 
 
 class _Chunk:
-    """A closed chunk moving through ready -> inflight -> done."""
+    """A closed chunk moving through ready -> inflight -> done, possibly
+    looping back to ready on a supervised retry or being superseded by
+    its two bisection halves."""
 
     def __init__(self, chunk_id, key, requests, priority, closed_by):
         self.chunk_id = chunk_id
@@ -148,15 +199,23 @@ class _Chunk:
         self.out = None  # device future once dispatched
         self.dispatch_t = None
         self.build_s = 0.0
+        self.attempts = 0  # execution attempts so far (supervision)
+        self.route = "device"  # this attempt's routing (device/fallback)
+        self.probe = False  # this attempt IS the breaker's half-open probe
+        self.fired = NO_FAULTS  # this attempt's armed injected faults
+        self.padded = None  # pad_chunk result, computed once per chunk
+        self.last_failure = ("", "")  # (classification, detail)
 
 
 @dataclass
 class ServeReport(EnsembleReport):
     """EnsembleReport extended with the serving pipeline's observability:
-    per-chunk and per-request timing, occupancy, forced-close reasons.
-    The engine counters (cases/buckets/dispatches/programs_built/
-    padded_cases) keep their offline meaning — the pipeline routes the
-    engine's own stages, so the same counters measure the same events."""
+    per-chunk and per-request timing, occupancy, forced-close reasons,
+    and the failure telemetry.  The engine counters (cases/buckets/
+    dispatches/programs_built/padded_cases) keep their offline meaning —
+    the pipeline routes the engine's own stages, so the same counters
+    measure the same events (fallback-served chunks run on a sibling CPU
+    engine and are counted by ``fallback_chunks`` instead)."""
 
     depth: int = 1
     window_ms: float = 0.0
@@ -171,6 +230,14 @@ class ServeReport(EnsembleReport):
         default_factory=lambda: deque(maxlen=LOG_CAP))
     forced_closes: dict = field(default_factory=dict)
     max_inflight: int = 0
+    # failure telemetry (lifetime-exact, like the engine counters)
+    retries: int = 0  # supervised re-dispatches
+    faults: dict = field(default_factory=dict)  # classification -> count
+    backoff_ms_total: float = 0.0
+    bisections: int = 0
+    fallback_chunks: int = 0
+    quarantined: list = field(default_factory=list)
+    breaker: object = None  # the pipeline's CircuitBreaker, if any
 
     @staticmethod
     def _pct(xs) -> dict:
@@ -201,16 +268,43 @@ class ServeReport(EnsembleReport):
         return {"max": self.max_inflight,
                 "time_weighted_mean": float(area / span)}
 
+    def resilience(self) -> dict:
+        """The failure-telemetry block of :meth:`metrics`: retry/backoff
+        totals, fault classifications, quarantined case ids, fallback
+        chunk count, and the breaker's timestamped transition trail."""
+        out = {
+            "retries": self.retries,
+            "faults": dict(self.faults),
+            "backoff_ms_total": round(self.backoff_ms_total, 3),
+            "bisections": self.bisections,
+            "fallback_chunks": self.fallback_chunks,
+            "quarantined": [dict(q) for q in self.quarantined],
+        }
+        if self.breaker is not None:
+            out["breaker"] = {
+                "state": self.breaker.state,
+                "threshold": self.breaker.threshold,
+                # most recent TRANSITION_CAP entries; the count is
+                # lifetime-exact (a flapping breaker grows forever)
+                "transition_count": self.breaker.transition_count,
+                "transitions": [dict(t) for t in self.breaker.transitions],
+            }
+        else:
+            out["breaker"] = {"state": "disabled", "transition_count": 0,
+                              "transitions": []}
+        return out
+
     def metrics(self) -> dict:
         """The one-call dump: engine counters (lifetime-exact) + pipeline
         knobs + latency percentiles + stage totals + occupancy + the
-        per-chunk log, the latter four over the most recent ``LOG_CAP``
-        entries (``log_window`` in the dump)."""
+        failure telemetry + the per-chunk log, the latter four over the
+        most recent ``LOG_CAP`` entries (``log_window`` in the dump)."""
         return {
             "log_window": LOG_CAP,
             "cases": self.cases,
             "buckets": self.buckets,
-            # lifetime-exact (every chunk was closed exactly once; the
+            # lifetime-exact (every chunk was closed exactly once —
+            # bisection halves count as their own "bisect" closes; the
             # windowed chunk_log may hold fewer)
             "chunks": sum(self.forced_closes.values()),
             "dispatches": self.dispatches,
@@ -229,6 +323,7 @@ class ServeReport(EnsembleReport):
             "fetch_ms_total": round(
                 sum(c["fetch_ms"] for c in self.chunk_log), 3),
             "occupancy": self.occupancy(),
+            "resilience": self.resilience(),
             "chunk_log": list(self.chunk_log),
         }
 
@@ -238,19 +333,42 @@ class ServeReport(EnsembleReport):
 
 class ServePipeline:
     """Continuous-batching scheduler with up to ``depth`` chunks in
-    flight over one :class:`EnsembleEngine`.
+    flight over one :class:`EnsembleEngine`, supervised end to end.
 
-    Parameters: ``depth`` D (in-flight dispatch cap, >= 1; 1 is the
-    fenced A/B schedule), ``window_ms`` T (microbatch wait bound),
-    ``window_size`` B (size trigger; defaults to the engine's top batch
-    size so chunk partitioning matches the offline ``run()`` exactly),
-    ``clock`` (injectable for deterministic scheduler tests).  Remaining
-    kwargs construct the engine (method/precision/variant/...).
+    Scheduling parameters: ``depth`` D (in-flight dispatch cap, >= 1; 1
+    is the fenced A/B schedule), ``window_ms`` T (microbatch wait
+    bound), ``window_size`` B (size trigger; defaults to the engine's
+    top batch size so chunk partitioning matches the offline ``run()``
+    exactly), ``clock`` (injectable for deterministic scheduler tests).
+
+    Supervision parameters: ``retries`` (re-dispatches per chunk after
+    its first attempt; bisection halves get fresh budgets),
+    ``backoff_ms`` (base of the exponential per-chunk retry backoff,
+    applied via the injectable ``sleep``), ``fetch_deadline_ms`` (per-
+    chunk fence/fetch deadline; 0/None = no watchdog, the inline PR 3
+    fence), ``fallback`` (route chunks through the CPU-backend sibling
+    engine while the breaker is open), ``breaker`` (a prebuilt
+    :class:`~nonlocalheatequation_tpu.serve.resilience.CircuitBreaker`;
+    default one is built from ``breaker_threshold`` /
+    ``breaker_cooldown_ms`` on the pipeline clock when ``fallback`` is
+    on), ``nan_policy`` ("quarantine": a non-finite fetched buffer is a
+    classified fault; "serve": PR 3's a-diverged-solve-is-a-result
+    behavior), ``faults`` (a deterministic
+    :class:`~nonlocalheatequation_tpu.utils.faults.FaultPlan`; defaults
+    to env ``NLHEAT_FAULT_PLAN`` when set).  Remaining kwargs construct
+    the engine (method/precision/variant/...).
     """
 
     def __init__(self, engine: EnsembleEngine | None = None, *,
                  depth: int = 2, window_ms: float = 5.0,
                  window_size: int | None = None, clock=time.monotonic,
+                 retries: int = 2, backoff_ms: float = 10.0,
+                 fetch_deadline_ms: float | None = None,
+                 fallback: bool = True, breaker: CircuitBreaker | None = None,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_ms: float = 5000.0,
+                 nan_policy: str = "quarantine",
+                 faults: FaultPlan | None = None, sleep=time.sleep,
                  **engine_kwargs):
         if engine is None:
             engine = EnsembleEngine(**engine_kwargs)
@@ -269,6 +387,27 @@ class ServePipeline:
             raise ValueError(
                 f"window_size {ws} outside the engine batch sizes "
                 f"{engine.batch_sizes} (max {engine.batch_sizes[-1]})")
+        retries = int(retries)
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        if backoff_ms < 0:
+            raise ValueError(f"backoff_ms must be >= 0, got {backoff_ms}")
+        if fetch_deadline_ms is not None and fetch_deadline_ms < 0:
+            raise ValueError(
+                f"fetch_deadline_ms must be >= 0, got {fetch_deadline_ms}")
+        if nan_policy not in ("quarantine", "serve"):
+            raise ValueError(
+                f"nan_policy must be 'quarantine' or 'serve', got "
+                f"{nan_policy!r}")
+        # everything that can refuse parses BEFORE the donation-depth pin
+        # below: a ctor that raises past the pin would leak it process-
+        # wide, because close() never runs on a failed __init__
+        if faults is None:
+            faults = FaultPlan.from_env()
+        if breaker is None and fallback:
+            breaker = CircuitBreaker(threshold=breaker_threshold,
+                                     cooldown_ms=breaker_cooldown_ms,
+                                     clock=clock)
         # refuses loudly on NLHEAT_DONATE=1 with depth > 1 — donation is
         # not pipeline-safe (module docstring); restored by close()
         self._prev_depth = donation.set_pipeline_depth(depth)
@@ -277,8 +416,20 @@ class ServePipeline:
         self.window_s = window_ms / 1e3
         self.window_size = ws
         self._clock = clock
+        self._sleep = sleep
+        self.retries = retries
+        self.backoff_ms = float(backoff_ms)
+        self.fetch_deadline_s = (fetch_deadline_ms / 1e3
+                                 if fetch_deadline_ms else None)
+        self.nan_policy = nan_policy
+        self._faults = faults
+        self._fallback_on = bool(fallback)
+        self._fallback: CpuFallback | None = None
+        self._fallback_dead = False
+        self._breaker = breaker
         self.report = engine.report = ServeReport(
-            depth=depth, window_ms=window_ms, window_size=ws)
+            depth=depth, window_ms=window_ms, window_size=ws,
+            breaker=breaker)
         self._open: dict = {}
         self._ready: list[_Chunk] = []
         self._inflight: deque[_Chunk] = deque()
@@ -350,35 +501,282 @@ class ServePipeline:
     def _pop_ready(self) -> _Chunk:
         # highest priority first; FIFO (chunk_id) within a priority —
         # starvation-free because every chunk's CLOSE is window-bounded
-        # and the dispatch loop drains _ready completely
+        # and the dispatch loop drains _ready completely (a retried chunk
+        # keeps its chunk_id, so it also keeps its FIFO slot)
         best = min(self._ready, key=lambda c: (-c.priority, c.chunk_id))
         self._ready.remove(best)
         return best
 
+    # -- supervised execution -----------------------------------------------
+    def _route(self) -> str:
+        """Breaker routing for the next chunk execution."""
+        if self._breaker is None:
+            return "device"
+        route = self._breaker.route()
+        if route == "fallback" and self._ensure_fallback() is None:
+            return "device"  # no CPU backend here: keep trying the device
+        return route
+
+    def _ensure_fallback(self) -> CpuFallback | None:
+        if self._fallback is None and self._fallback_on \
+                and not self._fallback_dead:
+            try:
+                fb = CpuFallback(self.engine)
+                fb._cpu_device()  # probe: is a CPU backend present at all?
+                self._fallback = fb
+            except Exception as e:  # noqa: BLE001 — no CPU plugin
+                # loud, once: an operator reading breaker-open telemetry
+                # must know degraded CPU serving never engaged and the
+                # chunks are staying on the (failing) device path
+                print(f"serve: CPU fallback unavailable "
+                      f"({type(e).__name__}: {e}); breaker-open chunks "
+                      "stay on the device path", file=sys.stderr)
+                self._fallback_dead = True
+        return self._fallback
+
     def _dispatch(self, chunk: _Chunk) -> None:
+        """One supervised execution attempt: route, arm injected faults,
+        pad (once per chunk) + build + stage + dispatch through the
+        engine's stages.  Fallback-routed chunks complete synchronously
+        (their fetch is its own fence) and never enter the in-flight
+        window; device-routed chunks proceed exactly as PR 3 dispatched
+        them — async, no fence."""
+        chunk.attempts += 1
+        chunk.route = self._route()
+        # tag the half-open probe: only ITS outcome may settle the probe
+        # slot — a stale device chunk retiring mid-probe must not
+        chunk.probe = (self._breaker is not None
+                       and chunk.route == "device"
+                       and self._breaker.routed_probe)
+        chunk.fired = (self._faults.draw([r.seq for r in chunk.requests])
+                       if self._faults is not None else NO_FAULTS)
         t0 = self._clock()
-        padded = self.engine.pad_chunk([r.case for r in chunk.requests])
-        multi = self.engine.build_program(chunk.key, padded)
-        U0 = self.engine.stage_inputs(padded)
-        chunk.build_s = self._clock() - t0
-        chunk.dispatch_t = self._clock()
-        chunk.out = self.engine.dispatch_chunk(multi, U0)  # async, no fence
+        try:
+            if chunk.fired.raise_ is not None:
+                raise InjectedFault(chunk.fired.raise_,
+                                    self._faults.attempt - 1)
+            if chunk.padded is None:
+                chunk.padded = self.engine.pad_chunk(
+                    [r.case for r in chunk.requests])
+            if chunk.route == "fallback":
+                chunk.build_s = 0.0
+                chunk.dispatch_t = self._clock()
+                self._record_queue_wait(chunk)
+                # no fetch deadline on the fallback: it is the host's own
+                # synchronous CPU computation (first call pays the XLA
+                # compile in line) — it cannot tunnel-wedge, so there is
+                # nothing for the hang watchdog to guard; an armed stall
+                # still classifies (the inline path's immediate hang)
+                outcome, t1, payload = self._guarded(
+                    chunk, lambda: self._fetch_fallback(chunk),
+                    deadline_s=None)
+                if self._complete_attempt(chunk, outcome, t1, payload):
+                    self.report.fallback_chunks += 1
+                return
+            multi = self.engine.build_program(chunk.key, chunk.padded)
+            # every attempt RE-STAGES: a fresh device input buffer per
+            # dispatch, so the depth-1 donating schedule never re-reads
+            # a frame a previous attempt donated away (utils/donation.py)
+            U0 = self.engine.stage_inputs(chunk.padded)
+            chunk.build_s = self._clock() - t0
+            chunk.dispatch_t = self._clock()
+            chunk.out = self.engine.dispatch_chunk(multi, U0)  # async
+        except Exception as e:  # noqa: BLE001 — classified, never fatal
+            self._attempt_failed(chunk, CLASS_ERROR, e)
+            return
         chunk.state = "inflight"
         self._inflight.append(chunk)
-        for r in chunk.requests:
-            r.queue_wait_s = chunk.dispatch_t - r.submit_t
-            self.report.queue_wait_ms.append(r.queue_wait_s * 1e3)
+        self._record_queue_wait(chunk)
         n = len(self._inflight)
         self.report.max_inflight = max(self.report.max_inflight, n)
         self.report.occupancy_samples.append((chunk.dispatch_t, n))
 
-    def _retire(self, chunk: _Chunk) -> None:
-        """Fence + fetch one in-flight chunk and distribute its lanes."""
-        self._inflight.remove(chunk)
-        t0 = self._clock()
+    def _record_queue_wait(self, chunk: _Chunk) -> None:
+        # queue wait means submit -> FIRST dispatch that actually staged
+        # (a first attempt that dies in the dispatch stage never set
+        # dispatch_t, so the retry records it instead); recorded once per
+        # request — bisection halves keep their parent's sample
+        for r in chunk.requests:
+            if r.queue_wait_s is None:
+                r.queue_wait_s = chunk.dispatch_t - r.submit_t
+                self.report.queue_wait_ms.append(r.queue_wait_s * 1e3)
+
+    def _fetch_device(self, chunk: _Chunk):
+        """Fence + fetch one in-flight chunk (the supervised body; runs
+        inline, or inside the watchdog thread when a deadline is set)."""
+        if chunk.fired.stall is not None:
+            # the injected hang: blocks until the supervisor's
+            # classification (or close) releases it — it can never
+            # "finish early" under host load
+            chunk.fired.stall.wait()
         fence_scalar(chunk.out)  # device completion barrier
         t1 = self._clock()
-        vals = np.asarray(chunk.out)  # host fetch; padding lanes dropped
+        return t1, np.asarray(chunk.out)  # host fetch
+
+    def _fetch_fallback(self, chunk: _Chunk):
+        # no stall wait here: the only caller runs deadline-free, and
+        # _guarded's no-deadline path classifies an armed stall before
+        # this body is ever entered
+        vals = self._ensure_fallback().run_chunk(chunk.key, chunk.padded)
+        return self._clock(), vals
+
+    def _guarded(self, chunk: _Chunk, fn, deadline_s="use-default"):
+        """Run one fetch under the per-chunk deadline.  Returns
+        ``(outcome, t_fence, payload)`` where outcome is "ok" (payload =
+        fetched values), CLASS_ERROR (payload = the exception), or
+        CLASS_HANG (payload = None).  Without a deadline this is the
+        inline PR 3 path — no thread; an armed stall is then classified
+        immediately instead of blocking the scheduler forever."""
+        if deadline_s == "use-default":
+            deadline_s = self.fetch_deadline_s
+        if deadline_s is None:
+            if chunk.fired.stall is not None:
+                chunk.fired.stall.set()
+                return CLASS_HANG, self._clock(), None
+            try:
+                t1, vals = fn()
+            except Exception as e:  # noqa: BLE001
+                return CLASS_ERROR, self._clock(), e
+            return "ok", t1, vals
+        box: dict = {}
+
+        def worker():
+            try:
+                box["t1"], box["vals"] = fn()
+            except Exception as e:  # noqa: BLE001
+                box["exc"] = e
+
+        th = threading.Thread(target=worker, daemon=True)
+        th.start()
+        th.join(deadline_s)
+        if th.is_alive():
+            # deadline missed: classify a hang and ABANDON the thread —
+            # the wedge discipline forbids killing the client, and a
+            # daemon thread blocked in a dead fetch costs nothing.  Only
+            # THIS chunk's injected stall is released (so its worker
+            # exits promptly) — releasing every armed stall would defuse
+            # faults on OTHER in-flight chunks whenever a genuinely slow
+            # fence trips the deadline, making injected outcomes depend
+            # on interleaving; close() still releases everything.
+            if chunk.fired.stall is not None:
+                chunk.fired.stall.set()
+            return CLASS_HANG, self._clock(), None
+        if "exc" in box:
+            return CLASS_ERROR, self._clock(), box["exc"]
+        return "ok", box["t1"], box["vals"]
+
+    def _scan(self, chunk: _Chunk, vals):
+        """Post-fetch corruption check (+ the injector's nan hook)."""
+        if chunk.fired.nan is not None:
+            vals = self._faults.apply_nan(
+                chunk.fired, vals, [r.seq for r in chunk.requests])
+        if self.nan_policy == "quarantine" \
+                and not np.all(np.isfinite(vals)):
+            return CLASS_CORRUPT, vals
+        return "ok", vals
+
+    def _release_stalls(self) -> None:
+        if self._faults is not None:
+            self._faults.release_stalls()
+
+    def _record_breaker(self, chunk: _Chunk, ok: bool) -> None:
+        if self._breaker is None or chunk.route != "device":
+            return
+        if ok:
+            self._breaker.record_success(probe=chunk.probe)
+        else:
+            self._breaker.record_failure(probe=chunk.probe)
+
+    def _attempt_failed(self, chunk: _Chunk, classification: str,
+                        exc=None) -> None:
+        """Classify, count, and decide: bounded retry with exponential
+        backoff, bisection, or quarantine."""
+        chunk.out = None  # drop the device future; retries re-stage
+        f = self.report.faults
+        f[classification] = f.get(classification, 0) + 1
+        # corruption is DATA-shaped: a legitimately divergent input
+        # reproduces its NaNs on any backend, and the device path DID
+        # execute and deliver a buffer — so the breaker records a
+        # SUCCESS (clearing a half-open probe; never opening on bad
+        # data); only error/hang attest to device-path ill-health
+        self._record_breaker(chunk, ok=(classification == CLASS_CORRUPT))
+        detail = f"{type(exc).__name__}: {exc}" if exc is not None else ""
+        chunk.last_failure = (classification, detail)
+        if chunk.attempts <= self.retries:
+            self.report.retries += 1
+            delay_s = (self.backoff_ms / 1e3) * (2 ** (chunk.attempts - 1))
+            if delay_s > 0:
+                self.report.backoff_ms_total += delay_s * 1e3
+                self._sleep(delay_s)
+            chunk.state = "ready"
+            self._ready.append(chunk)
+            return
+        if len(chunk.requests) > 1:
+            self._bisect(chunk)
+        else:
+            self._quarantine(chunk, classification, detail)
+
+    def _bisect(self, chunk: _Chunk) -> None:
+        """Poison isolation: split the exhausted chunk in half; both
+        halves re-enter the ready queue as fresh chunks (fresh attempt
+        budgets, re-padded on dispatch).  Repeated, this isolates the
+        failing case in O(log B) extra chunk executions while every
+        chunk-mate is re-bucketed and served normally."""
+        mid = len(chunk.requests) // 2
+        self.report.bisections += 1
+        fc = self.report.forced_closes
+        for part in (chunk.requests[:mid], chunk.requests[mid:]):
+            half = _Chunk(self._next_chunk, chunk.key, part,
+                          chunk.priority, "bisect")
+            self._next_chunk += 1
+            for r in part:
+                r._chunk = half
+            fc["bisect"] = fc.get("bisect", 0) + 1
+            self._ready.append(half)
+        chunk.state = "done"  # superseded by its halves
+
+    def _quarantine(self, chunk: _Chunk, classification: str,
+                    detail: str) -> None:
+        """The isolated poison case completes exceptionally."""
+        req = chunk.requests[0]
+        req.error = ServeError(classification, req.seq, chunk.chunk_id,
+                               chunk.attempts, detail)
+        req.latency_s = self._clock() - req.submit_t
+        self.report.quarantined.append({
+            "case": req.seq, "classification": classification,
+            "attempts": chunk.attempts, "chunk": chunk.chunk_id})
+        chunk.state = "done"
+
+    def _complete_attempt(self, chunk: _Chunk, outcome, t_fence,
+                          payload) -> bool:
+        """The shared tail of one supervised execution attempt, for both
+        routes: scan the fetched buffer, then finish the chunk or
+        classify the failure (retry / bisect / quarantine).  Returns
+        True when the chunk finished with results."""
+        if outcome == "ok":
+            outcome, payload = self._scan(chunk, payload)
+            if outcome == "ok":
+                self._record_breaker(chunk, ok=True)
+                self._finish(chunk, payload, t_fence)
+                return True
+            self._attempt_failed(chunk, outcome)
+            return False
+        self._attempt_failed(
+            chunk, outcome, payload if outcome == CLASS_ERROR else None)
+        return False
+
+    def _retire(self, chunk: _Chunk) -> None:
+        """Fence + fetch one in-flight chunk under supervision and
+        distribute its lanes (or classify the failure)."""
+        self._inflight.remove(chunk)
+        outcome, t1, payload = self._guarded(
+            chunk, lambda: self._fetch_device(chunk))
+        self._complete_attempt(chunk, outcome, t1, payload)
+        self.report.occupancy_samples.append(
+            (self._clock(), len(self._inflight)))
+
+    def _finish(self, chunk: _Chunk, vals, t_fence) -> None:
+        """Distribute a retired chunk's lanes (padding lanes dropped)."""
         t2 = self._clock()
         for j, r in enumerate(chunk.requests):
             r.result = np.asarray(vals[j])
@@ -391,45 +789,52 @@ class ServePipeline:
             "cases": len(chunk.requests),
             "closed_by": chunk.closed_by,
             "build_ms": round(chunk.build_s * 1e3, 3),
-            "device_ms": round((t1 - chunk.dispatch_t) * 1e3, 3),
-            "fetch_ms": round((t2 - t1) * 1e3, 3),
+            "device_ms": round((t_fence - chunk.dispatch_t) * 1e3, 3),
+            "fetch_ms": round((t2 - t_fence) * 1e3, 3),
+            "route": chunk.route,
+            "attempt": chunk.attempts,
         })
-        self.report.occupancy_samples.append((t2, len(self._inflight)))
 
     # -- completion ---------------------------------------------------------
     def wait(self, req: ServeRequest) -> np.ndarray:
         """Force one request to completion (an implicit immediate
         deadline): close its open chunk if still accumulating, dispatch
-        through the normal capacity discipline, fence its chunk."""
-        while req.result is None:
-            if req._chunk is None:
+        through the normal capacity discipline, fence its chunk.  Raises
+        the typed ``ServeError`` if the case was quarantined."""
+        while req.result is None and req.error is None:
+            ch = req._chunk
+            if ch is None:
                 self._close(req.case.bucket_key(), "wait")
-            elif req._chunk.state == "ready":
+            elif ch.state == "ready":
                 if len(self._inflight) >= self.depth:
                     self._retire(self._inflight[0])
                 else:
                     self._dispatch(self._pop_ready())
             else:  # inflight
-                self._retire(req._chunk)
+                self._retire(ch)
+        if req.error is not None:
+            raise req.error
         return req.result
 
     def drain(self) -> None:
         """Flush everything: close all partial chunks, dispatch them
-        (retiring as capacity demands), then retire all in-flight work."""
+        (retiring as capacity demands), then retire all in-flight work —
+        including any retries and bisection halves a failure re-queues.
+        Quarantined requests do NOT raise here; their handles carry the
+        ``ServeError`` (``wait()`` raises it)."""
         for key in list(self._open):
             self._close(key, "drain")
-        while self._ready:
-            if len(self._inflight) >= self.depth:
-                self._retire(self._inflight[0])
-            else:
+        while self._ready or self._inflight:
+            if self._ready and len(self._inflight) < self.depth:
                 self._dispatch(self._pop_ready())
-        while self._inflight:
-            self._retire(self._inflight[0])
+            else:
+                self._retire(self._inflight[0])
 
     def serve_cases(self, cases) -> list:
         """Convenience: submit every case, drain, return results in
         submission order — the schedule-changed twin of
-        ``EnsembleEngine.run()`` (bit-identical output)."""
+        ``EnsembleEngine.run()`` (bit-identical output).  A quarantined
+        case's slot holds None (its handle carries the ServeError)."""
         handles = [self.submit(c) for c in cases]
         self.drain()
         return [h.result for h in handles]
@@ -438,11 +843,13 @@ class ServePipeline:
         """Drain and release the pipeline.  The process-wide donation
         depth declared at construction is restored even if the final
         drain raises (a failed serve run must not leave donation pinned
-        for the rest of the process)."""
+        for the rest of the process), and any armed/abandoned injected
+        stalls are released so no test leaks a blocked thread."""
         if not self._closed:
             try:
                 self.drain()
             finally:
+                self._release_stalls()
                 donation.set_pipeline_depth(self._prev_depth)
                 self._closed = True
 
@@ -492,3 +899,32 @@ def serve_fence_ab(engine: EnsembleEngine, cases, depth: int,
         if sec_p < pipe_best:
             pipe_best, pipe_rep = sec_p, rep
     return compile_s, fenced_best, pipe_best, pipe_rep
+
+
+def serve_chaos(engine: EnsembleEngine, cases, depth: int, plan_spec: str,
+                *, retries: int = 2, fetch_deadline_ms: float = 2000.0,
+                breaker_threshold: int = 1,
+                breaker_cooldown_ms: float = 600_000.0):
+    """The chaos measurement shared by bench.py (``BENCH_SERVE_FAULTS``)
+    and tools/bench_table.py (``resilience`` group): serve ``cases``
+    through a fully supervised pipeline while the deterministic plan
+    ``plan_spec`` (utils/faults.py grammar) injects faults mid-stream.
+    The default breaker opens on the FIRST device failure and stays open
+    (10-minute cooldown), so any injected raise/stall fault guarantees at
+    least one fallback-served chunk — the evidence the ``servefault``
+    queue step gates on.  (A nan-only plan does NOT: corruption is
+    data-shaped and deliberately never opens the breaker, so a chaos gate
+    on ``fallback_chunks`` must inject raise or stall.)  Returns ``(wall_s, results, report)``; a quarantined
+    case's results slot is None."""
+    pipe = ServePipeline(
+        engine=engine, depth=depth, window_ms=0.0,
+        faults=FaultPlan.parse(plan_spec), retries=retries,
+        fetch_deadline_ms=fetch_deadline_ms, backoff_ms=0.0,
+        breaker=CircuitBreaker(threshold=breaker_threshold,
+                               cooldown_ms=breaker_cooldown_ms))
+    try:
+        t0 = time.perf_counter()
+        results = pipe.serve_cases(cases)
+        return time.perf_counter() - t0, results, pipe.report
+    finally:
+        pipe.close()

@@ -3,8 +3,13 @@
 The reference has NO checkpointing (SURVEY.md section 5: VTK/CSV logs are
 write-only observability) — this is a capability extension.  State is the
 temperature field plus the timestep and the solver parameters that must match
-on resume; storage is a single .npz written atomically (tmp + rename) so a
-kill mid-write never corrupts the latest checkpoint.
+on resume; storage is a single .npz written atomically (same-directory tmp +
+``os.replace``) so a kill mid-write never corrupts the latest checkpoint,
+and v2 checkpoints carry a CRC32 integrity marker over the payload so a
+torn/bit-rotted file is refused LOUDLY at load with a
+resume-from-the-previous-checkpoint hint instead of resuming a
+plausible-looking but wrong trajectory (the serving stack's robustness
+discipline applied to the resume path).
 """
 
 from __future__ import annotations
@@ -12,6 +17,7 @@ from __future__ import annotations
 import json
 import os
 import socket
+import zlib
 
 import numpy as np
 
@@ -31,24 +37,52 @@ def _process_index() -> int:
 
     return jax.process_index()
 
-FORMAT_VERSION = 1
+#: v1: u/t/params, no integrity marker.  v2 adds ``crc`` (CRC32 over the
+#: state bytes, the timestep, and the params JSON); v1 files keep loading.
+FORMAT_VERSION = 2
+
+CORRUPT_HINT = (
+    "the file is truncated or corrupt (torn write, disk fault); delete it "
+    "and resume from the previous checkpoint, or restart from t=0"
+)
+
+
+def _payload_crc(u: np.ndarray, t: int, params_json: bytes) -> int:
+    crc = zlib.crc32(params_json)
+    crc = zlib.crc32(np.int64(t).tobytes(), crc)
+    # ascontiguousarray: pinning the layout keeps the crc a pure function
+    # of the VALUES the resume path will read back; .data (not tobytes)
+    # feeds crc32 through the buffer protocol without materializing a
+    # byte-copy of the whole state field
+    return zlib.crc32(np.ascontiguousarray(u).data, crc)
 
 
 def save_state(path: str, u: np.ndarray, t: int, params: dict | None = None):
-    """Atomically write solver state at timestep ``t`` (u = state AFTER t steps)."""
+    """Atomically write solver state at timestep ``t`` (u = state AFTER t
+    steps): same-directory tmp + ``os.replace`` (a kill mid-write leaves
+    the previous checkpoint untouched), payload CRC32 included so
+    ``load_state`` can refuse a torn file loudly."""
     # host-unique tmp: on a multi-host shared filesystem, pids alone can
     # collide across hosts' independent pid namespaces
     tmp = f"{path}.tmp.{socket.gethostname()}.{os.getpid()}"
     meta = dict(params or {})
+    u = np.asarray(u)
+    params_json = json.dumps(meta).encode()
     try:
         with open(tmp, "wb") as f:
             np.savez(
                 f,
-                u=np.asarray(u),
+                u=u,
                 t=np.int64(t),
                 version=np.int64(FORMAT_VERSION),
-                params=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+                params=np.frombuffer(params_json, dtype=np.uint8),
+                crc=np.uint32(_payload_crc(u, t, params_json)),
             )
+            # the replace below is only atomic for bytes that reached the
+            # disk; flush+fsync closes the torn-page window a crash right
+            # after os.replace would otherwise leave
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         # a failed write (disk full, kill) must not strand tmp files next to
@@ -61,14 +95,45 @@ def save_state(path: str, u: np.ndarray, t: int, params: dict | None = None):
 
 
 def load_state(path: str):
-    """-> (u, t, params).  Raises ValueError on unknown format versions."""
-    with np.load(path) as z:
-        version = int(z["version"])
-        if version != FORMAT_VERSION:
-            raise ValueError(f"unsupported checkpoint version {version}")
-        u = z["u"]
-        t = int(z["t"])
-        params = json.loads(z["params"].tobytes().decode()) if "params" in z else {}
+    """-> (u, t, params).  Raises ValueError on unknown format versions
+    and — LOUDLY, with a resume-from-previous hint — on a truncated or
+    corrupt file (unreadable archive, missing members, CRC mismatch).
+    A missing file propagates as FileNotFoundError, unchanged."""
+    try:
+        with np.load(path) as z:
+            version = int(z["version"])
+            u = np.array(z["u"])
+            t = int(z["t"])
+            params_raw = z["params"].tobytes() if "params" in z else b"{}"
+            crc = int(z["crc"]) if "crc" in z.files else None
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        # zipfile.BadZipFile, EOFError, KeyError on a missing member,
+        # OSError mid-read: all the shapes a torn write takes — one loud,
+        # typed refusal instead of a stack trace
+        raise ValueError(
+            f"checkpoint {path!r} could not be read "
+            f"({type(e).__name__}: {e}): " + CORRUPT_HINT) from e
+    if version not in (1, FORMAT_VERSION):
+        raise ValueError(f"unsupported checkpoint version {version}")
+    if version >= 2:
+        if crc is None:
+            raise ValueError(
+                f"checkpoint {path!r} (v{version}) is missing its "
+                "integrity marker: " + CORRUPT_HINT)
+        got = _payload_crc(u, t, params_raw)
+        if got != crc:
+            raise ValueError(
+                f"checkpoint {path!r} failed its integrity check "
+                f"(crc {got:#010x} != recorded {crc:#010x}): "
+                + CORRUPT_HINT)
+    try:
+        params = json.loads(params_raw.decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ValueError(
+            f"checkpoint {path!r} carries unreadable parameters "
+            f"({type(e).__name__}): " + CORRUPT_HINT) from e
     # v1 checkpoints written before the schema moved to a dimension-agnostic
     # 'shape' list carried nx/ny(/nz) keys; translate so they keep resuming
     # instead of failing with a confusing "'shape' missing" mismatch

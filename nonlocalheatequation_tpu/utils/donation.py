@@ -33,6 +33,13 @@ pinned OFF, and an EXPLICIT ``NLHEAT_DONATE=1`` is refused loudly rather
 than silently ignored (double-buffering donated frames across D
 in-flight chunks is future work; until then the combination is an
 error, not a degraded mode).
+
+Retry discipline (serve/server.py supervision): on the depth-1 schedule
+donation may be ON, and a donated input buffer is INVALID after the
+dispatch that consumed it — so a supervised retry must never replay a
+previously staged buffer.  The pipeline's contract is that every
+execution attempt RE-STAGES its inputs (``EnsembleEngine.stage_inputs``
+allocates a fresh device buffer per dispatch).
 """
 
 from __future__ import annotations

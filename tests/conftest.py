@@ -17,7 +17,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
               "NLHEAT_LANE_RUNS", "NLHEAT_TM", "NLHEAT_DONATE",
               "NLHEAT_TUNE_PRECISION", "NLHEAT_TUNE_BATCH",
-              "BENCH_PRECISION", "BENCH_ENSEMBLE"):
+              "NLHEAT_FAULT_PLAN", "BENCH_PRECISION", "BENCH_ENSEMBLE",
+              "BENCH_SERVE", "BENCH_SERVE_FAULTS"):
     os.environ.pop(_knob, None)
 # "" DISABLES autotune-cache persistence (unset means the per-user default
 # file since tuning became the on-TPU default): the suite must neither read

@@ -123,6 +123,42 @@ def test_bench_serve_mode_emits_amortization_and_latency():
     assert rec["partial"] is False
 
 
+def test_bench_servefault_mode_serves_through_injected_fault():
+    # BENCH_SERVE_FAULTS: the chaos rung — the pipelined schedule runs
+    # once under a deterministic injected plan through the supervised
+    # pipeline (retries + first-failure breaker + CPU fallback).  The
+    # plan fails one dispatch attempt AND its first retry, so the
+    # breaker demonstrably opens and the fallback route serves; the JSON
+    # line must show every request served (no poison), at least one
+    # fallback chunk, and the servefault variant label, on the same
+    # one-line rc=0 contract
+    proc, rec = run_bench({"BENCH_SERVE": "3",
+                           "BENCH_SERVE_FAULTS": "raise@1x2",
+                           "BENCH_ACCURACY": "0"})
+    assert proc.returncode == 0
+    assert rec["value"] > 0
+    assert rec["variant"] == "servefault3"
+    assert rec["cases"] == 8
+    assert rec["served"] == 8 and rec["poison"] == 0
+    assert rec["fallback_chunks"] >= 1
+    assert rec["retries_total"] >= 1
+    assert rec["breaker_transitions"] >= 1  # closed -> open observed
+    assert rec["fault_plan"] == "raise@1x2"
+    assert rec["partial"] is False
+
+
+def test_leaked_fault_plan_scrubbed_from_headline_run():
+    # an ambient NLHEAT_FAULT_PLAN (leaked from a chaos shell) must not
+    # inject failures into a normal measurement: the parent scrubs it
+    # and the run completes as a plain healthy ladder
+    proc, rec = run_bench({"NLHEAT_FAULT_PLAN": "raise@0x*",
+                           "BENCH_ACCURACY": "0"})
+    assert proc.returncode == 0
+    assert rec["value"] > 0 and rec["partial"] is False
+    assert "variant" not in rec
+    assert "scrubbed leaked NLHEAT_FAULT_PLAN" in proc.stderr
+
+
 def test_tight_deadline_emits_partial_not_zero():
     # Budget long enough for probe + first rung, short enough to cut the
     # ladder; grid 512 on CPU forces a multi-second second rung.

@@ -69,6 +69,83 @@ def test_version_guard(tmp_path):
         ckpt.load_state(path)
 
 
+def test_truncated_checkpoint_refused_loudly_with_hint(tmp_path):
+    # the torn-write shapes: a file cut at any point must refuse with the
+    # typed ValueError carrying the resume-from-previous hint — never a
+    # bare zipfile/KeyError stack trace, never a silent partial resume
+    path = str(tmp_path / "state.npz")
+    u = np.random.default_rng(1).normal(size=(16, 16))
+    ckpt.save_state(path, u, 7, {"eps": 3})
+    blob = open(path, "rb").read()
+    for cut in (0, 10, len(blob) // 2, len(blob) - 8):
+        with open(path, "wb") as f:
+            f.write(blob[:cut])
+        with pytest.raises(ValueError, match="previous checkpoint"):
+            ckpt.load_state(path)
+
+
+def test_corrupt_payload_fails_integrity_check(tmp_path):
+    # bit rot INSIDE a structurally valid archive: npz stores arrays
+    # uncompressed, so a flipped state byte survives unzip — only the
+    # crc marker catches it
+    path = str(tmp_path / "state.npz")
+    u = np.random.default_rng(2).normal(size=(16, 16))
+    ckpt.save_state(path, u, 7, {"eps": 3})
+    blob = bytearray(open(path, "rb").read())
+    # flip one byte in the middle of the (large, uncompressed) u payload
+    blob[len(blob) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(ValueError, match="integrity|previous checkpoint"):
+        ckpt.load_state(path)
+
+
+def test_kill_mid_write_leaves_previous_checkpoint_loadable(tmp_path,
+                                                           monkeypatch):
+    # the crash-safety contract: a kill at ANY point of save_state leaves
+    # the previous checkpoint intact and loadable, and strands no tmp
+    # files next to it
+    path = str(tmp_path / "state.npz")
+    u1 = np.random.default_rng(3).normal(size=(8, 8))
+    ckpt.save_state(path, u1, 5, {"eps": 3})
+
+    # kill #1: mid-serialization (np.savez dies after writing some bytes)
+    def _dying_savez(f, **kw):
+        f.write(b"partial garbage")
+        raise KeyboardInterrupt  # the signal-shaped interruption
+
+    monkeypatch.setattr(ckpt.np, "savez", _dying_savez)
+    with pytest.raises(KeyboardInterrupt):
+        ckpt.save_state(path, np.zeros((8, 8)), 6, {"eps": 3})
+    monkeypatch.undo()
+
+    # kill #2: after the tmp write, before the atomic publish
+    monkeypatch.setattr(ckpt.os, "replace",
+                        lambda *a: (_ for _ in ()).throw(KeyboardInterrupt))
+    with pytest.raises(KeyboardInterrupt):
+        ckpt.save_state(path, np.zeros((8, 8)), 6, {"eps": 3})
+    monkeypatch.undo()
+
+    u2, t, params = ckpt.load_state(path)  # the previous state survives
+    assert t == 5 and (u2 == u1).all() and params["eps"] == 3
+    leftovers = [p.name for p in tmp_path.iterdir() if ".tmp." in p.name]
+    assert leftovers == []
+
+
+def test_v1_checkpoint_without_crc_still_loads(tmp_path):
+    # back-compat: pre-marker (v1) checkpoints keep resuming
+    import json as _json
+
+    path = str(tmp_path / "state.npz")
+    u = np.arange(6.0).reshape(2, 3)
+    with open(path, "wb") as f:
+        np.savez(f, u=u, t=np.int64(4), version=np.int64(1),
+                 params=np.frombuffer(_json.dumps({"eps": 2}).encode(),
+                                      dtype=np.uint8))
+    u2, t, params = ckpt.load_state(path)
+    assert t == 4 and (u2 == u).all() and params["eps"] == 2
+
+
 def test_distributed_interrupted_equals_uninterrupted(tmp_path):
     from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
     from nonlocalheatequation_tpu.parallel.mesh import make_mesh

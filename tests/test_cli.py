@@ -10,8 +10,8 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_cli(module, args, stdin=""):
-    env = {**os.environ}
+def run_cli(module, args, stdin="", env_extra=None):
+    env = {**os.environ, **(env_extra or {})}
     return subprocess.run(
         [sys.executable, "-m", f"nonlocalheatequation_tpu.cli.{module}",
          "--platform", "cpu", *args],
@@ -89,6 +89,66 @@ def test_2d_batch_serve_mode():
     assert r.returncode == 1 and "requires --test_batch" in r.stderr
     r = run_cli("solve2d", ["--test_batch", "--serve", "2", "--ensemble"])
     assert r.returncode == 1 and "drop --ensemble" in r.stderr
+
+
+def test_2d_serve_quarantines_poison_case_and_serves_the_rest():
+    # fault-tolerant serving surfaced through the CLI: a persistent
+    # injected fault following case 1 (NLHEAT_FAULT_PLAN, the same env
+    # knob the chaos suite uses) must quarantine exactly that case —
+    # loudly, with the typed classification in stderr and the failure
+    # telemetry in the metrics dump — and score it as a failed test
+    # instead of killing the batch; disabling the CPU fallback keeps the
+    # run on the pure retry+quarantine path
+    import json
+
+    r = run_cli("solve2d",
+                ["--test_batch", "--serve", "2", "--serve-retries", "1",
+                 "--serve-fallback", "0"],
+                stdin="3\n40 40 20 3 0.2 0.001 0.02\n"
+                      "40 40 20 3 0.2 0.001 0.02\n"
+                      "50 50 20 5 1 0.0005 0.02\n",
+                env_extra={"NLHEAT_FAULT_PLAN": "raise@c1x*"})
+    assert "Tests Failed" in r.stdout, r.stdout + r.stderr
+    assert r.returncode == 1
+    assert "case 1 QUARANTINED" in r.stderr
+    assert "classified 'error'" in r.stderr
+    metrics = [ln for ln in r.stderr.splitlines()
+               if ln.startswith("{") and '"resilience"' in ln]
+    assert metrics, r.stderr
+    m = json.loads(metrics[0])
+    assert [q["case"] for q in m["resilience"]["quarantined"]] == [1]
+    assert m["resilience"]["breaker"]["state"] == "disabled"
+
+
+def test_serve_supervision_flag_refusals():
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2",
+                            "--serve-retries", "-1"], stdin="0\n")
+    assert r.returncode == 1 and "--serve-retries" in r.stderr
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2",
+                            "--serve-deadline-ms", "-5"], stdin="0\n")
+    assert r.returncode == 1 and "--serve-deadline-ms" in r.stderr
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2",
+                            "--serve-nan-policy", "bogus"], stdin="0\n")
+    assert r.returncode == 2 and "--serve-nan-policy" in r.stderr
+    # a bool-flag typo must be a loud rc-2 refusal, never a silent
+    # False that quietly disables the CPU fallback it meant to enable
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2",
+                            "--serve-fallback", "ture"], stdin="0\n")
+    assert r.returncode == 2 and "--serve-fallback" in r.stderr
+
+
+def test_serve_nan_policy_serve_restores_diverged_result_contract():
+    # --serve-nan-policy serve: a deterministically divergent case is a
+    # SERVED result judged by the oracle criterion (PR 3's contract) —
+    # it fails the batch with a real error number, burns no retries, and
+    # is NOT quarantined
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2",
+                            "--serve-nan-policy", "serve"],
+                stdin="1\n20 20 40 5 1 5.0 0.02\n")
+    assert "Tests Failed" in r.stdout
+    assert r.returncode == 1
+    assert "QUARANTINED" not in r.stderr
+    assert '"quarantined": []' in r.stderr
 
 
 def test_serve_truncated_stream_still_refused_loudly():

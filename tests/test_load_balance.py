@@ -180,39 +180,60 @@ def test_elastic_measured_rebalance_from_imbalanced_map():
 
 
 class _DraggedDeviceSolver(ElasticSolver2D):
-    """Test double: tiles on ``slow_device`` take extra REAL wall-clock
-    (a sleep interposed in the tile step), emulating a slow/contended chip.
-    Only a measurement can see this — no tile-count model would."""
+    """Test double: tiles on ``slow_device`` cost extra VIRTUAL time.
+
+    The original version interposed a real ``sleep`` and asserted on real
+    ``perf_counter`` measurements; under host load mid-suite the noise
+    floor crossed the drag and the busy-rate assertion flaked (CHANGES.md
+    PR 3).  The executor's measurement clock is injectable exactly for
+    this: the solver measures through a virtual clock that only the tile
+    hook advances — per-tile cost and the slow device's drag are then
+    DETERMINISTIC, the rebalance loop sees the same rates every run, and
+    the telemetry/measurement plumbing is still exercised end to end
+    (same ``record``/``busy_rates``/``reset`` path, same serialized
+    measured windows)."""
 
     slow_device = 1
-    # large enough that host scheduling noise (parallel test runs, CI
-    # neighbors) cannot mask the dragged device inside a 5-step window
-    drag_s = 0.006
+    base_s = 0.002  # virtual per-tile cost on every device
+    drag_s = 0.006  # extra virtual cost per tile on the slow device
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._vclock = 0.0
+        self._measure_clock = lambda: self._vclock
 
     def _tile_hook(self, key):
+        self._vclock += self.base_s
         if int(self.assignment[key]) == self.slow_device:
-            import time as _time
-
-            _time.sleep(self.drag_s)
+            self._vclock += self.drag_s
 
 
 def test_elastic_measured_rebalance_detects_genuinely_slow_device():
-    """A device slowed by real elapsed time (not a model) sheds tiles, and
-    the final MEASURED busy rates meet the reference's <=1500/10000
-    acceptance criterion (src/2d_nonlocal_distributed.cpp:647-686)."""
+    """A device slowed in MEASURED time (virtual clock — deterministic,
+    see _DraggedDeviceSolver) sheds tiles, and the final measured busy
+    rates meet the reference's <=1500/10000 acceptance criterion
+    (src/2d_nonlocal_distributed.cpp:647-686).  The repeat loop proves
+    the deflake: every run must converge to the SAME assignment and
+    rates — there is no wall-clock left to race."""
     if len(jax.devices()) < 2:
         pytest.skip("needs >= 2 devices")
-    s = _DraggedDeviceSolver(4, 4, 6, 6, nt=81, eps=2, nbalance=10,
-                             k=0.2, dt=0.0005, dh=0.02,
-                             assignment=default_assignment(6, 6, 2),
-                             devices=jax.devices()[:2])
-    s.test_init()
-    s.do_work()
-    counts = np.bincount(s.assignment.ravel(), minlength=2)
-    assert counts[s.slow_device] < counts[1 - s.slow_device], counts
-    ok, max_diff = lb.balance_check(s.busy_rates())
-    assert ok, f"measured busy deviation {max_diff} > {lb.ACCEPT_MAX_DEVIATION}"
-    assert s.error_l2 / (24 * 24) <= 1e-6
+    final_assignments = []
+    for repeat in range(2):
+        s = _DraggedDeviceSolver(4, 4, 6, 6, nt=81, eps=2, nbalance=10,
+                                 k=0.2, dt=0.0005, dh=0.02,
+                                 assignment=default_assignment(6, 6, 2),
+                                 devices=jax.devices()[:2])
+        s.test_init()
+        s.do_work()
+        counts = np.bincount(s.assignment.ravel(), minlength=2)
+        assert counts[s.slow_device] < counts[1 - s.slow_device], counts
+        ok, max_diff = lb.balance_check(s.busy_rates())
+        assert ok, (f"run {repeat}: measured busy deviation {max_diff} > "
+                    f"{lb.ACCEPT_MAX_DEVIATION}")
+        assert s.error_l2 / (24 * 24) <= 1e-6
+        final_assignments.append(np.array(s.assignment))
+    assert np.array_equal(*final_assignments), \
+        "virtual-clock measurement must be run-to-run deterministic"
 
 
 def test_elastic_fused_equals_general_assembly():

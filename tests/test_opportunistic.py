@@ -22,6 +22,7 @@ SCRIPT = os.path.join(REPO, "tools", "tpu_opportunistic.sh")
 ALL_STEPS = [
     "bench4096", "resident512", "carried4096", "superstep2",
     "bf16-4096", "bf16-carried4096", "ensemble8x1024", "serve8x1024",
+    "servefault8x1024",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
@@ -70,6 +71,24 @@ def test_success_path_resident_variant(tmp_path):
     assert "resident512\n" in state
     assert "fail:" not in state
     assert '"variant": "resident"' in table
+
+
+@pytest.mark.slow  # ~60 s (a gate bench + the chaos bench child) — the
+# underlying servefault machinery is tier-1-covered by
+# tests/test_bench_harness.py; this proves the queue's gating greps
+def test_servefault_step_banks_chaos_evidence(tmp_path):
+    # the chaos A/B step must only bank when the JSON carries the
+    # servefault variant, all requests served (no poison), and a
+    # genuinely engaged fallback route
+    proc, state, table, _out = _run(
+        tmp_path, "servefault8x1024", {"OPP_GRID_ENS": "24"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    assert "servefault8x1024\n" in state
+    assert "fail:" not in state
+    assert '"variant": "servefault4"' in table
+    assert '"served": 8' in table and '"poison": 0' in table
+    assert '"fault_plan": "raise@1x2"' in table
 
 
 @pytest.mark.slow  # ~73 s: two strike rounds, each a full bench child plus

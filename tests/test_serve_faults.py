@@ -1,0 +1,527 @@
+"""Chaos suite: fault-tolerant serving (serve/server.py supervision,
+serve/resilience.py, utils/faults.py), on the CPU/f64 suite with NO real
+TPU — every fault is injected deterministically by a plan
+(utils/faults.py grammar), every breaker transition is driven by an
+injected clock, and every assertion reads ``ServeReport.metrics()``.
+
+What these tests pin:
+
+* the plan grammar parses/refuses loudly, and ``NLHEAT_FAULT_PLAN``
+  reaches a default-constructed pipeline;
+* TABLE-DRIVEN fault classification: each injected fault kind (raise /
+  stall / NaN corruption) maps to its classification ("error" / "hang" /
+  "corrupt"), its retry count, and its final request outcome — for both
+  the fenced (D=1) and pipelined (D>1) schedules;
+* bounded retry with exponential backoff (injected sleep records the
+  delays; backoff_ms_total matches);
+* poison-case quarantine by BISECTION: a persistent case-targeted fault
+  in an 8-case chunk is isolated in O(log B) splits; exactly that case's
+  ``wait()`` raises the typed ServeError, every chunk-mate is re-bucketed
+  and served BIT-IDENTICALLY to the offline engine;
+* the circuit breaker's full lifecycle — closed -> open on K consecutive
+  device failures -> fallback-routed chunks while open -> half-open probe
+  after the cooldown (injected clock) -> closed — observed from the
+  metrics' timestamped transition trail;
+* the end-to-end chaos acceptance: under a mid-stream plan (raise,
+  stall, NaN at staggered dispatch indices + one persistent poison
+  case), every non-poison request returns a result bit-identical to an
+  uninjected offline ``EnsembleEngine.run()``, exactly the poison case
+  raises ServeError, and the breaker opens, probes half-open, and
+  re-closes;
+* the happy path is untouched: with no faults the supervised defaults
+  report all-zero failure telemetry (the schedule itself is pinned by
+  tests/test_serve.py's spy counters).
+"""
+
+import numpy as np
+import pytest
+
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase,
+    EnsembleEngine,
+)
+from nonlocalheatequation_tpu.serve.resilience import (
+    CircuitBreaker,
+    ServeError,
+)
+from nonlocalheatequation_tpu.serve.server import ServePipeline
+from nonlocalheatequation_tpu.utils.faults import FaultPlan
+
+NX, NY, EPS, NSTEPS = 16, 16, 2, 2
+MIXED = [(1.0, 1e-4, 0.02), (0.5, 2e-4, 0.02), (0.2, 1e-4, 0.01)]
+
+
+def _cases(n, rng, shape=(NX, NY), nt=NSTEPS):
+    out = []
+    for i in range(n):
+        k, dt, dh = MIXED[i % len(MIXED)]
+        out.append(EnsembleCase(shape=shape, nt=nt, eps=EPS, k=k, dt=dt,
+                                dh=dh, test=False,
+                                u0=rng.normal(size=shape)))
+    return out
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- plan grammar ----------------------------------------------------------
+def test_plan_parses_targets_counts_and_log():
+    plan = FaultPlan.parse("raise@1,stall@3x2,nan@c5x*")
+    kinds = [e.kind for e in plan.entries]
+    assert kinds == ["raise", "stall", "nan"]
+    assert plan.entries[0].attempt == 1 and plan.entries[0].left == 1
+    assert plan.entries[1].attempt == 3 and plan.entries[1].left == 2
+    assert plan.entries[2].case == 5 and plan.entries[2].left == float("inf")
+    fired = plan.draw([0])  # attempt 0: nothing matches
+    assert not fired.any()
+    fired = plan.draw([5])  # attempt 1: raise@1 AND nan@c5 both match
+    assert fired.raise_ is not None and fired.nan is not None
+    assert [f["kind"] for f in plan.fired_log] == ["raise", "nan"]
+
+
+@pytest.mark.parametrize("bad", [
+    "raise", "boom@1", "nan@c", "stall@1x0", "raise@", "", "nan@cx*",
+])
+def test_plan_refuses_bad_specs_loudly(bad):
+    with pytest.raises(ValueError, match="fault.plan|entries"):
+        FaultPlan.parse(bad)
+
+
+def test_attempt_targeted_count_fires_on_consecutive_attempts():
+    # the xN count on an attempt-targeted entry is a RANGE: raise@1x2
+    # must fire at attempts 1 AND 2 (a global attempt index passes
+    # exactly once, so "the same index twice" would be unsatisfiable) —
+    # with a depth-1 schedule that is an attempt and its immediate retry
+    plan = FaultPlan.parse("raise@1x2")
+    assert [plan.draw([0]).raise_ is not None for _ in range(4)] == \
+        [False, True, True, False]
+    rng = np.random.default_rng(11)
+    cases = _cases(2, rng)
+    with ServePipeline(depth=1, window_ms=0.0, batch_sizes=(1,),
+                       retries=2, backoff_ms=0.0, fallback=False,
+                       faults=FaultPlan.parse("raise@1x2")) as pipe:
+        handles = [pipe.submit(c) for c in cases]
+        pipe.drain()
+    # case 1's first attempt (attempt 1) and its retry (attempt 2) both
+    # raise; the second retry serves — two retries, two errors, no poison
+    assert all(h.result is not None for h in handles)
+    m = pipe.metrics()["resilience"]
+    assert m["faults"] == {"error": 2}
+    assert m["retries"] == 2 and m["quarantined"] == []
+    # the request still carries its queue wait even though its chunk's
+    # FIRST attempt died in the dispatch stage (recorded at the first
+    # attempt that actually staged)
+    assert all(h.queue_wait_s is not None for h in handles)
+
+
+def test_env_plan_reaches_default_pipeline(monkeypatch):
+    monkeypatch.setenv("NLHEAT_FAULT_PLAN", "raise@0")
+    rng = np.random.default_rng(0)
+    with ServePipeline(depth=1, window_ms=0.0, batch_sizes=(1,),
+                       backoff_ms=0.0) as pipe:
+        h = pipe.submit(_cases(1, rng)[0])
+        out = h.wait()  # injected failure, retried, served
+    assert out is not None
+    assert pipe.metrics()["resilience"]["faults"] == {"error": 1}
+
+
+# -- table-driven classification (the satellite's table) -------------------
+#    (spec pattern, fetch deadline, expected classification)
+FAULT_TABLE = [
+    ("raise@{t}", None, "error"),
+    ("stall@{t}", 60.0, "hang"),
+    ("nan@{t}", None, "corrupt"),
+]
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+@pytest.mark.parametrize("spec,deadline,cls", FAULT_TABLE)
+def test_transient_fault_classified_retried_and_served(depth, spec,
+                                                       deadline, cls):
+    # one fault firing once at the first dispatch: classified, retried
+    # exactly once, and the request still serves bit-identically
+    rng = np.random.default_rng(1)
+    cases = _cases(1, rng)
+    offline = EnsembleEngine(batch_sizes=(1,)).run(cases)
+    engine = EnsembleEngine(batch_sizes=(1,))
+    with ServePipeline(engine=engine, depth=depth, window_ms=0.0,
+                       retries=2, backoff_ms=0.0, fallback=False,
+                       fetch_deadline_ms=deadline,
+                       faults=FaultPlan.parse(spec.format(t=0))) as pipe:
+        h = pipe.submit(cases[0])
+        out = h.wait()
+    m = pipe.metrics()["resilience"]
+    assert m["faults"] == {cls: 1}
+    assert m["retries"] == 1
+    assert m["quarantined"] == []
+    assert np.array_equal(out, offline[0])
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+@pytest.mark.parametrize("spec,deadline,cls", FAULT_TABLE)
+def test_persistent_fault_exhausts_retries_and_quarantines(depth, spec,
+                                                           deadline, cls):
+    # the same fault made persistent and case-targeted: the retry budget
+    # (2) is spent, the single-case chunk quarantines, wait() raises the
+    # typed error, and chunk-MATES in the stream are unaffected
+    rng = np.random.default_rng(2)
+    cases = _cases(4, rng)
+    offline = EnsembleEngine(batch_sizes=(1,)).run(cases)
+    engine = EnsembleEngine(batch_sizes=(1,))
+    with ServePipeline(engine=engine, depth=depth, window_ms=0.0,
+                       retries=2, backoff_ms=0.0, fallback=False,
+                       fetch_deadline_ms=deadline,
+                       faults=FaultPlan.parse(spec.format(t="c2x*"))) as pipe:
+        handles = [pipe.submit(c) for c in cases]
+        pipe.drain()
+        with pytest.raises(ServeError) as ei:
+            handles[2].wait()
+    err = ei.value
+    assert err.classification == cls
+    assert err.case_seq == 2 and err.attempts == 3
+    m = pipe.metrics()["resilience"]
+    assert m["faults"] == {cls: 3}
+    assert m["retries"] == 2
+    assert m["quarantined"] == [
+        {"case": 2, "classification": cls, "attempts": 3,
+         "chunk": err.chunk_id}]
+    for i in (0, 1, 3):
+        assert np.array_equal(handles[i].result, offline[i])
+
+
+def test_hang_classification_releases_only_its_own_stall():
+    # found live by the verify drive: classifying one chunk's hang used
+    # to release EVERY armed stall, so a deadline tripped by a genuinely
+    # slow fence defused faults on other in-flight chunks and the
+    # injected outcome depended on interleaving.  Two chunks in flight,
+    # both stall-armed: chunk A's transient hang must leave chunk B's
+    # persistent stall armed — B still quarantines, A still serves.
+    rng = np.random.default_rng(10)
+    cases = _cases(2, rng)
+    with ServePipeline(depth=2, window_ms=0.0, batch_sizes=(1,),
+                       retries=1, backoff_ms=0.0, fallback=False,
+                       fetch_deadline_ms=60.0,
+                       faults=FaultPlan.parse(
+                           "stall@0,stall@c1x*")) as pipe:
+        ha = pipe.submit(cases[0])
+        hb = pipe.submit(cases[1])
+        pipe.drain()
+    assert ha.result is not None and ha.error is None
+    assert hb.error is not None
+    assert hb.error.classification == "hang"
+    m = pipe.metrics()["resilience"]
+    assert [q["case"] for q in m["quarantined"]] == [1]
+
+
+def test_exponential_backoff_recorded_and_slept():
+    slept = []
+    rng = np.random.default_rng(3)
+    with ServePipeline(depth=1, window_ms=0.0, batch_sizes=(1,),
+                       retries=2, backoff_ms=100.0, fallback=False,
+                       faults=FaultPlan.parse("raise@c0x*"),
+                       sleep=slept.append) as pipe:
+        h = pipe.submit(_cases(1, rng)[0])
+        pipe.drain()
+    assert h.error is not None
+    assert slept == [0.1, 0.2]  # backoff_ms * 2^(attempt-1), exhaustion sleeps nothing
+    assert pipe.metrics()["resilience"]["backoff_ms_total"] == 300.0
+
+
+def test_corrupt_results_never_open_the_breaker():
+    # a persistent NaN is DATA-shaped (a divergent input reproduces on
+    # any backend): it must quarantine through the normal retry/bisect
+    # path WITHOUT opening the breaker — otherwise one bad input row
+    # reroutes every healthy chunk to the CPU fallback
+    rng = np.random.default_rng(12)
+    cases = _cases(3, rng)
+    with ServePipeline(depth=1, window_ms=0.0, batch_sizes=(1,),
+                       retries=1, backoff_ms=0.0,
+                       breaker_threshold=1, breaker_cooldown_ms=1e6,
+                       faults=FaultPlan.parse("nan@c1x*")) as pipe:
+        handles = [pipe.submit(c) for c in cases]
+        pipe.drain()
+    m = pipe.metrics()["resilience"]
+    assert [q["case"] for q in m["quarantined"]] == [1]
+    assert m["breaker"]["state"] == "closed"
+    assert m["breaker"]["transitions"] == []
+    assert m["fallback_chunks"] == 0
+    assert handles[0].result is not None and handles[2].result is not None
+
+
+def test_corrupt_half_open_probe_clears_and_recloses_the_breaker():
+    # review catch: a half-open probe whose fetch comes back corrupt
+    # must CLEAR the probe and re-close the breaker (the device path
+    # executed and delivered a buffer — data-shaped corruption attests
+    # device health); leaving probe_inflight set would wedge the breaker
+    # half-open and route all traffic to the fallback forever
+    clock = FakeClock()
+    rng = np.random.default_rng(13)
+    cases = _cases(4, rng)
+    with ServePipeline(depth=1, window_ms=0.0, batch_sizes=(1,),
+                       clock=clock, retries=1, backoff_ms=0.0,
+                       breaker_threshold=1, breaker_cooldown_ms=50.0,
+                       faults=FaultPlan.parse(
+                           "raise@0,nan@c2x*")) as pipe:
+        handles = [pipe.submit(c) for c in cases[:2]]
+        pipe.drain()  # case0: raise -> open; retry + case1 via fallback
+        assert pipe.metrics()["resilience"]["breaker"]["state"] == "open"
+        clock.advance(0.1)  # cooldown elapses
+        handles.append(pipe.submit(cases[2]))  # the probe — corrupt!
+        handles.append(pipe.submit(cases[3]))
+        pipe.drain()
+    m = pipe.metrics()["resilience"]
+    moves = [(t["from"], t["to"]) for t in m["breaker"]["transitions"]]
+    assert moves == [("closed", "open"), ("open", "half-open"),
+                     ("half-open", "closed")]
+    assert [q["case"] for q in m["quarantined"]] == [2]
+    for i in (0, 1, 3):
+        assert handles[i].result is not None, i
+
+
+def test_nan_policy_serve_keeps_diverged_results():
+    # nan_policy="serve" restores PR 3's contract: a non-finite fetched
+    # buffer is a legitimate served result, not a fault
+    rng = np.random.default_rng(4)
+    with ServePipeline(depth=1, window_ms=0.0, batch_sizes=(1,),
+                       nan_policy="serve",
+                       faults=FaultPlan.parse("nan@0")) as pipe:
+        out = pipe.submit(_cases(1, rng)[0]).wait()
+    assert not np.all(np.isfinite(out))
+    m = pipe.metrics()["resilience"]
+    assert m["faults"] == {} and m["retries"] == 0
+
+
+# -- bisection quarantine ---------------------------------------------------
+def test_bisection_isolates_poison_case_mates_served_bit_identical():
+    # one 8-case chunk with a persistent NaN on case 5: the chunk is
+    # bisected 8 -> 4 -> 2 -> 1 (3 bisections), exactly case 5
+    # quarantines, and all 7 mates match the offline engine bit for bit
+    # (re-padded halves duplicate their last case, same as offline pads)
+    rng = np.random.default_rng(5)
+    cases = _cases(8, rng)
+    offline = EnsembleEngine(batch_sizes=(8,)).run(cases)
+    engine = EnsembleEngine(batch_sizes=(8,))
+    # huge window: the SIZE trigger (window_size = top batch size 8)
+    # closes the chunk at the 8th submit, so all 8 cases share one chunk
+    with ServePipeline(engine=engine, depth=1, window_ms=10_000.0,
+                       retries=1, backoff_ms=0.0, fallback=False,
+                       faults=FaultPlan.parse("nan@c5x*")) as pipe:
+        handles = [pipe.submit(c) for c in cases]
+        pipe.drain()
+    m = pipe.metrics()["resilience"]
+    assert m["bisections"] == 3
+    assert [q["case"] for q in m["quarantined"]] == [5]
+    assert m["quarantined"][0]["classification"] == "corrupt"
+    with pytest.raises(ServeError, match="case 5 quarantined"):
+        handles[5].wait()
+    for i in range(8):
+        if i == 5:
+            continue
+        assert np.array_equal(handles[i].result, offline[i]), i
+    # every failing chunk burned its retry before splitting: 8, 4-half,
+    # 2-half, and the isolated case each retried once
+    assert m["retries"] == 4
+    assert m["faults"] == {"corrupt": 8}
+    assert pipe.metrics()["forced_closes"]["bisect"] == 6
+
+
+# -- circuit breaker --------------------------------------------------------
+def test_breaker_unit_lifecycle_with_injected_clock():
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=2, cooldown_ms=100.0, clock=clock)
+    assert br.route() == "device"
+    br.record_failure()
+    assert br.state == "closed" and br.route() == "device"
+    br.record_failure()  # 2 consecutive -> open
+    assert br.state == "open" and br.route() == "fallback"
+    clock.advance(0.05)
+    assert br.route() == "fallback"  # still cooling down
+    clock.advance(0.06)
+    assert br.route() == "device"  # the half-open probe
+    assert br.state == "half-open"
+    assert br.route() == "fallback"  # only ONE probe at a time
+    br.record_failure()  # probe failed -> open again, timer reset
+    assert br.state == "open"
+    clock.advance(0.11)
+    assert br.route() == "device"
+    br.record_success()  # probe succeeded -> closed
+    assert br.state == "closed"
+    moves = [(t["from"], t["to"]) for t in br.transitions]
+    assert moves == [("closed", "open"), ("open", "half-open"),
+                     ("half-open", "open"), ("open", "half-open"),
+                     ("half-open", "closed")]
+    with pytest.raises(ValueError, match="threshold"):
+        CircuitBreaker(threshold=0)
+
+
+def test_breaker_stale_outcomes_never_settle_the_probe():
+    # a depth-D pipeline can have chunks dispatched to the device BEFORE
+    # the breaker opened that retire while it is half-open: their
+    # outcomes (probe=False) must not close the breaker, cancel the
+    # probe slot, or re-stamp the open timer — only the probe's own
+    # outcome (probe=True) settles half-open
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=1, cooldown_ms=100.0, clock=clock)
+    br.record_failure(probe=False)
+    assert br.state == "open"
+    clock.advance(0.11)
+    assert br.route() == "device" and br.routed_probe  # the probe
+    assert br.state == "half-open"
+    br.record_success(probe=False)  # stale chunk retires: no transition
+    assert br.state == "half-open" and br.probe_inflight
+    br.record_failure(probe=False)  # stale failure: probe slot intact
+    assert br.state == "half-open" and br.probe_inflight
+    assert br.route() == "fallback" and not br.routed_probe
+    br.record_success(probe=True)  # the probe's own outcome closes it
+    assert br.state == "closed" and not br.probe_inflight
+    moves = [(t["from"], t["to"]) for t in br.transitions]
+    assert moves == [("closed", "open"), ("open", "half-open"),
+                     ("half-open", "closed")]
+
+
+def test_breaker_transition_trail_bounded_count_exact():
+    # a breaker flapping against a persistently dead device accumulates
+    # transitions forever; the retained trail is windowed at
+    # TRANSITION_CAP while transition_count stays lifetime-exact
+    from nonlocalheatequation_tpu.serve.resilience import TRANSITION_CAP
+
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=1, cooldown_ms=1.0, clock=clock)
+    br.record_failure()  # closed -> open
+    flaps = TRANSITION_CAP  # each flap: open -> half-open -> open
+    for _ in range(flaps):
+        clock.advance(0.002)
+        assert br.route() == "device"  # half-open probe
+        br.record_failure()  # probe fails -> open again
+    assert br.transition_count == 1 + 2 * flaps
+    assert len(br.transitions) == TRANSITION_CAP
+    assert br.transitions[-1]["to"] == "open"
+
+
+def test_failed_pipeline_ctor_does_not_leak_the_donation_pin(monkeypatch):
+    # ServePipeline pins the process-wide donation depth; a ctor that
+    # refuses (malformed ambient plan, bad breaker knobs) must refuse
+    # BEFORE pinning — close() never runs on a failed __init__, so a
+    # pin taken first would leak to every later solve in the process
+    from nonlocalheatequation_tpu.utils import donation
+
+    monkeypatch.setenv("NLHEAT_FAULT_PLAN", "raise@")  # malformed
+    with pytest.raises(ValueError, match="fault-plan"):
+        ServePipeline(depth=3, batch_sizes=(1,))
+    assert donation._pipeline_depth == 1
+    monkeypatch.delenv("NLHEAT_FAULT_PLAN")
+    with pytest.raises(ValueError, match="threshold"):
+        ServePipeline(depth=3, batch_sizes=(1,), breaker_threshold=0)
+    assert donation._pipeline_depth == 1
+
+
+def test_breaker_opens_routes_fallback_probes_and_recloses():
+    # pipeline-level lifecycle: two consecutive device failures (one
+    # chunk's attempt + retry) open the K=2 breaker; the retry and the
+    # next chunks serve via the CPU fallback; after the cooldown the
+    # half-open probe re-closes it — results all bit-identical (the CPU
+    # suite's fallback sibling builds the same conv programs)
+    clock = FakeClock()
+    rng = np.random.default_rng(6)
+    cases = _cases(4, rng)
+    offline = EnsembleEngine(batch_sizes=(1,)).run(cases)
+    engine = EnsembleEngine(batch_sizes=(1,))
+    with ServePipeline(engine=engine, depth=1, window_ms=0.0, clock=clock,
+                       retries=2, backoff_ms=0.0,
+                       breaker_threshold=2, breaker_cooldown_ms=1000.0,
+                       faults=FaultPlan.parse("raise@0,raise@1")) as pipe:
+        handles = [pipe.submit(c) for c in cases[:3]]
+        pipe.drain()  # case0: fail, fail (-> open), fallback-served
+        m = pipe.metrics()["resilience"]
+        assert m["breaker"]["state"] == "open"
+        assert m["fallback_chunks"] >= 2  # case0's 3rd attempt + cases 1-2
+        clock.advance(1.1)  # past the cooldown
+        handles.append(pipe.submit(cases[3]))  # the half-open probe
+        pipe.drain()
+    m = pipe.metrics()["resilience"]
+    assert m["breaker"]["state"] == "closed"
+    moves = [(t["from"], t["to"]) for t in m["breaker"]["transitions"]]
+    assert moves == [("closed", "open"), ("open", "half-open"),
+                     ("half-open", "closed")]
+    for h, want in zip(handles, offline):
+        assert np.array_equal(h.result, want)
+
+
+# -- the acceptance chaos run ----------------------------------------------
+def test_chaos_acceptance_mid_stream_faults_breaker_cycle_and_quarantine():
+    """The ISSUE 4 acceptance scenario: an injected mid-stream plan —
+    raise at dispatch 1, stall at dispatch 3, NaN at dispatch 5, plus a
+    persistent NaN following case 6 — against a supervised pipelined
+    (D=3) schedule with a K=1 breaker.  Every non-poison request must
+    come back bit-identical to an uninjected offline run, exactly case 6
+    must raise ServeError, and the breaker must be OBSERVED (from
+    metrics) to open, probe half-open, and re-close."""
+    clock = FakeClock()
+    rng = np.random.default_rng(7)
+    cases = _cases(9, rng)
+    offline = EnsembleEngine(batch_sizes=(1,)).run(cases)
+    engine = EnsembleEngine(batch_sizes=(1,))
+    with ServePipeline(engine=engine, depth=3, window_ms=0.0, clock=clock,
+                       retries=1, backoff_ms=0.0, fetch_deadline_ms=100.0,
+                       breaker_threshold=1, breaker_cooldown_ms=50.0,
+                       sleep=lambda s: None,
+                       faults=FaultPlan.parse(
+                           "raise@1,stall@3,nan@5,nan@c6x*")) as pipe:
+        handles = [pipe.submit(c) for c in cases[:8]]
+        pipe.drain()
+        m = pipe.metrics()["resilience"]
+        assert m["breaker"]["state"] == "open"  # opened at the raise
+        clock.advance(0.1)  # cooldown elapses
+        handles.append(pipe.submit(cases[8]))  # the half-open probe
+        pipe.drain()
+    m = pipe.metrics()
+    res = m["resilience"]
+    # every fault kind fired and was classified
+    assert res["faults"]["error"] >= 1
+    assert res["faults"]["hang"] >= 1
+    assert res["faults"]["corrupt"] >= 2  # the transient + the poison's
+    # exactly the poison case quarantined, with the right classification
+    assert [q["case"] for q in res["quarantined"]] == [6]
+    assert res["quarantined"][0]["classification"] == "corrupt"
+    with pytest.raises(ServeError) as ei:
+        handles[6].wait()
+    assert ei.value.classification == "corrupt" and ei.value.case_seq == 6
+    # the breaker cycled: open while faults flowed, fallback served the
+    # open window, half-open probe re-closed it
+    moves = [(t["from"], t["to"])
+             for t in res["breaker"]["transitions"]]
+    assert moves == [("closed", "open"), ("open", "half-open"),
+                     ("half-open", "closed")]
+    assert res["fallback_chunks"] >= 1
+    # every non-poison request is bit-identical to the uninjected offline
+    # engine — device-served, retried, and fallback-served alike
+    for i in range(9):
+        if i == 6:
+            continue
+        assert np.array_equal(handles[i].result, offline[i]), i
+    # the telemetry is in the one-call dump the CLIs print
+    assert "resilience" in m and "breaker" in m["resilience"]
+
+
+def test_happy_path_supervision_reports_all_zero_telemetry():
+    rng = np.random.default_rng(8)
+    cases = _cases(6, rng)
+    offline = EnsembleEngine().run(cases)
+    with ServePipeline(depth=2, window_ms=0.0) as pipe:
+        served = pipe.serve_cases(cases)
+    res = pipe.metrics()["resilience"]
+    assert res["retries"] == 0 and res["faults"] == {}
+    assert res["bisections"] == 0 and res["fallback_chunks"] == 0
+    assert res["quarantined"] == [] and res["backoff_ms_total"] == 0.0
+    assert res["breaker"]["state"] == "closed"
+    assert res["breaker"]["transitions"] == []
+    for got, want in zip(served, offline):
+        assert np.array_equal(got, want)

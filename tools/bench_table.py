@@ -13,7 +13,9 @@ Env:
     BT_GRID3D (256 / 48), BT_DIST_GRID (2048 / 256), BT_UNSTRUCT_M (512 / 64),
     BT_SCALE_BLOCK (2048 / 256, per-device block edge of the scaling sweep),
     BT_ENS_GRID (1024 / 64) + BT_ENS_CASES (8, the ensemble/serve A/B
-    bucket), BT_SERVE_DEPTH (4, the serve group's pipelined in-flight cap)
+    bucket), BT_SERVE_DEPTH (4, the serve group's pipelined in-flight cap),
+    BT_FAULT_PLAN (the resilience group's injected chaos plan,
+    utils/faults.py grammar; default "raise@1,stall@3,nan@5")
 """
 
 from __future__ import annotations
@@ -718,6 +720,75 @@ def bench_serve(steps: int):
          occupancy=pipe_rep.occupancy())
 
 
+def bench_resilience(steps: int):
+    """Fault-tolerance overhead + chaos A/B (ISSUE 4): C single-case
+    chunks served twice through serve/server.py — once with the
+    supervised defaults and NO faults (the supervision-overhead row: the
+    happy path must cost nothing vs the plain pipelined schedule), once
+    under a deterministic injected plan (raise + stall + NaN mid-stream,
+    utils/faults.py) with a first-failure breaker and the CPU-fallback
+    route live.  The chaos row records the resilience evidence —
+    served/poison counts, fallback chunk count, retry total, breaker
+    transitions — plus ``bit_identical``: whether every non-poison
+    result matched an uninjected offline ``EnsembleEngine.run()`` (on
+    this CPU-suite machinery check it must)."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+    from nonlocalheatequation_tpu.serve.ensemble import (
+        EnsembleCase,
+        EnsembleEngine,
+    )
+    from nonlocalheatequation_tpu.serve.server import (
+        ServePipeline,
+        serve_chaos,
+    )
+
+    D = int(os.environ.get("BT_SERVE_DEPTH", 4))
+    C = int(os.environ.get("BT_ENS_CASES", 8))
+    n = cfg("BT_ENS_GRID", 1024, 64)
+    method = "pallas" if on_tpu() else "sat"
+    op = NonlocalOp2D(8, k=1.0, dt=1.0, dh=1.0 / n, method=method)
+    dt = stable_dt(op)
+    rng = np.random.default_rng(0)
+    cases = [EnsembleCase(shape=(n, n), nt=steps, eps=8, k=1.0, dt=dt,
+                          dh=1.0 / n, test=False,
+                          u0=rng.normal(size=(n, n))) for _ in range(C)]
+    offline = EnsembleEngine(method=method, batch_sizes=(1,)).run(cases)
+
+    # supervised happy path: best-of-3 after a warming pass (shared
+    # engine/program cache, like the serve group)
+    engine = EnsembleEngine(method=method, batch_sizes=(1,))
+    best = float("inf")
+    for i in range(4):
+        pipe = ServePipeline(engine=engine, depth=D, window_ms=0.0)
+        try:
+            t0 = time.perf_counter()
+            pipe.serve_cases(cases)
+            sec = time.perf_counter() - t0
+        finally:
+            pipe.close()
+        if i == 0:
+            log(f"    supervised compile+first: {sec:.2f}s")
+        else:
+            best = min(best, sec)
+    emit(f"resilience/supervised{C}", C * n * n, steps, best, grid=n,
+         eps=8, cases=C, depth=D)
+
+    # chaos half: deterministic mid-stream faults over the warmed engine
+    plan = os.environ.get("BT_FAULT_PLAN", "raise@1,stall@3,nan@5")
+    wall, results, rep = serve_chaos(engine, cases, D, plan,
+                                     fetch_deadline_ms=2000.0)
+    res = rep.resilience()
+    served = [(i, r) for i, r in enumerate(results) if r is not None]
+    ident = all(np.array_equal(r, offline[i]) for i, r in served)
+    emit(f"resilience/chaos{C}", len(served) * n * n, steps, wall, grid=n,
+         eps=8, cases=C, depth=D, fault_plan=plan, served=len(served),
+         poison=len(res["quarantined"]),
+         fallback_chunks=res["fallback_chunks"],
+         retries_total=res["retries"],
+         breaker_transitions=res["breaker"]["transition_count"],
+         bit_identical=bool(ident))
+
+
 BENCHES = {
     "methods2d": bench_methods2d,
     "small2d": bench_small2d,
@@ -732,6 +803,7 @@ BENCHES = {
     "autotune": bench_autotune,
     "ensemble": bench_ensemble,
     "serve": bench_serve,
+    "resilience": bench_resilience,
 }
 
 
@@ -750,6 +822,10 @@ def main() -> int:
     # stay mutually comparable; bench.py measures the donating
     # production default)
     os.environ["NLHEAT_DONATE"] = "0"
+    # a fault plan leaked from a chaos shell must not inject failures
+    # into evidence rows; the resilience group injects its own plan
+    # explicitly (BT_FAULT_PLAN)
+    os.environ.pop("NLHEAT_FAULT_PLAN", None)
     steps = int(os.environ.get("BT_STEPS", 20))
     names = [a for a in sys.argv[1:] if not a.startswith("-")] or list(BENCHES)
     log(f"backend={jax.default_backend()} devices={len(jax.devices())} "

@@ -98,7 +98,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 #   beyond  : tm sweep, stretch8192 (compile headroom), remaining
 #             tables, profile
 STEPS="bench4096 resident512 carried4096 superstep2 \
-bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 \
+bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 servefault8x1024 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -180,6 +180,18 @@ run_step_cmd() {  # the queue's one name->command map
       # bank the step.  Short-window class: one compile, two schedules.
       bench_nofb BENCH_SERVE=4 BENCH_GRID="${OPP_GRID_ENS:-1024}" \
         BENCH_LADDER="${OPP_GRID_ENS:-1024}" BENCH_ACCURACY=0 ;;
+    servefault8x1024)
+      # chaos A/B (ISSUE 4): the pipelined serve schedule with a
+      # deterministic mid-stream fault injected (raise at dispatch 1,
+      # twice — the attempt AND its first retry fail, so the supervised
+      # retry, the first-failure breaker, and the CPU-fallback route all
+      # demonstrably engage on real hardware).  Gate (step_variant_ok):
+      # every non-poison request served ("served": 8, "poison": 0) and
+      # "fallback_chunks" >= 1 in the JSON — a run where the machinery
+      # silently degraded cannot bank the step.
+      bench_nofb BENCH_SERVE=4 BENCH_SERVE_FAULTS="raise@1x2" \
+        BENCH_GRID="${OPP_GRID_ENS:-1024}" \
+        BENCH_LADDER="${OPP_GRID_ENS:-1024}" BENCH_ACCURACY=0 ;;
     superstep2-tm128)
       bench_nofb BENCH_SUPERSTEP=2 NLHEAT_TM=128 BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
@@ -200,7 +212,7 @@ run_step_cmd() {  # the queue's one name->command map
       # guard the wildcard: an unknown group must fail instantly (the old
       # '*' branch behavior), not burn a heal window on re-gate + strikes
       case " methods2d small2d dist2d scaling 3d unstructured \
-unstructured3d elastic elastic-general eps-sweep " in
+unstructured3d elastic elastic-general eps-sweep resilience " in
         *" ${1#table-} "*) ;;
         *) log "unknown step $1"; return 2 ;;
       esac
@@ -270,6 +282,10 @@ PYEOF
     serve8x1024)
       grep -q '"variant": "serve4"' "$2" \
         && grep -q '"fence_amortization"' "$2" ;;
+    servefault8x1024)
+      grep -q '"variant": "servefault4"' "$2" \
+        && grep -q '"served": 8' "$2" && grep -q '"poison": 0' "$2" \
+        && grep -Eq '"fallback_chunks": [1-9]' "$2" ;;
     superstep2-tm128)
       grep -q '"variant": "superstep2"' "$2" && grep -q '"tm": 128' "$2" ;;
     superstep3-tm96)

@@ -75,6 +75,14 @@ labeled "variant": "servefaultD" and carries "served"/"poison"/
 servefault queue step can gate on all-non-poison-served +
 fallback_chunks >= 1; a leaked ambient NLHEAT_FAULT_PLAN is scrubbed
 — only this knob injects faults into a bench run),
+BENCH_TRACE (with BENCH_SERVE=D: the observability A/B — the SAME
+pipelined schedule timed with the obs/ span tracer off vs installed;
+the rung is labeled "variant": "serveobsD" and carries
+"trace_overhead" = traced/untraced wall ratio (the ISSUE 5 gate:
+<= 1.05 on the serve proxy) and "spans" = lifetime span count; set it
+to a DIRECTORY path (anything other than "1") to also write the
+Perfetto-loadable host_trace.json artifact there, its path echoed in
+"trace_path"),
 BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
@@ -282,11 +290,13 @@ class Best:
             **({"cases*points*steps/s": rung["cases*points*steps/s"]}
                if "cases*points*steps/s" in rung else {}),
             # serve rungs: the pipelined-vs-fenced evidence fields, plus
-            # the servefault chaos rung's resilience evidence
+            # the servefault chaos rung's resilience evidence and the
+            # serveobs rung's tracing-overhead evidence
             **{k: rung[k] for k in
                ("fence_amortization", "latency_ms", "occupancy",
                 "served", "poison", "fallback_chunks", "retries_total",
-                "fault_plan", "breaker_transitions")
+                "fault_plan", "breaker_transitions",
+                "trace_overhead", "spans", "trace_path")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -892,6 +902,58 @@ def child_measure():
                         fault_plan=plan_spec,
                         breaker_transitions=res["breaker"][
                             "transition_count"],
+                    )
+                    last_op = op
+                    any_rung = True
+                    continue
+                trace_knob = os.environ.get("BENCH_TRACE")
+                if trace_knob:
+                    # observability A/B: same pipelined schedule, tracer
+                    # off vs installed (obs/trace.py) — the ratio is the
+                    # host-side span-recording cost, gated <= 1.05 by
+                    # the obs queue step / bench_table obs group
+                    from nonlocalheatequation_tpu.serve.server import (
+                        serve_traced_ab,
+                    )
+
+                    # the overhead ratio divides two near-equal walls:
+                    # min-of-N with more iters steadies it on small
+                    # (CPU-proxy) workloads; the TPU workload is large
+                    # enough that the default converges
+                    compile_s, plain_best, traced_best, tracer, rep = \
+                        serve_traced_ab(engine, cases, srv,
+                                        iters=int(os.environ.get(
+                                            "BENCH_TRACE_ITERS", 3)))
+                    overhead = traced_best / plain_best
+                    log(f"rung {grid}^2 obs: untraced "
+                        f"{plain_best * 1e3:.1f} ms vs traced "
+                        f"{traced_best * 1e3:.1f} ms "
+                        f"({overhead:.3f}x, {tracer.spans_total} spans)")
+                    extra = {}
+                    if trace_knob != "1":
+                        try:
+                            os.makedirs(trace_knob, exist_ok=True)
+                            path = os.path.join(trace_knob,
+                                                "host_trace.json")
+                            if tracer.write(path):
+                                extra["trace_path"] = path
+                        except OSError as e:
+                            log(f"BENCH_TRACE dir {trace_knob!r} "
+                                f"unusable ({e}); artifact skipped")
+                    value = C * grid * grid * steps / traced_best
+                    event(
+                        event="rung",
+                        grid=grid,
+                        steps=steps,
+                        best_s=traced_best,
+                        ms_per_step=traced_best / steps * 1e3,
+                        value=value,
+                        compile_s=round(compile_s, 3),
+                        variant=f"serveobs{srv}",
+                        cases=C,
+                        trace_overhead=round(overhead, 4),
+                        spans=tracer.spans_total,
+                        **extra,
                     )
                     last_op = op
                     any_rung = True

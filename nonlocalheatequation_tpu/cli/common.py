@@ -4,7 +4,9 @@ version banner (Config.h parity, Config.h.in:11-13)."""
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
+import socket
 import sys
 
 
@@ -213,6 +215,222 @@ def add_ensemble_flag(p: argparse.ArgumentParser):
     )
 
 
+def add_obs_flags(p: argparse.ArgumentParser):
+    """The obs/ surface shared by the solve CLIs (docs/architecture.md
+    "Observability"): one trace directory, one metrics file, one scrape
+    port.  All three are opt-in; with none given the observability
+    subsystem stays on its zero-cost disabled path."""
+    p.add_argument(
+        "--trace",
+        default=None,
+        metavar="DIR",
+        help="capture the host-side span timeline (obs/trace.py) AND a "
+             "jax.profiler device capture into DIR — DIR/host_trace.json "
+             "plus the profiler's plugins/ tree load side by side in "
+             "ui.perfetto.dev (ambient NLHEAT_TRACE=DIR does the same)",
+    )
+    p.add_argument(
+        "--metrics-out",
+        dest="metrics_out",
+        default=None,
+        metavar="FILE",
+        help="atomically write the run's metrics JSON to FILE on exit "
+             "(the same one-line dump --serve/--ensemble print to "
+             "stderr; the obs registry snapshot otherwise); an "
+             "unwritable path refuses loudly before the solve starts",
+    )
+    p.add_argument(
+        "--metrics-port",
+        dest="metrics_port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve Prometheus text at 127.0.0.1:PORT/metrics and the "
+             "one-line JSON snapshot at /metrics.json while the run is "
+             "live (PORT 0 picks a free port, printed to stderr); bound "
+             "to the serving pipeline's registry during --serve",
+    )
+
+
+def validate_obs_args(args) -> str | None:
+    """The obs flags' honesty checks (caller prints + exits 1).  The
+    --metrics-out probe runs BEFORE the solve: a typo'd path must refuse
+    up front, not discard an hour of work at the final write."""
+    port = getattr(args, "metrics_port", None)
+    if port is not None and not 0 <= port <= 65535:
+        return f"--metrics-port must be in [0, 65535] (got {port})"
+    path = getattr(args, "metrics_out", None)
+    if path:
+        if os.path.isdir(path):
+            # a sibling probe would pass but the final os.replace onto a
+            # directory cannot — refuse now, not after the solve
+            return f"--metrics-out {path!r} is a directory, not a file"
+        # same-directory probe, the tmp naming discipline of
+        # utils/checkpoint.atomic_file (the final write reuses it) —
+        # hostname included so ranks on different hosts sharing a
+        # filesystem (and possibly a pid) never unlink each other's probe
+        probe = f"{path}.tmp.probe.{socket.gethostname()}.{os.getpid()}"
+        try:
+            with open(probe, "w"):
+                pass
+            os.unlink(probe)
+        except OSError as e:
+            return f"--metrics-out {path!r} is not writable: {e}"
+    if (getattr(args, "trace", None) or os.environ.get("NLHEAT_TRACE")) \
+            and getattr(args, "profile", None):
+        # jax.profiler cannot nest: obs_session's --trace capture would
+        # silently swallow the --profile one.  --trace DIR already
+        # contains the device capture; asking for both is a conflict.
+        return ("--trace already captures the jax.profiler device "
+                "timeline into its directory; drop --profile (or use "
+                "--profile alone for a device-only capture)")
+    return None
+
+
+#: Holders obs_session reads at exit: the --metrics-out payload a batch
+#: driver recorded (serve_batch / the --ensemble closures), and the live
+#: registry the --metrics-port endpoint follows while a pipeline runs.
+_metrics_payload: list = [None]
+_live_registry: list = [None]
+
+
+def set_metrics_payload(line: str) -> None:
+    """Record the metrics JSON --metrics-out should persist (the same
+    line the batch drivers print to stderr)."""
+    _metrics_payload[0] = line
+
+
+def set_live_registry(registry) -> None:
+    """Point the --metrics-port scrape endpoint at a live registry (the
+    serving pipeline's / the ensemble report's own backing store, so a
+    scrape mid-run and the final dump agree by construction)."""
+    _live_registry[0] = registry
+
+
+def _scrape_registry():
+    if _live_registry[0] is not None:
+        return _live_registry[0]
+    from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+
+    return REGISTRY
+
+
+def publish_solve_metrics(tag: str, elapsed_s: float, points: int,
+                          steps: int, error_l2=None) -> None:
+    """Mirror one solo solve's outcome into the process registry
+    (``/solve{tag}/...`` gauges) so --metrics-out and --metrics-port
+    expose something meaningful on non-batch runs too.  Observability:
+    never raises."""
+    try:
+        from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+
+        REGISTRY.gauge(f"/solve{{{tag}}}/elapsed-s").set(round(elapsed_s, 6))
+        REGISTRY.gauge(f"/solve{{{tag}}}/points").set(int(points))
+        REGISTRY.gauge(f"/solve{{{tag}}}/steps").set(int(steps))
+        if error_l2 is not None:
+            REGISTRY.gauge(f"/solve{{{tag}}}/error-l2").set(float(error_l2))
+    except Exception:  # noqa: BLE001 — observability never raises
+        pass
+
+
+@contextlib.contextmanager
+def obs_session(args):
+    """The observability lifecycle shared by the solve CLIs (obs/):
+    install the span tracer and the jax.profiler capture under one
+    ``--trace DIR``, start the ``--metrics-port`` scrape endpoint, and
+    persist ``--metrics-out`` atomically on the way out.
+
+    Composition contract (ISSUE 5): ``--trace DIR`` captures BOTH
+    timelines into the same directory — the host-side spans as
+    ``DIR/host_trace.json`` (written here on exit) and the device-side
+    ``jax.profiler`` tree (utils/profiling.py starts/stops it around the
+    body) — so one Perfetto session shows dispatch scheduling above the
+    per-op device timeline.  Everything in here obeys the obs contract:
+    a failed trace write or a dead scrape endpoint never fails the
+    solve; only the --metrics-out write the user explicitly asked for
+    exits non-zero when it cannot land."""
+    from nonlocalheatequation_tpu.obs import trace as obs_trace
+    from nonlocalheatequation_tpu.utils import profiling
+
+    trace_dir = (getattr(args, "trace", None)
+                 or os.environ.get("NLHEAT_TRACE") or None)
+    _metrics_payload[0] = None
+    _live_registry[0] = None
+    tracer = prev = server = None
+    if trace_dir:
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+        except OSError as e:
+            print(f"[obs] --trace {trace_dir!r} cannot be created ({e}); "
+                  "tracing disabled", file=sys.stderr)
+            trace_dir = None
+        else:
+            tracer = obs_trace.Tracer()
+            prev = obs_trace.set_tracer(tracer)
+    port = getattr(args, "metrics_port", None)
+    if port is not None:
+        try:
+            from nonlocalheatequation_tpu.obs.export import serve_metrics
+
+            server = serve_metrics(port, _scrape_registry)
+            print(f"metrics: http://127.0.0.1:{server.port}/metrics "
+                  "(Prometheus) and /metrics.json", file=sys.stderr)
+        except OSError as e:
+            print(f"[obs] --metrics-port {port} cannot bind ({e}); "
+                  "scrape endpoint disabled", file=sys.stderr)
+    body_raised = False
+    try:
+        with profiling.trace(trace_dir):
+            yield
+    except BaseException:
+        body_raised = True
+        raise
+    finally:
+        if tracer is not None:
+            obs_trace.set_tracer(prev)
+            name = "host_trace.json"
+            try:
+                # a non-zero rank in a multi-process run gets its own
+                # file — concurrent ranks must not clobber rank 0's
+                # artifact (jax is already imported by the solve body;
+                # single-process process_index() is 0, keeping the
+                # stable name the tools/tests gate on)
+                import jax
+
+                if jax.process_index():
+                    name = f"host_trace.rank{jax.process_index()}.json"
+            except Exception:  # noqa: BLE001 — obs never fails the solve
+                pass
+            out = os.path.join(trace_dir, name)
+            if tracer.write(out):
+                print(f"trace: {len(tracer)} spans "
+                      f"({tracer.spans_total} lifetime) -> {out}",
+                      file=sys.stderr)
+        if server is not None:
+            server.close()
+        path = getattr(args, "metrics_out", None)
+        if path:
+            payload = _metrics_payload[0]
+            if payload is None:
+                payload = _scrape_registry().snapshot_json()
+            from nonlocalheatequation_tpu.utils.checkpoint import (
+                atomic_write_text,
+            )
+
+            try:
+                atomic_write_text(path, payload + "\n")
+                print(f"metrics written to {path}", file=sys.stderr)
+            except OSError as e:
+                # validated up front, so this is a mid-run filesystem
+                # change — still refuse loudly, the user asked for it;
+                # but never let this finally-block exit MASK an
+                # exception already propagating out of the solve body
+                print(f"--metrics-out {path!r} failed: {e}",
+                      file=sys.stderr)
+                if not body_raised:
+                    raise SystemExit(1) from None
+
+
 def iter_batch_cases(read_case, row_tokens, stream=None):
     """Incremental batch_tester intake: yield cases AS LINES ARRIVE.
 
@@ -356,7 +574,12 @@ def serve_batch(case_iter, make_solver, engine_kwargs, args):
     test (error inf) instead of killing the batch — the whole point of
     the fault-tolerance layer.  Prints the pipeline summary and the
     one-line JSON metrics dump (failure telemetry included) to stderr.
-    Returns ``[(error_l2, n)]`` in submission order."""
+    Observability (obs/): the pipeline's registry backs the
+    --metrics-port endpoint while the run is live and the final
+    ``metrics_json()`` line becomes the --metrics-out payload (a
+    ``--profile DIR`` jax.profiler capture wraps the whole batch in
+    :func:`run_batch`, this driver included).  Returns
+    ``[(error_l2, n)]`` in submission order."""
     import numpy as np
 
     from nonlocalheatequation_tpu.serve.server import ServePipeline
@@ -367,6 +590,7 @@ def serve_batch(case_iter, make_solver, engine_kwargs, args):
                        fetch_deadline_ms=args.serve_deadline_ms or None,
                        nan_policy=args.serve_nan_policy,
                        **engine_kwargs) as pipe:
+        set_live_registry(pipe.registry)
         pairs = []
         for row in case_iter:
             s = make_solver(*row)
@@ -374,7 +598,9 @@ def serve_batch(case_iter, make_solver, engine_kwargs, args):
             pairs.append((s, pipe.submit(s.ensemble_case())))
         pipe.drain()
         print(f"serve: {pipe.report.summary()}", file=sys.stderr)
-        print(pipe.metrics_json(), file=sys.stderr)
+        line = pipe.metrics_json()
+        print(line, file=sys.stderr)
+        set_metrics_payload(line)
         out = []
         for s, h in pairs:
             if h.error is not None:
@@ -459,8 +685,23 @@ def parse_batch_cases(read_case, tokens, row_tokens=None):
     return cases
 
 
+def _publish_batch_metrics(cases_n: int, failed: bool) -> None:
+    """Mirror the batch verdict into the process registry so
+    --metrics-out has a payload even on the sequential path (the
+    serve/ensemble drivers record their full report instead).  Never
+    raises."""
+    try:
+        from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+
+        REGISTRY.gauge("/batch/cases").set(int(cases_n))
+        REGISTRY.gauge("/batch/failed").set(int(failed))
+    except Exception:  # noqa: BLE001 — observability never raises
+        pass
+
+
 def run_batch(read_case, run_case, threshold=1e-6, multi=False,
-              row_tokens=None, run_ensemble=None, run_serve=None):
+              row_tokens=None, run_ensemble=None, run_serve=None,
+              profile=None):
     """The reference's batch_tester protocol (1d_nonlocal_serial.cpp:239-266):
     stdin = num_tests then one parameter row per test; prints "Tests Passed"
     or "Tests Failed" (the ctest pass/fail regex).
@@ -481,15 +722,22 @@ def run_batch(read_case, run_case, threshold=1e-6, multi=False,
     launch (``multi=True``) the stdin rules apply: tty refusal, and the
     token stream must be identical on every rank — which requires the
     whole stream up front, so streaming modes refuse multi-process runs.
+    With ``profile`` (a directory) the whole batch — sequential,
+    ensemble, and served alike — runs under a ``jax.profiler`` capture
+    (utils/profiling.py; the bugfix for --profile being solo-path-only).
     """
+    from nonlocalheatequation_tpu.utils.profiling import trace
+
     guard_multihost_stdin(multi)
     if run_serve is not None:
         if multi:
             raise SystemExit(
                 "--serve streams stdin incrementally and cannot verify "
                 "rank-identical input; run serving single-process")
-        results = run_serve(iter_batch_cases(read_case, row_tokens))
+        with trace(profile):
+            results = run_serve(iter_batch_cases(read_case, row_tokens))
         failed = any(error_l2 / n > threshold for error_l2, n in results)
+        _publish_batch_metrics(len(results), failed)
         print("Tests Failed" if failed else "Tests Passed")
         return 1 if failed else 0
     if multi or row_tokens is None:
@@ -509,15 +757,17 @@ def run_batch(read_case, run_case, threshold=1e-6, multi=False,
         # whole iterator first preserves the validate-every-row-before-
         # any-solve-runs contract of parse_batch_cases
         cases = list(iter_batch_cases(read_case, row_tokens))
-    if run_ensemble is not None:
-        failed = any(error_l2 / n > threshold
-                     for error_l2, n in run_ensemble(cases))
-    else:
-        failed = False
-        for case in cases:
-            error_l2, n = run_case(case)
-            if error_l2 / n > threshold:
-                failed = True
-                break
+    with trace(profile):
+        if run_ensemble is not None:
+            failed = any(error_l2 / n > threshold
+                         for error_l2, n in run_ensemble(cases))
+        else:
+            failed = False
+            for case in cases:
+                error_l2, n = run_case(case)
+                if error_l2 / n > threshold:
+                    failed = True
+                    break
+    _publish_batch_metrics(len(cases), failed)
     print("Tests Failed" if failed else "Tests Passed")
     return 1 if failed else 0

@@ -11,13 +11,19 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
+    add_obs_flags,
     add_platform_flags,
     add_precision_flags,
     add_serve_flags,
     apply_platform,
     bool_flag,
+    obs_session,
+    publish_solve_metrics,
     run_batch,
     serve_batch,
+    set_live_registry,
+    set_metrics_payload,
+    validate_obs_args,
     validate_serve_args,
     version_banner,
 )
@@ -42,10 +48,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", default="jit", choices=("oracle", "jit"))
     p.add_argument("--log", action="store_true",
                    help="write csv/vtu logs every nlog steps")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the solve into DIR")
     add_platform_flags(p)
     add_precision_flags(p)
     add_ensemble_flag(p)
     add_serve_flags(p)
+    add_obs_flags(p)
     return p
 
 
@@ -67,13 +76,18 @@ def main(argv=None) -> int:
         print("--resync is not supported with --ensemble (the batched "
               "paths have no per-step precision switch)", file=sys.stderr)
         return 1
-    err = validate_serve_args(args)
+    err = validate_serve_args(args) or validate_obs_args(args)
     if err:
         print(err, file=sys.stderr)
         return 1
     version_banner("1d_nonlocal")
     apply_platform(args)
 
+    with obs_session(args):
+        return _run(args)
+
+
+def _run(args) -> int:
     if args.test_batch:
         # row: nx nt eps k dt dx  (tests/1d.txt)
         def read_case(toks, pos):
@@ -101,9 +115,11 @@ def main(argv=None) -> int:
                     s.test_init()
                     solvers.append(s)
                 engine = EnsembleEngine(precision=args.precision)
+                set_live_registry(engine.report.registry)
                 states = engine.run([s.ensemble_case() for s in solvers])
                 print(f"ensemble: {engine.report.summary()}",
                       file=sys.stderr)
+                set_metrics_payload(engine.report.metrics_json())
                 out = []
                 for s, u in zip(solvers, states):
                     s.u = u
@@ -120,7 +136,8 @@ def main(argv=None) -> int:
                     args)
 
         return run_batch(read_case, run_case, row_tokens=6,
-                         run_ensemble=run_ensemble, run_serve=run_serve)
+                         run_ensemble=run_ensemble, run_serve=run_serve,
+                         profile=args.profile)
 
     s = make_solver(args, args.nx, args.nt, args.eps, args.k, args.dt, args.dx)
     if args.log:
@@ -133,9 +150,14 @@ def main(argv=None) -> int:
     else:
         s.input_init(np.array(sys.stdin.read().split(), dtype=np.float64)[: args.nx])
 
+    from nonlocalheatequation_tpu.utils.profiling import trace
+
     t0 = time.perf_counter()
-    u = s.do_work()
+    with trace(args.profile):
+        u = s.do_work()
     elapsed = time.perf_counter() - t0
+    publish_solve_metrics("1d", elapsed, args.nx, args.nt,
+                          error_l2=s.error_l2 if args.test else None)
 
     if args.test:
         s.print_error(args.cmp)

@@ -12,13 +12,19 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
+    add_obs_flags,
     add_platform_flags,
     add_precision_flags,
     add_serve_flags,
     apply_platform,
     bool_flag,
+    obs_session,
+    publish_solve_metrics,
     run_batch,
     serve_batch,
+    set_live_registry,
+    set_metrics_payload,
+    validate_obs_args,
     validate_serve_args,
     version_banner,
 )
@@ -55,6 +61,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_precision_flags(p)
     add_ensemble_flag(p)
     add_serve_flags(p)
+    add_obs_flags(p)
     return p
 
 
@@ -80,15 +87,21 @@ def main(argv=None) -> int:
               "sequential batch, or --precision bf16 without --resync",
               file=sys.stderr)
         return 1
-    err = validate_serve_args(args, [
+    err = (validate_serve_args(args, [
         (args.serve and (args.checkpoint or args.resume),
          "--checkpoint/--resume cannot be combined with --serve")])
+        or validate_obs_args(args))
     if err:
         print(err, file=sys.stderr)
         return 1
     version_banner("2d_nonlocal")
     apply_platform(args)
 
+    with obs_session(args):
+        return _run(args)
+
+
+def _run(args) -> int:
     from nonlocalheatequation_tpu.models.solver2d import Solver2D
 
     def make_solver(nx, ny, nt, eps, k, dt, dh):
@@ -127,9 +140,11 @@ def main(argv=None) -> int:
                     solvers.append(s)
                 engine = EnsembleEngine(method=args.method,
                                         precision=args.precision)
+                set_live_registry(engine.report.registry)
                 states = engine.run([s.ensemble_case() for s in solvers])
                 print(f"ensemble: {engine.report.summary()}",
                       file=sys.stderr)
+                set_metrics_payload(engine.report.metrics_json())
                 out = []
                 for s, u in zip(solvers, states):
                     s.u = u
@@ -146,7 +161,8 @@ def main(argv=None) -> int:
                     args)
 
         return run_batch(read_case, run_case, row_tokens=7,
-                         run_ensemble=run_ensemble, run_serve=run_serve)
+                         run_ensemble=run_ensemble, run_serve=run_serve,
+                         profile=args.profile)
 
     s = make_solver(args.nx, args.ny, args.nt, args.eps, args.k, args.dt, args.dh)
     if args.log:
@@ -169,6 +185,8 @@ def main(argv=None) -> int:
     with trace(args.profile):
         s.do_work()
     elapsed = time.perf_counter() - t0
+    publish_solve_metrics("2d", elapsed, args.nx * args.ny, args.nt,
+                          error_l2=s.error_l2 if args.test else None)
 
     if args.test:
         s.print_error(args.cmp)

@@ -15,6 +15,7 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
+    add_obs_flags,
     add_platform_flags,
     add_precision_flags,
     add_serve_flags,
@@ -22,8 +23,13 @@ from nonlocalheatequation_tpu.cli.common import (
     check_same_input_state,
     cli_startup,
     guard_multihost_stdin,
+    obs_session,
+    publish_solve_metrics,
     run_batch,
     serve_batch,
+    set_live_registry,
+    set_metrics_payload,
+    validate_obs_args,
     validate_serve_args,
 )
 
@@ -57,10 +63,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="steps between checkpoints (0 = never)")
     p.add_argument("--resume", action="store_true",
                    help="resume from the --checkpoint file before running")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the solve into DIR")
     add_platform_flags(p)
     add_precision_flags(p)
     add_ensemble_flag(p)
     add_serve_flags(p)
+    add_obs_flags(p)
     return p
 
 
@@ -100,10 +109,11 @@ def main(argv=None) -> int:
         print("--ensemble runs the serial batched engine; it cannot be "
               "combined with --distributed or --resync", file=sys.stderr)
         return 1
-    err = validate_serve_args(args, [
+    err = (validate_serve_args(args, [
         (args.serve and args.distributed,
          "--serve runs the serial batched engine; it cannot be combined "
          "with --distributed")])
+        or validate_obs_args(args))
     if err:
         print(err, file=sys.stderr)
         return 1
@@ -118,6 +128,11 @@ def main(argv=None) -> int:
 
     multi = cli_startup(args, "3d_nonlocal", validate_multi=_need_distributed)
 
+    with obs_session(args):
+        return _run(args, multi)
+
+
+def _run(args, multi: bool) -> int:
     from nonlocalheatequation_tpu.models.solver3d import Solver3D
 
     def make_solver(nx, ny, nz, nt, eps, k, dt, dh):
@@ -167,9 +182,11 @@ def main(argv=None) -> int:
                     solvers.append(s)
                 engine = EnsembleEngine(method=args.method,
                                         precision=args.precision)
+                set_live_registry(engine.report.registry)
                 states = engine.run([s.ensemble_case() for s in solvers])
                 print(f"ensemble: {engine.report.summary()}",
                       file=sys.stderr)
+                set_metrics_payload(engine.report.metrics_json())
                 out = []
                 for s, u in zip(solvers, states):
                     s.u = u
@@ -186,7 +203,8 @@ def main(argv=None) -> int:
                     args)
 
         return run_batch(read_case, run_case, multi=multi, row_tokens=8,
-                         run_ensemble=run_ensemble, run_serve=run_serve)
+                         run_ensemble=run_ensemble, run_serve=run_serve,
+                         profile=args.profile)
 
     s = make_solver(args.nx, args.ny, args.nz, args.nt, args.eps, args.k,
                     args.dt, args.dh)
@@ -200,9 +218,14 @@ def main(argv=None) -> int:
     if args.resume:
         s.resume(args.checkpoint)
 
+    from nonlocalheatequation_tpu.utils.profiling import trace
+
     t0 = time.perf_counter()
-    s.do_work()
+    with trace(args.profile):
+        s.do_work()
     elapsed = time.perf_counter() - t0
+    publish_solve_metrics("3d", elapsed, args.nx * args.ny * args.nz,
+                          args.nt, error_l2=s.error_l2 if args.test else None)
 
     if args.test:
         s.print_error(args.cmp)

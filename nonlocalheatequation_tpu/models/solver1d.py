@@ -15,6 +15,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.ops.nonlocal_op import (
     NonlocalOp1D,
     make_step_fn,
@@ -75,32 +76,37 @@ class Solver1D:
         else:
             g = lg = None
 
-        if self.backend == "oracle":
-            u = self.u0.copy()
-            for t in range(self.nt):
-                du = self.op.apply_np(u)
-                if self.test:
-                    du = du + source_at(g, lg, t, self.op.dt)
-                u = u + self.op.dt * du
-                if t % self.nlog == 0 and self.logger is not None:
-                    self.logger(t, u)
-        else:
-            dtype = self.dtype or (
-                jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-            )
-            u = jnp.asarray(self.u0, dtype)
-            if self.logger is None:
-                from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn
-
-                multi = make_multi_step_fn(self.op, self.nt, g, lg, dtype)
-                u = np.asarray(multi(u, 0))
-            else:
-                step = jax.jit(make_step_fn(self.op, g, lg, dtype))
+        with obs_trace.span("solver.do_work", cat="solver",
+                            shape=str(self.nx), steps=self.nt,
+                            backend=self.backend):
+            if self.backend == "oracle":
+                u = self.u0.copy()
                 for t in range(self.nt):
-                    u = step(u, t)
+                    du = self.op.apply_np(u)
+                    if self.test:
+                        du = du + source_at(g, lg, t, self.op.dt)
+                    u = u + self.op.dt * du
                     if t % self.nlog == 0 and self.logger is not None:
-                        self.logger(t, np.asarray(u))
-                u = np.asarray(u)
+                        self.logger(t, u)
+            else:
+                dtype = self.dtype or (
+                    jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+                )
+                u = jnp.asarray(self.u0, dtype)
+                if self.logger is None:
+                    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+                        make_multi_step_fn,
+                    )
+
+                    multi = make_multi_step_fn(self.op, self.nt, g, lg, dtype)
+                    u = np.asarray(multi(u, 0))
+                else:
+                    step = jax.jit(make_step_fn(self.op, g, lg, dtype))
+                    for t in range(self.nt):
+                        u = step(u, t)
+                        if t % self.nlog == 0 and self.logger is not None:
+                            self.logger(t, np.asarray(u))
+                    u = np.asarray(u)
 
         self.u = u
         if self.test:

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
+from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.ops.nonlocal_op import (
     NonlocalOp2D,
     make_multi_step_fn,
@@ -103,10 +104,13 @@ class Solver2D(CheckpointMixin, ManufacturedMetrics2D):
     def do_work(self) -> np.ndarray:
         g, lg = self.op.source_parts(self.nx, self.ny) if self.test else (None, None)
 
-        if self.backend == "oracle":
-            u = self._run_oracle(g, lg)
-        else:
-            u = self._run_jit(g, lg)
+        with obs_trace.span("solver.do_work", cat="solver",
+                            shape=f"{self.nx}x{self.ny}",
+                            steps=self.nt - self.t0, backend=self.backend):
+            if self.backend == "oracle":
+                u = self._run_oracle(g, lg)
+            else:
+                u = self._run_jit(g, lg)
 
         self.u = u
         if self.test:

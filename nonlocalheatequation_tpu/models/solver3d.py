@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
+from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D, source_at
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
 
@@ -88,18 +89,21 @@ class Solver3D(CheckpointMixin, ManufacturedMetrics2D):
         else:
             g = lg = None
 
-        if self.backend == "oracle":
-            u = self.u0.copy()
-            for t in range(self.t0, self.nt):
-                du = self.op.apply_np(u)
-                if self.test:
-                    du = du + source_at(g, lg, t, self.op.dt)
-                u = u + self.op.dt * du
-                if t % self.nlog == 0 and self.logger is not None:
-                    self.logger(t, u)
-                self._maybe_checkpoint(t, u)
-        else:
-            u = self._run_jit(g, lg)
+        with obs_trace.span("solver.do_work", cat="solver",
+                            shape=f"{self.nx}x{self.ny}x{self.nz}",
+                            steps=self.nt - self.t0, backend=self.backend):
+            if self.backend == "oracle":
+                u = self.u0.copy()
+                for t in range(self.t0, self.nt):
+                    du = self.op.apply_np(u)
+                    if self.test:
+                        du = du + source_at(g, lg, t, self.op.dt)
+                    u = u + self.op.dt * du
+                    if t % self.nlog == 0 and self.logger is not None:
+                        self.logger(t, u)
+                    self._maybe_checkpoint(t, u)
+            else:
+                u = self._run_jit(g, lg)
 
         self.u = u
         if self.test:

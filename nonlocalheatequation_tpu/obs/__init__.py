@@ -1,0 +1,45 @@
+"""Unified observability: span tracer, counter registry, exporters.
+
+The reference's observability is HPX's: performance counters in one
+hierarchical namespace (``/threads{locality#0/total}/idle-rate``,
+src/2d_nonlocal_distributed.cpp:112-128) driving the load balancer, and
+wall-clock CSV around ``do_work``.  This package is the TPU framework's
+equivalent, grown past fragments (per-report counters, stderr dumps, a
+bare ``jax.profiler`` wrapper) into one subsystem:
+
+* ``obs/trace.py`` — a thread-safe, BOUNDED (ring-buffer) span tracer
+  with an injectable clock, exporting Chrome trace-event JSON loadable
+  in Perfetto; spans cover the serving pipeline's stages, the ensemble
+  engine's chunk lifecycle, solver step batches, checkpoint save/load,
+  and autotune probes.  The CLI ``--trace DIR`` flag captures it next
+  to the ``jax.profiler`` device timeline (utils/profiling.py).
+* ``obs/metrics.py`` — a counter/gauge/histogram registry with
+  HPX-style names (``/serve/retries``, ``/device{3}/busy-rate``) that
+  is the single BACKING STORE for ``ServeReport``/``EnsembleReport``
+  (their fields are properties over registry metrics), the
+  load-balance busy rates, and the resilience telemetry — with
+  Prometheus text exposition and a one-line JSON snapshot.
+* ``obs/export.py`` — the opt-in scrape endpoint (``--metrics-port``)
+  and the ``NLHEAT_EVENT_LOG`` JSONL event stream.
+
+Contract everywhere: observability never raises, never adds a fence or
+device sync (host-side timestamps only; fetch timings come from fences
+the pipeline already performs), memory is bounded, and the disabled
+path is zero-cost (pinned by PR 3's fence-discipline spy test running
+untouched with tracing off).
+"""
+
+from nonlocalheatequation_tpu.obs.export import (  # noqa: F401
+    EventLog,
+    serve_metrics,
+)
+from nonlocalheatequation_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY,
+    MetricsRegistry,
+)
+from nonlocalheatequation_tpu.obs.trace import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    set_tracer,
+    span,
+)

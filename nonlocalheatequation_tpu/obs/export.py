@@ -1,0 +1,129 @@
+"""Exporters: a scrape endpoint and a JSONL event log.
+
+The registry (obs/metrics.py) and tracer (obs/trace.py) hold telemetry
+in process; this module moves it OUT:
+
+* :func:`serve_metrics` — an opt-in stdlib-HTTP endpoint (the CLIs'
+  ``--metrics-port``) answering ``/metrics`` with the Prometheus text
+  exposition and ``/metrics.json`` with the one-line JSON snapshot, on
+  127.0.0.1 only (telemetry, not an API; a scraper runs on the host).
+  The registry argument may be a callable so the endpoint follows a
+  live object — the serve CLIs bind it to the running pipeline's
+  registry, which is ``ServeReport``'s own backing store, so a scrape
+  mid-run and the final ``metrics_json()`` dump agree by construction.
+* :class:`EventLog` — an append-only JSONL stream of discrete events
+  (quarantines, breaker transitions, fallback routes, retired chunks),
+  enabled by ``NLHEAT_EVENT_LOG=PATH``.  Disk-backed, so memory stays
+  bounded no matter how long the server lives.
+
+Both obey the observability contract: never raise past construction,
+never fence, zero cost when off (``EventLog.from_env`` returns None
+when the env var is unset; emitters hold that None and skip one ``if``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+#: Env var naming the JSONL event-log path (scrubbed by tests/conftest.py
+#: — a leaked developer setting must not make the suite write files).
+EVENT_LOG_ENV = "NLHEAT_EVENT_LOG"
+
+
+class EventLog:
+    """Append-only JSONL event stream.  ``emit`` never raises."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        # line-buffered append: events from a crashed run survive
+        self._f = open(path, "a", buffering=1)
+
+    def emit(self, **event) -> None:
+        try:
+            line = json.dumps(event, default=str)
+            with self._lock:
+                self._f.write(line + "\n")
+        except Exception:  # noqa: BLE001 — observability never raises
+            pass
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    @classmethod
+    def from_env(cls, environ=os.environ) -> "EventLog | None":
+        """The opt-in hook: an EventLog when ``NLHEAT_EVENT_LOG`` is set
+        and openable, else None (one loud stderr line on an unopenable
+        path — a typo'd path must not silently drop the telemetry it
+        asked for, and must not kill the run either)."""
+        path = environ.get(EVENT_LOG_ENV)
+        if not path:
+            return None
+        try:
+            return cls(path)
+        except OSError as e:
+            print(f"[obs] {EVENT_LOG_ENV}={path!r} cannot be opened "
+                  f"({e}); event log disabled", file=sys.stderr)
+            return None
+
+
+class MetricsServer:
+    """The ``--metrics-port`` scrape endpoint (127.0.0.1 only)."""
+
+    def __init__(self, port: int, registry):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        get_registry = registry if callable(registry) else (lambda: registry)
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    reg = get_registry()
+                    if self.path.startswith("/metrics.json"):
+                        body = reg.snapshot_json().encode()
+                        ctype = "application/json"
+                    elif self.path.startswith("/metrics"):
+                        body = reg.prometheus().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    else:
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception:  # noqa: BLE001 — a scrape must not kill us
+                    try:
+                        self.send_error(500)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def log_message(self, *a):  # silence per-request stderr chatter
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", int(port)), Handler)
+        self.port = self._httpd.server_address[1]  # resolved (port 0 = any)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="nlheat-metrics")
+        self._thread.start()
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def serve_metrics(port: int, registry) -> MetricsServer:
+    """Start the scrape endpoint; ``registry`` is a MetricsRegistry or a
+    zero-arg callable returning one (a live binding)."""
+    return MetricsServer(port, registry)

@@ -1,0 +1,246 @@
+"""Bounded span tracer exporting Chrome trace-event JSON (Perfetto).
+
+The reference's only timeline is wall-clock CSV around ``do_work``
+(src/2d_nonlocal_distributed.cpp:1390-1395); the framework's device-side
+timeline is the ``jax.profiler`` capture (utils/profiling.py).  This
+module adds the HOST-side timeline between them: named spans around the
+serving pipeline's stages (window close, build/stage/dispatch,
+fence/fetch, retries, bisection, breaker transitions, fallback routes —
+serve/server.py), the ensemble engine's chunk lifecycle, solver
+``do_work`` step batches, checkpoint save/load, and autotune probes —
+exported in the Chrome trace-event format
+(https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU)
+so one file loads in ui.perfetto.dev next to the profiler capture (the
+CLI ``--trace DIR`` flag captures both into the same directory).
+
+Hard rules (the observability contract, docs/architecture.md):
+
+* **never raises** — every record path swallows its own failures;
+* **never fences** — timestamps are host clock reads the instrumented
+  code mostly already makes; fetch spans reuse the fences the pipeline
+  performs anyway (``Tracer.complete`` takes the CALLER's timestamps,
+  so tracing adds no clock reads on timed paths);
+* **bounded** — a ring buffer of ``capacity`` events (oldest evicted),
+  with a lifetime-exact ``spans_total``;
+* **zero-cost when off** — the module-level :func:`span`/:func:`instant`
+  helpers are no-ops (one attribute read) until :func:`set_tracer`
+  installs a tracer, so the disabled path of every instrumented module
+  stays byte-for-byte on its old schedule (PR 3's fence-discipline and
+  bit-identity tests run with tracing off and pass untouched).
+
+The clock is injectable: tests drive a virtual clock and assert golden
+span sequences deterministically (tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from collections import deque
+
+#: Default ring-buffer capacity (events).  At ~6 events per served chunk
+#: this holds hours of serving; the cap is the point — a long-lived
+#: server must not grow host memory with its request count.
+DEFAULT_CAPACITY = 65536
+
+
+class _NullSpan:
+    """The shared no-op context manager the disabled path returns."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+#: Explicit "tracing OFF" sentinel for constructors whose ``tracer=None``
+#: means "inherit the process-global tracer" (serve/server.py
+#: ServePipeline): pass TRACE_OFF to force the untraced path even when a
+#: global tracer is installed — the A/B baseline in serve_traced_ab
+#: must never silently trace both arms.
+TRACE_OFF = _NullSpan()
+
+
+class _Span:
+    """Context manager recording one complete ('X') event on exit."""
+
+    __slots__ = ("_tracer", "_name", "_cat", "_tid", "_args", "_t0")
+
+    def __init__(self, tracer, name, cat, tid, args):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._tid = tid
+        self._args = args
+
+    def __enter__(self):
+        try:
+            self._t0 = self._tracer._clock()
+        except Exception:  # noqa: BLE001 — observability never raises
+            self._t0 = 0.0
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        args = self._args
+        if exc_type is not None:
+            args = {**args, "error": exc_type.__name__}
+        self._tracer.complete(self._name, self._t0, cat=self._cat,
+                              tid=self._tid, **args)
+        return False
+
+
+class Tracer:
+    """Thread-safe bounded span recorder with an injectable clock.
+
+    ``complete``/``instant``/``counter`` append one Chrome trace event
+    each; ``span`` is the context-manager form.  ``chrome_trace`` returns
+    the loadable document; ``write`` saves it (never raises).
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 clock=time.monotonic, pid: int | None = None):
+        capacity = int(capacity)
+        if capacity < 1:
+            raise ValueError(f"tracer capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.events: deque = deque(maxlen=capacity)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.pid = os.getpid() if pid is None else int(pid)
+        self.spans_total = 0  # lifetime-exact (evictions included)
+
+    def _emit(self, ev: dict) -> None:
+        try:
+            with self._lock:
+                self.events.append(ev)
+                self.spans_total += 1
+        except Exception:  # noqa: BLE001 — observability never raises
+            pass
+
+    def complete(self, name: str, t0: float, t1: float | None = None,
+                 cat: str = "", tid: int = 0, **args) -> None:
+        """One complete ('X') span from the CALLER's host-clock
+        timestamps in seconds — no extra clock reads on timed paths
+        (``t1=None`` reads the tracer clock once)."""
+        try:
+            if t1 is None:
+                t1 = self._clock()
+            ev = {"name": name, "cat": cat or "nlheat", "ph": "X",
+                  "ts": round(t0 * 1e6, 3),
+                  "dur": round(max(0.0, t1 - t0) * 1e6, 3),
+                  "pid": self.pid, "tid": int(tid)}
+            if args:
+                ev["args"] = args
+            self._emit(ev)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def instant(self, name: str, ts: float | None = None, cat: str = "",
+                tid: int = 0, **args) -> None:
+        """One instant ('i') event (retry, bisect, breaker move...)."""
+        try:
+            if ts is None:
+                ts = self._clock()
+            ev = {"name": name, "cat": cat or "nlheat", "ph": "i", "s": "t",
+                  "ts": round(ts * 1e6, 3), "pid": self.pid, "tid": int(tid)}
+            if args:
+                ev["args"] = args
+            self._emit(ev)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def counter(self, name: str, ts: float | None = None, tid: int = 0,
+                **values) -> None:
+        """One counter ('C') sample — Perfetto renders these as tracks
+        (the pipeline samples chunks-in-flight here)."""
+        try:
+            if ts is None:
+                ts = self._clock()
+            self._emit({"name": name, "cat": "nlheat", "ph": "C",
+                        "ts": round(ts * 1e6, 3), "pid": self.pid,
+                        "tid": int(tid), "args": values})
+        except Exception:  # noqa: BLE001
+            pass
+
+    def span(self, name: str, cat: str = "", tid: int = 0, **args) -> _Span:
+        return _Span(self, name, cat, tid, args)
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def chrome_trace(self) -> dict:
+        """The Perfetto-loadable document."""
+        with self._lock:
+            events = list(self.events)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> bool:
+        """Save :meth:`chrome_trace` to ``path``.  Never raises (a trace
+        that cannot be written must not kill the solve it observed);
+        returns False and prints to stderr on failure."""
+        try:
+            doc = self.chrome_trace()
+            # tmp + rename, hostname+pid disambiguated (the
+            # utils/checkpoint.atomic_file discipline): concurrent
+            # writers — distributed ranks sharing a filesystem — each
+            # land a COMPLETE document; a reader can never observe
+            # interleaved or truncated JSON that Perfetto rejects
+            # id(self) on top of hostname+pid: two tracers flushed from
+            # threads of one process must not share a tmp either
+            tmp = (f"{path}.tmp.{socket.gethostname()}"
+                   f".{os.getpid()}.{id(self)}")
+            with open(tmp, "w") as f:
+                # default=str: one exotic span arg (a numpy scalar, a
+                # Path) must degrade to its repr, not discard the whole
+                # artifact (obs/export.py EventLog.emit does the same)
+                json.dump(doc, f, default=str)
+            os.replace(tmp, path)
+            return True
+        except Exception as e:  # noqa: BLE001
+            try:
+                print(f"[obs] trace write to {path!r} failed: {e!r}",
+                      file=sys.stderr)
+            except Exception:  # noqa: BLE001
+                pass
+            return False
+
+
+_tracer: Tracer | None = None
+
+
+def get_tracer() -> Tracer | None:
+    return _tracer
+
+
+def set_tracer(tracer: Tracer | None) -> Tracer | None:
+    """Install the process-global tracer (None disables); returns the
+    previous one so callers can restore it."""
+    global _tracer
+    prev = _tracer
+    _tracer = tracer
+    return prev
+
+
+def span(name: str, cat: str = "", **args):
+    """Module-level span helper: a real span under the global tracer,
+    the shared no-op context otherwise (one attribute read — the
+    zero-cost disabled path)."""
+    t = _tracer
+    if t is None:
+        return NULL_SPAN
+    return t.span(name, cat=cat, **args)
+
+
+def instant(name: str, cat: str = "", **args) -> None:
+    t = _tracer
+    if t is not None:
+        t.instant(name, cat=cat, **args)

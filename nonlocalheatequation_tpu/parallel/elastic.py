@@ -54,9 +54,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
+from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, source_at
 from nonlocalheatequation_tpu.parallel.load_balance import (
     MeasuredTelemetry,
+    publish_busy_rates,
     rebalance_assignment,
 )
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
@@ -309,8 +311,12 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
             # otherwise be vacuously zero (and a final-state acceptance
             # check vacuously green)
             self._last_window_rates = np.asarray(busy, dtype=np.float64)
-        new_assignment = rebalance_assignment(self.assignment, busy)
-        return self.migrate(new_assignment)
+        with obs_trace.span("balance.rebalance", cat="balance",
+                            devices=int(np.asarray(busy).size)):
+            new_assignment = rebalance_assignment(self.assignment, busy)
+            moved = self.migrate(new_assignment)
+        publish_busy_rates(busy, moved=moved)
+        return moved
 
     # -- batched per-device fused path --------------------------------------
     def _make_batched(self, test: bool):

@@ -364,6 +364,32 @@ def rebalance_assignment(assignment: np.ndarray, busy: np.ndarray,
     return assignment
 
 
+def publish_busy_rates(busy, moved: int | None = None,
+                       registry=None) -> None:
+    """Mirror one rebalance window's busy rates into the obs registry —
+    ``/device{d}/busy-rate`` gauges plus ``/balance/windows`` and (when
+    ``moved`` tiles actually migrated) ``/balance/tiles-moved`` and
+    ``/balance/rebalances`` counters, the namespace twin of the HPX
+    idle-rate counters this module models
+    (src/2d_nonlocal_distributed.cpp:112-128).  A window where the
+    balancer ran but moved nothing counts only as a window — the
+    rebalances counter reflects actual migrations, not invocations.
+    Defaults to the process-wide ``REGISTRY``; never raises
+    (observability must not fail a rebalance)."""
+    try:
+        from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+
+        reg = REGISTRY if registry is None else registry
+        for d, b in enumerate(np.asarray(busy, dtype=np.float64)):
+            reg.gauge(f"/device{{{d}}}/busy-rate").set(float(b))
+        reg.counter("/balance/windows").inc()
+        if moved:
+            reg.counter("/balance/rebalances").inc()
+            reg.counter("/balance/tiles-moved").inc(int(moved))
+    except Exception:  # noqa: BLE001 — observability never raises
+        pass
+
+
 def balance_check(busy: np.ndarray) -> tuple[bool, float]:
     """The reference's acceptance criterion (test_load_balance, :647-686):
     max |busy_i - mean| <= 1500 (units of 0.01%)."""

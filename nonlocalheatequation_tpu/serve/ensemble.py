@@ -43,13 +43,17 @@ variants plus the vmap fallback once per (shape, B) and run the winner
 
 from __future__ import annotations
 
+import json
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from nonlocalheatequation_tpu.obs import trace as obs_trace
+from nonlocalheatequation_tpu.obs.metrics import MetricsRegistry, backed
 
 #: Allowed chunk sizes, ascending.  Buckets larger than the top size are
 #: split into top-size chunks; the remainder pads up to the smallest
@@ -85,23 +89,56 @@ class EnsembleCase:
         return (float(self.k), float(self.dt), float(self.dh))
 
 
-@dataclass
 class EnsembleReport:
     """Observability counters for one engine lifetime (tests assert on
-    them: an 8-case same-shape bucket must be 1 program / 1 dispatch)."""
+    them: an 8-case same-shape bucket must be 1 program / 1 dispatch).
 
-    cases: int = 0
-    buckets: int = 0
-    dispatches: int = 0
-    programs_built: int = 0
-    padded_cases: int = 0
-    strategies: dict = field(default_factory=dict)
+    Since the obs subsystem (obs/metrics.py) every counter is BACKED by
+    a metrics registry under HPX-style names (``/ensemble/cases``...):
+    the fields below are properties over registry metrics, so the
+    registry's Prometheus/JSON expositions and this report read the
+    same storage.  The default registry is PRIVATE to the report (two
+    engines in one process never share counters); the serving pipeline
+    exposes its report's registry for scraping (cli ``--metrics-port``).
+    """
+
+    cases = backed("_m_cases")
+    buckets = backed("_m_buckets")
+    dispatches = backed("_m_dispatches")
+    programs_built = backed("_m_programs_built")
+    padded_cases = backed("_m_padded_cases")
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        r = self.registry
+        self._m_cases = r.counter("/ensemble/cases")
+        self._m_buckets = r.counter("/ensemble/buckets")
+        self._m_dispatches = r.counter("/ensemble/dispatches")
+        self._m_programs_built = r.counter("/ensemble/programs-built")
+        self._m_padded_cases = r.counter("/ensemble/padded-cases")
+        self.strategies: dict = {}
 
     def summary(self) -> str:
         return (f"{self.cases} cases -> {self.buckets} buckets, "
                 f"{self.dispatches} dispatches, "
                 f"{self.programs_built} programs "
                 f"({self.padded_cases} padding lanes)")
+
+    def metrics(self) -> dict:
+        """The engine counters as one dict (the --metrics-out payload
+        for --ensemble runs; ServeReport overrides with the full serving
+        dump)."""
+        return {
+            "cases": self.cases,
+            "buckets": self.buckets,
+            "dispatches": self.dispatches,
+            "programs_built": self.programs_built,
+            "padded_cases": self.padded_cases,
+            "strategies": {str(k): v for k, v in self.strategies.items()},
+        }
+
+    def metrics_json(self) -> str:
+        return json.dumps(self.metrics())
 
 
 class EnsembleEngine:
@@ -214,8 +251,14 @@ class EnsembleEngine:
         self.report.buckets += len(buckets)
         for key, idxs in buckets.items():
             for part in self._chunks(idxs):
-                chunk = self.pad_chunk([cases[i] for i in part])
-                out = self._run_chunk(key, chunk)
+                # span: the offline chunk lifecycle (pad -> build ->
+                # dispatch -> fetch), a no-op unless a tracer is
+                # installed (obs/trace.py — the serving pipeline traces
+                # its own stages instead, per attempt)
+                with obs_trace.span("ensemble.chunk", cat="ensemble",
+                                    bucket=str(key), cases=len(part)):
+                    chunk = self.pad_chunk([cases[i] for i in part])
+                    out = self._run_chunk(key, chunk)
                 for j, i in enumerate(part):
                     results[i] = np.asarray(out[j])
         return results
@@ -240,8 +283,11 @@ class EnsembleEngine:
         if multi is None:
             # operators are only needed to BUILD a program (and for the
             # u0 test-mode default below); a cache hit skips them
-            ops = [self._make_op(c) for c in chunk]
-            multi = self._build_program(key, chunk, ops, test, dtype)
+            with obs_trace.span("ensemble.build", cat="ensemble",
+                                bucket=str(key), cases=len(chunk),
+                                variant=self.variant):
+                ops = [self._make_op(c) for c in chunk]
+                multi = self._build_program(key, chunk, ops, test, dtype)
             self._programs[prog_key] = multi
             self.report.programs_built += 1
         return multi

@@ -119,12 +119,24 @@ class CircuitBreaker:
         self.routed_probe = False  # last route() handed out the probe
         self.transitions: deque = deque(maxlen=TRANSITION_CAP)
         self.transition_count = 0  # lifetime-exact
+        #: Optional ``(from_state, to_state, t)`` callback the serving
+        #: pipeline installs to mirror transitions into the obs
+        #: subsystem (registry counter, trace instant, event log).
+        #: Exceptions are swallowed — observability never fails a route.
+        self.on_transition = None
 
     def _move(self, to: str) -> None:
-        self.transitions.append(
-            {"t": self._clock(), "from": self.state, "to": to})
+        frm = self.state
+        t = self._clock()
+        self.transitions.append({"t": t, "from": frm, "to": to})
         self.transition_count += 1
         self.state = to
+        cb = self.on_transition
+        if cb is not None:
+            try:
+                cb(frm, to, t)
+            except Exception:  # noqa: BLE001 — observability never raises
+                pass
 
     def route(self) -> str:
         self.routed_probe = False

@@ -96,19 +96,24 @@ import sys
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 #: Bound on every observability window (per-chunk log, latency/queue-wait
-#: samples, occupancy trace): a long-lived serving process must not grow
-#: host memory with its request count, so percentiles, stage totals, and
-#: the metrics dump cover the most recent LOG_CAP entries (the counters —
-#: cases/dispatches/... — remain lifetime-exact).
+#: samples, occupancy trace, quarantine trail): a long-lived serving
+#: process must not grow host memory with its request count, so
+#: percentiles, stage totals, and the metrics dump cover the most recent
+#: LOG_CAP entries — each window's companion ``count`` (obs/metrics.py
+#: Trail/Histogram) and the counters (cases/dispatches/...) remain
+#: lifetime-exact.
 LOG_CAP = 4096
 
 import numpy as np
 
 import jax.numpy as jnp
 
+from nonlocalheatequation_tpu.obs import trace as obs_trace
+from nonlocalheatequation_tpu.obs.export import EventLog
+from nonlocalheatequation_tpu.obs.metrics import MetricsRegistry, backed
 from nonlocalheatequation_tpu.serve.ensemble import (
     EnsembleCase,
     EnsembleEngine,
@@ -207,7 +212,6 @@ class _Chunk:
         self.last_failure = ("", "")  # (classification, detail)
 
 
-@dataclass
 class ServeReport(EnsembleReport):
     """EnsembleReport extended with the serving pipeline's observability:
     per-chunk and per-request timing, occupancy, forced-close reasons,
@@ -215,42 +219,54 @@ class ServeReport(EnsembleReport):
     dispatches/programs_built/padded_cases) keep their offline meaning —
     the pipeline routes the engine's own stages, so the same counters
     measure the same events (fallback-served chunks run on a sibling CPU
-    engine and are counted by ``fallback_chunks`` instead)."""
+    engine and are counted by ``fallback_chunks`` instead).
 
-    depth: int = 1
-    window_ms: float = 0.0
-    window_size: int = 0
-    # bounded windows (LOG_CAP most recent entries; see the constant)
-    chunk_log: deque = field(default_factory=lambda: deque(maxlen=LOG_CAP))
-    request_latency_ms: deque = field(
-        default_factory=lambda: deque(maxlen=LOG_CAP))
-    queue_wait_ms: deque = field(
-        default_factory=lambda: deque(maxlen=LOG_CAP))
-    occupancy_samples: deque = field(  # (t, in_flight)
-        default_factory=lambda: deque(maxlen=LOG_CAP))
-    forced_closes: dict = field(default_factory=dict)
-    max_inflight: int = 0
-    # failure telemetry (lifetime-exact, like the engine counters)
-    retries: int = 0  # supervised re-dispatches
-    faults: dict = field(default_factory=dict)  # classification -> count
-    backoff_ms_total: float = 0.0
-    bisections: int = 0
-    fallback_chunks: int = 0
-    quarantined: list = field(default_factory=list)
-    breaker: object = None  # the pipeline's CircuitBreaker, if any
+    Like the engine counters, every field below is BACKED by the
+    report's metrics registry (obs/metrics.py) under the ``/serve``
+    namespace — the registry's Prometheus text and JSON snapshot agree
+    with :meth:`metrics` on every shared counter by construction.  The
+    windows (chunk log, latency/queue-wait samples, occupancy trace,
+    quarantine trail) are bounded at LOG_CAP with lifetime-exact
+    companion counts (the windowed-trail pattern the breaker transition
+    log introduced)."""
 
-    @staticmethod
-    def _pct(xs) -> dict:
-        if not xs:
-            return {}
-        a = np.asarray(xs, np.float64)
-        return {
-            "p50": float(np.percentile(a, 50)),
-            "p90": float(np.percentile(a, 90)),
-            "p99": float(np.percentile(a, 99)),
-            "mean": float(a.mean()),
-            "max": float(a.max()),
-        }
+    depth = backed("_m_depth")
+    window_ms = backed("_m_window_ms")
+    window_size = backed("_m_window_size")
+    max_inflight = backed("_m_max_inflight")
+    retries = backed("_m_retries")
+    backoff_ms_total = backed("_m_backoff_ms_total")
+    bisections = backed("_m_bisections")
+    fallback_chunks = backed("_m_fallback_chunks")
+
+    def __init__(self, depth: int = 1, window_ms: float = 0.0,
+                 window_size: int = 0, breaker: object = None,
+                 registry: MetricsRegistry | None = None):
+        super().__init__(registry=registry)
+        r = self.registry
+        self._m_depth = r.gauge("/serve/depth")
+        self._m_window_ms = r.gauge("/serve/window-ms")
+        self._m_window_size = r.gauge("/serve/window-size")
+        self._m_max_inflight = r.gauge("/serve/max-inflight")
+        self._m_retries = r.counter("/serve/retries")
+        self._m_backoff_ms_total = r.counter("/serve/backoff-ms-total")
+        self._m_bisections = r.counter("/serve/bisections")
+        self._m_fallback_chunks = r.counter("/serve/fallback-chunks")
+        # bounded windows (LOG_CAP most recent entries; see the constant)
+        self.chunk_log = r.trail("/serve/chunk-log", window=LOG_CAP)
+        self.request_latency_ms = r.histogram("/serve/request-latency-ms",
+                                              window=LOG_CAP)
+        self.queue_wait_ms = r.histogram("/serve/queue-wait-ms",
+                                         window=LOG_CAP)
+        self.occupancy_samples = r.trail("/serve/occupancy",  # (t, n)
+                                         window=LOG_CAP)
+        self.quarantined = r.trail("/serve/quarantined", window=LOG_CAP)
+        self.forced_closes = r.labeled("/serve/closes")
+        self.faults = r.labeled("/serve/faults")  # classification -> count
+        self.depth = depth
+        self.window_ms = window_ms
+        self.window_size = window_size
+        self.breaker = breaker  # the pipeline's CircuitBreaker, if any
 
     def occupancy(self) -> dict:
         """Max and time-weighted mean chunks in flight over the sampled
@@ -278,7 +294,9 @@ class ServeReport(EnsembleReport):
             "backoff_ms_total": round(self.backoff_ms_total, 3),
             "bisections": self.bisections,
             "fallback_chunks": self.fallback_chunks,
+            # windowed trail (LOG_CAP most recent) + lifetime-exact count
             "quarantined": [dict(q) for q in self.quarantined],
+            "quarantined_total": self.quarantined.count,
         }
         if self.breaker is not None:
             out["breaker"] = {
@@ -298,9 +316,15 @@ class ServeReport(EnsembleReport):
         """The one-call dump: engine counters (lifetime-exact) + pipeline
         knobs + latency percentiles + stage totals + occupancy + the
         failure telemetry + the per-chunk log, the latter four over the
-        most recent ``LOG_CAP`` entries (``log_window`` in the dump)."""
+        most recent ``LOG_CAP`` entries (``log_window`` in the dump,
+        each window's lifetime-exact companion count alongside)."""
         return {
             "log_window": LOG_CAP,
+            # lifetime-exact window companions: how many entries each
+            # bounded window has EVER absorbed (== len until it wraps)
+            "requests_completed": self.request_latency_ms.count,
+            "chunks_completed": self.chunk_log.count,
+            "occupancy_samples_total": self.occupancy_samples.count,
             "cases": self.cases,
             "buckets": self.buckets,
             # lifetime-exact (every chunk was closed exactly once —
@@ -314,8 +338,8 @@ class ServeReport(EnsembleReport):
             "window_ms": self.window_ms,
             "window_size": self.window_size,
             "forced_closes": dict(self.forced_closes),
-            "request_latency_ms": self._pct(self.request_latency_ms),
-            "queue_wait_ms": self._pct(self.queue_wait_ms),
+            "request_latency_ms": self.request_latency_ms.percentiles(),
+            "queue_wait_ms": self.queue_wait_ms.percentiles(),
             "build_ms_total": round(
                 sum(c["build_ms"] for c in self.chunk_log), 3),
             "device_ms_total": round(
@@ -369,6 +393,7 @@ class ServePipeline:
                  breaker_cooldown_ms: float = 5000.0,
                  nan_policy: str = "quarantine",
                  faults: FaultPlan | None = None, sleep=time.sleep,
+                 registry: MetricsRegistry | None = None, tracer=None,
                  **engine_kwargs):
         if engine is None:
             engine = EnsembleEngine(**engine_kwargs)
@@ -408,6 +433,25 @@ class ServePipeline:
             breaker = CircuitBreaker(threshold=breaker_threshold,
                                      cooldown_ms=breaker_cooldown_ms,
                                      clock=clock)
+        # observability (obs/): the report and its registry, the span
+        # tracer (an explicit one, else the process-global one — None
+        # when tracing is off, the zero-cost path), and the opt-in JSONL
+        # event log.  Built HERE, still before the donation pin below —
+        # a ctor that raises past the pin would leak it process-wide.
+        report = ServeReport(depth=depth, window_ms=window_ms,
+                             window_size=ws, breaker=breaker,
+                             registry=registry)
+        self._tracer = (None if tracer is obs_trace.TRACE_OFF
+                        else tracer if tracer is not None
+                        else obs_trace.get_tracer())
+        self._events = EventLog.from_env()
+        self.registry = report.registry
+        if breaker is not None:
+            # mirror the breaker's lifetime-exact transition count into
+            # the registry (a prebuilt breaker may arrive with history)
+            self.registry.counter("/breaker/transitions").set(
+                breaker.transition_count)
+            breaker.on_transition = self._breaker_moved
         # refuses loudly on NLHEAT_DONATE=1 with depth > 1 — donation is
         # not pipeline-safe (module docstring); restored by close()
         self._prev_depth = donation.set_pipeline_depth(depth)
@@ -427,9 +471,7 @@ class ServePipeline:
         self._fallback: CpuFallback | None = None
         self._fallback_dead = False
         self._breaker = breaker
-        self.report = engine.report = ServeReport(
-            depth=depth, window_ms=window_ms, window_size=ws,
-            breaker=breaker)
+        self.report = engine.report = report
         self._open: dict = {}
         self._ready: list[_Chunk] = []
         self._inflight: deque[_Chunk] = deque()
@@ -437,6 +479,40 @@ class ServePipeline:
         self._next_seq = 0
         self._next_chunk = 0
         self._closed = False
+
+    # -- observability emitters (obs/) --------------------------------------
+    # All three are single-`if` no-ops when tracing/logging is off, emit
+    # from timestamps the scheduler already took (no extra fences, no
+    # extra clock reads on timed paths), and never raise (the tracer and
+    # event log swallow their own failures).
+    def _t_span(self, name: str, t0, t1, **args) -> None:
+        tr = self._tracer
+        if tr is not None:
+            tr.complete(name, t0, t1, cat="serve", **args)
+
+    def _t_instant(self, name: str, ts=None, **args) -> None:
+        tr = self._tracer
+        if tr is not None:
+            tr.instant(name, ts=ts if ts is not None else self._clock(),
+                       cat="serve", **args)
+
+    def _t_inflight(self, ts, n: int) -> None:
+        tr = self._tracer
+        if tr is not None:
+            tr.counter("serve.inflight", ts=ts, inflight=n)
+
+    def _breaker_moved(self, frm: str, to: str, t: float) -> None:
+        """CircuitBreaker transition hook: mirror into the registry, the
+        trace, and the event log (the trail itself lives on the breaker,
+        surfaced by :meth:`ServeReport.resilience`)."""
+        try:
+            self.registry.counter("/breaker/transitions").inc()
+            self._t_instant("breaker.transition", ts=t,
+                            **{"from": frm, "to": to})
+            if self._events is not None:
+                self._events.emit(event="breaker", t=t, frm=frm, to=to)
+        except Exception:  # noqa: BLE001 — observability never raises
+            pass
 
     # -- intake -------------------------------------------------------------
     def submit(self, case: EnsembleCase, *, deadline_ms: float | None = None,
@@ -496,6 +572,8 @@ class ServePipeline:
         self._ready.append(chunk)
         fc = self.report.forced_closes
         fc[why] = fc.get(why, 0) + 1
+        self._t_instant("serve.close", chunk=chunk.chunk_id, why=why,
+                        cases=len(oc.requests))
         return chunk
 
     def _pop_ready(self) -> _Chunk:
@@ -570,8 +648,22 @@ class ServePipeline:
                 outcome, t1, payload = self._guarded(
                     chunk, lambda: self._fetch_fallback(chunk),
                     deadline_s=None)
-                if self._complete_attempt(chunk, outcome, t1, payload):
+                ok = self._complete_attempt(chunk, outcome, t1, payload)
+                # the EFFECTIVE outcome: _complete_attempt's finite scan
+                # can reclassify a fetched-ok payload as corrupt (the
+                # end-of-span clock read stays behind the tracer guard)
+                if self._tracer is not None:
+                    self._t_span("serve.fallback", t0, self._clock(),
+                                 chunk=chunk.chunk_id,
+                                 attempt=chunk.attempts,
+                                 outcome="ok" if ok else
+                                 (chunk.last_failure[0] or outcome))
+                if ok:
                     self.report.fallback_chunks += 1
+                    if self._events is not None:
+                        self._events.emit(event="fallback-chunk",
+                                          chunk=chunk.chunk_id,
+                                          cases=len(chunk.requests))
                 return
             multi = self.engine.build_program(chunk.key, chunk.padded)
             # every attempt RE-STAGES: a fresh device input buffer per
@@ -582,14 +674,26 @@ class ServePipeline:
             chunk.dispatch_t = self._clock()
             chunk.out = self.engine.dispatch_chunk(multi, U0)  # async
         except Exception as e:  # noqa: BLE001 — classified, never fatal
+            if self._tracer is not None:
+                self._t_span("serve.build", t0, self._clock(),
+                             chunk=chunk.chunk_id, attempt=chunk.attempts,
+                             error=type(e).__name__)
             self._attempt_failed(chunk, CLASS_ERROR, e)
             return
+        # spans from the timestamps the scheduler already took: the
+        # host-side pad/build/stage stage, then the (async) launch
+        self._t_span("serve.build", t0, chunk.dispatch_t,
+                     chunk=chunk.chunk_id, attempt=chunk.attempts)
+        self._t_instant("serve.dispatch", ts=chunk.dispatch_t,
+                        chunk=chunk.chunk_id, attempt=chunk.attempts,
+                        route=chunk.route)
         chunk.state = "inflight"
         self._inflight.append(chunk)
         self._record_queue_wait(chunk)
         n = len(self._inflight)
         self.report.max_inflight = max(self.report.max_inflight, n)
         self.report.occupancy_samples.append((chunk.dispatch_t, n))
+        self._t_inflight(chunk.dispatch_t, n)
 
     def _record_queue_wait(self, chunk: _Chunk) -> None:
         # queue wait means submit -> FIRST dispatch that actually staged
@@ -705,6 +809,14 @@ class ServePipeline:
         if chunk.attempts <= self.retries:
             self.report.retries += 1
             delay_s = (self.backoff_ms / 1e3) * (2 ** (chunk.attempts - 1))
+            self._t_instant("serve.retry", chunk=chunk.chunk_id,
+                            attempt=chunk.attempts,
+                            classification=classification,
+                            backoff_ms=delay_s * 1e3)
+            if self._events is not None:
+                self._events.emit(event="retry", chunk=chunk.chunk_id,
+                                  attempt=chunk.attempts,
+                                  classification=classification)
             if delay_s > 0:
                 self.report.backoff_ms_total += delay_s * 1e3
                 self._sleep(delay_s)
@@ -724,6 +836,9 @@ class ServePipeline:
         chunk-mate is re-bucketed and served normally."""
         mid = len(chunk.requests) // 2
         self.report.bisections += 1
+        self._t_instant("serve.bisect", chunk=chunk.chunk_id,
+                        cases=len(chunk.requests),
+                        halves=[self._next_chunk, self._next_chunk + 1])
         fc = self.report.forced_closes
         for part in (chunk.requests[:mid], chunk.requests[mid:]):
             half = _Chunk(self._next_chunk, chunk.key, part,
@@ -745,6 +860,15 @@ class ServePipeline:
         self.report.quarantined.append({
             "case": req.seq, "classification": classification,
             "attempts": chunk.attempts, "chunk": chunk.chunk_id})
+        self._t_instant("serve.quarantine", case=req.seq,
+                        chunk=chunk.chunk_id,
+                        classification=classification,
+                        attempts=chunk.attempts)
+        if self._events is not None:
+            self._events.emit(event="quarantine", case=req.seq,
+                              chunk=chunk.chunk_id,
+                              classification=classification,
+                              attempts=chunk.attempts, detail=detail)
         chunk.state = "done"
 
     def _complete_attempt(self, chunk: _Chunk, outcome, t_fence,
@@ -769,11 +893,22 @@ class ServePipeline:
         """Fence + fetch one in-flight chunk under supervision and
         distribute its lanes (or classify the failure)."""
         self._inflight.remove(chunk)
+        t_f0 = self._clock() if self._tracer is not None else None
         outcome, t1, payload = self._guarded(
             chunk, lambda: self._fetch_device(chunk))
-        self._complete_attempt(chunk, outcome, t1, payload)
-        self.report.occupancy_samples.append(
-            (self._clock(), len(self._inflight)))
+        ok = self._complete_attempt(chunk, outcome, t1, payload)
+        t_now = self._clock()
+        if t_f0 is not None:
+            # the fetch span reuses the fence the retire performs anyway;
+            # like serve.fallback it reports the EFFECTIVE outcome —
+            # _complete_attempt's finite scan can reclassify a
+            # fetched-ok payload as corrupt
+            self._t_span("serve.fetch", t_f0, t_now, chunk=chunk.chunk_id,
+                         attempt=chunk.attempts,
+                         outcome="ok" if ok else
+                         (chunk.last_failure[0] or outcome))
+        self.report.occupancy_samples.append((t_now, len(self._inflight)))
+        self._t_inflight(t_now, len(self._inflight))
 
     def _finish(self, chunk: _Chunk, vals, t_fence) -> None:
         """Distribute a retired chunk's lanes (padding lanes dropped)."""
@@ -784,7 +919,7 @@ class ServePipeline:
             self.report.request_latency_ms.append(r.latency_s * 1e3)
         chunk.state = "done"
         chunk.out = None
-        self.report.chunk_log.append({
+        entry = {
             "chunk": chunk.chunk_id,
             "cases": len(chunk.requests),
             "closed_by": chunk.closed_by,
@@ -793,7 +928,10 @@ class ServePipeline:
             "fetch_ms": round((t2 - t_fence) * 1e3, 3),
             "route": chunk.route,
             "attempt": chunk.attempts,
-        })
+        }
+        self.report.chunk_log.append(entry)
+        if self._events is not None:
+            self._events.emit(event="chunk", **entry)
 
     # -- completion ---------------------------------------------------------
     def wait(self, req: ServeRequest) -> np.ndarray:
@@ -851,6 +989,8 @@ class ServePipeline:
             finally:
                 self._release_stalls()
                 donation.set_pipeline_depth(self._prev_depth)
+                if self._events is not None:
+                    self._events.close()
                 self._closed = True
 
     def __enter__(self):
@@ -899,6 +1039,50 @@ def serve_fence_ab(engine: EnsembleEngine, cases, depth: int,
         if sec_p < pipe_best:
             pipe_best, pipe_rep = sec_p, rep
     return compile_s, fenced_best, pipe_best, pipe_rep
+
+
+def serve_traced_ab(engine: EnsembleEngine, cases, depth: int,
+                    iters: int = 2):
+    """The traced-vs-untraced measurement shared by bench.py
+    (``BENCH_TRACE``) and tools/bench_table.py (``obs`` group): time the
+    SAME pipelined schedule of ``cases`` over ONE engine twice per iter —
+    once with tracing off (the zero-cost disabled path) and once with a
+    span :class:`~nonlocalheatequation_tpu.obs.trace.Tracer` installed on
+    the pipeline — so the ratio isolates the host-side cost of recording
+    spans (the ISSUE 5 gate: <= 5% on the serve proxy).  The first
+    traced pass warms the program cache and its wall is the compile
+    time.  Returns ``(compile_s, untraced_best_s, traced_best_s,
+    best_tracer, best_traced_report)``."""
+    from nonlocalheatequation_tpu.obs.trace import Tracer
+
+    # a non-positive iter count would return inf walls and a None tracer
+    # that bench.py dereferences — always measure at least once
+    iters = max(1, int(iters))
+
+    def run_schedule(tracer):
+        pipe = ServePipeline(engine=engine, depth=depth, window_ms=0.0,
+                             tracer=tracer)
+        try:
+            t0 = time.perf_counter()
+            pipe.serve_cases(cases)
+            return time.perf_counter() - t0, pipe.report
+        finally:
+            pipe.close()
+
+    compile_s, _ = run_schedule(Tracer())
+    plain_best = float("inf")
+    traced_best, best_tracer, best_rep = float("inf"), None, None
+    for _ in range(iters):
+        # TRACE_OFF, not None: the baseline must stay untraced even when
+        # a process-global tracer is installed (--trace/NLHEAT_TRACE),
+        # or the A/B would trace both arms and measure nothing
+        sec_u, _ = run_schedule(obs_trace.TRACE_OFF)
+        plain_best = min(plain_best, sec_u)
+        tracer = Tracer()
+        sec_t, rep = run_schedule(tracer)
+        if sec_t < traced_best:
+            traced_best, best_tracer, best_rep = sec_t, tracer, rep
+    return compile_s, plain_best, traced_best, best_tracer, best_rep
 
 
 def serve_chaos(engine: EnsembleEngine, cases, depth: int, plan_spec: str,

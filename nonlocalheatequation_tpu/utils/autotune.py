@@ -44,6 +44,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from nonlocalheatequation_tpu.obs import trace as obs_trace
+
 # probe length: long enough to amortize per-call dispatch into the same
 # regime the real run sees (the tunnel adds ~64 ms per call,
 # docs/bench/README.md), short enough to keep tuning cheap
@@ -307,8 +309,10 @@ def pick_batched_multi_step_fn(ops, nsteps: int, shape, dtype,
                 if name in recorded:
                     continue
                 try:
-                    recorded[name] = _measure_batched(
-                        maker, ops, shape, dtype) * 1e3
+                    with obs_trace.span("autotune.probe", cat="autotune",
+                                        candidate=name, key=key):
+                        recorded[name] = _measure_batched(
+                            maker, ops, shape, dtype) * 1e3
                 except Exception as e:  # noqa: BLE001 — a variant that
                     # fails to build/compile simply doesn't compete
                     recorded[name] = None
@@ -435,7 +439,9 @@ def pick_multi_step_fn(op, nsteps: int, shape, dtype):
                 if name in recorded:
                     continue
                 try:
-                    timings[name] = _measure(maker, op, shape, dtype)
+                    with obs_trace.span("autotune.probe", cat="autotune",
+                                        candidate=name, key=key):
+                        timings[name] = _measure(maker, op, shape, dtype)
                 except Exception as e:  # noqa: BLE001 — a variant that
                     # fails to build/compile simply doesn't compete
                     timings[name] = None

@@ -14,12 +14,15 @@ discipline applied to the resume path).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import socket
 import zlib
 
 import numpy as np
+
+from nonlocalheatequation_tpu.obs import trace as obs_trace
 
 
 def _fetch_global(u):
@@ -57,19 +60,49 @@ def _payload_crc(u: np.ndarray, t: int, params_json: bytes) -> int:
     return zlib.crc32(np.ascontiguousarray(u).data, crc)
 
 
-def save_state(path: str, u: np.ndarray, t: int, params: dict | None = None):
-    """Atomically write solver state at timestep ``t`` (u = state AFTER t
-    steps): same-directory tmp + ``os.replace`` (a kill mid-write leaves
-    the previous checkpoint untouched), payload CRC32 included so
-    ``load_state`` can refuse a torn file loudly."""
+@contextlib.contextmanager
+def atomic_file(path: str, mode: str = "wb"):
+    """Crash-safe file write, the checkpoint discipline factored out for
+    any must-not-tear artifact (``--metrics-out`` reuses it): yield a
+    same-directory tmp file, fsync it, then atomically ``os.replace``
+    onto ``path`` — a kill mid-write leaves the previous file untouched,
+    and a failed write never strands the tmp next to the live file."""
     # host-unique tmp: on a multi-host shared filesystem, pids alone can
     # collide across hosts' independent pid namespaces
     tmp = f"{path}.tmp.{socket.gethostname()}.{os.getpid()}"
+    try:
+        with open(tmp, mode) as f:
+            yield f
+            # the replace below is only atomic for bytes that reached the
+            # disk; flush+fsync closes the torn-page window a crash right
+            # after os.replace would otherwise leave
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Crash-safe small-text write (metrics dumps, manifests)."""
+    with atomic_file(path, "w") as f:
+        f.write(text)
+
+
+def save_state(path: str, u: np.ndarray, t: int, params: dict | None = None):
+    """Atomically write solver state at timestep ``t`` (u = state AFTER t
+    steps) via :func:`atomic_file`, payload CRC32 included so
+    ``load_state`` can refuse a torn file loudly."""
     meta = dict(params or {})
     u = np.asarray(u)
     params_json = json.dumps(meta).encode()
-    try:
-        with open(tmp, "wb") as f:
+    with obs_trace.span("checkpoint.save", cat="checkpoint", step=int(t),
+                        bytes=int(u.nbytes)):
+        with atomic_file(path, "wb") as f:
             np.savez(
                 f,
                 u=u,
@@ -78,20 +111,6 @@ def save_state(path: str, u: np.ndarray, t: int, params: dict | None = None):
                 params=np.frombuffer(params_json, dtype=np.uint8),
                 crc=np.uint32(_payload_crc(u, t, params_json)),
             )
-            # the replace below is only atomic for bytes that reached the
-            # disk; flush+fsync closes the torn-page window a crash right
-            # after os.replace would otherwise leave
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        # a failed write (disk full, kill) must not strand tmp files next to
-        # the live checkpoint
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
 
 
 def load_state(path: str):
@@ -99,6 +118,11 @@ def load_state(path: str):
     and — LOUDLY, with a resume-from-previous hint — on a truncated or
     corrupt file (unreadable archive, missing members, CRC mismatch).
     A missing file propagates as FileNotFoundError, unchanged."""
+    with obs_trace.span("checkpoint.load", cat="checkpoint"):
+        return _load_state(path)
+
+
+def _load_state(path: str):
     try:
         with np.load(path) as z:
             version = int(z["version"])
@@ -227,7 +251,12 @@ class CheckpointMixin:
         for start, count in self._ckpt_chunks(log_due):
             if count not in runners:
                 runners[count] = make_runner(count)
-            u = runners[count](u, start)
+            # span per fused step batch (the reference's do_work CSV
+            # granularity); dispatch is async, so the span measures the
+            # host-side submit unless the runner fences internally
+            with obs_trace.span("solver.steps", cat="solver",
+                                start=start, count=count):
+                u = runners[count](u, start)
             last = start + count - 1
             if log_due is not None and log_due(last):
                 logger(last, _fetch_global(u))

@@ -18,7 +18,10 @@ for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
               "NLHEAT_LANE_RUNS", "NLHEAT_TM", "NLHEAT_DONATE",
               "NLHEAT_TUNE_PRECISION", "NLHEAT_TUNE_BATCH",
               "NLHEAT_FAULT_PLAN", "BENCH_PRECISION", "BENCH_ENSEMBLE",
-              "BENCH_SERVE", "BENCH_SERVE_FAULTS"):
+              "BENCH_SERVE", "BENCH_SERVE_FAULTS",
+              # a leaked event-log/trace path must not make the suite
+              # write telemetry files (obs/export.py, cli obs_session)
+              "NLHEAT_EVENT_LOG", "NLHEAT_TRACE", "BENCH_TRACE"):
     os.environ.pop(_knob, None)
 # "" DISABLES autotune-cache persistence (unset means the per-user default
 # file since tuning became the on-TPU default): the suite must neither read

@@ -123,6 +123,31 @@ def test_bench_serve_mode_emits_amortization_and_latency():
     assert rec["partial"] is False
 
 
+def test_bench_trace_mode_emits_overhead_and_artifact(tmp_path):
+    # BENCH_TRACE (with BENCH_SERVE=D): the observability A/B — the same
+    # pipelined schedule timed with the obs/ span tracer off vs
+    # installed.  The JSON line must carry the serveobsD variant label,
+    # the traced/untraced overhead ratio, the lifetime span count, and
+    # (BENCH_TRACE=DIR) the path of a written Perfetto-loadable
+    # host_trace.json, on the same one-line rc=0 contract
+    import json
+
+    tdir = tmp_path / "trace"
+    proc, rec = run_bench({"BENCH_SERVE": "3", "BENCH_TRACE": str(tdir),
+                           "BENCH_ACCURACY": "0"})
+    assert proc.returncode == 0
+    assert rec["value"] > 0
+    assert rec["variant"] == "serveobs3"
+    assert rec["cases"] == 8
+    assert rec["trace_overhead"] > 0
+    assert rec["spans"] > 0
+    doc = json.loads(open(rec["trace_path"]).read())
+    assert len(doc["traceEvents"]) == rec["spans"]
+    assert {"serve.build", "serve.dispatch", "serve.fetch"} <= {
+        ev["name"] for ev in doc["traceEvents"]}
+    assert rec["partial"] is False
+
+
 def test_bench_servefault_mode_serves_through_injected_fault():
     # BENCH_SERVE_FAULTS: the chaos rung — the pipelined schedule runs
     # once under a deterministic injected plan through the supervised

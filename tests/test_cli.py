@@ -384,3 +384,133 @@ def test_reference_workflow_chain(tmp_path):
     l2 = float(r.stdout.split("l2:")[1].split()[0])
     npoints = 10 * 10
     assert l2 / npoints <= 1e-6, f"L2/N contract violated: {l2 / npoints}"
+
+
+# -- observability flags (obs/, ISSUE 5) ------------------------------------
+def test_metrics_out_writes_serve_dump_atomically(tmp_path):
+    # --metrics-out persists the same one-line dump --serve prints to
+    # stderr; the file parses and agrees on the headline counters
+    import json
+
+    out = tmp_path / "metrics.json"
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2",
+                            "--metrics-out", str(out)],
+                stdin="2\n32 32 10 5 1 0.001 0.03125\n"
+                      "32 32 10 5 1 0.001 0.03125\n")
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+    assert f"metrics written to {out}" in r.stderr
+    m = json.loads(out.read_text())
+    assert m["cases"] == 2 and "resilience" in m
+    # no stranded tmp file from the atomic-write discipline
+    assert list(tmp_path.iterdir()) == [out]
+
+
+def test_metrics_out_unwritable_path_refused_before_solve(tmp_path):
+    # a typo'd path must refuse up front (exit 1, loud), not discard the
+    # run's metrics at the final write
+    bad = tmp_path / "no" / "such" / "dir" / "m.json"
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2",
+                            "--metrics-out", str(bad)], stdin="0\n")
+    assert r.returncode == 1
+    assert "not writable" in r.stderr
+    assert "Tests" not in r.stdout  # refused before any solve ran
+
+
+def test_metrics_out_solo_run_snapshots_solve_gauges(tmp_path):
+    import json
+
+    out = tmp_path / "m.json"
+    r = run_cli("solve1d", ["--test", "--nx", "32", "--nt", "10",
+                            "--metrics-out", str(out)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    m = json.loads(out.read_text())
+    assert m["/solve{1d}/points"] == 32 and m["/solve{1d}/steps"] == 10
+    assert m["/solve{1d}/elapsed-s"] > 0
+    assert m["/solve{1d}/error-l2"] <= 32 * 1e-6
+
+
+def test_trace_flag_writes_perfetto_loadable_host_trace(tmp_path):
+    # --trace DIR: the host-side span timeline lands as
+    # DIR/host_trace.json (Chrome trace-event JSON) next to the
+    # jax.profiler capture tree
+    import json
+
+    tdir = tmp_path / "tr"
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2",
+                            "--trace", str(tdir)],
+                stdin="2\n32 32 10 5 1 0.001 0.03125\n"
+                      "32 32 10 5 1 0.001 0.03125\n")
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+    doc = json.loads((tdir / "host_trace.json").read_text())
+    names = {ev["name"] for ev in doc["traceEvents"]}
+    assert {"serve.close", "serve.build", "serve.dispatch",
+            "serve.fetch"} <= names
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "i", "C") and "ts" in ev
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+    # the jax.profiler device capture landed in the SAME directory
+    assert any(p.name != "host_trace.json" for p in tdir.rglob("*")
+               if p.is_file())
+
+
+def test_metrics_port_out_of_range_refused():
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2",
+                            "--metrics-port", "99999"], stdin="0\n")
+    assert r.returncode == 1
+    assert "--metrics-port" in r.stderr
+
+
+def test_metrics_out_directory_path_refused_up_front(tmp_path):
+    # a directory passes the sibling-file probe but the final
+    # os.replace cannot land on it — must refuse before the solve
+    r = run_cli("solve2d", ["--test_batch", "--serve", "2",
+                            "--metrics-out", str(tmp_path)], stdin="0\n")
+    assert r.returncode == 1
+    assert "is a directory" in r.stderr
+    assert "Tests" not in r.stdout
+
+
+def test_trace_plus_profile_conflict_refused(tmp_path):
+    # jax.profiler cannot nest: --trace already captures the device
+    # timeline, so a combined --profile would silently vanish — refuse
+    r = run_cli("solve2d", ["--test", "--trace", str(tmp_path / "tr"),
+                            "--profile", str(tmp_path / "prof")])
+    assert r.returncode == 1
+    assert "--trace already captures" in r.stderr
+
+
+def test_metrics_out_midrun_write_failure_never_masks_solve_error(tmp_path):
+    # the finally-block refusal (SystemExit 1) only fires when the solve
+    # body exited cleanly: a solve exception must propagate with its
+    # own traceback even when the --metrics-out write also fails
+    import shutil
+    import types
+
+    from nonlocalheatequation_tpu.cli.common import obs_session
+
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    args = types.SimpleNamespace(trace=None, metrics_port=None,
+                                 metrics_out=str(sub / "m.json"))
+    with pytest.raises(RuntimeError, match="solve blew up"):
+        with obs_session(args):
+            shutil.rmtree(sub)  # the mid-run filesystem change
+            raise RuntimeError("solve blew up")
+    # and the clean-body path still refuses loudly
+    with pytest.raises(SystemExit) as ei:
+        with obs_session(args):
+            pass
+    assert ei.value.code == 1
+
+
+def test_ensemble_metrics_out_records_engine_report(tmp_path):
+    import json
+
+    out = tmp_path / "m.json"
+    r = run_cli("solve2d", ["--test_batch", "--ensemble",
+                            "--metrics-out", str(out)],
+                stdin="2\n32 32 10 5 1 0.001 0.03125\n"
+                      "32 32 10 5 1 0.001 0.03125\n")
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+    m = json.loads(out.read_text())
+    assert m["cases"] == 2 and m["dispatches"] == 1

@@ -1,0 +1,621 @@
+"""Unified observability (obs/): tracer, registry, exporters.
+
+What these tests pin, on the CPU/f64 suite:
+
+* the span tracer's ring buffer: capacity bounds the memory, oldest
+  spans evict first, and ``spans_total`` stays lifetime-exact through
+  eviction (the windowed-trail + exact-count pattern);
+* a GOLDEN Chrome trace for a 2-chunk pipelined serve with one injected
+  retry on an injected clock — the exact (ph, name) event sequence,
+  schema-validated (``ph``/``ts``/``dur``/``pid``/``tid``), proving the
+  retry attempt, both dispatches, and the in-flight counter track are
+  all visible in Perfetto;
+* the ISSUE 5 acceptance run: PR 4's chaos plan under a tracer produces
+  a Perfetto-loadable document in which retries, bisection, the breaker
+  open -> half-open -> closed cycle, and fallback chunks are spans, and
+  the SAME run's Prometheus exposition + JSON snapshot agree with
+  ``ServeReport.metrics()`` on every shared counter (one backing store
+  — they cannot disagree — but the contract is pinned here);
+* the metrics registry: HPX-style name grammar to Prometheus sample
+  translation, one-name-one-kind registration, windowed histograms and
+  trails with lifetime-exact counts;
+* the exporters: the 127.0.0.1 scrape endpoint serves both expositions
+  live, and ``NLHEAT_EVENT_LOG`` streams discrete events as JSONL;
+* the observability contract: recording never raises (a poisoned clock
+  is swallowed), and the disabled path returns the shared no-op span.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from nonlocalheatequation_tpu.obs import trace as obs_trace
+from nonlocalheatequation_tpu.obs.export import EventLog, serve_metrics
+from nonlocalheatequation_tpu.obs.metrics import MetricsRegistry
+from nonlocalheatequation_tpu.obs.trace import NULL_SPAN, Tracer
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase,
+    EnsembleEngine,
+)
+from nonlocalheatequation_tpu.serve.server import ServePipeline
+from nonlocalheatequation_tpu.utils.faults import FaultPlan
+
+NX, NY, EPS, NSTEPS = 16, 16, 2, 2
+
+
+def _cases(n, rng, nt=NSTEPS):
+    return [EnsembleCase(shape=(NX, NY), nt=nt, eps=EPS, k=1.0, dt=1e-4,
+                         dh=0.02, test=False,
+                         u0=rng.normal(size=(NX, NY))) for _ in range(n)]
+
+
+class TickClock:
+    """Strictly-increasing injected clock: every read advances 1 ms, so
+    span timestamps are deterministic without wall-clock racing."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 0.001
+        return self.t
+
+
+class StepClock:
+    """Manually-advanced clock (the breaker-cooldown tests)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _check_schema(events):
+    """Chrome trace-event schema: the fields Perfetto actually keys on."""
+    assert events, "no events recorded"
+    for ev in events:
+        assert ev["ph"] in ("X", "i", "C"), ev
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert isinstance(ev["cat"], str) and ev["cat"]
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        if ev["ph"] == "i":
+            assert ev["s"] in ("t", "p", "g")
+
+
+# -- tracer unit behavior ---------------------------------------------------
+def test_ring_buffer_evicts_oldest_and_keeps_exact_lifetime_count():
+    clock = TickClock()
+    tr = Tracer(capacity=4, clock=clock)
+    for i in range(10):
+        t0 = clock()
+        tr.complete(f"e{i}", t0)
+    assert len(tr) == 4  # bounded
+    assert [ev["name"] for ev in tr.events] == ["e6", "e7", "e8", "e9"]
+    assert tr.spans_total == 10  # lifetime-exact through eviction
+    doc = tr.chrome_trace()
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    _check_schema(doc["traceEvents"])
+
+
+def test_tracer_capacity_must_be_positive():
+    with pytest.raises(ValueError, match="capacity"):
+        Tracer(capacity=0)
+
+
+def test_span_context_manager_records_error_and_timing():
+    clock = TickClock()
+    tr = Tracer(clock=clock)
+    with tr.span("ok", cat="t", detail=1):
+        pass
+    with pytest.raises(RuntimeError):
+        with tr.span("boom", cat="t"):
+            raise RuntimeError("x")
+    ok, boom = tr.events
+    assert ok["name"] == "ok" and ok["args"] == {"detail": 1}
+    assert ok["dur"] == pytest.approx(1000.0)  # one 1 ms tick, in us
+    assert boom["args"]["error"] == "RuntimeError"
+
+
+def test_disabled_path_is_the_shared_noop_span():
+    assert obs_trace.get_tracer() is None  # the suite default
+    assert obs_trace.span("anything", cat="x", a=1) is NULL_SPAN
+    obs_trace.instant("anything")  # no tracer: silently dropped
+
+
+def test_recording_never_raises_on_a_poisoned_clock():
+    def bad_clock():
+        raise RuntimeError("clock down")
+
+    tr = Tracer(clock=bad_clock)
+    with tr.span("s"):  # enter + exit both read the clock
+        pass
+    tr.instant("i")
+    tr.counter("c", v=1)
+    # untimeable events drop silently — the solve never notices
+    assert tr.spans_total == 0
+    tr.complete("caller-timed", 0.0, 1.0)  # caller timestamps still land
+    assert tr.spans_total == 1
+
+
+def test_write_failure_returns_false_never_raises(tmp_path, capsys):
+    tr = Tracer()
+    tr.complete("e", 0.0, 1.0)
+    assert tr.write(str(tmp_path)) is False  # a directory: open() fails
+    assert "trace write" in capsys.readouterr().err
+    out = tmp_path / "t.json"
+    assert tr.write(str(out)) is True
+    _check_schema(json.load(open(out))["traceEvents"])
+
+
+# -- the golden pipelined-serve trace ---------------------------------------
+def test_golden_trace_two_chunk_pipelined_serve_with_one_retry():
+    """ISSUE 5 satellite: deterministic spans for a 2-chunk pipelined
+    serve with one injected retry, on an injected clock."""
+    clock = TickClock()
+    tracer = Tracer(clock=clock, pid=7)
+    rng = np.random.default_rng(0)
+    engine = EnsembleEngine(batch_sizes=(1,))
+    with ServePipeline(engine=engine, depth=2, window_ms=0.0, clock=clock,
+                       retries=1, backoff_ms=1.0, sleep=lambda s: None,
+                       faults=FaultPlan.parse("raise@1"),
+                       tracer=tracer) as pipe:
+        for c in _cases(2, rng):
+            pipe.submit(c)
+        pipe.drain()
+    events = list(tracer.events)
+    _check_schema(events)
+    # the golden sequence: chunk 0 dispatches clean; chunk 1's first
+    # attempt raises (the injected fault), retries, dispatches; both are
+    # IN FLIGHT together (the counter track reaches 2); then two fetches
+    assert [(ev["ph"], ev["name"]) for ev in events] == [
+        ("i", "serve.close"),      # chunk 0 closes (size trigger)
+        ("X", "serve.build"),      # chunk 0 pad/build/stage
+        ("i", "serve.dispatch"),   # chunk 0 async launch
+        ("C", "serve.inflight"),   # 1 in flight
+        ("i", "serve.close"),      # chunk 1 closes
+        ("X", "serve.build"),      # chunk 1 attempt 1: injected raise
+        ("i", "serve.retry"),      # classified + retried
+        ("X", "serve.build"),      # chunk 1 attempt 2
+        ("i", "serve.dispatch"),
+        ("C", "serve.inflight"),   # 2 in flight — pipelining is real
+        ("X", "serve.fetch"),      # chunk 0 retires (the due fence)
+        ("C", "serve.inflight"),
+        ("X", "serve.fetch"),      # chunk 1 retires
+        ("C", "serve.inflight"),
+    ]
+    assert events[5]["args"]["error"] == "InjectedFault"
+    retry = events[6]["args"]
+    assert retry == {"chunk": 1, "attempt": 1, "classification": "error",
+                     "backoff_ms": 1.0}
+    assert events[7]["args"] == {"chunk": 1, "attempt": 2}
+    assert [ev["args"]["inflight"] for ev in events
+            if ev["ph"] == "C"] == [1, 2, 1, 0]
+    assert all(ev["pid"] == 7 for ev in events)
+    # injected clock: timestamps are monotone non-decreasing microseconds
+    ts = [ev["ts"] for ev in events]
+    assert ts == sorted(ts) and ts[0] > 0
+    assert tracer.spans_total == len(events) == 14
+    assert pipe.report.retries == 1
+
+
+def test_bisection_and_quarantine_are_visible_as_spans():
+    """An 8-case chunk with one persistent poison: the bisection chain
+    (8 -> 4 -> 2 -> 1) and the quarantine land in the trace."""
+    clock = TickClock()
+    tracer = Tracer(clock=clock)
+    rng = np.random.default_rng(3)
+    engine = EnsembleEngine(batch_sizes=(8,))
+    # huge window: the SIZE trigger closes one 8-case chunk
+    with ServePipeline(engine=engine, depth=1, window_ms=10_000.0,
+                       clock=clock, retries=0, backoff_ms=0.0,
+                       fallback=False, sleep=lambda s: None,
+                       faults=FaultPlan.parse("nan@c6x*"),
+                       tracer=tracer) as pipe:
+        handles = [pipe.submit(c) for c in _cases(8, rng)]
+        pipe.drain()
+    names = [ev["name"] for ev in tracer.events]
+    assert names.count("serve.bisect") == pipe.report.bisections >= 3
+    quar = [ev for ev in tracer.events if ev["name"] == "serve.quarantine"]
+    assert len(quar) == 1
+    assert quar[0]["args"]["case"] == 6
+    assert quar[0]["args"]["classification"] == "corrupt"
+    assert handles[6].error is not None
+    assert all(h.result is not None for i, h in enumerate(handles) if i != 6)
+
+
+def test_fetch_span_reports_effective_outcome_after_scan():
+    """A fetched-ok payload the finite scan reclassifies as corrupt must
+    not trace as outcome="ok": the serve.fetch span reports the
+    EFFECTIVE outcome, matching the retry/quarantine instants beside
+    it (the serve.fallback span already did)."""
+    clock = TickClock()
+    tracer = Tracer(clock=clock)
+    rng = np.random.default_rng(5)
+    engine = EnsembleEngine(batch_sizes=(1,))
+    with ServePipeline(engine=engine, depth=1, window_ms=0.0, clock=clock,
+                       retries=0, backoff_ms=0.0, fallback=False,
+                       sleep=lambda s: None,
+                       faults=FaultPlan.parse("nan@c0x*"),
+                       tracer=tracer) as pipe:
+        h = pipe.submit(_cases(1, rng)[0])
+        pipe.drain()
+    assert h.error is not None  # the single case quarantines
+    fetches = [ev for ev in tracer.events if ev["name"] == "serve.fetch"]
+    assert fetches and all(
+        ev["args"]["outcome"] == "corrupt" for ev in fetches)
+
+
+def test_traced_ab_baseline_ignores_a_process_global_tracer():
+    # the untraced arm passes TRACE_OFF, not None: with a global tracer
+    # installed (--trace/NLHEAT_TRACE) a None tracer would inherit it
+    # and the A/B would trace both arms, gating on a vacuous ~1.0 ratio
+    from nonlocalheatequation_tpu.serve.server import serve_traced_ab
+
+    installed = Tracer()
+    prev = obs_trace.set_tracer(installed)
+    try:
+        rng = np.random.default_rng(13)
+        engine = EnsembleEngine(batch_sizes=(1,))
+        serve_traced_ab(engine, _cases(1, rng), depth=1, iters=1)
+    finally:
+        obs_trace.set_tracer(prev)
+    # the engine's one-off warmup build span belongs to the global
+    # timeline; no PIPELINE span from either arm may leak there
+    assert all(not ev["name"].startswith("serve.")
+               for ev in installed.events)
+    # and the sentinel itself forces the zero-cost path on a pipeline
+    pipe = ServePipeline(engine=EnsembleEngine(batch_sizes=(1,)),
+                         depth=1, tracer=obs_trace.TRACE_OFF)
+    try:
+        assert pipe._tracer is None
+    finally:
+        pipe.close()
+
+
+def test_trace_write_degrades_exotic_span_args_to_str(tmp_path):
+    # one non-JSON-serializable span arg must cost that arg its repr,
+    # not the whole artifact (EventLog.emit's default=str discipline)
+    from pathlib import Path
+
+    tracer = Tracer(clock=TickClock())
+    # np.float32 is NOT a float subclass — json.dump alone raises
+    tracer.complete("serve.build", 0.001, 0.002, cat="serve",
+                    rate=np.float32(0.25), where=Path("/x"))
+    out = tmp_path / "t.json"
+    assert tracer.write(str(out)) is True
+    doc = json.loads(out.read_text())
+    args = doc["traceEvents"][0]["args"]
+    assert args["rate"] == "0.25" and args["where"] == "/x"
+
+
+def test_trace_write_is_atomic_concurrent_writers_never_tear(tmp_path):
+    # distributed ranks sharing a filesystem write via tmp + os.replace:
+    # the artifact is always ONE writer's complete document, never
+    # interleaved JSON Perfetto would reject — and no tmp file strands
+    out = tmp_path / "host_trace.json"
+    tracers = []
+    for n in (3, 7):
+        t = Tracer(clock=TickClock())
+        for i in range(n):
+            t.complete(f"serve.s{i}", 0.001 * (i + 1), 0.001 * (i + 2),
+                       cat="serve")
+        tracers.append(t)
+    threads = [threading.Thread(target=t.write, args=(str(out),))
+               for t in tracers]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    doc = json.loads(out.read_text())  # valid, complete
+    assert len(doc["traceEvents"]) in (3, 7)
+    assert list(tmp_path.iterdir()) == [out]
+
+
+def test_serve_traced_ab_floors_iters_at_one():
+    # iters <= 0 would return inf walls and a None tracer that bench.py
+    # dereferences — the A/B must always measure at least once
+    from nonlocalheatequation_tpu.serve.server import serve_traced_ab
+
+    rng = np.random.default_rng(11)
+    engine = EnsembleEngine(batch_sizes=(1,))
+    compile_s, plain, traced, tracer, rep = serve_traced_ab(
+        engine, _cases(1, rng), depth=1, iters=0)
+    assert np.isfinite(plain) and np.isfinite(traced)
+    assert tracer is not None and tracer.spans_total > 0
+    assert rep is not None and rep.cases == 1
+
+
+# -- the acceptance chaos run ----------------------------------------------
+def test_chaos_trace_and_expositions_agree_with_report_metrics(tmp_path):
+    """The ISSUE 5 acceptance: PR 4's chaos plan under a tracer yields a
+    Perfetto-loadable trace showing retries, the breaker cycle, and the
+    fallback chunks — and the run's Prometheus text + JSON snapshot
+    agree with ``ServeReport.metrics()`` on every shared counter."""
+    clock = StepClock()
+    tracer = Tracer(clock=clock)
+    rng = np.random.default_rng(7)
+    cases = _cases(9, rng)
+    engine = EnsembleEngine(batch_sizes=(1,))
+    with ServePipeline(engine=engine, depth=3, window_ms=0.0, clock=clock,
+                       retries=1, backoff_ms=0.0, fetch_deadline_ms=100.0,
+                       breaker_threshold=1, breaker_cooldown_ms=50.0,
+                       sleep=lambda s: None,
+                       faults=FaultPlan.parse("raise@1,stall@3,nan@5,nan@c6x*"),
+                       tracer=tracer) as pipe:
+        for c in cases[:8]:
+            pipe.submit(c)
+        pipe.drain()
+        clock.advance(0.1)  # breaker cooldown elapses
+        pipe.submit(cases[8])  # the half-open probe
+        pipe.drain()
+
+    # -- the trace: every resilience mechanism is visible ------------------
+    events = list(tracer.events)
+    _check_schema(events)
+    names = [ev["name"] for ev in events]
+    assert names.count("serve.retry") == pipe.report.retries >= 1
+    moves = [(ev["args"]["from"], ev["args"]["to"]) for ev in events
+             if ev["name"] == "breaker.transition"]
+    assert moves == [("closed", "open"), ("open", "half-open"),
+                     ("half-open", "closed")]
+    fallbacks = [ev for ev in events if ev["name"] == "serve.fallback"
+                 and ev["args"]["outcome"] == "ok"]
+    assert len(fallbacks) == pipe.report.fallback_chunks >= 1
+    assert any(ev["name"] == "serve.quarantine"
+               and ev["args"]["case"] == 6 for ev in events)
+    # Perfetto-loadable: the written artifact is valid trace-event JSON
+    out = tmp_path / "host_trace.json"
+    assert tracer.write(str(out)) is True
+    doc = json.load(open(out))
+    assert doc["traceEvents"] and _check_schema(doc["traceEvents"]) is None
+
+    # -- the expositions agree with metrics() on every shared counter ------
+    m = pipe.metrics()
+    res = m["resilience"]
+    reg = pipe.registry
+    snap = reg.snapshot()
+    assert snap["/ensemble/cases"] == m["cases"]
+    assert snap["/ensemble/dispatches"] == m["dispatches"]
+    assert snap["/ensemble/buckets"] == m["buckets"]
+    assert snap["/ensemble/programs-built"] == m["programs_built"]
+    assert snap["/serve/retries"] == res["retries"]
+    assert snap["/serve/bisections"] == res["bisections"]
+    assert snap["/serve/fallback-chunks"] == res["fallback_chunks"]
+    assert snap["/serve/faults"] == res["faults"]
+    assert snap["/serve/quarantined"]["count"] == res["quarantined_total"]
+    assert snap["/breaker/transitions"] == \
+        res["breaker"]["transition_count"] == len(moves)
+    assert snap["/serve/request-latency-ms"]["count"] == \
+        m["requests_completed"]
+    # one-line JSON snapshot round-trips to the same numbers
+    assert json.loads(reg.snapshot_json()) == json.loads(json.dumps(
+        snap, default=float))
+    assert "\n" not in reg.snapshot_json()
+    prom = reg.prometheus()
+    assert f"nlheat_serve_retries {res['retries']}" in prom
+    assert f"nlheat_ensemble_cases {m['cases']}" in prom
+    assert (f"nlheat_breaker_transitions "
+            f"{res['breaker']['transition_count']}") in prom
+    for label, count in res["faults"].items():
+        assert f'nlheat_serve_faults{{key="{label}"}} {count}' in prom
+
+
+# -- metrics registry -------------------------------------------------------
+def test_registry_kinds_and_one_name_one_kind():
+    reg = MetricsRegistry()
+    c = reg.counter("/serve/retries")
+    c.inc()
+    c.inc(2)
+    assert reg.counter("/serve/retries") is c and c.value == 3
+    g = reg.gauge("/serve/depth")
+    g.set(4)
+    assert g.value == 4
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("/serve/retries")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("/serve/depth")
+
+
+def test_histogram_window_bounds_memory_count_stays_exact():
+    reg = MetricsRegistry()
+    h = reg.histogram("/serve/lat", window=8)
+    for i in range(100):
+        h.observe(float(i))
+    assert len(h) == 8 and h.count == 100  # windowed + lifetime-exact
+    assert h.total == sum(range(100))
+    p = h.percentiles()
+    assert p["max"] == 99.0 and p["p50"] >= 92.0  # the recent window
+    t = reg.trail("/serve/log", window=4)
+    for i in range(10):
+        t.append({"i": i})
+    assert [e["i"] for e in t] == [6, 7, 8, 9] and t.count == 10
+
+
+def test_stable_copy_retries_racing_writer_then_defaults():
+    # the exposition-side race guard: a RuntimeError (deque/dict mutated
+    # during iteration) is retried; a persistent one falls back to the
+    # default instead of raising out of a scrape handler
+    from nonlocalheatequation_tpu.obs.metrics import _stable_copy
+
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise RuntimeError("deque mutated during iteration")
+        return [1, 2]
+
+    assert _stable_copy(flaky, []) == [1, 2] and calls[0] == 3
+
+    def hopeless():
+        raise RuntimeError("deque mutated during iteration")
+
+    assert _stable_copy(hopeless, {"d": 1}) == {"d": 1}
+
+
+def test_expositions_survive_a_racing_recorder_thread():
+    # the advertised mid-run scrape: the HTTP handler thread reads
+    # prometheus()/snapshot_json() while the pipeline thread records —
+    # deque/dict iteration must never leak a RuntimeError into a 500
+    reg = MetricsRegistry()
+    h = reg.histogram("/serve/request-latency-ms", window=64)
+    lab = reg.labeled("/serve/faults")
+    stop = threading.Event()
+
+    def record():
+        i = 0
+        while not stop.is_set():
+            h.observe(float(i % 97))
+            lab[f"k{i % 13}"] = lab.get(f"k{i % 13}", 0) + 1
+            i += 1
+
+    w = threading.Thread(target=record)
+    w.start()
+    try:
+        for _ in range(300):
+            prom = reg.prometheus()
+            assert "nlheat_serve_request_latency_ms_count" in prom
+            json.loads(reg.snapshot_json())
+    finally:
+        stop.set()
+        w.join()
+
+
+def test_prometheus_name_grammar_instance_becomes_label():
+    reg = MetricsRegistry()
+    reg.gauge("/device{3}/busy-rate").set(0.25)
+    reg.counter("/serve{chunk}/retries").inc(2)
+    reg.labeled("/serve/faults")["hang"] = 5
+    prom = reg.prometheus()
+    assert 'nlheat_device_busy_rate{device="3"} 0.25' in prom
+    assert 'nlheat_serve_retries{serve="chunk"} 2' in prom
+    assert 'nlheat_serve_faults{key="hang"} 5' in prom
+    assert "# TYPE nlheat_device_busy_rate gauge" in prom
+    assert "# TYPE nlheat_serve_retries counter" in prom
+
+
+def test_report_and_registry_share_one_storage():
+    from nonlocalheatequation_tpu.serve.server import ServeReport
+
+    r = ServeReport(depth=2)
+    r.retries += 3
+    r.faults["hang"] = r.faults.get("hang", 0) + 1
+    assert r.registry.get("/serve/retries").value == 3
+    assert r.registry.get("/serve/faults")["hang"] == 1
+    r.registry.get("/serve/retries").inc()  # the other direction
+    assert r.retries == 4
+    # two reports never share counters (private registry each)
+    assert ServeReport().retries == 0
+    # the ISSUE 5 bound: every report window caps at LOG_CAP, so a
+    # long-lived server cannot grow its report without bound
+    from nonlocalheatequation_tpu.serve.server import LOG_CAP
+
+    for w in (r.chunk_log.entries, r.occupancy_samples.entries,
+              r.quarantined.entries, r.request_latency_ms.samples,
+              r.queue_wait_ms.samples):
+        assert w.maxlen == LOG_CAP
+
+
+def test_publish_busy_rates_counts_windows_vs_actual_rebalances():
+    from nonlocalheatequation_tpu.parallel.load_balance import (
+        publish_busy_rates,
+    )
+
+    reg = MetricsRegistry()
+    publish_busy_rates([0.2, 0.8], moved=0, registry=reg)  # ran, no moves
+    publish_busy_rates([0.5, 0.5], moved=3, registry=reg)
+    snap = reg.snapshot()
+    assert snap["/balance/windows"] == 2
+    assert snap["/balance/rebalances"] == 1  # only the window that moved
+    assert snap["/balance/tiles-moved"] == 3
+    assert snap["/device{0}/busy-rate"] == 0.5  # latest window's gauge
+
+
+# -- exporters --------------------------------------------------------------
+def test_scrape_endpoint_serves_both_expositions():
+    reg = MetricsRegistry()
+    reg.counter("/serve/retries").inc(3)
+    srv = serve_metrics(0, reg)  # port 0: pick a free one
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "nlheat_serve_retries 3" in text
+        js = json.loads(
+            urllib.request.urlopen(f"{base}/metrics.json").read())
+        assert js["/serve/retries"] == 3
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/other")
+    finally:
+        srv.close()
+
+
+def test_scrape_endpoint_follows_a_live_registry_binding():
+    holder = [MetricsRegistry()]
+    srv = serve_metrics(0, lambda: holder[0])
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        holder[0].gauge("/serve/depth").set(1)
+        js = json.loads(
+            urllib.request.urlopen(f"{base}/metrics.json").read())
+        assert js == {"/serve/depth": 1}
+        holder[0] = MetricsRegistry()  # a new pipeline's registry
+        holder[0].gauge("/serve/depth").set(8)
+        js = json.loads(
+            urllib.request.urlopen(f"{base}/metrics.json").read())
+        assert js == {"/serve/depth": 8}
+    finally:
+        srv.close()
+
+
+def test_event_log_streams_serve_events_as_jsonl(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    monkeypatch.setenv("NLHEAT_EVENT_LOG", str(path))
+    clock = TickClock()
+    rng = np.random.default_rng(11)
+    engine = EnsembleEngine(batch_sizes=(1,))
+    with ServePipeline(engine=engine, depth=1, window_ms=0.0, clock=clock,
+                       retries=1, backoff_ms=0.0, sleep=lambda s: None,
+                       faults=FaultPlan.parse("raise@0")) as pipe:
+        for c in _cases(2, rng):
+            pipe.submit(c)
+        pipe.drain()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    kinds = [ln["event"] for ln in lines]
+    assert kinds.count("retry") == pipe.report.retries == 1
+    assert kinds.count("chunk") == 2  # one record per retired chunk
+    assert lines[0]["classification"] == "error"
+
+
+def test_event_log_unopenable_path_is_loud_but_not_fatal(tmp_path, capsys):
+    log = EventLog.from_env(
+        {"NLHEAT_EVENT_LOG": str(tmp_path / "no" / "dir" / "x.jsonl")})
+    assert log is None
+    assert "cannot be opened" in capsys.readouterr().err
+    assert EventLog.from_env({}) is None  # unset: the zero-cost path
+
+
+def test_event_log_emit_is_thread_safe_one_json_per_line(tmp_path):
+    path = tmp_path / "e.jsonl"
+    log = EventLog(str(path))
+    threads = [threading.Thread(
+        target=lambda i=i: [log.emit(event="t", thread=i, n=j)
+                            for j in range(50)]) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log.close()
+    lines = path.read_text().splitlines()
+    assert len(lines) == 200
+    assert all(json.loads(ln)["event"] == "t" for ln in lines)

@@ -1,6 +1,8 @@
 """jax.profiler trace capture (utils/profiling.py) — SURVEY.md section 5's
-TPU tracing equivalent.  Verifies a trace is actually written around a solve
-and that profiling never breaks the solve itself."""
+TPU tracing equivalent.  Verifies a trace is actually written around a solve,
+that profiling never breaks the solve itself, and (the ISSUE 5 bugfix) that
+``--profile`` now wraps the batch drivers — served and ensemble workloads
+included — instead of only the solo-solve path."""
 
 import os
 
@@ -26,3 +28,70 @@ def test_trace_none_is_noop():
     with trace(None):
         s.do_work()
     assert s.u is not None
+
+
+def test_run_batch_threads_profile_to_every_mode(monkeypatch, capsys):
+    """The ISSUE 5 bugfix, unit level: ``run_batch(profile=...)`` wraps
+    the sequential, ensemble, AND served drivers in one profiling
+    context — and ``profile=None`` stays the no-op path (``trace(None)``
+    yields immediately; the drivers run outside any capture)."""
+    from nonlocalheatequation_tpu.cli import common
+    from nonlocalheatequation_tpu.utils import profiling
+
+    captures = []
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def spy_trace(log_dir):
+        captures.append(("enter", log_dir))
+        yield
+        captures.append(("exit", log_dir))
+
+    monkeypatch.setattr(profiling, "trace", spy_trace)
+    monkeypatch.setattr("sys.stdin", __import__("io").StringIO("1\n7\n"))
+
+    def read_case(toks, pos):
+        return ((int(toks[pos]),), pos + 1)
+
+    def run_serve(case_iter):
+        assert captures == [("enter", "DIR")]  # serving runs INSIDE
+        return [(0.0, n) for (n,) in case_iter]
+
+    rc = common.run_batch(read_case, None, row_tokens=1,
+                          run_serve=run_serve, profile="DIR")
+    assert rc == 0 and captures == [("enter", "DIR"), ("exit", "DIR")]
+    assert "Tests Passed" in capsys.readouterr().out
+
+    captures.clear()
+    monkeypatch.setattr("sys.stdin", __import__("io").StringIO("1\n7\n"))
+
+    def run_ensemble(cases):
+        assert captures == [("enter", None)]
+        return [(0.0, n) for (n,) in cases]
+
+    # profile=None: the no-op path — trace(None) is entered (and is a
+    # no-op, test_trace_none_is_noop) so the disabled wiring adds nothing
+    rc = common.run_batch(read_case, None, row_tokens=1,
+                          run_ensemble=run_ensemble, profile=None)
+    assert rc == 0 and captures == [("enter", None), ("exit", None)]
+    capsys.readouterr()
+
+
+def test_profile_flag_captures_served_batch(tmp_path):
+    """The bugfix, end to end: a ``--serve`` batch under ``--profile``
+    writes a real jax.profiler capture around the pipelined workload."""
+    import subprocess
+    import sys
+
+    logdir = str(tmp_path / "prof")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "nonlocalheatequation_tpu.cli.solve2d",
+         "--platform", "cpu", "--test_batch", "--serve", "2",
+         "--profile", logdir],
+        input="2\n32 32 10 5 1 0.001 0.03125\n32 32 10 5 1 0.001 0.03125\n",
+        capture_output=True, text=True, timeout=540, cwd=repo)
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+    found = [os.path.join(rt, f) for rt, _, fs in os.walk(logdir) for f in fs]
+    assert found, "no profiler capture written around the served batch"

@@ -15,7 +15,10 @@ Env:
     BT_ENS_GRID (1024 / 64) + BT_ENS_CASES (8, the ensemble/serve A/B
     bucket), BT_SERVE_DEPTH (4, the serve group's pipelined in-flight cap),
     BT_FAULT_PLAN (the resilience group's injected chaos plan,
-    utils/faults.py grammar; default "raise@1,stall@3,nan@5")
+    utils/faults.py grammar; default "raise@1,stall@3,nan@5"),
+    BT_OBS_ITERS (5, min-of iterations for the obs group's
+    traced-vs-untraced A/B — the overhead ratio is a difference of two
+    near-equal walls, so it needs more samples than the big ratios)
 """
 
 from __future__ import annotations
@@ -720,6 +723,47 @@ def bench_serve(steps: int):
          occupancy=pipe_rep.occupancy())
 
 
+def bench_obs(steps: int):
+    """Observability overhead A/B (ISSUE 5): C single-case chunks
+    scheduled through serve/server.py twice per iteration — tracing off
+    (the zero-cost disabled path: the pipeline holds ``tracer=None`` and
+    every emitter is one attribute test) vs a live obs/ span tracer
+    recording the full chunk lifecycle.  The traced row records
+    ``trace_overhead`` = traced/untraced wall (the ISSUE 5 acceptance
+    gate: <= 1.05 on the CPU proxy) and the lifetime span count.  Spans
+    are host-side appends under a lock — no fence, no device sync — so
+    the ratio measures pure bookkeeping."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+    from nonlocalheatequation_tpu.serve.ensemble import (
+        EnsembleCase,
+        EnsembleEngine,
+    )
+    from nonlocalheatequation_tpu.serve.server import serve_traced_ab
+
+    D = int(os.environ.get("BT_SERVE_DEPTH", 4))
+    C = int(os.environ.get("BT_ENS_CASES", 8))
+    iters = int(os.environ.get("BT_OBS_ITERS", 5))
+    n = cfg("BT_ENS_GRID", 1024, 64)
+    method = "pallas" if on_tpu() else "sat"
+    op = NonlocalOp2D(8, k=1.0, dt=1.0, dh=1.0 / n, method=method)
+    dt = stable_dt(op)
+    rng = np.random.default_rng(0)
+    cases = [EnsembleCase(shape=(n, n), nt=steps, eps=8, k=1.0, dt=dt,
+                          dh=1.0 / n, test=False,
+                          u0=rng.normal(size=(n, n))) for _ in range(C)]
+    engine = EnsembleEngine(method=method, batch_sizes=(1,))
+    compile_s, plain_best, traced_best, tracer, _ = serve_traced_ab(
+        engine, cases, D, iters=iters)
+    log(f"    obs compile+first: {compile_s:.2f}s; "
+        f"{tracer.spans_total} spans")
+    emit(f"obs/untraced{C}", C * n * n, steps, plain_best, grid=n, eps=8,
+         cases=C, depth=D)
+    emit(f"obs/traced{C}", C * n * n, steps, traced_best, grid=n, eps=8,
+         cases=C, depth=D,
+         trace_overhead=round(traced_best / plain_best, 4),
+         spans=tracer.spans_total)
+
+
 def bench_resilience(steps: int):
     """Fault-tolerance overhead + chaos A/B (ISSUE 4): C single-case
     chunks served twice through serve/server.py — once with the
@@ -803,6 +847,7 @@ BENCHES = {
     "autotune": bench_autotune,
     "ensemble": bench_ensemble,
     "serve": bench_serve,
+    "obs": bench_obs,
     "resilience": bench_resilience,
 }
 

@@ -83,6 +83,16 @@ the rung is labeled "variant": "serveobsD" and carries
 to a DIRECTORY path (anything other than "1") to also write the
 Perfetto-loadable host_trace.json artifact there, its path echoed in
 "trace_path"),
+BENCH_MULTICHIP=N (N >= 2: the sharded-solving A/B — each rung runs the
+distributed 2D solver over ONE shared N-device mesh twice, collective
+halos (ppermute between launches) vs the FUSED remote-DMA halo engine
+(ops/pallas_halo.py), same mesh, same initial state; the rung is
+labeled "variant": "multichipN" with "comm": "fused" and carries
+"halo_overlap" = collective/fused wall ratio — the overlap evidence —
+plus "devices"/"mesh"; on a single-chip tunnel N clamps to the devices
+actually present and the label says so; off-TPU the parent forces N
+virtual host devices so the CPU proxy exercises the real collective
+paths),
 BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
@@ -296,7 +306,9 @@ class Best:
                ("fence_amortization", "latency_ms", "occupancy",
                 "served", "poison", "fallback_chunks", "retries_total",
                 "fault_plan", "breaker_transitions",
-                "trace_overhead", "spans", "trace_path")
+                "trace_overhead", "spans", "trace_path",
+                # multichip rung: the fused-vs-collective halo evidence
+                "comm", "halo_overlap", "devices", "mesh")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -528,6 +540,15 @@ def main():
     # scrub must PIN it off, not just delete it — a bench rung must run
     # exactly the variant its label claims
     os.environ["NLHEAT_AUTOTUNE"] = "0"
+    # BENCH_MULTICHIP off-TPU: the virtual-device-count flag must reach
+    # every child BEFORE its backend first initializes (it only affects
+    # the host platform, so it is harmless for real-TPU children)
+    mc_env = int(os.environ.get("BENCH_MULTICHIP", 0) or 0)
+    if mc_env >= 2:
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={mc_env}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
     # NLHEAT_FAULT_PLAN joins the scrub: a fault plan leaked from a chaos
     # shell would inject failures into a headline measurement; the serve
     # fault rung re-injects deliberately via BENCH_SERVE_FAULTS only
@@ -771,6 +792,10 @@ def child_measure():
     # default to the fastest XLA path instead.
     method = os.environ.get("BENCH_METHOD") or None  # "" == unset
     note = "env override" if method else None
+    if int(os.environ.get("BENCH_MULTICHIP", 0) or 0) >= 2:
+        # the multichip A/B runs both arms on the pallas kernels (the
+        # fused family is pallas-only); the label must say what ran
+        method, note = "pallas", "multichip A/B (fused needs pallas)"
     if method is None and os.environ.get("BENCH_FAULT") == "hang_method":
         # fault injection for the parent's kill-and-retry-with-sat path
         # (tests/test_bench_harness.py); a forced BENCH_METHOD bypasses it,
@@ -808,6 +833,16 @@ def child_measure():
     srv = int(os.environ.get("BENCH_SERVE", 0) or 0)
     if srv == 1:
         srv = 0  # the A/B needs a pipelined depth; 0/1 mean off
+    mchip = int(os.environ.get("BENCH_MULTICHIP", 0) or 0)
+    if mchip == 1:
+        mchip = 0  # the A/B needs a mesh; 0/1 mean off
+    if mchip and (srv or ens or any(os.environ.get(k) for k in
+                                    ("BENCH_CARRIED", "BENCH_RESIDENT",
+                                     "BENCH_SUPERSTEP"))):
+        log("BENCH_MULTICHIP set: ignoring BENCH_SERVE/ENSEMBLE/CARRIED/"
+            "RESIDENT/SUPERSTEP — the multichip rung is its own labeled "
+            "variant")
+        srv = ens = 0
     if srv and (ens or any(os.environ.get(k) for k in
                            ("BENCH_CARRIED", "BENCH_RESIDENT",
                             "BENCH_SUPERSTEP"))):
@@ -830,6 +865,99 @@ def child_measure():
             dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
             op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / grid, method=method,
                               precision=PRECISION)
+            if mchip:
+                # sharded-solving A/B: the SAME mesh, the SAME initial
+                # state, two halo engines — collective (ppermute fenced
+                # between launches) vs fused (remote-DMA inside the step
+                # kernel, ops/pallas_halo.py).  Both arms run
+                # method='pallas' (the fused family is pallas-only; a
+                # like-for-like ratio needs the same compute kernel).
+                from jax import lax
+
+                from nonlocalheatequation_tpu.parallel.distributed2d import (
+                    Solver2DDistributed,
+                )
+                from nonlocalheatequation_tpu.parallel.mesh import (
+                    factor_devices,
+                    make_mesh,
+                )
+
+                ndev = min(mchip, len(jax.devices()))
+                if ndev < mchip:
+                    # a single-chip tunnel cannot fake an N-chip mesh —
+                    # clamp and label honestly (the variant carries the
+                    # EFFECTIVE device count)
+                    log(f"BENCH_MULTICHIP={mchip}: only {ndev} device(s) "
+                        f"present; running the A/B on a {ndev}-device mesh")
+                # degrade, never zero: drop to the largest device count
+                # whose most-square factorization divides the grid (a
+                # 6-device mesh factors 3x2, which 1024 cannot shard)
+                while ndev > 1:
+                    mx, my = factor_devices(ndev)
+                    if grid % mx == 0 and grid % my == 0:
+                        break
+                    ndev -= 1
+                else:
+                    mx = my = 1
+                if ndev < min(mchip, len(jax.devices())):
+                    log(f"BENCH_MULTICHIP: mesh {ndev + 1}+ does not "
+                        f"divide grid {grid}; using {ndev} device(s) "
+                        f"({mx}x{my})")
+                mesh = make_mesh(mx, my, jax.devices()[:ndev])
+                u0 = rng.normal(size=(grid, grid))
+                walls = {}
+                compile_s = {}
+                for comm in ("collective", "fused"):
+                    s = Solver2DDistributed(
+                        grid, grid, 1, 1, nt=steps, eps=EPS, k=1.0,
+                        dt=dt, dh=1.0 / grid, method="pallas",
+                        dtype=jnp.float32, mesh=mesh, comm=comm)
+                    s.input_init(u0)
+                    step = s._build_step(1)
+                    u, _src = s._device_state()
+
+                    @jax.jit
+                    def multi(uc, step=step):
+                        return lax.scan(
+                            lambda c, t: (step(c, t), None), uc,
+                            jnp.arange(steps))[0]
+
+                    t0 = time.perf_counter()
+                    u = multi(u)
+                    sync(u)
+                    compile_s[comm] = time.perf_counter() - t0
+                    best = float("inf")
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        u = multi(u)
+                        sync(u)
+                        best = min(best, time.perf_counter() - t0)
+                    walls[comm] = best
+                    log(f"rung {grid}^2 multichip {comm}: "
+                        f"{best * 1e3:.1f} ms "
+                        f"(compile {compile_s[comm]:.2f}s, "
+                        f"mesh {mx}x{my})")
+                overlap = walls["collective"] / walls["fused"]
+                value = grid * grid * steps / walls["fused"]
+                event(
+                    event="rung",
+                    grid=grid,
+                    steps=steps,
+                    best_s=walls["fused"],
+                    ms_per_step=walls["fused"] / steps * 1e3,
+                    value=value,
+                    compile_s=round(compile_s["fused"], 3),
+                    variant=f"multichip{ndev}",
+                    comm="fused",
+                    halo_overlap=round(overlap, 4),
+                    devices=ndev,
+                    mesh={"x": mx, "y": my},
+                )
+                last_op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / grid,
+                                       method="pallas",
+                                       precision=PRECISION)
+                any_rung = True
+                continue
             if srv:
                 # pipelined-vs-fenced serving A/B: C single-case chunks
                 # (batch_sizes=(1,) pins one dispatch per case, the

@@ -56,6 +56,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="exchange a K*eps-wide halo once per K steps and "
                         "advance K steps locally (communication-avoiding; "
                         "K-fold fewer collective rounds)")
+    p.add_argument("--comm", default="collective",
+                   choices=("collective", "fused"),
+                   help="halo-exchange engine: 'collective' (ppermute "
+                        "between launches) or 'fused' (remote-DMA exchange "
+                        "inside the Pallas step kernel, overlapped with "
+                        "the interior sweep; needs --method pallas)")
     p.add_argument("--method", default="auto",
                    choices=("auto", "conv", "shift", "sat", "pallas"))
     p.add_argument("--log", action="store_true")
@@ -119,6 +125,14 @@ def main(argv=None) -> int:
     # rebalancing.  The plain path stays on the fused SPMD program.
     use_elastic = (assignment is not None or args.nbalance > 0
                    or args.test_load_balance)
+    if args.comm != "collective" and use_elastic:
+        # honesty rule: the elastic executor's gang programs move halos
+        # by all_gather over the slot axis (parallel/gang.py) — there is
+        # no fused-DMA schedule there to select
+        print("--comm fused is the SPMD path's fused-exchange engine; "
+              "the elastic executor (partition maps / --nbalance / "
+              "--test_load_balance) does not support it", file=sys.stderr)
+        return 1
     if args.resync:
         # honesty rule: neither the SPMD scan nor the elastic executor has
         # a per-step precision switch (Solver2DDistributed refuses the
@@ -179,7 +193,7 @@ def main(argv=None) -> int:
             k=k, dt=dt, dh=dh, mesh=mesh, method=args.method,
             checkpoint_path=args.checkpoint, ncheckpoint=args.ncheckpoint,
             superstep=args.superstep, precision=args.precision,
-            resync_every=args.resync,
+            resync_every=args.resync, comm=args.comm,
         )
 
     if args.test_batch:

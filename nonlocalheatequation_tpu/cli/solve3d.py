@@ -54,6 +54,13 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("auto", "shift", "sat", "pallas"))
     p.add_argument("--distributed", action="store_true",
                    help="shard over the device mesh (SPMD + halo exchange)")
+    p.add_argument("--comm", default="collective",
+                   choices=("collective", "fused"),
+                   help="with --distributed: halo-exchange engine — "
+                        "'collective' (ppermute between launches) or "
+                        "'fused' (remote-DMA exchange inside the Pallas "
+                        "step kernel, overlapped with the interior sweep; "
+                        "needs --method pallas)")
     p.add_argument("--superstep", type=int, default=1, metavar="K",
                    help="with --distributed: exchange a K*eps-wide halo "
                         "once per K steps (communication-avoiding)")
@@ -81,6 +88,12 @@ def main(argv=None) -> int:
     if args.test_batch and (args.resume or args.checkpoint):
         print("--checkpoint/--resume cannot be combined with --test_batch",
               file=sys.stderr)
+        return 1
+    if args.comm != "collective" and not args.distributed:
+        # honesty rule: the serial solvers exchange no halos at all —
+        # accepting --comm fused there would claim an overlap that
+        # cannot exist
+        print("--comm fused requires --distributed", file=sys.stderr)
         return 1
     if args.superstep > 1 and not args.distributed:
         # honesty rule (see solve2d_distributed): never run the per-step
@@ -146,7 +159,8 @@ def _run(args, multi: bool) -> int:
                                        checkpoint_path=args.checkpoint,
                                        ncheckpoint=args.ncheckpoint,
                                        superstep=args.superstep,
-                                       precision=args.precision)
+                                       precision=args.precision,
+                                       comm=args.comm)
         return Solver3D(nx, ny, nz, nt, eps, nlog=args.nlog, k=k, dt=dt,
                         dh=dh, backend=args.backend, method=args.method,
                         checkpoint_path=args.checkpoint,

@@ -1,0 +1,764 @@
+"""Fused distributed halo kernels — remote-DMA ghost exchange overlapped
+with the interior stencil sweep.
+
+The collective distributed path (parallel/halo.py + distributed2d/3d)
+fences every halo exchange against the step: `lax.ppermute` collectives
+run *between* kernel launches, so each timestep is exchange -> sweep with
+no overlap — and the multi-hop long-horizon case pays one sequential
+ppermute round per hop per axis.  The reference hides exactly this
+latency with its interior/boundary two-stage dataflow: ghost-zone RPC
+futures fly while interior tiles compute
+(src/2d_nonlocal_distributed.cpp:1156-1261).  This module is that design
+TPU-native, inside the Pallas kernel itself:
+
+* :func:`plan_exchange` rasterizes the reference's neighbor rectangles
+  (``add_neighbour_rectangle``, :982-992) for a block on a device mesh:
+  one message per neighbor offset — 8 in 2D at one hop, like the
+  reference's 8-neighbor tiles; ``(2m+1)^d - 1`` when the horizon spans
+  m shards — with the transfer width CAPPED at the remaining hop depth
+  (parallel/halo.hop_widths), and each message carrying its exact source
+  rectangle (sender block coords) and destination rectangle (receiver
+  frame coords).  Multi-hop bands DMA *directly* to the device m hops
+  away instead of store-and-forwarding through the ring.
+* the **RDMA kernel** (:func:`build_fused_nsum_2d` /
+  :func:`build_fused_nsum_3d`, TPU only): each device's kernel preps a
+  halo frame in VMEM scratch, barriers with its neighbors
+  (``get_barrier_semaphore`` — a send may never land in a frame still
+  being prepped), starts ``make_async_remote_copy`` for every plan
+  message (DMA semaphores in scratch), computes the INTERIOR cells —
+  which read no halo — while the bands are in flight, waits on the recv
+  semaphores, and finishes the eps-wide boundary ring.  Communication
+  rides under compute instead of fencing it.
+* the **split compute kernel** (:func:`build_split_nsum_2d` /
+  :func:`build_split_nsum_3d`): the same interior-then-ring compute body
+  over a pre-filled frame, with no DMA machinery.  Off-TPU it runs in
+  the Pallas interpreter under shard_map (bands moved by the existing
+  ppermute transport), so the fused kernel's compute decomposition is
+  exercised — and pinned BITWISE against the `halo_pad_*` oracle — by
+  the CPU tier-1 suite on every run (tests/test_halo_fused.py).  What
+  CPU cannot exercise is the RDMA transport itself; that evidence comes
+  from the on-device dryrun/bench rungs.
+
+The kernels emit the raw neighbor SUM; the solver forms
+``du = c*h^d * (nsum - Wsum*u)`` outside, in exactly
+``NonlocalOp*.apply_padded``'s expression — which is what makes the
+fused path bitwise the collective path on the f64 CPU suite rather than
+merely 1e-12-close: the strip plan's per-element value is invariant to
+the evaluated sub-rectangle (each output element sums the same window
+slices in the same order whatever ``tm``/``ny``/``row0``/``col0`` range
+it is computed in — the same invariance the resident kernel's docstring
+proves for strip heights), and the dyadic/NAF chains are lane- and
+column-local, so stale not-yet-arrived halo values can never leak into
+interior elements computed while the DMA is in flight.
+
+Only ``method='pallas'``-capable buckets (uniform J) can run fused;
+everything else refuses loudly (:func:`require_fused`).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from nonlocalheatequation_tpu.ops.pallas_kernel import (
+    _VMEM_BUDGET,
+    _VMEM_LIMIT,
+    _block_neighbor_sum_3d,
+    _lane_runs,
+    _lane_runs_3d,
+    _lane_slots,
+    _on_tpu,
+    _reject_f64_on_tpu,
+    _round_up,
+    _strip_neighbor_sum,
+    _strip_plan_3d,
+    _window_pad,
+)
+from nonlocalheatequation_tpu.parallel.halo import hop_widths
+from nonlocalheatequation_tpu.utils.compat import array_vma, out_struct
+
+#: collective_id of the fused kernels' neighbor barrier (2D and 3D use
+#: distinct ids so a program mixing both can never cross their barriers)
+_COLLECTIVE_ID_2D = 0x2D
+_COLLECTIVE_ID_3D = 0x3D
+
+
+# ---------------------------------------------------------------------------
+# The exchange plan: the reference's neighbor rectangles on a device mesh
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class HaloMsg:
+    """One directed band: sender at mesh position p pushes
+    ``block[src]`` into the frame of the receiver at ``p + offset``,
+    landing at ``frame[dst]``.  ``src`` is in sender block coordinates,
+    ``dst`` in receiver frame coordinates (block at offset eps per
+    sharded axis); both are per-axis ``(start, stop)`` pairs."""
+
+    offset: tuple[int, ...]
+    src: tuple[tuple[int, int], ...]
+    dst: tuple[tuple[int, int], ...]
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return tuple(b - a for a, b in self.src)
+
+    def size(self) -> int:
+        return int(np.prod(self.shape))
+
+
+def _axis_ranges(extent: int, nshards: int, eps: int):
+    """Per-axis {offset: (src_range, dst_range)} for one sharded axis.
+
+    Offset +h: the receiver sits h shards AFTER the sender, so the
+    sender's trailing ``hop_widths(eps, extent)[h-1]``-wide band lands in
+    the receiver's leading (low-side) halo — and mirrored for -h.  Hops
+    are capped at ``nshards - 1``: a band from beyond the mesh does not
+    exist, and the un-sent halo stays zero, which IS the volumetric
+    boundary condition (exactly `lax.ppermute`'s un-targeted-output
+    semantics, parallel/halo.py).
+    """
+    widths = hop_widths(eps, extent)
+    hops = min(len(widths), max(nshards - 1, 0))
+    ranges = {0: ((0, extent), (eps, eps + extent))}
+    for h in range(1, hops + 1):
+        w = widths[h - 1]
+        # +h: sender's LAST w rows -> receiver frame rows ending at the
+        # low-halo depth (h-1)*extent below the block edge
+        lo = eps - (h - 1) * extent - w
+        ranges[h] = ((extent - w, extent), (lo, lo + w))
+        # -h: sender's FIRST w rows -> receiver's high-side halo
+        hi = eps + extent + (h - 1) * extent
+        ranges[-h] = ((0, w), (hi, hi + w))
+    return ranges
+
+
+def plan_exchange(
+    mesh_shape: tuple[int, ...],
+    block_shape: tuple[int, ...],
+    eps: int,
+) -> tuple[HaloMsg, ...]:
+    """Every band one device pushes per exchange, in a deterministic
+    order (message i on every device targets the same offset — the SPMD
+    symmetry the semaphore pairing relies on: my message i lands on my
+    +offset neighbor's ``recv_sems[i]``, and the message arriving on MY
+    ``recv_sems[i]`` is my -offset neighbor's message i)."""
+    if len(mesh_shape) != len(block_shape):
+        raise ValueError(
+            f"mesh_shape {mesh_shape} and block_shape {block_shape} "
+            "disagree in rank")
+    per_axis = [
+        _axis_ranges(int(b), int(n), int(eps))
+        for b, n in zip(block_shape, mesh_shape)
+    ]
+    msgs = []
+    offsets = [sorted(r.keys()) for r in per_axis]
+    for combo in np.ndindex(*[len(o) for o in offsets]):
+        off = tuple(offsets[ax][i] for ax, i in enumerate(combo))
+        if all(o == 0 for o in off):
+            continue
+        src = tuple(per_axis[ax][o][0] for ax, o in enumerate(off))
+        dst = tuple(per_axis[ax][o][1] for ax, o in enumerate(off))
+        msgs.append(HaloMsg(offset=off, src=src, dst=dst))
+    return tuple(msgs)
+
+
+def plan_bytes(plan, itemsize: int) -> int:
+    """Bytes one interior device pushes per exchange (edge devices skip
+    out-of-mesh targets at runtime; this is the invariant per-exchange
+    upper bound the /halo/bytes counter and the docs quote)."""
+    return sum(m.size() for m in plan) * int(itemsize)
+
+
+def collective_bytes(
+    mesh_shape: tuple[int, ...],
+    block_shape: tuple[int, ...],
+    eps: int,
+    itemsize: int,
+) -> int:
+    """Bytes one device ppermutes per `halo_pad_nd` exchange (both
+    directions), with the hop-capped widths — the regression-test pin
+    for the parallel/halo.py byte-cap fix.  Axis k's bands carry the
+    earlier axes' halos (the two-phase corner trick), so extents grow by
+    2*eps per completed axis."""
+    total = 0
+    extents = [int(b) for b in block_shape]
+    for ax, (bs, nshards) in enumerate(zip(block_shape, mesh_shape)):
+        if int(nshards) <= 1:
+            extents[ax] += 2 * eps
+            continue
+        other = 1
+        for j, e in enumerate(extents):
+            if j != ax:
+                other *= e
+        per_direction = sum(hop_widths(eps, int(bs)))
+        total += 2 * per_direction * other * int(itemsize)
+        extents[ax] += 2 * eps
+    return total
+
+
+# ---------------------------------------------------------------------------
+# VMEM fit models (the halo-resident frame layout)
+# ---------------------------------------------------------------------------
+
+
+def _fits_fused(bx: int, by: int, eps: int, itemsize: int,
+                bf16: bool = False) -> bool:
+    """Stack model for the 2D fused/split kernels: the halo frame
+    (bx+2e+pad, by+2e) lives whole in VMEM, the interior phase runs one
+    frame-sized strip-plan evaluation, and the ring phase's four
+    narrow-window evaluations are counted as one more frame-sized one
+    (conservative, like every _fits* model — a too-big block fails here
+    with guidance, never inside Mosaic)."""
+    pad = _window_pad(eps)
+    Rf, Lf = bx + 2 * eps + pad, by + 2 * eps
+    frame = Rf * Lf * itemsize
+    out = bx * by * itemsize
+    log_steps = max(1, int(np.ceil(np.log2(Rf))))
+    lane_slots = _lane_slots({(h, L) for h, _j0, L in _lane_runs(eps)})
+    per_eval = 2 * log_steps + 6 + lane_slots
+    stack = 2 * per_eval * frame + 4 * frame + 4 * out
+    if bf16:
+        stack += frame  # the rounded-operand copy
+    return stack <= _VMEM_BUDGET
+
+
+def _fits_fused_3d(bx: int, by: int, bz: int, eps: int, itemsize: int,
+                   bf16: bool = False) -> bool:
+    """3D twin of :func:`_fits_fused` over the (bx+2e+pad, by+2e, bz+2e)
+    frame."""
+    _heights, parts_by_h, _pows, pad = _strip_plan_3d(eps)
+    Rf = bx + 2 * eps + pad
+    Ry = by + 2 * eps
+    Lz = bz + 2 * eps
+    frame = Rf * Ry * Lz * itemsize
+    out = bx * by * bz * itemsize
+    runs = _lane_runs_3d(eps)
+    lane_slots = _lane_slots({(h, L) for h, _jj, _kk0, L in runs})
+    log_steps = max(1, int(np.ceil(np.log2(Rf))))
+    per_eval = 2 * log_steps + 4 + len(parts_by_h) + lane_slots
+    stack = 2 * per_eval * frame + 4 * frame + 4 * out
+    if bf16:
+        stack += frame
+    return stack <= _VMEM_BUDGET
+
+
+def fits_fused(block_shape: tuple[int, ...], eps: int,
+               dtype=jnp.float32, precision: str = "f32") -> bool:
+    """Public gate: can the fused kernel family hold this per-device
+    block's halo frame in VMEM?"""
+    itemsize = jnp.dtype(dtype).itemsize
+    bf16 = precision == "bf16"
+    if len(block_shape) == 2:
+        return _fits_fused(*block_shape, eps, itemsize, bf16=bf16)
+    if len(block_shape) == 3:
+        return _fits_fused_3d(*block_shape, eps, itemsize, bf16=bf16)
+    raise ValueError(f"fused halo kernels are 2D/3D; got {block_shape}")
+
+
+def require_fused(op, block_shape: tuple[int, ...], dtype,
+                  ksteps: int = 1) -> None:
+    """Loud honesty gate for ``comm='fused'``: every configuration the
+    kernel family cannot serve is refused with guidance instead of being
+    silently downgraded to the collective path (the same policy as the
+    ensemble variants and --superstep)."""
+    if len(block_shape) not in (2, 3):
+        raise ValueError(
+            f"comm='fused' serves 2D/3D grids; got rank {len(block_shape)}")
+    if op.method != "pallas":
+        raise ValueError(
+            f"comm='fused' runs the Pallas halo kernel family and needs "
+            f"method='pallas' explicitly (got method={op.method!r}); use "
+            "comm='collective' for the XLA methods")
+    if not getattr(op, "uniform", True):
+        raise ValueError(
+            "comm='fused' supports the uniform influence function only "
+            "(J == 1, the sat/pallas identity); use comm='collective'")
+    if max(1, int(ksteps)) != 1:
+        raise ValueError(
+            "comm='fused' fuses the exchange into each step kernel; the "
+            "superstep's K-wide exchange is a different schedule — use "
+            "comm='collective' with superstep, or superstep=1")
+    _reject_f64_on_tpu(jnp.dtype(dtype))
+    if not fits_fused(block_shape, op.eps, dtype,
+                      getattr(op, "precision", "f32")):
+        raise ValueError(
+            f"comm='fused': per-device block {block_shape} with "
+            f"eps={op.eps} exceeds the {_VMEM_BUDGET >> 20} MiB VMEM "
+            "budget for the halo-resident frame; shard the grid over "
+            "more devices or use comm='collective'")
+
+
+def fused_transport() -> str:
+    """Which transport ``comm='fused'`` engages on this backend:
+    ``'rdma'`` (in-kernel remote DMA) on TPU, ``'interp'`` (the split
+    kernel under the ppermute transport, Pallas interpreter) elsewhere —
+    the off-TPU form exists so the CPU suite exercises and pins the
+    fused compute body (module docstring)."""
+    return "rdma" if _on_tpu() else "interp"
+
+
+# ---------------------------------------------------------------------------
+# The shared compute body: interior first, eps ring second
+# ---------------------------------------------------------------------------
+
+
+def _lane_window(eps: int) -> int:
+    """Lane width of the 2D ring phase's left/right column windows:
+    reads reach 3*eps - 1 lanes plus the lane-run roll slack (the
+    wrap-garbage invariant of _strip_neighbor_sum), rounded up for
+    Mosaic's lane tiling."""
+    lmax = max((L for _h, _j0, L in _lane_runs(eps)), default=1)
+    return _round_up(3 * eps + lmax + 7, 128)
+
+
+def _nsum_phases_2d(w, bx: int, by: int, eps: int, out_ref,
+                    phase: str) -> None:
+    """Write the neighbor-sum region(s) of one phase into ``out_ref``.
+
+    ``w`` is the (bx+2e+pad, by+2e) frame (operand-rounded already on
+    the bf16 tier).  ``phase='interior'`` writes the halo-independent
+    center; ``'ring'`` the eps-wide boundary frame; ``'all'`` the whole
+    block in one oracle-shaped evaluation (degenerate blocks where no
+    interior exists).  Every evaluation is `_strip_neighbor_sum` with
+    the same plan the per-step kernel runs, so retained elements are
+    bitwise the oracle's (module docstring).
+    """
+    pad = _window_pad(eps)
+    Lf = by + 2 * eps
+    e = eps
+    if phase == "all":
+        out_ref[:, :] = _strip_neighbor_sum(w, bx, by, e, row0=e, col0=e)
+        return
+    if phase == "interior":
+        out_ref[e : bx - e, e : by - e] = _strip_neighbor_sum(
+            w, bx - 2 * e, by - 2 * e, e, row0=2 * e, col0=2 * e)
+        return
+    assert phase == "ring"
+    # top band: block rows [0, e), all columns
+    out_ref[:e, :] = _strip_neighbor_sum(
+        w[: 3 * e + pad, :], e, by, e, row0=e, col0=e)
+    # bottom band: block rows [bx - e, bx)
+    out_ref[bx - e : bx, :] = _strip_neighbor_sum(
+        w[bx - e : bx + 2 * e + pad, :], e, by, e, row0=e, col0=e)
+    # left / right column bands: middle rows, e columns each — narrow
+    # lane windows (reads stay inside; _lane_window pins the slack)
+    tm = bx - 2 * e
+    wlan = min(Lf, _lane_window(e))
+    out_ref[e : bx - e, :e] = _strip_neighbor_sum(
+        w[e : bx - e + pad, :wlan], tm, e, e, row0=e, col0=e)
+    out_ref[e : bx - e, by - e : by] = _strip_neighbor_sum(
+        w[e : bx - e + pad, Lf - wlan :], tm, e, e, row0=e,
+        col0=wlan - 2 * e)
+
+
+def _nsum_phases_3d(w, bx: int, by: int, bz: int, eps: int, out_ref,
+                    phase: str) -> None:
+    """3D twin of :func:`_nsum_phases_2d`: interior box first, then the
+    six face slabs of the eps ring (x slabs full-face, y slabs on
+    middle-x rows, z slabs on the middle-xy core), each evaluated on a
+    window sliced to its reach."""
+    pad = _strip_plan_3d(eps)[3]
+    e = eps
+    if phase == "all":
+        out_ref[:, :, :] = _block_neighbor_sum_3d(
+            w, bx, by, bz, e, row0=e, col0=e, z0=e)
+        return
+    if phase == "interior":
+        out_ref[e : bx - e, e : by - e, e : bz - e] = (
+            _block_neighbor_sum_3d(w, bx - 2 * e, by - 2 * e, bz - 2 * e,
+                                   e, row0=2 * e, col0=2 * e, z0=2 * e))
+        return
+    assert phase == "ring"
+    # x-low / x-high slabs: block rows [0, e) and [bx-e, bx), full y x z
+    out_ref[:e, :, :] = _block_neighbor_sum_3d(
+        w[: 3 * e + pad, :, :], e, by, bz, e, row0=e, col0=e, z0=e)
+    out_ref[bx - e : bx, :, :] = _block_neighbor_sum_3d(
+        w[bx - e : bx + 2 * e + pad, :, :], e, by, bz, e, row0=e,
+        col0=e, z0=e)
+    # y slabs on the middle-x rows (no rolls cross y: 3e width suffices)
+    tm = bx - 2 * e
+    Ry = by + 2 * e
+    out_ref[e : bx - e, :e, :] = _block_neighbor_sum_3d(
+        w[e : bx - e + pad, : 3 * e, :], tm, e, bz, e, row0=e, col0=e,
+        z0=e)
+    out_ref[e : bx - e, by - e : by, :] = _block_neighbor_sum_3d(
+        w[e : bx - e + pad, Ry - 3 * e :, :], tm, e, bz, e, row0=e,
+        col0=e, z0=e)
+    # z slabs on the middle-xy core — narrow lane windows
+    tn = by - 2 * e
+    lmax = max((L for _h, _jj, _k0, L in _lane_runs_3d(eps)), default=1)
+    Lz = bz + 2 * e
+    wlan = min(Lz, _round_up(3 * e + lmax + 7, 128))
+    out_ref[e : bx - e, e : by - e, :e] = _block_neighbor_sum_3d(
+        w[e : bx - e + pad, e : by + e, :wlan], tm, tn, e, e, row0=e,
+        col0=e, z0=e)
+    out_ref[e : bx - e, e : by - e, bz - e : bz] = _block_neighbor_sum_3d(
+        w[e : bx - e + pad, e : by + e, Lz - wlan :], tm, tn, e, e,
+        row0=e, col0=e, z0=wlan - 2 * e)
+
+
+def _degenerate(block_shape: tuple[int, ...], eps: int) -> bool:
+    """No pure-interior cells (a multi-hop-sized block): the kernel runs
+    one whole-block oracle-shaped evaluation after the wait — there is
+    nothing to overlap, and we say so rather than fake a split."""
+    return any(int(b) <= 2 * eps for b in block_shape)
+
+
+# ---------------------------------------------------------------------------
+# Split kernel: the fused compute body over a pre-filled frame
+# ---------------------------------------------------------------------------
+
+
+def _kernel_params_fused(collective_id: int | None = None):
+    if _on_tpu():
+        kw = dict(vmem_limit_bytes=_VMEM_LIMIT)
+        if collective_id is not None:
+            kw["collective_id"] = collective_id
+            kw["has_side_effects"] = True
+        cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+        try:
+            return dict(compiler_params=cls(**kw))
+        except TypeError:  # pre-has_side_effects TPUCompilerParams
+            kw.pop("has_side_effects", None)
+            return dict(compiler_params=cls(**kw))
+    return dict(interpret=True)
+
+
+@functools.lru_cache(maxsize=None)
+def build_split_nsum_2d(eps: int, bx: int, by: int, dtype_name: str,
+                        precision: str = "f32"):
+    """(frame: (bx+2e+pad, by+2e)) -> (bx, by) neighbor sum, computed
+    interior phase then ring phase — the fused kernel's compute body
+    with the transport factored out (module docstring).  Interpreter
+    mode off-TPU; bitwise the `build_neighbor_sum_2d` oracle."""
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    bf16 = precision == "bf16"
+    degen = _degenerate((bx, by), eps)
+
+    def kernel(frame_ref, out_ref):
+        w = frame_ref[:]
+        if bf16:
+            # the tier's operand semantic: one bf16 round-trip of the
+            # state before any accumulation (nonlocal_op._bf16_round)
+            w = w.astype(jnp.bfloat16).astype(dtype)
+        if degen:
+            _nsum_phases_2d(w, bx, by, eps, out_ref, "all")
+        else:
+            _nsum_phases_2d(w, bx, by, eps, out_ref, "interior")
+            _nsum_phases_2d(w, bx, by, eps, out_ref, "ring")
+
+    def split_nsum(frame):
+        vma = array_vma(frame)
+        return pl.pallas_call(
+            kernel,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=out_struct((bx, by), dtype, vma=vma),
+            **_kernel_params_fused(),
+        )(frame)
+
+    return split_nsum
+
+
+@functools.lru_cache(maxsize=None)
+def build_split_nsum_3d(eps: int, bx: int, by: int, bz: int,
+                        dtype_name: str, precision: str = "f32"):
+    """3D twin of :func:`build_split_nsum_2d` over the
+    (bx+2e+pad, by+2e, bz+2e) frame."""
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    bf16 = precision == "bf16"
+    degen = _degenerate((bx, by, bz), eps)
+
+    def kernel(frame_ref, out_ref):
+        w = frame_ref[:]
+        if bf16:
+            w = w.astype(jnp.bfloat16).astype(dtype)
+        if degen:
+            _nsum_phases_3d(w, bx, by, bz, eps, out_ref, "all")
+        else:
+            _nsum_phases_3d(w, bx, by, bz, eps, out_ref, "interior")
+            _nsum_phases_3d(w, bx, by, bz, eps, out_ref, "ring")
+
+    def split_nsum(frame):
+        vma = array_vma(frame)
+        return pl.pallas_call(
+            kernel,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=out_struct((bx, by, bz), dtype, vma=vma),
+            **_kernel_params_fused(),
+        )(frame)
+
+    return split_nsum
+
+
+# ---------------------------------------------------------------------------
+# RDMA kernel: exchange started in-kernel, overlapped with the interior
+# ---------------------------------------------------------------------------
+
+
+def _build_rdma_kernel(dims: int, eps: int, block_shape, mesh_shape,
+                       axis_names, dtype, precision, frame_shape):
+    """The fused step kernel body shared by 2D/3D: prep frame -> neighbor
+    barrier -> start remote DMAs -> interior phase -> recv waits -> ring
+    phase -> send waits (the frame must not be re-prepped by the next
+    step while a DMA still reads it)."""
+    bf16 = precision == "bf16"
+    plan = plan_exchange(mesh_shape, block_shape, eps)
+    degen = _degenerate(block_shape, eps)
+    center = tuple(slice(eps, eps + b) for b in block_shape)
+
+    def kernel(u_ref, out_ref, frame_ref, send_sems, recv_sems):
+        idx = [lax.axis_index(n) for n in axis_names]
+
+        def exists(offsets, sign):
+            """Whether my neighbor at sign*offsets is inside the mesh."""
+            ok = None
+            for ax, o in enumerate(offsets):
+                c = idx[ax] + sign * o
+                in_ax = (c >= 0) & (c < mesh_shape[ax])
+                ok = in_ax if ok is None else ok & in_ax
+            return ok
+
+        # -- prep: zero collar (volumetric BC for never-targeted halo
+        # regions and the chain pad) + the block in the frame center
+        frame_ref[...] = jnp.zeros(frame_shape, dtype)
+        frame_ref[center] = u_ref[...]
+        # -- readiness barrier: tell each device that SENDS to me that
+        # my frame is safe to land in; wait for the same signal from
+        # each device I send to (one signal per directed plan edge).
+        # Step t+1 signals can never pollute a step t wait: a neighbor
+        # reaches its t+1 signal only after finishing step t, which
+        # required MY step t bands — sent after my own t wait completed.
+        bar = pltpu.get_barrier_semaphore()
+        for msg in plan:
+            @pl.when(exists(msg.offset, -1))
+            def _signal(msg=msg):
+                pltpu.semaphore_signal(
+                    bar, inc=1,
+                    device_id=tuple(idx[ax] - o
+                                    for ax, o in enumerate(msg.offset)),
+                    device_id_type=pltpu.DeviceIdType.MESH)
+        for msg in plan:
+            @pl.when(exists(msg.offset, +1))
+            def _await(msg=msg):
+                pltpu.semaphore_wait(bar, 1)
+        # -- start every band; the DMAs fly while the interior computes
+        descs = []
+        for i, msg in enumerate(plan):
+            src = tuple(slice(a + eps, b + eps) for a, b in msg.src)
+            dst = tuple(slice(a, b) for a, b in msg.dst)
+            desc = pltpu.make_async_remote_copy(
+                src_ref=frame_ref.at[src],
+                dst_ref=frame_ref.at[dst],
+                send_sem=send_sems.at[i],
+                recv_sem=recv_sems.at[i],
+                device_id=tuple(idx[ax] + o
+                                for ax, o in enumerate(msg.offset)),
+                device_id_type=pltpu.DeviceIdType.MESH)
+            descs.append(desc)
+
+            @pl.when(exists(msg.offset, +1))
+            def _start(desc=desc):
+                desc.start()
+
+        nsum_phases = _nsum_phases_2d if dims == 2 else _nsum_phases_3d
+
+        def phases(phase):
+            w = frame_ref[:]
+            if bf16:
+                w = w.astype(jnp.bfloat16).astype(dtype)
+            nsum_phases(w, *block_shape, eps, out_ref, phase)
+
+        if not degen:
+            phases("interior")
+        # -- recv waits: message i on MY recv semaphore is my -offset
+        # neighbor's message i (plan_exchange docstring); absent senders
+        # leave the zero collar in place
+        for i, msg in enumerate(plan):
+            @pl.when(exists(msg.offset, -1))
+            def _wait_recv(desc=descs[i]):
+                desc.wait_recv()
+        phases("all" if degen else "ring")
+        # -- send waits: our outbound reads of frame_ref must complete
+        # before the next step's prep overwrites it
+        for i, msg in enumerate(plan):
+            @pl.when(exists(msg.offset, +1))
+            def _wait_send(desc=descs[i]):
+                desc.wait_send()
+
+    n_msgs = max(1, len(plan))
+    scratch = [
+        pltpu.VMEM(frame_shape, dtype),
+        pltpu.SemaphoreType.DMA((n_msgs,)),
+        pltpu.SemaphoreType.DMA((n_msgs,)),
+    ]
+    return kernel, scratch
+
+
+@functools.lru_cache(maxsize=None)
+def build_fused_nsum_2d(eps: int, bx: int, by: int, dtype_name: str,
+                        mesh_shape: tuple[int, int],
+                        axis_names: tuple[str, str] = ("x", "y"),
+                        precision: str = "f32"):
+    """(u_blk: (bx, by)) -> (bx, by) neighbor sum with the halo exchange
+    fused into the kernel via remote DMA (TPU only; must be called
+    inside a shard_map over ``axis_names``).  See the module docstring
+    for the schedule and the bit-identity argument."""
+    if not _on_tpu():
+        raise ValueError(
+            "build_fused_nsum_2d is the TPU remote-DMA kernel; off-TPU "
+            "the fused path runs the split kernel under the ppermute "
+            "transport (fused_transport())")
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    bf16 = precision == "bf16"
+    if not _fits_fused(bx, by, eps, dtype.itemsize, bf16=bf16):
+        raise ValueError(
+            f"fused halo kernel: block {bx}x{by} eps={eps} exceeds the "
+            f"{_VMEM_BUDGET >> 20} MiB VMEM budget; shard further or use "
+            "comm='collective'")
+    pad = _window_pad(eps)
+    frame_shape = (bx + 2 * eps + pad, by + 2 * eps)
+    kernel, scratch = _build_rdma_kernel(
+        2, eps, (bx, by), tuple(mesh_shape), tuple(axis_names), dtype,
+        precision, frame_shape)
+
+    def fused_nsum(u_blk):
+        vma = array_vma(u_blk)
+        return pl.pallas_call(
+            kernel,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=out_struct((bx, by), dtype, vma=vma),
+            scratch_shapes=scratch,
+            **_kernel_params_fused(_COLLECTIVE_ID_2D),
+        )(u_blk)
+
+    return fused_nsum
+
+
+@functools.lru_cache(maxsize=None)
+def build_fused_nsum_3d(eps: int, bx: int, by: int, bz: int,
+                        dtype_name: str,
+                        mesh_shape: tuple[int, int, int],
+                        axis_names: tuple[str, str, str] = ("x", "y", "z"),
+                        precision: str = "f32"):
+    """3D twin of :func:`build_fused_nsum_2d`."""
+    if not _on_tpu():
+        raise ValueError(
+            "build_fused_nsum_3d is the TPU remote-DMA kernel; off-TPU "
+            "the fused path runs the split kernel under the ppermute "
+            "transport (fused_transport())")
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    bf16 = precision == "bf16"
+    if not _fits_fused_3d(bx, by, bz, eps, dtype.itemsize, bf16=bf16):
+        raise ValueError(
+            f"fused halo kernel: block {bx}x{by}x{bz} eps={eps} exceeds "
+            f"the {_VMEM_BUDGET >> 20} MiB VMEM budget; shard further or "
+            "use comm='collective'")
+    pad = _strip_plan_3d(eps)[3]
+    frame_shape = (bx + 2 * eps + pad, by + 2 * eps, bz + 2 * eps)
+    kernel, scratch = _build_rdma_kernel(
+        3, eps, (bx, by, bz), tuple(mesh_shape), tuple(axis_names), dtype,
+        precision, frame_shape)
+
+    def fused_nsum(u_blk):
+        vma = array_vma(u_blk)
+        return pl.pallas_call(
+            kernel,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=out_struct((bx, by, bz), dtype, vma=vma),
+            scratch_shapes=scratch,
+            **_kernel_params_fused(_COLLECTIVE_ID_3D),
+        )(u_blk)
+
+    return fused_nsum
+
+
+# ---------------------------------------------------------------------------
+# Solver-facing maker
+# ---------------------------------------------------------------------------
+
+
+def halo_stats(mesh_shape: tuple[int, ...], block_shape: tuple[int, ...],
+               eps: int, comm: str, itemsize: int) -> dict:
+    """Per-device, per-exchange-round traffic of one schedule — the
+    numbers behind the /halo/bytes and /halo/exchanges counters and the
+    halo.exchange span attributes (obs wiring in the distributed
+    solvers).  Static host-side arithmetic: no fence, no device read."""
+    if comm == "fused":
+        plan = plan_exchange(mesh_shape, block_shape, eps)
+        return {"messages": len(plan),
+                "bytes": plan_bytes(plan, itemsize)}
+    nmsg = sum(2 * min(len(hop_widths(eps, int(b))), max(int(n) - 1, 0))
+               for b, n in zip(block_shape, mesh_shape))
+    return {"messages": nmsg,
+            "bytes": collective_bytes(mesh_shape, block_shape, eps,
+                                      itemsize)}
+
+
+def make_fused_apply(op, mesh_shape: tuple[int, ...],
+                     axis_names: tuple[str, ...]):
+    """The ``comm='fused'`` local operator for a distributed solver's
+    shard_map body: (u_blk) -> L(u)_blk, halos included.
+
+    On TPU the neighbor sum comes from the remote-DMA kernel.  Off-TPU
+    the SAME compute body runs as the split kernel in the Pallas
+    interpreter, with the bands moved by the existing collective
+    transport (`halo_pad_nd`) — the form the CPU tier-1 suite pins
+    BITWISE against the collective oracle.  Either way ``du`` is formed
+    outside the kernel in exactly ``apply_padded``'s expression.
+    """
+    from nonlocalheatequation_tpu.parallel.halo import halo_pad_nd
+
+    eps = int(op.eps)
+    precision = getattr(op, "precision", "f32")
+    dims = len(mesh_shape)
+    transport = fused_transport()
+
+    def nsum_fn(u_blk):
+        name = jnp.dtype(u_blk.dtype).name
+        if transport == "rdma":
+            build = (build_fused_nsum_2d if dims == 2
+                     else build_fused_nsum_3d)
+            fused = build(eps, *u_blk.shape, name, tuple(mesh_shape),
+                          tuple(axis_names), precision)
+            return fused(u_blk)
+        pad = (_window_pad(eps) if dims == 2
+               else _strip_plan_3d(eps)[3])
+        frame = halo_pad_nd(u_blk, eps, mesh_shape, axis_names)
+        widths = [(0, 0)] * frame.ndim
+        widths[0] = (0, pad)  # the chain-roll slack below the frame
+        frame = jnp.pad(frame, widths)
+        build = build_split_nsum_2d if dims == 2 else build_split_nsum_3d
+        return build(eps, *u_blk.shape, name, precision)(frame)
+
+    if dims == 2:
+        def apply_fused(u_blk):
+            # apply_padded's expression VERBATIM, same scalar fold order
+            # (c * dh * dh — a different association costs the last ulp
+            # of the bitwise contract): operand-rounded center on the
+            # bf16 tier, full precision else
+            return op.c * op.dh * op.dh * (
+                nsum_fn(u_blk) - op.wsum * op._operand(u_blk))
+    else:
+        def apply_fused(u_blk):
+            # the 3D apply_padded folds the scale c * dh**3
+            return op.c * op.dh ** 3 * (
+                nsum_fn(u_blk) - op.wsum * op._operand(u_blk))
+
+    return apply_fused

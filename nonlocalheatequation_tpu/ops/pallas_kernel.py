@@ -367,14 +367,19 @@ def _lane_runs(eps: int):
     return _lane_runs_cached(eps, _lane_runs_enabled())
 
 
-def _strip_neighbor_sum(w, tm: int, ny: int, eps: int, row0: int | None = None):
+def _strip_neighbor_sum(w, tm: int, ny: int, eps: int, row0: int | None = None,
+                        col0: int | None = None):
     """Masked-circle neighbor sum for one strip.
 
     ``w`` is the (tm + pad, ny + 2*eps) window whose row r holds padded row
     ``strip_start + r``; returns the (tm, ny) sum over the eps-ball centered
     at each of the strip's points.  ``row0`` is the window row holding the
     strip's first center (default eps; the carried-frame kernel passes its
-    dead-band offset D).
+    dead-band offset D).  ``col0`` is likewise the window LANE of the
+    strip's first center (default eps; the fused halo kernels evaluate
+    interior/ring sub-rectangles at other offsets — ops/pallas_halo.py).
+    Per-element results are bitwise invariant to the (tm, ny, row0, col0)
+    sub-rectangle: each element sums the same slices in the same order.
 
     All rolls are downward (row r reads rows >= r), so wrap-around garbage
     lands only in the bottom ``pad`` rows, which are never read — no masking
@@ -413,10 +418,13 @@ def _strip_neighbor_sum(w, tm: int, ny: int, eps: int, row0: int | None = None):
         v, [(h, L) for h, _j0, L in _lane_runs(eps)], lane_down)
     if row0 is None:
         row0 = eps
+    if col0 is None:
+        col0 = eps
     acc = None
     for h, j0, run_len in _lane_runs(eps):
         a = row0 - h
-        sl = wsums[h, run_len][a : a + tm, j0 : j0 + ny]
+        cj = (col0 - eps) + j0
+        sl = wsums[h, run_len][a : a + tm, cj : cj + ny]
         acc = sl if acc is None else acc + sl
     return acc
 
@@ -663,7 +671,8 @@ def _lane_runs_3d(eps: int):
 
 def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int,
                            row0: int | None = None,
-                           col0: int | None = None):
+                           col0: int | None = None,
+                           z0: int | None = None):
     """Masked-sphere neighbor sum for one (tm, tn, nz) block.
 
     ``w`` is the (tm + pad, tn + 2*eps, nz + 2*eps) window; row r of axis 0
@@ -679,6 +688,8 @@ def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int,
         row0 = eps
     if col0 is None:
         col0 = eps
+    if z0 is None:
+        z0 = eps
     _heights, parts_by_h, pows, _pad = _strip_plan_3d(eps)
     tmw = w.shape[0]
     down = lambda x, s: pltpu.roll(x, tmw - s, 0)  # noqa: E731
@@ -705,7 +716,8 @@ def _block_neighbor_sum_3d(w, tm: int, tn: int, nz: int, eps: int,
     for h, jj, kk0, run_len in _lane_runs_3d(eps):
         a = row0 - h
         cj = (col0 - eps) + jj
-        sl = wsums[h, run_len][a : a + tm, cj : cj + tn, kk0 : kk0 + nz]
+        ck = (z0 - eps) + kk0
+        sl = wsums[h, run_len][a : a + tm, cj : cj + tn, ck : ck + nz]
         acc = sl if acc is None else acc + sl
     return acc
 

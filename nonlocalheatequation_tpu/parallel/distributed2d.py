@@ -15,9 +15,14 @@ Capability parity with the reference's flagship distributed solver
   (nx*npx) x (ny*npy) grid — the reference's distributed solver has the same
   property, which is what its tests rely on.
 
-The reference's interior/boundary two-stage overlap (:1156-1261) is subsumed:
-XLA schedules the collective-permutes alongside the interior FLOPs within the
-fused step program.
+The reference's interior/boundary two-stage overlap (:1156-1261) has two
+forms here, selected by ``comm=``: ``"collective"`` (default) leaves the
+ppermutes to XLA's scheduler between kernel launches; ``"fused"`` moves
+the exchange INTO the step kernel (ops/pallas_halo.py) — each device
+starts remote DMA of its eps bands, sweeps its interior while they fly,
+then finishes the boundary ring — the reference's overlap done
+explicitly, with the CPU suite pinning the fused path bitwise against
+the collective oracle.
 """
 
 from __future__ import annotations
@@ -83,6 +88,7 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         superstep: int = 1,
         precision: str = "f32",
         resync_every: int = 0,
+        comm: str = "collective",
     ):
         self.nx, self.ny, self.npx, self.npy = int(nx), int(ny), int(npx), int(npy)
         self.NX, self.NY = self.nx * self.npx, self.ny * self.npy
@@ -126,6 +132,19 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         self.mesh = mesh if mesh is not None else choose_mesh_for_grid(self.NX, self.NY)
         self.logger = logger
         self.dtype = dtype
+        if comm not in ("collective", "fused"):
+            raise ValueError(
+                f"comm must be 'collective' or 'fused', got {comm!r}")
+        self.comm = comm
+        if comm == "fused":
+            # honesty gate up front: every fused-incapable config is
+            # refused at construction, never silently downgraded
+            from nonlocalheatequation_tpu.ops.pallas_halo import (
+                require_fused,
+            )
+
+            require_fused(self.op, self._block_shape(), self._dtype(),
+                          ksteps=self.ksteps)
         self.checkpoint_path = checkpoint_path
         self.ncheckpoint = int(ncheckpoint)
         self.t0 = 0
@@ -134,6 +153,16 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         self.u = None
         self.error_l2 = 0.0
         self.error_linf = 0.0
+
+    def _dtype(self):
+        return self.dtype or (
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        )
+
+    def _block_shape(self) -> tuple[int, int]:
+        """Per-device block of the uniform sharding."""
+        mx, my = self.mesh.shape["x"], self.mesh.shape["y"]
+        return (self.NX // mx, self.NY // my)
 
     # -- initialization (2d_nonlocal_distributed.cpp:178-190) ---------------
     def test_init(self):
@@ -177,18 +206,30 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         src_halo = (self.ksteps - 1) * eps
 
         if self.ksteps == 1:
+            if self.comm == "fused":
+                # the fused-exchange operator (ops/pallas_halo.py):
+                # remote-DMA halos inside the kernel on TPU, the same
+                # split compute body under the ppermute transport
+                # off-TPU — du is apply_padded's expression either way
+                from nonlocalheatequation_tpu.ops.pallas_halo import (
+                    make_fused_apply,
+                )
+
+                apply_blk = make_fused_apply(op, mesh_shape, ("x", "y"))
+            else:
+                def apply_blk(u_blk):
+                    return op.apply_padded(
+                        halo_pad_2d(u_blk, eps, mesh_shape))
             if self.test:
                 def local_step(u_blk, g_blk, lg_blk, t):
-                    upad = halo_pad_2d(u_blk, eps, mesh_shape)
-                    du = op.apply_padded(upad) + source_at(
+                    du = apply_blk(u_blk) + source_at(
                         g_blk, lg_blk, t, op.dt)
                     return u_blk + op.dt * du
 
                 in_specs = (spec, spec, spec, P())
             else:
                 def local_step(u_blk, t):
-                    upad = halo_pad_2d(u_blk, eps, mesh_shape)
-                    return u_blk + op.dt * op.apply_padded(upad)
+                    return u_blk + op.dt * apply_blk(u_blk)
 
                 in_specs = (spec, P())
         else:
@@ -270,9 +311,7 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
                                  out_specs=(spec, spec)))(g, lg)
 
     def _device_state(self):
-        dtype = self.dtype or (
-            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        )
+        dtype = self._dtype()
         sharding = grid_sharding(self.mesh)
         # put_global == device_put single-controller; per-process shard
         # materialization when the mesh spans hosts (parallel/multihost.py).
@@ -287,8 +326,43 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         lg = put_global(np.asarray(lg, npdt), sharding)
         return u, (g, lg)
 
+    def _halo_obs(self, steps: int):
+        """Publish the run's scheduled halo traffic (obs/metrics.py
+        registry: /halo/bytes, /halo/exchanges) and return the span
+        attributes.  Static host-side arithmetic from the exchange plan
+        — no fence, no device read, on any path.  The stats follow the
+        TRANSPORT that actually runs, not the comm label: comm='fused'
+        off-TPU moves bands with the ppermute transport (the interp
+        split-kernel form), so its traffic is the collective plan's."""
+        from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+        from nonlocalheatequation_tpu.ops.pallas_halo import (
+            fused_transport,
+            halo_stats,
+        )
+
+        mesh_shape = tuple(self.mesh.shape[n] for n in ("x", "y"))
+        block = self._block_shape()
+        itemsize = jnp.dtype(self._dtype()).itemsize
+        transport = (fused_transport() if self.comm == "fused"
+                     else "collective")
+        stats = halo_stats(
+            mesh_shape, block, self.eps,
+            "fused" if transport == "rdma" else "collective", itemsize)
+        ndev = int(np.prod(mesh_shape))
+        rounds = -(-steps // self.ksteps)  # one exchange per (super)step
+        REGISTRY.counter("/halo/exchanges").inc(
+            rounds * stats["messages"] * ndev)
+        REGISTRY.counter("/halo/bytes").inc(
+            rounds * stats["bytes"] * ndev)
+        return dict(comm=self.comm, transport=transport, devices=ndev,
+                    rounds=rounds,
+                    messages_per_round=stats["messages"] * ndev,
+                    bytes_per_device_round=stats["bytes"])
+
     # -- time loop (2d_nonlocal_distributed.cpp:1271-1325) ------------------
     def do_work(self) -> np.ndarray:
+        from nonlocalheatequation_tpu.obs import trace as obs_trace
+
         steps_by_k: dict = {}
 
         def get_step(K):
@@ -326,13 +400,17 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
 
             return lambda u0, start: run(u0, jnp.int32(start), source_args)
 
-        if self.logger is None and not checkpointing:
-            u = make_runner(self.nt - self.t0)(u, self.t0)
-        else:
-            # fused scan per segment; barriers = log and checkpoint steps
-            u = self._run_chunked(u, make_runner)
-
-        self.u = fetch_global(u)
+        # halo.exchange span: dispatch through the final fetch fence —
+        # timestamps this loop takes anyway (PR 5 discipline: the
+        # disabled path is one attribute read, no added fences)
+        with obs_trace.span("halo.exchange", cat="halo",
+                            **self._halo_obs(self.nt - self.t0)):
+            if self.logger is None and not checkpointing:
+                u = make_runner(self.nt - self.t0)(u, self.t0)
+            else:
+                # fused scan per segment; barriers = log/checkpoint steps
+                u = self._run_chunked(u, make_runner)
+            self.u = fetch_global(u)
         if self.test:
             self.compute_l2(self.nt)
             self.compute_linf(self.nt)

@@ -77,6 +77,7 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         ncheckpoint: int = 0,
         superstep: int = 1,
         precision: str = "f32",
+        comm: str = "collective",
     ):
         self.NX, self.NY, self.NZ = int(NX), int(NY), int(NZ)
         self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
@@ -92,6 +93,17 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         )
         self.logger = logger
         self.dtype = dtype
+        if comm not in ("collective", "fused"):
+            raise ValueError(
+                f"comm must be 'collective' or 'fused', got {comm!r}")
+        self.comm = comm
+        if comm == "fused":
+            from nonlocalheatequation_tpu.ops.pallas_halo import (
+                require_fused,
+            )
+
+            require_fused(self.op, self._block_shape(), self._dtype(),
+                          ksteps=self.ksteps)
         self.checkpoint_path = checkpoint_path
         self.ncheckpoint = int(ncheckpoint)
         self.t0 = 0
@@ -100,6 +112,16 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         self.u = None
         self.error_l2 = 0.0
         self.error_linf = 0.0
+
+    def _dtype(self):
+        return self.dtype or (
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        )
+
+    def _block_shape(self) -> tuple[int, int, int]:
+        """Per-device block of the uniform sharding."""
+        m = tuple(self.mesh.shape[n] for n in ("x", "y", "z"))
+        return (self.NX // m[0], self.NY // m[1], self.NZ // m[2])
 
     def test_init(self):
         self.test = True
@@ -125,18 +147,29 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         src_halo = (self.ksteps - 1) * eps  # see the 2D solver
 
         if self.ksteps == 1:
+            if self.comm == "fused":
+                # fused-exchange operator (ops/pallas_halo.py): see the
+                # 2D solver — remote-DMA halos in-kernel on TPU, the
+                # same split compute body off-TPU
+                from nonlocalheatequation_tpu.ops.pallas_halo import (
+                    make_fused_apply,
+                )
+
+                apply_blk = make_fused_apply(op, mesh_shape, names)
+            else:
+                def apply_blk(u_blk):
+                    return op.apply_padded(
+                        halo_pad_nd(u_blk, eps, mesh_shape, names))
             if self.test:
                 def local_step(u_blk, g_blk, lg_blk, t):
-                    upad = halo_pad_nd(u_blk, eps, mesh_shape, names)
-                    du = op.apply_padded(upad) + source_at(
+                    du = apply_blk(u_blk) + source_at(
                         g_blk, lg_blk, t, op.dt)
                     return u_blk + op.dt * du
 
                 in_specs = (spec, spec, spec, P())
             else:
                 def local_step(u_blk, t):
-                    upad = halo_pad_nd(u_blk, eps, mesh_shape, names)
-                    return u_blk + op.dt * op.apply_padded(upad)
+                    return u_blk + op.dt * apply_blk(u_blk)
 
                 in_specs = (spec, P())
         else:
@@ -221,7 +254,37 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         return jax.jit(shard_map(pad2, mesh=mesh, in_specs=(spec, spec),
                                  out_specs=(spec, spec)))(g, lg)
 
+    def _halo_obs(self, steps: int):
+        """Publish scheduled halo traffic; see the 2D solver's twin (the
+        stats follow the transport that actually runs)."""
+        from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+        from nonlocalheatequation_tpu.ops.pallas_halo import (
+            fused_transport,
+            halo_stats,
+        )
+
+        mesh_shape = tuple(self.mesh.shape[n] for n in ("x", "y", "z"))
+        block = self._block_shape()
+        itemsize = jnp.dtype(self._dtype()).itemsize
+        transport = (fused_transport() if self.comm == "fused"
+                     else "collective")
+        stats = halo_stats(
+            mesh_shape, block, self.eps,
+            "fused" if transport == "rdma" else "collective", itemsize)
+        ndev = int(np.prod(mesh_shape))
+        rounds = -(-steps // self.ksteps)
+        REGISTRY.counter("/halo/exchanges").inc(
+            rounds * stats["messages"] * ndev)
+        REGISTRY.counter("/halo/bytes").inc(
+            rounds * stats["bytes"] * ndev)
+        return dict(comm=self.comm, transport=transport, devices=ndev,
+                    rounds=rounds,
+                    messages_per_round=stats["messages"] * ndev,
+                    bytes_per_device_round=stats["bytes"])
+
     def do_work(self) -> np.ndarray:
+        from nonlocalheatequation_tpu.obs import trace as obs_trace
+
         steps_by_k: dict = {}
 
         def get_step(K):
@@ -257,12 +320,13 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
 
             return lambda u0, start: run(u0, jnp.int32(start), source_args)
 
-        if self.logger is None and not checkpointing:
-            u = make_runner(self.nt - self.t0)(u, self.t0)
-        else:
-            u = self._run_chunked(u, make_runner)
-
-        self.u = fetch_global(u)
+        with obs_trace.span("halo.exchange", cat="halo",
+                            **self._halo_obs(self.nt - self.t0)):
+            if self.logger is None and not checkpointing:
+                u = make_runner(self.nt - self.t0)(u, self.t0)
+            else:
+                u = self._run_chunked(u, make_runner)
+            self.u = fetch_global(u)
         if self.test:
             self.compute_l2(self.nt)
             self.compute_linf(self.nt)

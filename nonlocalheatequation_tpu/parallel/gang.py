@@ -339,8 +339,15 @@ class GangExecutor:
     """
 
     def __init__(self, solver):
+        from nonlocalheatequation_tpu.parallel.mesh_axes import (
+            create_hybrid_mesh,
+        )
+
         self.s = solver
-        self.mesh = Mesh(np.asarray(solver.devices), ("d",))
+        # the slot axis rides ICI (parallel/mesh_axes.py): gang halos cross
+        # it every step, so a multi-slice device set must keep it on-slice
+        self.mesh = create_hybrid_mesh(("d",), (len(solver.devices),),
+                                       solver.devices)
         self.plan: GangPlan | None = None
         self._runs: dict[tuple[bool, bool], object] = {}
         self._state = None

@@ -35,6 +35,22 @@ def _take_edge(x, axis: int, size: int, last: bool):
     return lax.slice(x, tuple(start), tuple(limit))
 
 
+def hop_widths(eps: int, bs: int) -> tuple[int, ...]:
+    """Per-hop transfer widths of one axis direction: hop h carries
+    ``min(bs, eps - (h-1)*bs)`` rows — full blocks forward through the
+    intermediate hops (their every row lands in the receiver's halo), and
+    only the FINAL hop's band is partial.  The single source of truth for
+    the collective ring below, the fused plan (ops/pallas_halo.py), and
+    the exchanged-byte regression tests."""
+    widths = []
+    remaining = int(eps)
+    while remaining > 0:
+        w = min(int(bs), remaining)
+        widths.append(w)
+        remaining -= int(bs)
+    return tuple(widths)
+
+
 def _axis_halo(block, axis: int, axis_name: str, nshards: int, eps: int):
     """Pad ``block`` with an eps-wide halo along ``axis`` from mesh neighbors."""
     bs = block.shape[axis]
@@ -43,21 +59,31 @@ def _axis_halo(block, axis: int, axis_name: str, nshards: int, eps: int):
     # i+1 -> i: every shard receives its RIGHT neighbor's data (zeros at i=n-1)
     from_right = [(i + 1, i) for i in range(nshards - 1)]
 
-    hops = -(-eps // bs)  # ceil: >1 only when the horizon exceeds the shard edge
+    widths = hop_widths(eps, bs)
+    hops = len(widths)  # > 1 only when the horizon exceeds the shard edge
     if hops == 1:
         left = lax.ppermute(_take_edge(block, axis, eps, last=True), axis_name, from_left)
         right = lax.ppermute(_take_edge(block, axis, eps, last=False), axis_name, from_right)
     else:
+        # Multi-hop ring.  Hops 1..H-1 forward the full block (every row
+        # is halo content for some depth); the LAST hop carries only the
+        # ``widths[-1]``-wide band still missing — re-permuting the full
+        # block there moved (bs - w) dead rows per axis direction (the
+        # round-9 byte-cap fix; hop_widths pins the contract).
         lefts, rights = [], []
         cur_l = cur_r = block
-        for _ in range(hops):
+        for h in range(hops):
+            if h == hops - 1 and widths[h] < bs:
+                cur_l = _take_edge(cur_l, axis, widths[h], last=True)
+                cur_r = _take_edge(cur_r, axis, widths[h], last=False)
             cur_l = lax.ppermute(cur_l, axis_name, from_left)
             cur_r = lax.ppermute(cur_r, axis_name, from_right)
             lefts.append(cur_l)
             rights.append(cur_r)
-        # lefts[h] holds the block h+1 shards to the left; stitch in grid order
-        left = _take_edge(jnp.concatenate(lefts[::-1], axis), axis, eps, last=True)
-        right = _take_edge(jnp.concatenate(rights, axis), axis, eps, last=False)
+        # lefts[h] holds the band from the block h+1 shards to the left;
+        # stitch in grid order — the capped widths sum to eps exactly
+        left = jnp.concatenate(lefts[::-1], axis)
+        right = jnp.concatenate(rights, axis)
     return jnp.concatenate([left, block, right], axis)
 
 

@@ -15,6 +15,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from nonlocalheatequation_tpu.parallel.mesh_axes import create_hybrid_mesh
+
 
 def factor_devices(n: int) -> tuple[int, int]:
     """Factor n into the most-square (dx, dy) grid, dx*dy == n."""
@@ -51,8 +53,10 @@ def make_mesh(
         npx, npy = factor_devices(len(devices))
     if npx * npy > len(devices):
         raise ValueError(f"mesh {npx}x{npy} needs {npx * npy} devices, have {len(devices)}")
-    dev_grid = np.asarray(devices[: npx * npy]).reshape(npx, npy)
-    return Mesh(dev_grid, ("x", "y"))
+    # hybrid-aware placement (parallel/mesh_axes.py): single-granule device
+    # sets reshape exactly as before; multi-slice/multi-process sets put
+    # the halo-crossing axes on ICI links
+    return create_hybrid_mesh(("x", "y"), (npx, npy), devices)
 
 
 def grid_sharding(mesh: Mesh) -> NamedSharding:
@@ -91,8 +95,7 @@ def make_mesh_3d(
             f"mesh {mx}x{my}x{mz} needs {mx * my * mz} devices, "
             f"have {len(devices)}"
         )
-    dev_grid = np.asarray(devices[: mx * my * mz]).reshape(mx, my, mz)
-    return Mesh(dev_grid, ("x", "y", "z"))
+    return create_hybrid_mesh(("x", "y", "z"), (mx, my, mz), devices)
 
 
 def grid_sharding_3d(mesh: Mesh) -> NamedSharding:

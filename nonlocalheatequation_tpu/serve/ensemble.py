@@ -8,7 +8,8 @@ module is the scheduler half of the batched ensemble engine:
 
 * **Bucketing** — submitted :class:`EnsembleCase` rows group by
   ``(shape, nt, eps, test)``; the engine-level settings (dtype,
-  precision tier, method, superstep depth) complete the key.  ``nt``
+  precision tier, method, superstep depth, halo-comm engine) complete
+  the key.  ``nt``
   joins the issue's ``(grid, eps, dtype, precision, ksteps)`` key
   because the scan length is part of the compiled program.  Cases in one
   bucket may differ in physics (k, dt, dh): the ops-layer makers bake a
@@ -157,15 +158,36 @@ class EnsembleEngine:
     VARIANTS = ("auto", "per-step", "carried", "superstep", "stacked",
                 "vmap")
 
+    #: halo-exchange engines a sharded (distributed-case) bucket can ask
+    #: for; part of the program key so two engines differing only in
+    #: comm never share compiled programs (ops/pallas_halo.py).  HONESTY
+    #: NOTE: no current bucket builds a sharded program — every ensemble
+    #: case today is a single-device solve, so comm='fused' changes the
+    #: key (and is validated against the pallas-only rule) but not the
+    #: compiled programs; the knob exists so sharded case buckets, when
+    #: they land, bucket correctly from day one instead of silently
+    #: sharing programs across comm engines.
+    COMMS = ("collective", "fused")
+
     def __init__(self, method: str = "auto", precision: str = "f32",
                  dtype=None, variant: str = "auto", ksteps: int = 0,
-                 batch_sizes=BATCH_SIZES):
+                 batch_sizes=BATCH_SIZES, comm: str = "collective"):
         if variant not in self.VARIANTS:
             raise ValueError(
                 f"unknown ensemble variant {variant!r}; one of "
                 f"{self.VARIANTS}")
         if variant == "superstep" and ksteps < 2:
             raise ValueError("variant='superstep' needs ksteps >= 2")
+        if comm not in self.COMMS:
+            raise ValueError(
+                f"unknown comm {comm!r}; one of {self.COMMS}")
+        if comm == "fused" and method != "pallas":
+            # the fused halo family is pallas-only (require_fused); the
+            # engine repeats the refusal up front so a sharded bucket
+            # can never reach program build with an unservable key
+            raise ValueError(
+                "comm='fused' needs method='pallas' "
+                "(ops/pallas_halo.require_fused)")
         sizes = tuple(sorted({int(b) for b in batch_sizes}))
         if not sizes or sizes[0] < 1:
             raise ValueError(f"bad batch_sizes {batch_sizes!r}")
@@ -175,6 +197,7 @@ class EnsembleEngine:
         self.variant = variant
         self.ksteps = int(ksteps)
         self.batch_sizes = sizes
+        self.comm = comm
         self.report = EnsembleReport()
         self._programs: dict = {}
 
@@ -188,7 +211,8 @@ class EnsembleEngine:
         perturb the device counters."""
         kw = dict(method=self.method, precision=self.precision,
                   dtype=self.dtype, variant=self.variant,
-                  ksteps=self.ksteps, batch_sizes=self.batch_sizes)
+                  ksteps=self.ksteps, batch_sizes=self.batch_sizes,
+                  comm=self.comm)
         kw.update(overrides)
         return EnsembleEngine(**kw)
 
@@ -278,7 +302,8 @@ class EnsembleEngine:
         test = key[3]
         dtype = self._dtype()
         prog_key = (key, len(chunk), self.variant,
-                    tuple(c.physics() for c in chunk), dtype.name)
+                    tuple(c.physics() for c in chunk), dtype.name,
+                    self.comm)
         multi = self._programs.get(prog_key)
         if multi is None:
             # operators are only needed to BUILD a program (and for the

@@ -216,9 +216,13 @@ class CpuFallback:
         if sib is None:
             # variant pinned to "auto": the carried/superstep pallas
             # schedules cannot engage off-TPU and would refuse; auto
-            # resolves to the vmap/stacked XLA compositions here
+            # resolves to the vmap/stacked XLA compositions here.  comm
+            # pinned to "collective" for the same reason — the fused
+            # halo engine is pallas-only and a CPU fallback chunk runs
+            # unsharded anyway
             sib = self._engines[method] = e.sibling(method=method,
-                                                    variant="auto")
+                                                    variant="auto",
+                                                    comm="collective")
         return sib
 
     def run_chunk(self, key, padded) -> np.ndarray:

@@ -104,6 +104,26 @@ def test_bench_ensemble_mode_emits_cases_field():
     assert rec["accuracy"]["ok"] is True  # the solo gate still runs
 
 
+def test_bench_multichip_mode_emits_halo_overlap():
+    # BENCH_MULTICHIP=N: the sharded-solving A/B — the distributed 2D
+    # solver over one shared N-device mesh, collective vs FUSED halo
+    # engines (ops/pallas_halo.py).  The JSON line must carry the
+    # multichipN variant, comm=fused, the collective/fused halo_overlap
+    # ratio, and the mesh layout, on the same one-line rc=0 contract —
+    # here on the CPU proxy where the parent forces N virtual devices
+    proc, rec = run_bench({"BENCH_MULTICHIP": "8", "BENCH_GRID": "64",
+                           "BENCH_LADDER": "64", "BENCH_ACCURACY": "0"})
+    assert proc.returncode == 0
+    assert rec["value"] > 0
+    assert rec["variant"] == "multichip8"
+    assert rec["comm"] == "fused"
+    assert rec["halo_overlap"] > 0
+    assert rec["devices"] == 8
+    assert rec["mesh"] == {"x": 4, "y": 2}
+    assert rec["method"] == "pallas"  # both A/B arms run the pallas path
+    assert rec["partial"] is False
+
+
 def test_bench_serve_mode_emits_amortization_and_latency():
     # BENCH_SERVE=D: the serving-pipeline A/B — fenced (depth 1) vs
     # pipelined (depth D) schedules of C single-case chunks in one rung.

@@ -22,7 +22,7 @@ SCRIPT = os.path.join(REPO, "tools", "tpu_opportunistic.sh")
 ALL_STEPS = [
     "bench4096", "resident512", "carried4096", "superstep2",
     "bf16-4096", "bf16-carried4096", "ensemble8x1024", "serve8x1024",
-    "servefault8x1024", "obs8x1024",
+    "servefault8x1024", "obs8x1024", "multichip1024",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
@@ -118,6 +118,22 @@ def test_obs_step_banks_trace_evidence(tmp_path):
     assert '"trace_overhead"' in table and '"spans"' in table
     doc = json.loads((tdir / "host_trace.json").read_text())
     assert doc["traceEvents"], "trace artifact empty"
+
+
+def test_multichip_step_banks_halo_ab_evidence(tmp_path):
+    # the fused-vs-collective halo A/B step (round 9) must only bank
+    # when the JSON carries the multichip variant, the halo_overlap
+    # ratio, and the fused comm label; on the 8-virtual-device CPU
+    # smoke mesh the A/B runs the real shard_map programs
+    proc, state, table, _out = _run(
+        tmp_path, "multichip1024", {"OPP_GRID_MC": "64"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    assert "multichip1024\n" in state
+    assert "fail:" not in state
+    assert '"variant": "multichip8"' in table
+    assert '"halo_overlap"' in table
+    assert '"comm": "fused"' in table
 
 
 @pytest.mark.slow  # ~73 s: two strike rounds, each a full bench child plus

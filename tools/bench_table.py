@@ -833,6 +833,45 @@ def bench_resilience(steps: int):
          bit_identical=bool(ident))
 
 
+def bench_multichip(steps: int):
+    """Fused-vs-collective halo A/B (round 9, ops/pallas_halo.py): the
+    distributed 2D solver over ONE shared device mesh, collective halos
+    (ppermute fenced between kernel launches) vs the fused remote-DMA
+    exchange overlapped with the interior sweep.  Both arms run
+    method='pallas' (the fused family is pallas-only; a like-for-like
+    ratio needs the same compute kernel), the same mesh, and the same
+    initial state; the fused row records ``halo_overlap`` =
+    collective/fused wall.  Off-TPU the fused arm runs the split kernel
+    in the Pallas interpreter — the ratio there exercises the machinery
+    and the bitwise contract, not the overlap (the interpreter dominates
+    the wall); the overlap evidence is a TPU row
+    (tools/tpu_opportunistic.sh ``multichip1024``)."""
+    from nonlocalheatequation_tpu.parallel.distributed2d import (
+        Solver2DDistributed,
+    )
+    from nonlocalheatequation_tpu.parallel.mesh import (
+        factor_devices,
+        make_mesh,
+    )
+
+    n = cfg("BT_MC_GRID", 2048, 64)
+    ndev = len(jax.devices())
+    mx, my = factor_devices(ndev)
+    mesh = make_mesh(mx, my, jax.devices())
+    walls = {}
+    for comm in ("collective", "fused"):
+        s = Solver2DDistributed(n, n, 1, 1, nt=steps, eps=8, k=1.0,
+                                dt=1e-7, dh=1.0 / n, method="pallas",
+                                dtype=jnp.float32, mesh=mesh, comm=comm)
+        walls[comm] = _time_dist_solver(s, steps)
+    emit("2d/multichip-collective", n * n, steps, walls["collective"],
+         grid=n, eps=8, devices=ndev, mesh=dict(mesh.shape),
+         comm="collective")
+    emit("2d/multichip-fused", n * n, steps, walls["fused"], grid=n,
+         eps=8, devices=ndev, mesh=dict(mesh.shape), comm="fused",
+         halo_overlap=round(walls["collective"] / walls["fused"], 4))
+
+
 BENCHES = {
     "methods2d": bench_methods2d,
     "small2d": bench_small2d,
@@ -849,6 +888,7 @@ BENCHES = {
     "serve": bench_serve,
     "obs": bench_obs,
     "resilience": bench_resilience,
+    "multichip": bench_multichip,
 }
 
 

@@ -99,7 +99,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 #             tables, profile
 STEPS="bench4096 resident512 carried4096 superstep2 \
 bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 servefault8x1024 \
-obs8x1024 \
+obs8x1024 multichip1024 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -205,6 +205,19 @@ run_step_cmd() {  # the queue's one name->command map
         BENCH_TRACE="${OPP_OBS_TRACE_DIR:-docs/bench/obs_trace_$ROUND}" \
         BENCH_GRID="${OPP_GRID_ENS:-1024}" \
         BENCH_LADDER="${OPP_GRID_ENS:-1024}" BENCH_ACCURACY=0 ;;
+    multichip1024)
+      # sharded-solving A/B (round 9, ops/pallas_halo.py): the
+      # distributed 2D solver over one shared device mesh, collective
+      # (ppermute) vs FUSED (remote-DMA inside the step kernel) halo
+      # engines — the JSON row carries "halo_overlap" =
+      # collective/fused wall.  BENCH_MULTICHIP clamps to the devices
+      # actually present: the 1-chip tunnel banks on-device
+      # compile+numerics evidence for the fused kernel on a 1x1 mesh
+      # (variant "multichip1"); a multi-chip slice banks the real
+      # overlap ratio.  Gate: variant label + halo_overlap + comm.
+      bench_nofb BENCH_MULTICHIP="${OPP_MC_DEVICES:-8}" \
+        BENCH_GRID="${OPP_GRID_MC:-1024}" \
+        BENCH_LADDER="${OPP_GRID_MC:-1024}" BENCH_ACCURACY=0 ;;
     superstep2-tm128)
       bench_nofb BENCH_SUPERSTEP=2 NLHEAT_TM=128 BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
@@ -332,6 +345,9 @@ for line in open(sys.argv[1]):
 sys.exit(0 if ok else 1)
 PYEOF
       ;;
+    multichip1024)
+      grep -q '"variant": "multichip' "$2" && grep -q '"halo_overlap"' "$2" \
+        && grep -q '"comm": "fused"' "$2" ;;
     superstep2-tm128)
       grep -q '"variant": "superstep2"' "$2" && grep -q '"tm": 128' "$2" ;;
     superstep3-tm96)

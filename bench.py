@@ -308,7 +308,10 @@ class Best:
                 "fault_plan", "breaker_transitions",
                 "trace_overhead", "spans", "trace_path",
                 # multichip rung: the fused-vs-collective halo evidence
-                "comm", "halo_overlap", "devices", "mesh")
+                "comm", "halo_overlap", "devices", "mesh",
+                # tta rung: the time-to-accuracy evidence (ISSUE 8)
+                "stepper", "eff_dt", "steps_taken", "steps_ratio",
+                "tta", "tta_target", "tta_speedup")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -836,6 +839,15 @@ def child_measure():
     mchip = int(os.environ.get("BENCH_MULTICHIP", 0) or 0)
     if mchip == 1:
         mchip = 0  # the A/B needs a mesh; 0/1 mean off
+    tta = os.environ.get("BENCH_TTA") == "1"
+    if tta and (srv or ens or mchip or any(os.environ.get(k) for k in
+                                           ("BENCH_CARRIED",
+                                            "BENCH_RESIDENT",
+                                            "BENCH_SUPERSTEP"))):
+        log("BENCH_TTA set: ignoring BENCH_SERVE/ENSEMBLE/MULTICHIP/"
+            "CARRIED/RESIDENT/SUPERSTEP — the tta rung is its own "
+            "labeled variant")
+        srv = ens = mchip = 0
     if mchip and (srv or ens or any(os.environ.get(k) for k in
                                     ("BENCH_CARRIED", "BENCH_RESIDENT",
                                      "BENCH_SUPERSTEP"))):
@@ -865,6 +877,122 @@ def child_measure():
             dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
             op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / grid, method=method,
                               precision=PRECISION)
+            if tta:
+                # time-to-accuracy A/B/C (ISSUE 8): a FIXED problem —
+                # the manufactured-solution test on grid^2 to the
+                # horizon T = steps * dt_ref at the 0.8x-stable Euler
+                # dt, with a fixed error target (BENCH_TTA_TARGET,
+                # default the repo contract 1e-6) — solved by each
+                # stepper tier.  Per arm the search walks step counts
+                # (doubling from the arm's stability floor) to the
+                # SMALLEST count meeting the target, so the rung
+                # measures seconds-to-target and steps-to-solution,
+                # not pts*steps/s; "value" stays the Euler arm's honest
+                # throughput so the headline metric keeps its unit.
+                from nonlocalheatequation_tpu.models import steppers as stp
+
+                T = steps * dt
+                target = float(os.environ.get("BENCH_TTA_TARGET", 1e-6))
+                stages = int(os.environ.get("BENCH_TTA_STAGES", 8))
+
+                def tta_arm(stepper, nsteps, arm_method, stages_=0,
+                            time_it=False):
+                    """err (l2/N, f64 oracle criterion) + wall seconds
+                    of one (stepper, nsteps) trial; fresh device state
+                    per run (the multi fns donate on TPU)."""
+                    op_a = NonlocalOp2D(EPS, k=1.0, dt=T / nsteps,
+                                        dh=1.0 / grid, method=arm_method,
+                                        precision=PRECISION)
+                    g_a, lg_a = op_a.source_parts(grid, grid)
+                    multi = stp.make_multi_step_fn(
+                        op_a, nsteps, g_a, lg_a, jnp.float32,
+                        stepper=stepper, stages=stages_)
+                    u0 = np.asarray(op_a.spatial_profile(grid, grid),
+                                    np.float32)
+                    t0 = time.perf_counter()
+                    out = multi(jnp.asarray(u0), 0)
+                    sync(out)
+                    wall = time.perf_counter() - t0  # compile+first
+                    if time_it:
+                        best_w = float("inf")
+                        for _ in range(2):
+                            t0 = time.perf_counter()
+                            out = multi(jnp.asarray(u0), 0)
+                            sync(out)
+                            best_w = min(best_w,
+                                         time.perf_counter() - t0)
+                        wall = best_w
+                    want = op_a.manufactured_solution(grid, grid, nsteps)
+                    d = np.asarray(out, np.float64) - want
+                    return float(np.sum(d * d)) / (grid * grid), wall
+
+                arms = {}
+                walls = {}  # unrounded: ratios divide these, never the
+                # rounded display fields (a sub-0.1ms arm must not
+                # round to 0 and void the rung)
+                err_e, wall_e = tta_arm("euler", steps, method,
+                                        time_it=True)
+                walls["euler"] = wall_e
+                arms["euler"] = {"steps": steps, "eff_dt": T / steps,
+                                 "seconds": round(wall_e, 4),
+                                 "err_l2_per_n": err_e, "method": method,
+                                 "met_target": bool(err_e <= target)}
+                log(f"rung {grid}^2 tta euler: {steps} steps, "
+                    f"{wall_e * 1e3:.1f} ms, err {err_e:.2e}")
+                methods_a = {"rkc": method, "expo": "fft"}
+                for arm in ("rkc", "expo"):
+                    st = stages if arm == "rkc" else 0
+                    n_run = stp.min_steps_to_target(
+                        lambda n, a=arm, s_=st: tta_arm(
+                            a, n, methods_a[a], s_)[0],
+                        stp.superstep_floor(op, T, arm, st), steps,
+                        target,
+                        log=lambda n, e, a=arm: log(
+                            f"rung {grid}^2 tta {a} trial {n} steps: "
+                            f"err {e:.2e} (target {target:g})"))
+                    err_a, wall_a = tta_arm(arm, n_run, methods_a[arm],
+                                            st, time_it=True)
+                    walls[arm] = wall_a
+                    arms[arm] = {
+                        "steps": n_run, "eff_dt": T / n_run,
+                        "seconds": round(wall_a, 4),
+                        "err_l2_per_n": err_a,
+                        "method": methods_a[arm],
+                        "met_target": bool(err_a <= target),
+                        **({"stages": stages} if arm == "rkc" else {}),
+                    }
+                    log(f"rung {grid}^2 tta {arm}: {n_run} steps "
+                        f"(eff_dt {T / n_run:.3e}), "
+                        f"{wall_a * 1e3:.1f} ms, err {err_a:.2e}"
+                        + ("" if arms[arm]["met_target"]
+                           else " [target NOT met]"))
+                # winner: fewest steps among arms that met the target
+                # (euler included); ties break toward fewer seconds
+                met = {a: r for a, r in arms.items() if r["met_target"]}
+                pool = met if met else arms
+                win = min(pool, key=lambda a: (pool[a]["steps"],
+                                               walls[a]))
+                wrec = arms[win]
+                value = grid * grid * steps / wall_e
+                event(
+                    event="rung",
+                    grid=grid,
+                    steps=steps,
+                    best_s=wall_e,
+                    ms_per_step=wall_e / steps * 1e3,
+                    value=value,
+                    variant="tta",
+                    stepper=win,
+                    eff_dt=wrec["eff_dt"],
+                    steps_taken=wrec["steps"],
+                    steps_ratio=round(steps / wrec["steps"], 2),
+                    tta_speedup=round(wall_e / walls[win], 3),
+                    tta_target=target,
+                    tta=arms,
+                )
+                last_op = op
+                any_rung = True
+                continue
             if mchip:
                 # sharded-solving A/B: the SAME mesh, the SAME initial
                 # state, two halo engines — collective (ppermute fenced

@@ -97,6 +97,115 @@ def precision_kwargs(args) -> dict:
     return {"precision": args.precision, "resync_every": args.resync}
 
 
+def add_stepper_flags(p: argparse.ArgumentParser):
+    """Time-integrator flags shared by the solve CLIs (ISSUE 8,
+    models/steppers.py): forward Euler (the reference's scheme and the
+    default — bit-identical legacy behavior), RKC super-stepping (any
+    method, dt up to ~s^2/2 past the Euler bound), or the spectral
+    exponential integrator (method='fft' only, unconditionally stable).
+    """
+    p.add_argument(
+        "--stepper",
+        default="euler",
+        choices=("euler", "rkc", "expo"),
+        help="time integrator: euler (default, the reference's scheme), "
+             "rkc (s-stage Runge-Kutta-Chebyshev super-stepping — works "
+             "with every --method including pallas; dt may exceed the "
+             "Euler bound by ~s^2/2), or expo (spectral exponential "
+             "integrator, requires --method fft; unconditionally stable, "
+             "exact interior diffusion per step)",
+    )
+    p.add_argument(
+        "--superstep-stages",
+        dest="stages",
+        type=int,
+        default=0,
+        metavar="S",
+        help="--stepper rkc: internal stage count s >= 2 (0 picks the "
+             "default 8); the stability interval grows ~2*s^2, so dt up "
+             "to ~s^2/2 past the Euler bound costs s operator "
+             "evaluations — a net ~s/2 fewer applies to a fixed horizon",
+    )
+
+
+def stepper_kwargs(args) -> dict:
+    """The solver kwargs for add_stepper_flags' namespace (the rkc
+    default stage count resolved here so every surface agrees)."""
+    from nonlocalheatequation_tpu.models.steppers import DEFAULT_STAGES
+
+    stages = args.stages
+    if args.stepper == "rkc" and stages == 0:
+        stages = DEFAULT_STAGES
+    return {"stepper": args.stepper, "stages": stages}
+
+
+def validate_stepper_args(args) -> str | None:
+    """The stepper flags' honesty checks (caller prints + exits 1);
+    the dt-vs-bound policy lives in :func:`announce_stable_dt`."""
+    if args.stepper != "euler" and getattr(args, "backend", "jit") == \
+            "oracle":
+        return ("--backend oracle is Euler-only (the ground truth for "
+                "the reference's own scheme); run --stepper "
+                f"{args.stepper} on the jit backend")
+    if args.stepper == "expo" and getattr(args, "method", "fft") != "fft":
+        return ("--stepper expo integrates in the spectral domain; it "
+                "requires --method fft (rkc super-steps every other "
+                "method)")
+    if args.stages and args.stepper != "rkc":
+        return ("--superstep-stages is an rkc knob; --stepper "
+                f"{args.stepper} takes no stage count")
+    if args.stepper == "rkc" and args.stages != 0 and args.stages < 2:
+        return ("--stepper rkc needs --superstep-stages >= 2 "
+                f"(or 0 = default; got {args.stages})")
+    return None
+
+
+def announce_stable_dt(dim: int, k: float, eps: int, h: float, dt: float,
+                       stepper: str, stages: int) -> int | None:
+    """Print the stability bound ACTUALLY IN FORCE for the selected
+    (stepper, stages) and police an explicit dt against it (the ISSUE 8
+    bugfix: every CLI used to compute its stability advice with the
+    Euler-only constant and silently accept any --dt).
+
+    Policy: a super-stepping run (rkc/expo) that exceeds its model is
+    refused at rc 2 — the user opted into the stability contract and
+    integrating past it amplifies instead of diffusing.  An Euler run
+    past its bound only WARNS: several of the reference's own ctest
+    parameter rows sit marginally past the Euler bound (ops/constants.py
+    bf16 section) and reference parity means accepting them.  Returns
+    the exit code to use (2) or None to proceed.
+    """
+    import numpy as np
+
+    from nonlocalheatequation_tpu.ops import constants as C
+    from nonlocalheatequation_tpu.ops.stencil import (
+        horizon_mask_1d,
+        horizon_mask_2d,
+        horizon_mask_3d,
+    )
+
+    mask = {1: horizon_mask_1d, 2: horizon_mask_2d, 3: horizon_mask_3d}[dim](eps)
+    wsum = float(np.asarray(mask, np.float64).sum())
+    c = {1: C.c_1d, 2: C.c_2d, 3: C.c_3d}[dim](k, eps, h)
+    bound = C.stable_dt(c, h, dim, wsum, stepper=stepper, stages=stages)
+    label = stepper if stepper != "rkc" else f"rkc[s={stages}]"
+    print(f"stability: dt bound in force {bound:g} (stepper {label}; "
+          f"Euler bound {C.stable_dt(c, h, dim, wsum):g}); dt {dt:g}",
+          file=sys.stderr)
+    if dt <= bound * (1.0 + 1e-12):
+        return None
+    if stepper == "euler":
+        print(f"WARNING: dt {dt:g} exceeds the forward-Euler stability "
+              f"bound {bound:g}; accepted for reference parity (several "
+              "reference ctest rows sit marginally past it) but the "
+              "solve may amplify — consider --stepper rkc",
+              file=sys.stderr)
+        return None
+    print(f"dt {dt:g} exceeds the {label} stability bound {bound:g}; "
+          "raise --superstep-stages or shrink --dt", file=sys.stderr)
+    return 2
+
+
 def apply_platform_config(args):
     """The config-only half of :func:`apply_platform`: safe to run before
     ``init_multihost`` because it never queries the backend (a query

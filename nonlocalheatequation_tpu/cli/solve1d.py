@@ -15,6 +15,8 @@ from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
     add_precision_flags,
     add_serve_flags,
+    add_stepper_flags,
+    announce_stable_dt,
     apply_platform,
     bool_flag,
     obs_session,
@@ -23,8 +25,10 @@ from nonlocalheatequation_tpu.cli.common import (
     serve_batch,
     set_live_registry,
     set_metrics_payload,
+    stepper_kwargs,
     validate_obs_args,
     validate_serve_args,
+    validate_stepper_args,
     version_banner,
 )
 
@@ -46,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dx", type=float, default=0.02)
     p.add_argument("--no-header", action="store_true", dest="no_header")
     p.add_argument("--backend", default="jit", choices=("oracle", "jit"))
+    p.add_argument("--method", default="shift", choices=("shift", "fft"),
+                   help="neighbor-sum evaluation: shift (default, the "
+                        "reference-shaped slice-add loop) or fft (the "
+                        "circulant spectral apply, O(N log N) and "
+                        "eps-independent; <= 1e-12 of shift)")
+    add_stepper_flags(p)
     p.add_argument("--log", action="store_true",
                    help="write csv/vtu logs every nlog steps")
     p.add_argument("--profile", default=None, metavar="DIR",
@@ -62,8 +72,9 @@ def make_solver(args, nx, nt, eps, k, dt, dx):
     from nonlocalheatequation_tpu.models.solver1d import Solver1D
 
     return Solver1D(nx, nt, eps, nlog=args.nlog, k=k, dt=dt, dx=dx,
-                    backend=args.backend, precision=args.precision,
-                    resync_every=args.resync)
+                    backend=args.backend, method=args.method,
+                    precision=args.precision,
+                    resync_every=args.resync, **stepper_kwargs(args))
 
 
 def main(argv=None) -> int:
@@ -76,12 +87,20 @@ def main(argv=None) -> int:
         print("--resync is not supported with --ensemble (the batched "
               "paths have no per-step precision switch)", file=sys.stderr)
         return 1
-    err = validate_serve_args(args) or validate_obs_args(args)
+    err = (validate_stepper_args(args) or validate_serve_args(args)
+           or validate_obs_args(args))
     if err:
         print(err, file=sys.stderr)
         return 1
     version_banner("1d_nonlocal")
     apply_platform(args)
+    if not args.test_batch:
+        # ISSUE 8 bugfix: the bound actually in force, policed per stepper
+        sk = stepper_kwargs(args)
+        rc = announce_stable_dt(1, args.k, args.eps, args.dx, args.dt,
+                                sk["stepper"], sk["stages"])
+        if rc is not None:
+            return rc
 
     with obs_session(args):
         return _run(args)
@@ -114,7 +133,9 @@ def _run(args) -> int:
                     s = make_solver(args, *case)
                     s.test_init()
                     solvers.append(s)
-                engine = EnsembleEngine(precision=args.precision)
+                engine = EnsembleEngine(
+                    method=("fft" if args.method == "fft" else "auto"),
+                    precision=args.precision, **stepper_kwargs(args))
                 set_live_registry(engine.report.registry)
                 states = engine.run([s.ensemble_case() for s in solvers])
                 print(f"ensemble: {engine.report.summary()}",
@@ -132,7 +153,8 @@ def _run(args) -> int:
                 return serve_batch(
                     case_iter,
                     lambda *row: make_solver(args, *row),
-                    {"precision": args.precision},
+                    {"method": ("fft" if args.method == "fft" else "auto"),
+                     "precision": args.precision, **stepper_kwargs(args)},
                     args)
 
         return run_batch(read_case, run_case, row_tokens=6,

@@ -16,6 +16,8 @@ from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
     add_precision_flags,
     add_serve_flags,
+    add_stepper_flags,
+    announce_stable_dt,
     apply_platform,
     bool_flag,
     obs_session,
@@ -24,8 +26,10 @@ from nonlocalheatequation_tpu.cli.common import (
     serve_batch,
     set_live_registry,
     set_metrics_payload,
+    stepper_kwargs,
     validate_obs_args,
     validate_serve_args,
+    validate_stepper_args,
     version_banner,
 )
 
@@ -47,7 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-header", action="store_true", dest="no_header")
     p.add_argument("--backend", default="jit", choices=("oracle", "jit"))
     p.add_argument("--method", default="auto",
-                   choices=("auto", "conv", "shift", "sat", "pallas"))
+                   choices=("auto", "conv", "shift", "sat", "pallas",
+                            "fft"))
+    add_stepper_flags(p)
     p.add_argument("--log", action="store_true")
     p.add_argument("--checkpoint", default=None,
                    help="checkpoint file to write every --ncheckpoint steps")
@@ -87,15 +93,25 @@ def main(argv=None) -> int:
               "sequential batch, or --precision bf16 without --resync",
               file=sys.stderr)
         return 1
-    err = (validate_serve_args(args, [
-        (args.serve and (args.checkpoint or args.resume),
-         "--checkpoint/--resume cannot be combined with --serve")])
+    err = (validate_stepper_args(args)
+        or validate_serve_args(args, [
+            (args.serve and (args.checkpoint or args.resume),
+             "--checkpoint/--resume cannot be combined with --serve")])
         or validate_obs_args(args))
     if err:
         print(err, file=sys.stderr)
         return 1
     version_banner("2d_nonlocal")
     apply_platform(args)
+    if not args.test_batch:
+        # ISSUE 8 bugfix: print the stability bound actually in force
+        # for the selected stepper and refuse (rc 2) an over-bound
+        # explicit --dt on the opted-into super-stepping integrators
+        sk = stepper_kwargs(args)
+        rc = announce_stable_dt(2, args.k, args.eps, args.dh, args.dt,
+                                sk["stepper"], sk["stages"])
+        if rc is not None:
+            return rc
 
     with obs_session(args):
         return _run(args)
@@ -110,7 +126,8 @@ def _run(args) -> int:
                         checkpoint_path=args.checkpoint,
                         ncheckpoint=args.ncheckpoint,
                         precision=args.precision,
-                        resync_every=args.resync)
+                        resync_every=args.resync,
+                        **stepper_kwargs(args))
 
     if args.test_batch:
         # row: nx ny nt eps k dt dh  (tests/2d.txt)
@@ -139,7 +156,8 @@ def _run(args) -> int:
                     s.test_init()
                     solvers.append(s)
                 engine = EnsembleEngine(method=args.method,
-                                        precision=args.precision)
+                                        precision=args.precision,
+                                        **stepper_kwargs(args))
                 set_live_registry(engine.report.registry)
                 states = engine.run([s.ensemble_case() for s in solvers])
                 print(f"ensemble: {engine.report.summary()}",
@@ -157,7 +175,8 @@ def _run(args) -> int:
                 return serve_batch(
                     case_iter,
                     make_solver,
-                    {"method": args.method, "precision": args.precision},
+                    {"method": args.method, "precision": args.precision,
+                     **stepper_kwargs(args)},
                     args)
 
         return run_batch(read_case, run_case, row_tokens=7,

@@ -19,6 +19,8 @@ from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
     add_precision_flags,
     add_serve_flags,
+    add_stepper_flags,
+    announce_stable_dt,
     bool_flag,
     check_same_input_state,
     cli_startup,
@@ -29,8 +31,10 @@ from nonlocalheatequation_tpu.cli.common import (
     serve_batch,
     set_live_registry,
     set_metrics_payload,
+    stepper_kwargs,
     validate_obs_args,
     validate_serve_args,
+    validate_stepper_args,
 )
 
 
@@ -51,7 +55,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-header", action="store_true", dest="no_header")
     p.add_argument("--backend", default="jit", choices=("oracle", "jit"))
     p.add_argument("--method", default="auto",
-                   choices=("auto", "shift", "sat", "pallas"))
+                   choices=("auto", "shift", "sat", "pallas", "fft"))
+    add_stepper_flags(p)
     p.add_argument("--distributed", action="store_true",
                    help="shard over the device mesh (SPMD + halo exchange)")
     p.add_argument("--comm", default="collective",
@@ -88,6 +93,21 @@ def main(argv=None) -> int:
     if args.test_batch and (args.resume or args.checkpoint):
         print("--checkpoint/--resume cannot be combined with --test_batch",
               file=sys.stderr)
+        return 1
+    if args.method == "fft" and args.distributed:
+        # honesty rule: the spectral embedding is exact only for the
+        # whole-domain zero collar; a sharded block's halo carries
+        # neighbor data (ops/spectral.py docstring)
+        print("--method fft serves whole-domain solves only; "
+              "--distributed needs pallas/sat/shift", file=sys.stderr)
+        return 1
+    if args.stepper != "euler" and args.distributed:
+        print("--stepper rkc/expo runs on the serial jit solver; the "
+              "distributed scan is Euler-only for now", file=sys.stderr)
+        return 1
+    err0 = validate_stepper_args(args)
+    if err0:
+        print(err0, file=sys.stderr)
         return 1
     if args.comm != "collective" and not args.distributed:
         # honesty rule: the serial solvers exchange no halos at all —
@@ -140,6 +160,13 @@ def main(argv=None) -> int:
                 "backends would run N independent solves)")
 
     multi = cli_startup(args, "3d_nonlocal", validate_multi=_need_distributed)
+    if not args.test_batch:
+        # ISSUE 8 bugfix: the bound actually in force, policed per stepper
+        sk = stepper_kwargs(args)
+        rc = announce_stable_dt(3, args.k, args.eps, args.dh, args.dt,
+                                sk["stepper"], sk["stages"])
+        if rc is not None:
+            return rc
 
     with obs_session(args):
         return _run(args, multi)
@@ -166,7 +193,7 @@ def _run(args, multi: bool) -> int:
                         checkpoint_path=args.checkpoint,
                         ncheckpoint=args.ncheckpoint,
                         precision=args.precision,
-                        resync_every=args.resync)
+                        resync_every=args.resync, **stepper_kwargs(args))
 
     if args.test_batch:
         # row: nx ny nz nt eps k dt dh
@@ -195,7 +222,8 @@ def _run(args, multi: bool) -> int:
                     s.test_init()
                     solvers.append(s)
                 engine = EnsembleEngine(method=args.method,
-                                        precision=args.precision)
+                                        precision=args.precision,
+                                        **stepper_kwargs(args))
                 set_live_registry(engine.report.registry)
                 states = engine.run([s.ensemble_case() for s in solvers])
                 print(f"ensemble: {engine.report.summary()}",
@@ -213,7 +241,8 @@ def _run(args, multi: bool) -> int:
                 return serve_batch(
                     case_iter,
                     make_solver,
-                    {"method": args.method, "precision": args.precision},
+                    {"method": args.method, "precision": args.precision,
+                     **stepper_kwargs(args)},
                     args)
 
         return run_batch(read_case, run_case, multi=multi, row_tokens=8,

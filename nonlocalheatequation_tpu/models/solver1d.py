@@ -15,10 +15,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from nonlocalheatequation_tpu.models.steppers import (
+    validate_solver_stepper as _check_stepper,
+)
 from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.ops.nonlocal_op import (
     NonlocalOp1D,
-    make_step_fn,
     source_at,
 )
 
@@ -34,14 +36,20 @@ class Solver1D:
         dt: float = 0.001,
         dx: float = 0.02,
         backend: str = "oracle",
+        method: str = "shift",
+        stepper: str = "euler",
+        stages: int = 0,
         logger=None,
         dtype=None,
         precision: str = "f32",
         resync_every: int = 0,
     ):
         self.nx, self.nt, self.eps, self.nlog = int(nx), int(nt), int(eps), int(nlog)
-        self.op = NonlocalOp1D(eps, k, dt, dx, precision=precision,
+        self.op = NonlocalOp1D(eps, k, dt, dx, method=method,
+                               precision=precision,
                                resync_every=resync_every)
+        self.stepper, self.stages = _check_stepper(self.op, backend, stepper,
+                                                   stages)
         self.backend = backend
         self.logger = logger
         self.dtype = dtype
@@ -94,14 +102,22 @@ class Solver1D:
                 )
                 u = jnp.asarray(self.u0, dtype)
                 if self.logger is None:
-                    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+                    from nonlocalheatequation_tpu.models.steppers import (
                         make_multi_step_fn,
                     )
 
-                    multi = make_multi_step_fn(self.op, self.nt, g, lg, dtype)
+                    multi = make_multi_step_fn(self.op, self.nt, g, lg,
+                                               dtype, stepper=self.stepper,
+                                               stages=self.stages)
                     u = np.asarray(multi(u, 0))
                 else:
-                    step = jax.jit(make_step_fn(self.op, g, lg, dtype))
+                    from nonlocalheatequation_tpu.models.steppers import (
+                        make_step_fn,
+                    )
+
+                    step = jax.jit(make_step_fn(self.op, g, lg, dtype,
+                                                stepper=self.stepper,
+                                                stages=self.stages))
                     for t in range(self.nt):
                         u = step(u, t)
                         if t % self.nlog == 0 and self.logger is not None:

@@ -23,11 +23,16 @@ import jax.numpy as jnp
 from jax import lax
 
 from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
+from nonlocalheatequation_tpu.models.steppers import (
+    make_multi_step_fn,
+    make_step_fn,
+)
+from nonlocalheatequation_tpu.models.steppers import (
+    validate_solver_stepper as _check_stepper,
+)
 from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.ops.nonlocal_op import (
     NonlocalOp2D,
-    make_multi_step_fn,
-    make_step_fn,
     source_at,
 )
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
@@ -46,6 +51,8 @@ class Solver2D(CheckpointMixin, ManufacturedMetrics2D):
         dh: float = 0.02,
         backend: str = "oracle",
         method: str = "conv",
+        stepper: str = "euler",
+        stages: int = 0,
         nd: int | None = None,
         logger=None,
         dtype=None,
@@ -59,6 +66,8 @@ class Solver2D(CheckpointMixin, ManufacturedMetrics2D):
         self.op = NonlocalOp2D(eps, k, dt, dh, method=method,
                                precision=precision,
                                resync_every=resync_every)
+        self.stepper, self.stages = _check_stepper(self.op, backend, stepper,
+                                                   stages)
         self.backend = backend
         self.nd = nd  # dispatch-ahead depth (async analog); None = unthrottled
         self.logger = logger
@@ -139,15 +148,20 @@ class Solver2D(CheckpointMixin, ManufacturedMetrics2D):
         checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
         if self.logger is None and self.nd is None and not checkpointing:
             # fast path: the whole time loop is one lax.scan program
-            multi = make_multi_step_fn(self.op, nsteps, g, lg, dtype)
+            multi = make_multi_step_fn(self.op, nsteps, g, lg, dtype,
+                                       stepper=self.stepper,
+                                       stages=self.stages)
             return np.asarray(multi(u, self.t0))
         if self.nd is None:
             # fused scan per segment; barriers = log and checkpoint steps
             return np.asarray(self._run_chunked(
                 u, lambda count: make_multi_step_fn(
-                    self.op, count, g, lg, dtype)))
+                    self.op, count, g, lg, dtype, stepper=self.stepper,
+                    stages=self.stages)))
 
-        step = jax.jit(make_step_fn(self.op, g, lg, dtype))
+        step = jax.jit(make_step_fn(self.op, g, lg, dtype,
+                                    stepper=self.stepper,
+                                    stages=self.stages))
         inflight = []
         self.max_inflight_ = 0
         for t in range(self.t0, self.nt):

@@ -14,6 +14,9 @@ import jax
 import jax.numpy as jnp
 
 from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
+from nonlocalheatequation_tpu.models.steppers import (
+    validate_solver_stepper as _check_stepper,
+)
 from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D, source_at
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
@@ -36,6 +39,8 @@ class Solver3D(CheckpointMixin, ManufacturedMetrics2D):
         dh: float = 0.05,
         backend: str = "oracle",
         method: str = "sat",
+        stepper: str = "euler",
+        stages: int = 0,
         logger=None,
         dtype=None,
         checkpoint_path: str | None = None,
@@ -48,6 +53,8 @@ class Solver3D(CheckpointMixin, ManufacturedMetrics2D):
         self.op = NonlocalOp3D(eps, k, dt, dh, method=method,
                                precision=precision,
                                resync_every=resync_every)
+        self.stepper, self.stages = _check_stepper(self.op, backend, stepper,
+                                                   stages)
         self.backend = backend
         self.logger = logger
         self.dtype = dtype
@@ -112,7 +119,7 @@ class Solver3D(CheckpointMixin, ManufacturedMetrics2D):
         return u
 
     def _run_jit(self, g, lg):
-        from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        from nonlocalheatequation_tpu.models.steppers import (
             make_multi_step_fn,
         )
 
@@ -123,11 +130,13 @@ class Solver3D(CheckpointMixin, ManufacturedMetrics2D):
         checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
         if self.logger is None and not checkpointing:
             multi = make_multi_step_fn(self.op, self.nt - self.t0, g, lg,
-                                       dtype)
+                                       dtype, stepper=self.stepper,
+                                       stages=self.stages)
             return np.asarray(multi(u, self.t0))
         return np.asarray(self._run_chunked(
             u, lambda count: make_multi_step_fn(
-                self.op, count, g, lg, dtype)))
+                self.op, count, g, lg, dtype, stepper=self.stepper,
+                stages=self.stages)))
 
     # -- error metrics: ManufacturedMetrics2D (rank-agnostic) ---------------
     @property

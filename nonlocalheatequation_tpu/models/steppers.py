@@ -1,0 +1,392 @@
+"""Time-stepper tier: beat the forward-Euler stability limit.
+
+The reference integrates with forward Euler everywhere (PAPER.md section
+0), so dt is capped at 1/(c*h^d*Wsum) — at 4096^2 that is ~1.2e-7 and
+*steps-to-solution*, not per-step throughput, gates every real answer
+(ROADMAP item 2).  This module is the stepper abstraction threaded
+through Solver1D/2D/3D (``stepper=euler|rkc|expo``):
+
+* ``euler`` — delegates to the existing machinery untouched
+  (ops/nonlocal_op.make_step_fn / make_multi_step_fn, including the
+  pallas kernel variants and the autotuner), so the default path is
+  bit-identical to the pre-stepper code by construction.
+* ``rkc`` — s-stage Runge-Kutta-Chebyshev super-stepping (first order,
+  damped; Verwer's RKC1 coefficients).  The internal stability
+  polynomial T_s(w0 + w1*z)/T_s(w0) stretches the real stability
+  interval to beta(s) ~ 2*s^2 (ops/constants.rkc_beta), so dt may grow
+  ~s^2/2 past the Euler bound at s operator evaluations per step — a
+  net ~s/2 fewer operator applications to a fixed horizon.  Each stage
+  is one ``op.apply`` call, so rkc runs UNCHANGED on every evaluation
+  method including the pallas kernels (no kernel edits — the stage loop
+  lives above the method dispatch).  Construction refuses loudly when
+  ``op.dt`` exceeds the (stepper, stages) stability model
+  (ops/constants.stable_dt) instead of silently integrating garbage.
+* ``expo`` — exponential time differencing (ETD1 / exponential Euler)
+  in the spectral domain, ``method='fft'`` only: per step
+  ``u_hat <- e^{lambda*dt} u_hat + dt*phi1(lambda*dt) b_hat`` with the
+  exact circulant symbol lambda (ops/spectral.operator_symbol) and an
+  expm1-stable phi1.  lambda <= 0 makes it unconditionally stable; the
+  linear diffusion is integrated EXACTLY within each step, so for
+  autonomous sources (production runs: b = 0) one step reaches any
+  horizon with no time-discretization error beyond the boundary-coupling
+  term below.  Honesty note: the volumetric collar (u = 0 outside the
+  domain) is re-imposed at every step boundary — the circulant operator
+  and the collar projection do not commute, so a step of size DT carries
+  an O(DT^2) boundary-coupling defect concentrated near the domain edge
+  (zero when the state stays clear of the boundary).  Time-dependent
+  sources are frozen at the step start (first order), matching rkc.
+
+The manufactured-solution contract ``error_l2/#points <= 1e-6`` holds
+for every (method, stepper) pair at the reference configs
+(tests/test_spectral.py); the NumPy ``oracle`` backend stays Euler-only
+— it is the ground truth for the reference's own scheme, and the solvers
+refuse ``backend='oracle'`` with a non-Euler stepper rather than
+silently switching integrators.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from nonlocalheatequation_tpu.obs import trace as obs_trace
+from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+from nonlocalheatequation_tpu.ops.constants import (
+    RKC_DAMPING,
+    stable_dt_op,
+)
+from nonlocalheatequation_tpu.ops.nonlocal_op import (
+    make_multi_step_fn as _euler_multi_step_fn,
+)
+from nonlocalheatequation_tpu.ops.nonlocal_op import (
+    make_step_fn as _euler_step_fn,
+)
+from nonlocalheatequation_tpu.ops.nonlocal_op import (
+    check_bucket_ops,
+    source_at,
+)
+
+STEPPERS = ("euler", "rkc", "expo")
+
+#: Default RKC stage count for the CLI surface: beta(8) ~ 123 allows dt
+#: ~61x the Euler bound at 8 operator evaluations per step (~7.7x fewer
+#: applications to a fixed horizon) while the first-order error stays
+#: within the manufactured contract at the reference configs.
+DEFAULT_STAGES = 8
+
+
+def validate_stepper(op, stepper: str, stages: int = 0) -> None:
+    """The stepper tier's honesty checks, shared by solvers, the
+    ensemble engine, and the CLIs.  Raises ValueError with the bound in
+    force; never silently downgrades."""
+    if stepper not in STEPPERS:
+        raise ValueError(
+            f"unknown stepper {stepper!r}; one of {STEPPERS}")
+    if stepper == "euler":
+        return
+    if stepper == "rkc":
+        if stages < 2:
+            raise ValueError(
+                f"stepper='rkc' needs stages >= 2 (got {stages}); "
+                "stages ~ sqrt(2*dt/dt_euler) reaches a target dt")
+        bound = stable_dt_op(op, "rkc", stages)
+        if op.dt > bound * (1.0 + 1e-12):
+            euler = stable_dt_op(op, "euler")
+            raise ValueError(
+                f"dt={op.dt:g} exceeds the {stages}-stage RKC stability "
+                f"bound {bound:g} (Euler bound {euler:g}); raise "
+                "--superstep-stages or shrink dt — integrating past the "
+                "model would amplify, not diffuse")
+        return
+    # expo
+    if getattr(op, "method", None) != "fft":
+        raise ValueError(
+            "stepper='expo' integrates in the spectral domain; it "
+            "requires method='fft' (the circulant symbol is the "
+            "exponent) — rkc super-steps every other method")
+
+
+def superstep_floor(op, horizon: float, stepper: str,
+                    stages: int = 0) -> int:
+    """Smallest step count the (stepper, stages) stability model allows
+    for ``horizon`` at the benches' 0.8x safety headroom (expo is
+    unconditionally stable: floor 1).  ``op``'s dt is ignored — only
+    its spectrum matters."""
+    if stepper == "expo":
+        return 1
+    bound = 0.8 * stable_dt_op(op, stepper, stages)
+    if not np.isfinite(bound):
+        return 1
+    return max(1, int(np.ceil(horizon / bound)))
+
+
+def min_steps_to_target(trial, floor: int, cap: int, target: float,
+                        log=None) -> int:
+    """The time-to-accuracy step search shared by bench.py's BENCH_TTA
+    rung and tools/bench_table.py's tta group (one policy, two
+    surfaces): doubling from the stability ``floor``, the smallest step
+    count whose ``trial(nsteps) -> err_l2_per_n`` meets ``target``,
+    else ``cap`` — the caller re-runs the returned count and records
+    the ACTUAL error, so a cap fallback still reports honestly
+    (doubling can step over the cap without ever trying it)."""
+    n = max(1, int(floor))
+    while n <= cap:
+        err = trial(n)
+        if log is not None:
+            log(n, err)
+        if err <= target:
+            return n
+        n *= 2
+    return cap
+
+
+def validate_solver_stepper(op, backend: str, stepper: str,
+                            stages: int) -> tuple:
+    """Solver-construction validation: the stepper model checks plus the
+    oracle-backend rule (the NumPy oracle is the ground truth for the
+    reference's own forward-Euler scheme; a non-Euler oracle would be a
+    different integrator wearing the oracle's name).  Returns the
+    canonical (stepper, stages) pair."""
+    validate_stepper(op, stepper, stages)
+    if stepper != "euler" and backend == "oracle":
+        raise ValueError(
+            f"backend='oracle' is Euler-only (the reference's own "
+            f"scheme); run stepper={stepper!r} on the jit backend")
+    return stepper, int(stages)
+
+
+def _rkc_coeffs(stages: int) -> dict:
+    """Verwer RKC1 coefficients as baked host floats.  With
+    b_j = 1/T_j(w0): mu_j + nu_j = 1 exactly (the Chebyshev three-term
+    recurrence at w0), so the scheme needs no separate Y0 term and the
+    internal stages satisfy Y_j = P_j(dt*L) u with
+    P_j(z) = T_j(w0 + w1*z)/T_j(w0)."""
+    s = int(stages)
+    w0 = 1.0 + RKC_DAMPING / (s * s)
+    t = [1.0, w0]  # T_j(w0)
+    d = [0.0, 1.0]  # T_j'(w0)
+    for _ in range(2, s + 1):
+        t.append(2.0 * w0 * t[-1] - t[-2])
+        d.append(2.0 * t[-2] + 2.0 * w0 * d[-1] - d[-2])
+    w1 = t[s] / d[s]
+    b = [1.0 / tj for tj in t]
+    mu = [0.0, 0.0]
+    nu = [0.0, 0.0]
+    mut = [0.0, w1 / w0]  # mu~_1 = b_1 * w1
+    for j in range(2, s + 1):
+        mu.append(2.0 * w0 * b[j] / b[j - 1])
+        nu.append(-b[j] / b[j - 2])
+        mut.append(2.0 * w1 * b[j] / b[j - 1])
+    return {"s": s, "mu": mu, "nu": nu, "mut": mut}
+
+
+def _make_rkc_step(op, g, lg, dtype, stages):
+    """(u, t) -> u after ONE dt via the s-stage RKC1 recurrence.  Every
+    stage is one op.apply (any method — shift/conv/sat/pallas/fft); the
+    time-dependent source is frozen at the step's start (first order,
+    like the scheme itself)."""
+    co = _rkc_coeffs(stages)
+    s = co["s"]
+    test = g is not None
+    if test:
+        g = jnp.asarray(g, dtype)
+        lg = jnp.asarray(lg, dtype)
+    dt = op.dt
+
+    def rhs(u, t):
+        du = op.apply(u)
+        if test:
+            du = du + source_at(g, lg, t, dt)
+        return du
+
+    def step(u, t):
+        y_prev2 = u
+        y_prev = u + (co["mut"][1] * dt) * rhs(u, t)
+        for j in range(2, s + 1):
+            y = (co["mu"][j] * y_prev + co["nu"][j] * y_prev2
+                 + (co["mut"][j] * dt) * rhs(y_prev, t))
+            y_prev2, y_prev = y_prev, y
+        return y_prev
+
+    return step
+
+
+def _expo_tables(op, shape, dtype):
+    """Baked (E, P) = (e^{lambda*dt}, dt*phi1(lambda*dt)) for the expo
+    step, computed in float64 on the host (np.expm1 keeps phi1 =
+    expm1(z)/z exact through z -> 0; the z ~ 0 series covers the DC mode
+    where lambda = 0 exactly) and cast once to the compute dtype."""
+    from nonlocalheatequation_tpu.ops.spectral import operator_symbol
+
+    lam = operator_symbol(op, shape)
+    z = lam * op.dt
+    small = np.abs(z) < 1e-12
+    z_safe = np.where(small, 1.0, z)
+    phi1 = np.where(small, 1.0 + z / 2.0, np.expm1(z_safe) / z_safe)
+    E = np.exp(z)
+    P = op.dt * phi1
+    real = jnp.zeros((), dtype).real.dtype
+    return jnp.asarray(E, real), jnp.asarray(P, real)
+
+
+def _make_expo_step(op, g, lg, dtype):
+    """(u, t) -> u after ONE dt via spectral ETD1 (module docstring).
+    The collar is re-imposed every step by the zero-embedding itself."""
+    from nonlocalheatequation_tpu.ops.spectral import fft_box
+    from nonlocalheatequation_tpu.utils.compat import irfftn, rfftn
+
+    validate_stepper(op, "expo")
+    test = g is not None
+    dt = op.dt
+    if test:
+        g = np.asarray(g, np.float64)
+        lg = np.asarray(lg, np.float64)
+
+    tables: dict = {}
+
+    def step(u, t):
+        box = fft_box(u.shape, op.eps)
+        key = (u.shape, jnp.dtype(u.dtype).name)
+        if key not in tables:
+            tables[key] = _expo_tables(op, u.shape, u.dtype)
+        E, P = tables[key]
+        pad = [(0, b - s_) for s_, b in zip(u.shape, box)]
+        uh = rfftn(jnp.pad(op._operand(u), pad))
+        uh = E * uh
+        if test:
+            b_t = source_at(jnp.asarray(g, u.dtype),
+                            jnp.asarray(lg, u.dtype), t, dt)
+            uh = uh + P * rfftn(jnp.pad(b_t, pad))
+        out = irfftn(uh, s=box)
+        return out[tuple(slice(0, s_) for s_ in u.shape)]
+
+    return step
+
+
+def make_step_fn(op, g=None, lg=None, dtype=None, stepper: str = "euler",
+                 stages: int = 0):
+    """The stepper tier's (u, t) -> u_next builder; ``euler`` is exactly
+    ops/nonlocal_op.make_step_fn (bit-identical default path)."""
+    if stepper == "euler":
+        return _euler_step_fn(op, g, lg, dtype)
+    validate_stepper(op, stepper, stages)
+    if stepper == "rkc":
+        return _make_rkc_step(op, g, lg, dtype, stages)
+    return _make_expo_step(op, g, lg, dtype)
+
+
+def _maybe_tune_method(op, g):
+    """The stencil<->fft crossover dimension (``NLHEAT_TUNE_METHOD=1``,
+    production solves only): returns a per-call-memoizing resolver
+    ``shape, dtype -> op`` that measures the op's own method against its
+    fft twin once per (shape, dtype) and runs the winner
+    (utils/autotune.pick_op_method — the fft twin computes the same
+    function to <= 1e-12, the suite-pinned contract, so the swap is an
+    opt-in accuracy-class change exactly like NLHEAT_TUNE_PRECISION)."""
+    if (os.environ.get("NLHEAT_TUNE_METHOD") != "1" or g is not None
+            or getattr(op, "method", None) in (None, "fft")
+            or not getattr(op, "uniform", True)):
+        return None
+    from nonlocalheatequation_tpu.utils.autotune import pick_op_method
+
+    memo: dict = {}
+
+    def resolve(shape, dtype):
+        key = (tuple(shape), jnp.dtype(dtype).name)
+        if key not in memo:
+            memo[key] = pick_op_method(op, shape, dtype)
+        return memo[key]
+
+    return resolve
+
+
+def make_multi_step_fn(op, nsteps: int, g=None, lg=None, dtype=None,
+                       stepper: str = "euler", stages: int = 0):
+    """(u, t0) -> u after ``nsteps`` steps of the selected stepper.
+
+    ``euler`` delegates to ops/nonlocal_op.make_multi_step_fn — the
+    pallas variant stack, autotuner, and donation behavior are untouched
+    (the acceptance contract: the default path stays bit-identical).
+    ``rkc``/``expo`` scan their step over the same (u, t0) signature
+    with the state donated on TPU, publish the ``/stepper/*`` gauges at
+    build time (no per-step cost), and wrap each dispatch in a
+    ``stepper.superstep`` span (async dispatch — the span never adds a
+    fence; with no tracer installed it is one attribute read)."""
+    tune = _maybe_tune_method(op, g)
+    if stepper == "euler" and tune is None:
+        return _euler_multi_step_fn(op, nsteps, g, lg, dtype)
+    validate_stepper(op, stepper, stages)
+
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
+    built: dict = {}
+
+    def build(shape, dt_):
+        op_run = op if tune is None else tune(shape, dt_)
+        if stepper == "euler":
+            return _euler_multi_step_fn(op_run, nsteps, g, lg, dtype)
+        step = make_step_fn(op_run, g, lg, dtype, stepper=stepper,
+                            stages=stages)
+
+        def multi(u, t0):
+            ts = t0 + jnp.arange(nsteps)
+            out, _ = lax.scan(lambda uc, t: (step(uc, t), None), u, ts)
+            return out
+
+        return donated_jit(multi)
+
+    # build-time observability: gauges are set when a program is (re)built
+    # for a shape — the timed path reads nothing
+    REGISTRY.gauge("/stepper/stages").set(int(stages) if stepper == "rkc"
+                                          else 1)
+    REGISTRY.gauge("/stepper/eff-dt").set(float(op.dt))
+
+    def multi_dispatch(u, t0):
+        key = (u.shape, jnp.dtype(dtype or u.dtype).name)
+        fn = built.get(key)
+        if fn is None:
+            fn = built[key] = build(u.shape, dtype or u.dtype)
+        with obs_trace.span("stepper.superstep", cat="stepper",
+                            stepper=stepper, stages=stages, steps=nsteps,
+                            eff_dt=op.dt):
+            return fn(u, t0)
+
+    return multi_dispatch
+
+
+def make_batched_multi_step_fn(ops, nsteps: int, dtype=None,
+                               test: bool = False, gs=None, lgs=None,
+                               stepper: str = "rkc", stages: int = 0):
+    """(U: (B, *shape), t0) -> U for a non-Euler ensemble bucket: each
+    case's solo stepper scan inlined into ONE jitted program (the
+    stacked composition — one compile, one dispatch per chunk, and
+    bit-identical to the sequential stepper solves by construction,
+    serve/ensemble.py's mixed-physics rule applied to steppers)."""
+    from nonlocalheatequation_tpu.utils.donation import donated_jit
+
+    check_bucket_ops(ops)
+    for op in ops:
+        validate_stepper(op, stepper, stages)
+    steps = [
+        make_step_fn(op, gs[i] if test else None,
+                     lgs[i] if test else None, dtype,
+                     stepper=stepper, stages=stages)
+        for i, op in enumerate(ops)
+    ]
+
+    def multi(U, t0):
+        dt_ = dtype or U.dtype
+        U = U.astype(dt_)
+        ts = t0 + jnp.arange(nsteps)
+
+        def solo(step, u0):
+            out, _ = lax.scan(lambda uc, t: (step(uc, t), None), u0, ts)
+            return out
+
+        return jnp.stack([solo(s, U[i]) for i, s in enumerate(steps)])
+
+    return donated_jit(multi)

@@ -94,6 +94,92 @@ def validate_precision(precision: str) -> str:
     return precision
 
 
+# --------------------------------------------------------------------------
+# Time-integrator stability model
+# --------------------------------------------------------------------------
+#
+# The operator's spectrum lies in [-2*c*h^d*Wsum, 0] (docs/math_spec.md
+# section 6: the neighbor sum is bounded by Wsum*|u| and the center term
+# subtracts exactly Wsum*u, so every eigenvalue is real and non-positive
+# with |lambda| <= 2*c*h^d*Wsum).  A one-step method with stability
+# polynomial P is stable iff |P(dt*lambda)| <= 1 over that interval:
+#
+# * forward Euler: P(z) = 1 + z, stable for z in [-2, 0]
+#     -> dt <= 1 / (c*h^d*Wsum)
+# * RKC (s-stage Runge-Kutta-Chebyshev, first order, damped):
+#     P(z) = T_s(w0 + w1*z)/T_s(w0), stable for z in [-beta(s), 0] with
+#     beta(s) = (1 + w0)/w1 ~ 2*s^2 for small damping
+#     -> dt <= beta(s) / (2*c*h^d*Wsum)  (~s^2/2 x the Euler bound)
+# * exponential (spectral, method='fft' only): e^{dt*lambda} <= 1 for any
+#     dt since lambda <= 0 -> unconditionally stable (bound = inf).
+#
+# Historical bug this section fixes (ISSUE 8 satellite): every CLI
+# computed its stability advice with the Euler-only constant and silently
+# accepted any --dt, even when a super-stepping integrator could take (or
+# required refusing) larger steps.  stable_dt() below is the single
+# source of truth; the CLIs print the bound actually in force and refuse
+# (rc 2) an explicit --dt beyond it for the opted-into steppers.
+
+#: Chebyshev damping factor for the RKC stepper: w0 = 1 + eta/s^2 pulls
+#: the internal stability polynomial off the real-axis touch points so
+#: |P| <= ~1 - eta/2 strictly inside the interval (Verwer's classic
+#: choice), trading ~2.6% of the stability interval for robustness
+#: against spectrum-estimate error.
+RKC_DAMPING = 0.05
+
+
+def _cheb_pair(s: int, w0: float) -> tuple:
+    """(T_s(w0), T_s'(w0)) by the three-term recurrences (exact
+    polynomial evaluation; s is small, the recurrence is stable for
+    w0 >= 1)."""
+    t_prev, t = 1.0, w0  # T_0, T_1
+    d_prev, d = 0.0, 1.0  # T_0', T_1'
+    for _ in range(2, s + 1):
+        t_prev, t = t, 2.0 * w0 * t - t_prev
+        d_prev, d = d, 2.0 * t_prev + 2.0 * w0 * d - d_prev
+    return (t, d) if s >= 1 else (1.0, 0.0)
+
+
+def rkc_beta(stages: int) -> float:
+    """Real-axis stability-interval length beta(s) of the damped s-stage
+    RKC polynomial: P(z) = T_s(w0 + w1*z)/T_s(w0) keeps |P| <= 1 while
+    w0 + w1*z >= -1, i.e. for z in [-(1 + w0)/w1, 0].  beta(2) ~ 7.7,
+    beta(10) ~ 193 (~2*s^2*(1 - 4/3*eta) for small damping eta)."""
+    s = int(stages)
+    if s < 2:
+        raise ValueError(f"RKC needs stages >= 2, got {stages}")
+    w0 = 1.0 + RKC_DAMPING / (s * s)
+    ts, dts = _cheb_pair(s, w0)
+    w1 = ts / dts
+    return (1.0 + w0) / w1
+
+
+def stable_dt(c: float, h: float, dim: int, wsum: float,
+              stepper: str = "euler", stages: int = 0) -> float:
+    """Max stable dt for the (stepper, stages) pair on an operator with
+    scaling constant ``c``, grid spacing ``h``, dimension ``dim`` and
+    mask weight sum ``wsum`` — see the section comment for the model.
+    A degenerate operator (c truncated to 0, the reference's 1D long
+    cast) has an empty spectrum: every dt is stable (inf)."""
+    lam_max = 2.0 * c * (h ** dim) * wsum  # |lambda|_max
+    if stepper == "expo":
+        return math.inf
+    if lam_max <= 0.0:
+        return math.inf
+    if stepper == "euler":
+        return 2.0 / lam_max
+    if stepper == "rkc":
+        return rkc_beta(stages) / lam_max
+    raise ValueError(f"unknown stepper {stepper!r} (euler|rkc|expo)")
+
+
+def stable_dt_op(op, stepper: str = "euler", stages: int = 0) -> float:
+    """:func:`stable_dt` with (c, h, dim, wsum) read off an operator."""
+    dim = op.weights.ndim
+    h = op.dx if dim == 1 else op.dh
+    return stable_dt(op.c, h, dim, op.wsum, stepper=stepper, stages=stages)
+
+
 def c_1d(k: float, eps: int, dx: float) -> float:
     """1D scaling constant, integer-truncated exactly like the reference.
 

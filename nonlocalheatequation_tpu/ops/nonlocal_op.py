@@ -117,9 +117,16 @@ class _PrecisionPolicy:
 
 
 class NonlocalOp1D(_PrecisionPolicy):
-    """1D horizon operator (reference: src/1d_nonlocal_serial.cpp:198-206)."""
+    """1D horizon operator (reference: src/1d_nonlocal_serial.cpp:198-206).
+
+    ``method``: ``shift`` (default — the reference-shaped slice-add loop)
+    or ``fft`` (the circulant spectral apply, ops/spectral.py: O(N log N)
+    and eps-independent, exact for the volumetric boundary by the padded
+    collar embedding; <= 1e-12 of the shift path, not bit-identical —
+    the FFT reassociates every sum)."""
 
     def __init__(self, eps: int, k: float, dt: float, dx: float, influence=None,
+                 method: str = "shift",
                  precision: str = "f32", resync_every: int = 0):
         self.eps = int(eps)
         self.k = float(k)
@@ -128,7 +135,18 @@ class NonlocalOp1D(_PrecisionPolicy):
         self.c = c_1d(k, eps, dx)
         self.weights = influence_weights(horizon_mask_1d(self.eps), influence, dx)
         self.wsum = float(self.weights.sum())
+        self._influence = influence
+        self.uniform = influence is None
+        self.method = method
         self._init_precision(precision, resync_every)
+
+    def with_method(self, method: str) -> "NonlocalOp1D":
+        """Twin operator differing only in evaluation method (the
+        autotuner's stencil<->fft crossover probe builds these)."""
+        return NonlocalOp1D(
+            self.eps, self.k, self.dt, self.dx, influence=self._influence,
+            method=method, precision=self.precision,
+            resync_every=self.resync_every)
 
     # -- neighbor sum -------------------------------------------------------
     def neighbor_sum_np(self, u: np.ndarray) -> np.ndarray:
@@ -143,6 +161,10 @@ class NonlocalOp1D(_PrecisionPolicy):
         return acc
 
     def neighbor_sum(self, u: jnp.ndarray) -> jnp.ndarray:
+        if self.method == "fft":
+            from nonlocalheatequation_tpu.ops import spectral
+
+            return spectral.neighbor_sum_fft(self, self._operand(u))
         up = self._operand(jnp.pad(u, (self.eps, self.eps)))
         nx = u.shape[0]
         acc = jnp.zeros_like(u)
@@ -246,6 +268,9 @@ class NonlocalOp2D(_PrecisionPolicy):
         self.uniform = influence is None  # J == 1: sat/pallas paths are valid
         if method in ("sat", "pallas", "auto") and not self.uniform:
             method = "conv"
+        # fft needs no uniformity demotion: a weighted J still yields a
+        # fixed per-offset weight set, i.e. still a convolution — the
+        # symbol simply bakes the weights (ops/spectral.py)
         self.method = method
         self._init_precision(precision, resync_every)
         self._auto_cache: dict = {}
@@ -258,6 +283,14 @@ class NonlocalOp2D(_PrecisionPolicy):
             self.eps, self.k, self.dt, self.dh, influence=self._influence,
             method=self.method, precision=precision,
             resync_every=resync_every)
+
+    def with_method(self, method: str) -> "NonlocalOp2D":
+        """Twin operator differing only in evaluation method (the
+        autotuner's stencil<->fft crossover probe builds these)."""
+        return NonlocalOp2D(
+            self.eps, self.k, self.dt, self.dh, influence=self._influence,
+            method=method, precision=self.precision,
+            resync_every=self.resync_every)
 
     def _resolve_method(self, nx: int, ny: int, dtype) -> str:
         """Concrete method for this (shape, dtype); 'auto' picks per backend:
@@ -294,6 +327,10 @@ class NonlocalOp2D(_PrecisionPolicy):
         return acc
 
     def neighbor_sum(self, u: jnp.ndarray) -> jnp.ndarray:
+        if self.method == "fft":
+            from nonlocalheatequation_tpu.ops import spectral
+
+            return spectral.neighbor_sum_fft(self, self._operand(u))
         e = self.eps
         return self.neighbor_sum_padded(jnp.pad(u, ((e, e), (e, e))))
 
@@ -304,6 +341,15 @@ class NonlocalOp2D(_PrecisionPolicy):
         distributed path fills via collectives (zeros at the global edge).
         Returns the (nx, ny) sum.
         """
+        if self.method == "fft":
+            # honesty refusal: the spectral embedding is exact only when
+            # the collar is genuinely zero; a distributed block's halo
+            # carries neighbor data (ops/spectral.py docstring), so the
+            # padded entry points never serve fft
+            raise ValueError(
+                "method='fft' serves whole-domain (volumetric-collar) "
+                "solves only; halo-padded block evaluation (distributed/"
+                "fused-comm paths) needs pallas/sat/conv/shift")
         e = self.eps
         method = self._resolve_method(
             upad.shape[0] - 2 * e, upad.shape[1] - 2 * e, upad.dtype
@@ -825,6 +871,14 @@ class NonlocalOp3D(_PrecisionPolicy):
             method=self.method, precision=precision,
             resync_every=resync_every)
 
+    def with_method(self, method: str) -> "NonlocalOp3D":
+        """Twin operator differing only in evaluation method (see
+        NonlocalOp2D.with_method)."""
+        return NonlocalOp3D(
+            self.eps, self.k, self.dt, self.dh, influence=self._influence,
+            method=method, precision=self.precision,
+            resync_every=self.resync_every)
+
     # -- neighbor sum -------------------------------------------------------
     def neighbor_sum_np(self, u: np.ndarray) -> np.ndarray:
         nx, ny, nz = u.shape
@@ -846,6 +900,10 @@ class NonlocalOp3D(_PrecisionPolicy):
         return acc
 
     def neighbor_sum(self, u: jnp.ndarray) -> jnp.ndarray:
+        if self.method == "fft":
+            from nonlocalheatequation_tpu.ops import spectral
+
+            return spectral.neighbor_sum_fft(self, self._operand(u))
         e = self.eps
         return self.neighbor_sum_padded(jnp.pad(u, ((e, e), (e, e), (e, e))))
 
@@ -862,6 +920,11 @@ class NonlocalOp3D(_PrecisionPolicy):
         return m
 
     def neighbor_sum_padded(self, upad: jnp.ndarray) -> jnp.ndarray:
+        if self.method == "fft":
+            raise ValueError(
+                "method='fft' serves whole-domain (volumetric-collar) "
+                "solves only; halo-padded block evaluation (distributed/"
+                "fused-comm paths) needs pallas/sat/shift")
         e = self.eps
         nx, ny, nz = (s - 2 * e for s in upad.shape)
         method = self._resolve_method(nx, ny, nz, upad.dtype)

@@ -1,0 +1,185 @@
+"""Spectral (FFT/circulant) fast path for the nonlocal operator.
+
+On the uniform grid the horizon operator is a convolution with a fixed
+eps-ball stencil (SURVEY.md section 0: the reference's ``sum_local``
+walks the same offset set at every point), so it is diagonalized exactly
+by the DFT of a periodic box — an O(N log N) apply whose cost is
+independent of eps, where the stencil paths pay O(N * eps^d).
+
+Volumetric boundary (the reference's u = 0 outside the domain,
+src/2d_nonlocal_serial.cpp:213-221): embed the (n_1, ..., n_d) grid in a
+zero-padded periodic box with N_a >= n_a + eps points per axis.  Every
+read an interior point makes at offset |o| <= eps then lands either in
+the domain or in the zero collar — including the wrapped reads, which
+land in the SAME collar from the other side (index -j wraps to N - j >=
+n for N >= n + eps).  Circular convolution over the box therefore equals
+the volumetric-boundary operator exactly; the interior slice of the
+inverse transform is the answer and the collar output is discarded.
+Box sizes round up to the next 5-smooth integer for FFT speed (extra
+zeros keep the embedding argument intact).
+
+The symbol is baked per (weights, box) as a host-side float64 constant —
+the same discipline as the kernel paths' baked scalars (ops/pallas_kernel
+section comment): ``sigma(xi) = sum_o w_o cos(xi . o)`` is the real DFT
+of the centered offset kernel (real and even, so its transform is real),
+computed once via ``np.fft.rfftn`` of the kernel embedding;
+``symbol_direct`` is the literal cosine sum the tests pin it against.
+The full operator symbol ``lambda(xi) = c*h^d * (sigma(xi) - Wsum)``
+(equivalently ``c*h^d * sum_o w_o (cos(xi . o) - 1)``) is what the
+exponential integrator (models/steppers.py) exponentiates; it is <= 0
+everywhere, vanishing at DC, which is the unconditional-stability fact
+the ``expo`` stepper rests on.
+
+Honesty boundary: the embedding argument above is exact for ONE operator
+application with the collar re-zeroed before it — exactly what the
+per-step paths do — so ``method='fft'`` holds the same <= 1e-12 oracle
+contract as conv/shift/sat.  It does NOT extend to halo-padded
+distributed blocks (a block's halo carries neighbor data, not zeros), so
+the padded entry points refuse fft loudly instead of wrapping garbage.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+from nonlocalheatequation_tpu.utils.compat import irfftn, rfftn
+
+#: Baked neighbor-sum symbols, keyed by (weights bytes, box).  Symbols
+#: are pure functions of (weights, box) — physics scalars (c, dt, k)
+#: stay OUTSIDE the symbol so one baked array serves every operator that
+#: shares a stencil, exactly like the stencil masks themselves.
+_symbol_cache: dict = {}
+
+#: Process-wide count of operator applications entering the fft path.
+#: Python-level (incremented when the apply is TRACED or run eagerly —
+#: under jit that is once per compiled program, the honest "how many fft
+#: programs were built/entered" number, with zero per-step device cost).
+_fft_applies = REGISTRY.counter("/op/fft-applies")
+
+
+def fft_size(n: int) -> int:
+    """Smallest 5-smooth integer >= n (FFT-friendly box edge)."""
+    if n <= 1:
+        return 1
+    best = None
+    p2 = 1
+    while p2 < 2 * n:
+        p23 = p2
+        while p23 < 2 * n:
+            p235 = p23
+            while p235 < n:
+                p235 *= 5
+            if best is None or p235 < best:
+                best = p235
+            p23 *= 3
+        p2 *= 2
+    return best
+
+
+def fft_box(shape, eps: int) -> tuple:
+    """Padded periodic box for a grid of ``shape`` and horizon ``eps``:
+    per axis the smallest 5-smooth size >= n + eps (the collar-width
+    bound from the module docstring)."""
+    return tuple(fft_size(int(n) + int(eps)) for n in shape)
+
+
+def _kernel_embedding(weights: np.ndarray, box: tuple) -> np.ndarray:
+    """The centered offset kernel placed in the periodic box: entry at
+    index (o mod N) per axis carries w_o, offsets o in [-eps, eps]."""
+    w = np.asarray(weights, np.float64)
+    eps = (w.shape[0] - 1) // 2
+    k = np.zeros(box, np.float64)
+    # roll the (2eps+1)^d block so offset 0 lands at index 0
+    idx = tuple(
+        (np.arange(-eps, eps + 1) % n) for n in box
+    )
+    k[np.ix_(*idx)] = w
+    return k
+
+
+def neighbor_symbol(weights: np.ndarray, box: tuple) -> np.ndarray:
+    """sigma(xi) = sum_o w_o cos(xi . o) on the rfftn frequency grid of
+    ``box`` — the real DFT of the kernel embedding, baked float64.  The
+    kernel is real and even, so the transform is real analytically; the
+    float imaginary residue (~1e-17) is dropped."""
+    key = (np.asarray(weights, np.float64).tobytes(),
+           tuple(np.asarray(weights).shape), tuple(box))
+    sig = _symbol_cache.get(key)
+    if sig is None:
+        sig = np.ascontiguousarray(
+            np.fft.rfftn(_kernel_embedding(weights, box)).real)
+        _symbol_cache[key] = sig
+    return sig
+
+
+def symbol_direct(weights: np.ndarray, box: tuple) -> np.ndarray:
+    """The literal cosine sum sigma(xi) = sum_o w_o cos(xi . o) over the
+    rfftn frequency grid — O(#offsets * #frequencies), the reference
+    form the baked rfftn symbol is pinned against (tests/test_spectral).
+    """
+    w = np.asarray(weights, np.float64)
+    eps = (w.shape[0] - 1) // 2
+    d = w.ndim
+    freq_shape = tuple(box[:-1]) + (box[-1] // 2 + 1,)
+    xi = []
+    for a, n in enumerate(box):
+        npts = freq_shape[a]
+        xi.append(2.0 * np.pi * np.arange(npts) / n)
+    sig = np.zeros(freq_shape, np.float64)
+    for o_flat, wo in np.ndenumerate(w):
+        if wo == 0.0:
+            continue
+        phase = np.zeros(freq_shape, np.float64)
+        for a in range(d):
+            o = o_flat[a] - eps
+            shape_a = [1] * d
+            shape_a[a] = freq_shape[a]
+            phase = phase + (xi[a] * o).reshape(shape_a)
+        sig += wo * np.cos(phase)
+    return sig
+
+
+def operator_symbol(op, shape) -> np.ndarray:
+    """lambda(xi) = c*h^d * (sigma(xi) - Wsum) for ``op`` on a grid of
+    ``shape`` — the exact circulant spectrum of the volumetric operator
+    on the padded box (<= 0 everywhere, 0 at DC).  float64; callers cast
+    to their compute dtype."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import case_scale
+
+    box = fft_box(shape, op.eps)
+    return case_scale(op) * (neighbor_symbol(op.weights, box) - op.wsum)
+
+
+def _embed(u: jnp.ndarray, box: tuple) -> jnp.ndarray:
+    return jnp.pad(u, [(0, b - s) for s, b in zip(u.shape, box)])
+
+
+def neighbor_sum_fft(op, u: jnp.ndarray) -> jnp.ndarray:
+    """The eps-ball neighbor sum of an UNPADDED domain array via the
+    padded-box rFFT: embed, multiply by the baked neighbor symbol,
+    invert, slice the interior.  Exact for the volumetric boundary by
+    the collar argument (module docstring)."""
+    _fft_applies.inc()
+    box = fft_box(u.shape, op.eps)
+    sig = neighbor_symbol(op.weights, box)
+    uh = rfftn(_embed(u, box))
+    # the symbol is real: cast to the matching real dtype so complex64
+    # spectra are scaled by f32 (and complex128 by f64) — no silent
+    # upcast of the whole spectrum
+    sig_dev = jnp.asarray(sig, jnp.real(uh).dtype)
+    out = irfftn(uh * sig_dev, s=box)
+    return out[tuple(slice(0, s) for s in u.shape)]
+
+
+def neighbor_sum_fft_np(op, u: np.ndarray) -> np.ndarray:
+    """NumPy float64 twin of :func:`neighbor_sum_fft` (oracle/test use)."""
+    box = fft_box(u.shape, op.eps)
+    sig = neighbor_symbol(op.weights, box)
+    up = np.zeros(box, np.float64)
+    up[tuple(slice(0, s) for s in u.shape)] = u
+    out = np.fft.irfftn(np.fft.rfftn(up) * sig, s=box,
+                        axes=tuple(range(-len(box), 0)))
+    return out[tuple(slice(0, s) for s in u.shape)]

@@ -171,7 +171,10 @@ class EnsembleEngine:
 
     def __init__(self, method: str = "auto", precision: str = "f32",
                  dtype=None, variant: str = "auto", ksteps: int = 0,
-                 batch_sizes=BATCH_SIZES, comm: str = "collective"):
+                 batch_sizes=BATCH_SIZES, comm: str = "collective",
+                 stepper: str = "euler", stages: int = 0):
+        from nonlocalheatequation_tpu.models.steppers import STEPPERS
+
         if variant not in self.VARIANTS:
             raise ValueError(
                 f"unknown ensemble variant {variant!r}; one of "
@@ -188,6 +191,30 @@ class EnsembleEngine:
             raise ValueError(
                 "comm='fused' needs method='pallas' "
                 "(ops/pallas_halo.require_fused)")
+        if stepper not in STEPPERS:
+            raise ValueError(
+                f"unknown stepper {stepper!r}; one of {STEPPERS}")
+        if stepper == "rkc" and stages < 2:
+            raise ValueError("stepper='rkc' needs stages >= 2")
+        if stepper == "expo" and method != "fft":
+            # mirrors models/steppers.validate_stepper: the exponential
+            # integrator IS the spectral symbol — refused up front so an
+            # unservable key never reaches program build
+            raise ValueError(
+                "stepper='expo' requires method='fft' "
+                "(models/steppers.validate_stepper)")
+        if stepper != "euler" and variant in ("carried", "superstep",
+                                              "vmap"):
+            # the pallas carried/superstep schedules and the vmap
+            # composition are forward-Euler programs; a non-Euler bucket
+            # runs the stacked stepper composition (per-case solo scans
+            # in one program) — refuse rather than silently switch
+            # integrators
+            raise ValueError(
+                f"ensemble variant {variant!r} is Euler-only; "
+                f"stepper={stepper!r} buckets run variant "
+                "'auto'/'per-step'/'stacked' (the stacked stepper "
+                "composition)")
         sizes = tuple(sorted({int(b) for b in batch_sizes}))
         if not sizes or sizes[0] < 1:
             raise ValueError(f"bad batch_sizes {batch_sizes!r}")
@@ -198,6 +225,8 @@ class EnsembleEngine:
         self.ksteps = int(ksteps)
         self.batch_sizes = sizes
         self.comm = comm
+        self.stepper = stepper
+        self.stages = int(stages)
         self.report = EnsembleReport()
         self._programs: dict = {}
 
@@ -212,7 +241,7 @@ class EnsembleEngine:
         kw = dict(method=self.method, precision=self.precision,
                   dtype=self.dtype, variant=self.variant,
                   ksteps=self.ksteps, batch_sizes=self.batch_sizes,
-                  comm=self.comm)
+                  comm=self.comm, stepper=self.stepper, stages=self.stages)
         kw.update(overrides)
         return EnsembleEngine(**kw)
 
@@ -226,7 +255,11 @@ class EnsembleEngine:
 
         dim = len(case.shape)
         if dim == 1:
+            # the 1D operator's method axis is shift|fft; the 2D/3D
+            # engine settings (conv/sat/pallas/auto) all map to shift
             return NonlocalOp1D(case.eps, case.k, case.dt, case.dh,
+                                method=("fft" if self.method == "fft"
+                                        else "shift"),
                                 precision=self.precision)
         cls = NonlocalOp2D if dim == 2 else NonlocalOp3D
         return cls(case.eps, case.k, case.dt, case.dh, method=self.method,
@@ -301,9 +334,12 @@ class EnsembleEngine:
         chunk N computes on the device."""
         test = key[3]
         dtype = self._dtype()
+        # stepper/stages join the program key (ISSUE 8): two engines
+        # differing only in integrator must never share compiled
+        # programs — a mixed-physics fleet buckets per integrator
         prog_key = (key, len(chunk), self.variant,
                     tuple(c.physics() for c in chunk), dtype.name,
-                    self.comm)
+                    self.comm, self.stepper, self.stages)
         multi = self._programs.get(prog_key)
         if multi is None:
             # operators are only needed to BUILD a program (and for the
@@ -364,6 +400,20 @@ class EnsembleEngine:
             parts = [op.source_parts(*shape) for op in ops]
             gs = [g for g, _ in parts]
             lgs = [lg for _, lg in parts]
+        if self.stepper != "euler":
+            # non-Euler buckets: the stacked stepper composition — each
+            # case's solo rkc/expo scan inlined into ONE program (one
+            # compile, one dispatch per chunk; bit-identical to the
+            # sequential stepper solves by construction).  The ctor
+            # already refused the Euler-only variants.
+            from nonlocalheatequation_tpu.models.steppers import (
+                make_batched_multi_step_fn,
+            )
+
+            self.report.strategies[key] = f"stacked[{self.stepper}]"
+            return make_batched_multi_step_fn(
+                ops, nt, dtype=dtype, test=test, gs=gs, lgs=lgs,
+                stepper=self.stepper, stages=self.stages)
         resolved = self.method
         if dim == 2 and resolved == "auto":
             resolved = op0._resolve_method(shape[0], shape[1], dtype)

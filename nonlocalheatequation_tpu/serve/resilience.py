@@ -192,9 +192,11 @@ class CpuFallback:
     #: `_auto_method_{2,3}d`'s off-TPU picks (ops/nonlocal_op.py): the
     #: fast XLA CPU lowering per dimensionality.  Pallas and "auto" must
     #: not leak into the fallback — under an ambient TPU backend "auto"
-    #: resolves to the Mosaic kernel, which cannot execute on CPU.
+    #: resolves to the Mosaic kernel, which cannot execute on CPU.  fft
+    #: is an XLA lowering too (and the only method an expo-stepper
+    #: engine can run at all), so it passes through unchanged.
     _SAFE = {2: "conv", 3: "sat"}
-    _XLA_METHODS = ("conv", "shift", "sat")
+    _XLA_METHODS = ("conv", "shift", "sat", "fft")
 
     def __init__(self, engine):
         self.engine = engine

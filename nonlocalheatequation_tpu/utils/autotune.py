@@ -345,6 +345,72 @@ def pick_batched_multi_step_fn(ops, nsteps: int, shape, dtype,
     return cands[winner](ops, nsteps, dtype), winner
 
 
+def pick_op_method(op, shape, dtype):
+    """The stencil<->fft crossover dimension (``NLHEAT_TUNE_METHOD=1``,
+    ISSUE 8): measure the op's OWN method against its fft twin
+    (ops/spectral.py) on the same PROBE_STEPS base scan, once per
+    (device kind, method pair, shape, eps, dtype), and return the
+    operator to run — the original or its fft twin.  The crossover is
+    real and shape-dependent: the stencil paths cost O(N * eps^d) per
+    apply, the spectral path O(N log N) independent of eps, so fft wins
+    at large eps and loses to the fused kernels at small ones.  The fft
+    twin computes the same function to <= 1e-12 (the suite-pinned
+    oracle contract), not bit-identically — which is why this dimension
+    is opt-in behind its own env knob, like NLHEAT_TUNE_PRECISION.
+    Shares the persistent tuning-record file under ``method-ab`` keys."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import make_multi_step_fn_base
+
+    dtype = jnp.dtype(dtype)
+    if jax.default_backend() == "tpu" and dtype.itemsize == 8:
+        # the wedge rule (see pick_multi_step_fn): never time f64 scans
+        # on the live chip
+        return op
+    from nonlocalheatequation_tpu import __version__
+
+    precision = getattr(op, "precision", "f32")
+    key = "/".join([
+        f"v{__version__}",
+        jax.devices()[0].device_kind, "method-ab",
+        f"{op.method}-vs-fft",
+        "x".join(map(str, shape)), f"eps{op.eps}", dtype.name,
+    ] + ([f"prec-{precision}"] if precision != "f32" else []))
+    cands = {op.method: op, "fft": op.with_method("fft")}
+    maker = lambda o, n, d: make_multi_step_fn_base(o, n, dtype=d)  # noqa: E731
+
+    entry = _memory_cache.get(key)
+    if entry is None or not all(
+            n in entry.get("ms_per_step", {}) for n in cands):
+        file_cache = _load_file_cache()
+        entry = file_cache.get(key)
+        if entry is None or not all(
+                n in entry.get("ms_per_step", {}) for n in cands):
+            recorded = dict((entry or {}).get("ms_per_step", {}))
+            for name, cand in cands.items():
+                if name in recorded:
+                    continue
+                try:
+                    with obs_trace.span("autotune.probe", cat="autotune",
+                                        candidate=f"method:{name}",
+                                        key=key):
+                        recorded[name] = _measure(
+                            maker, cand, shape, dtype) * 1e3
+                except Exception as e:  # noqa: BLE001 — a method that
+                    # fails to build simply doesn't compete
+                    recorded[name] = None
+                    recorded[f"{name}_error"] = \
+                        f"{type(e).__name__}: {e}"[:200]
+            valid = {n: t for n, t in recorded.items()
+                     if isinstance(t, (int, float))
+                     and not isinstance(t, bool)}
+            winner = min(valid, key=valid.get) if valid else op.method
+            entry = {"winner": winner, "ms_per_step": recorded}
+            file_cache[key] = entry
+            _store_file_cache(file_cache)
+        _memory_cache[key] = entry
+    winner = entry["winner"]
+    return cands.get(winner, op)
+
+
 def pick_multi_step_fn(op, nsteps: int, shape, dtype):
     """Measure the fitting variants (cached) and build the winner at the
     real step count.  Returns (fn, winner_name)."""

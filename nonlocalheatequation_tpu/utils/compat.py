@@ -87,3 +87,45 @@ def out_struct(shape, dtype, vma=None) -> jax.ShapeDtypeStruct:
         except TypeError:  # pre-vma ShapeDtypeStruct
             pass
     return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# -- real-input FFT (ops/spectral.py) ---------------------------------------
+# The pinned jaxlib (0.4.x) ships jnp.fft.rfftn/irfftn, but older builds of
+# the axon plugin stack have shipped jnp.fft trees without the real-input
+# entry points.  The spectral path imports from here so the capability
+# split lives in one place: where rfftn exists it is used directly; where
+# it does not, the full complex transform + hermitian slice/embed is the
+# mathematically identical fallback (real input => hermitian spectrum).
+
+try:  # the normal case on the pinned jaxlib
+    from jax.numpy.fft import irfftn as _jnp_irfftn
+    from jax.numpy.fft import rfftn as _jnp_rfftn
+
+    def rfftn(x):
+        """Real-input N-D FFT (half spectrum along the last axis)."""
+        return _jnp_rfftn(x)
+
+    def irfftn(xh, s):
+        """Inverse of :func:`rfftn` back to a real array of shape ``s``."""
+        # axes spelled out: NumPy 2.x (and future jnp) deprecate s=
+        # without axes=
+        return _jnp_irfftn(xh, s=s, axes=tuple(range(-len(s), 0)))
+
+except ImportError:  # pragma: no cover — plugin builds without rfftn
+    import jax.numpy as _jnp
+
+    def rfftn(x):
+        full = _jnp.fft.fftn(x)
+        half = x.shape[-1] // 2 + 1
+        return full[..., :half]
+
+    def irfftn(xh, s):
+        n_last = s[-1]
+        # rebuild the redundant half from hermitian symmetry: the
+        # negative frequencies are the reversed conjugates of 1..ceil-1
+        tail = _jnp.conj(xh[..., 1:(n_last + 1) // 2])
+        for ax in range(xh.ndim - 1):
+            tail = _jnp.flip(_jnp.roll(tail, -1, axis=ax), axis=ax)
+        tail = _jnp.flip(tail, axis=-1)
+        full = _jnp.concatenate([xh, tail], axis=-1)
+        return _jnp.real(_jnp.fft.ifftn(full))

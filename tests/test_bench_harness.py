@@ -104,6 +104,30 @@ def test_bench_ensemble_mode_emits_cases_field():
     assert rec["accuracy"]["ok"] is True  # the solo gate still runs
 
 
+def test_bench_tta_mode_emits_steps_to_solution():
+    # BENCH_TTA=1: the time-to-accuracy rung — euler vs rkc vs expo to a
+    # fixed (grid, T_final, error target); the JSON must carry the
+    # variant label, the winning stepper, its effective dt/steps, the
+    # steps-to-solution ratio, and the per-arm breakdown — on the same
+    # one-line rc=0 ladder
+    proc, rec = run_bench({"BENCH_TTA": "1", "BENCH_GRID": "64",
+                           "BENCH_LADDER": "64", "BENCH_STEPS": "20",
+                           "BENCH_ACCURACY": "0"})
+    assert proc.returncode == 0
+    assert rec["value"] > 0
+    assert rec["variant"] == "tta"
+    assert rec["stepper"] in ("euler", "rkc", "expo")
+    assert rec["steps_taken"] >= 1 and rec["eff_dt"] > 0
+    assert rec["steps_ratio"] >= 1.0
+    arms = rec["tta"]
+    assert set(arms) == {"euler", "rkc", "expo"}
+    for arm in arms.values():
+        assert arm["steps"] >= 1 and "err_l2_per_n" in arm
+    assert arms["expo"]["method"] == "fft"
+    # the winner's record backs the headline fields
+    assert arms[rec["stepper"]]["steps"] == rec["steps_taken"]
+
+
 def test_bench_multichip_mode_emits_halo_overlap():
     # BENCH_MULTICHIP=N: the sharded-solving A/B — the distributed 2D
     # solver over one shared N-device mesh, collective vs FUSED halo

@@ -38,6 +38,40 @@ def test_2d_batch_failure_detected():
     assert r.returncode == 1
 
 
+def test_2d_fft_and_stepper_surface():
+    # ISSUE 8: the spectral method + stepper tier on the CLI — an fft
+    # batch passes the reference criterion; an rkc batch super-steps
+    # 9x the reference dt in 5 steps to the same horizon and passes
+    r = run_cli("solve2d", ["--test_batch", "--method", "fft"],
+                stdin="1\n50 50 45 5 1 0.0005 0.02\n")
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+    r = run_cli("solve2d", ["--test_batch", "--stepper", "rkc",
+                            "--superstep-stages", "8"],
+                stdin="1\n50 50 5 5 1 0.0045 0.02\n")
+    assert "Tests Passed" in r.stdout, r.stdout + r.stderr
+    # the stability bound actually in force is printed for solo runs
+    r = run_cli("solve2d", ["--test", "--stepper", "rkc", "--nt", "2",
+                            "--cmp", "0"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "stability: dt bound in force" in r.stderr
+    assert "rkc[s=8]" in r.stderr
+    # rc 2: an explicit --dt past the selected stepper's model
+    r = run_cli("solve2d", ["--test", "--stepper", "rkc",
+                            "--superstep-stages", "2", "--dt", "0.1"])
+    assert r.returncode == 2
+    assert "exceeds the rkc[s=2] stability bound" in r.stderr
+    # honesty refusals: expo needs fft; fft excludes --distributed (3d)
+    r = run_cli("solve2d", ["--test", "--stepper", "expo"])
+    assert r.returncode == 1 and "requires --method fft" in r.stderr
+    r = run_cli("solve3d", ["--test", "--method", "fft", "--distributed"])
+    assert r.returncode == 1 and "whole-domain" in r.stderr
+    # euler past its bound stays accepted (reference parity) with a loud
+    # warning naming the bound
+    r = run_cli("solve2d", ["--test", "--nt", "2", "--cmp", "0"])
+    assert r.returncode == 0
+    assert "WARNING: dt 0.0005 exceeds the forward-Euler" in r.stderr
+
+
 def test_2d_batch_ensemble_mode():
     # --ensemble schedules the cases through serve/ensemble.py: same
     # pass criterion and output, one batched program per shape bucket

@@ -285,10 +285,11 @@ def test_ensemble_comm_joins_engine_key():
     a.build_program(case.bucket_key(), chunk)
     b.build_program(case.bucket_key(), chunk)
     # the program keys differ in the comm slot: two engines differing
-    # only in comm can never share compiled programs
+    # only in comm can never share compiled programs (since ISSUE 8 the
+    # key ends ..., comm, stepper, stages)
     (ka,), (kb,) = a._programs.keys(), b._programs.keys()
-    assert ka[:-1] == kb[:-1] and (ka[-1], kb[-1]) == ("collective",
-                                                       "fused")
+    assert ka[:-3] == kb[:-3] and ka[-2:] == kb[-2:]
+    assert (ka[-3], kb[-3]) == ("collective", "fused")
     # sibling() carries comm; the CPU fallback pins it back to
     # collective (the fused family is pallas-only and fallback chunks
     # run unsharded)

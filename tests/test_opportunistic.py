@@ -22,7 +22,8 @@ SCRIPT = os.path.join(REPO, "tools", "tpu_opportunistic.sh")
 ALL_STEPS = [
     "bench4096", "resident512", "carried4096", "superstep2",
     "bf16-4096", "bf16-carried4096", "ensemble8x1024", "serve8x1024",
-    "servefault8x1024", "obs8x1024", "multichip1024",
+    "servefault8x1024", "obs8x1024", "multichip1024", "fft4096",
+    "tta4096",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
@@ -134,6 +135,24 @@ def test_multichip_step_banks_halo_ab_evidence(tmp_path):
     assert '"variant": "multichip8"' in table
     assert '"halo_overlap"' in table
     assert '"comm": "fused"' in table
+
+
+@pytest.mark.slow  # ~45 s (a gate bench + the tta search child) — the
+# tta machinery itself is tier-1-covered by tests/test_bench_harness.py;
+# this proves the queue's gate parses steps_ratio + the winner's
+# met_target before banking
+def test_tta_step_banks_steps_to_solution_evidence(tmp_path):
+    proc, state, table, _out = _run(
+        tmp_path, "tta4096",
+        # the >= 10x acceptance ratio is a large-grid property; the tiny
+        # CPU smoke grid proves the gate structure with a relaxed limit
+        {"OPP_GRID_TTA": "64", "OPP_TTA_MIN_RATIO": "2"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    assert "tta4096\n" in state
+    assert "fail:" not in state
+    assert '"variant": "tta"' in table
+    assert '"steps_ratio"' in table and '"tta"' in table
 
 
 @pytest.mark.slow  # ~73 s: two strike rounds, each a full bench child plus

@@ -1,0 +1,406 @@
+"""Spectral fast-operator path + stepper tier (ISSUE 8).
+
+Contracts pinned here:
+* the baked rfftn symbol equals the literal cosine sum per (eps, grid)
+  (ops/spectral.symbol_direct) — the symbol identity;
+* ``method='fft'`` applies are <= 1e-12 of the pallas oracle (1D: the
+  shift oracle — no 1D pallas kernel exists) on small f64 grids;
+* the manufactured-solution contract ``error_l2/#points <= 1e-6`` holds
+  for every shipped (method, stepper) combination at configs inside each
+  integrator's accuracy envelope (expo's boundary-coupling model:
+  models/steppers.py docstring);
+* RKC refuses loudly at dt just past its stability model and runs
+  UNCHANGED on the pallas path (stage loop above the method dispatch);
+* expo is fft-only with a loud refusal elsewhere, and over-resolved
+  Euler converges first-order TO the expo answer on a boundary-clear
+  state (the exactness demonstration);
+* stepper/method join the ensemble engine key; fft cases served through
+  the PR 3 pipeline are bit-identical to the offline engine.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nonlocalheatequation_tpu.models import steppers
+from nonlocalheatequation_tpu.models.solver1d import Solver1D
+from nonlocalheatequation_tpu.models.solver2d import Solver2D
+from nonlocalheatequation_tpu.models.solver3d import Solver3D
+from nonlocalheatequation_tpu.ops import spectral
+from nonlocalheatequation_tpu.ops.constants import (
+    rkc_beta,
+    stable_dt,
+    stable_dt_op,
+)
+from nonlocalheatequation_tpu.ops.nonlocal_op import (
+    NonlocalOp1D,
+    NonlocalOp2D,
+    NonlocalOp3D,
+)
+
+
+# --------------------------------------------------------------------------
+# symbol identity + apply oracle
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("eps,shape", [
+    (2, (17,)), (5, (50,)),
+    (2, (12, 18)), (5, (30, 30)), (9, (24, 40)),
+    (2, (10, 12, 14)), (3, (16, 16, 16)),
+])
+def test_symbol_matches_direct_cosine_sum(eps, shape):
+    from nonlocalheatequation_tpu.ops.stencil import (
+        horizon_mask_1d,
+        horizon_mask_2d,
+        horizon_mask_3d,
+        influence_weights,
+    )
+
+    mask = {1: horizon_mask_1d, 2: horizon_mask_2d,
+            3: horizon_mask_3d}[len(shape)](eps)
+    w = influence_weights(mask, None, 0.02)
+    box = spectral.fft_box(shape, eps)
+    baked = spectral.neighbor_symbol(w, box)
+    direct = spectral.symbol_direct(w, box)
+    assert baked.shape == direct.shape
+    assert np.abs(baked - direct).max() <= 1e-11 * max(1.0, w.sum())
+
+
+def test_operator_symbol_nonpositive_zero_at_dc():
+    op = NonlocalOp2D(4, 1.0, 1e-4, 0.02, method="fft")
+    lam = spectral.operator_symbol(op, (24, 24))
+    assert lam.flat[0] == pytest.approx(0.0, abs=1e-7)
+    assert lam.max() <= 1e-7  # <= 0 up to symbol rounding
+
+
+@pytest.mark.parametrize("dim,eps,shape", [
+    (1, 5, (50,)), (1, 3, (31,)),
+    (2, 4, (24, 24)), (2, 9, (20, 28)),
+    (3, 3, (12, 12, 12)),
+])
+def test_fft_apply_matches_oracle_1e12(dim, eps, shape):
+    """fft vs the pallas oracle (2D/3D; interpret-mode on the CPU suite)
+    and the shift/NumPy oracles, <= 1e-12 relative on f64."""
+    mk = {1: NonlocalOp1D, 2: NonlocalOp2D, 3: NonlocalOp3D}[dim]
+    h = 1.0 / shape[0]
+    op_fft = mk(eps, 1.0, 1e-5, h, method="fft")
+    u = np.random.default_rng(dim).normal(size=shape)
+    got = np.asarray(op_fft.apply(jnp.asarray(u)))
+    want_np = op_fft.apply_np(u)
+    scale = max(1.0, np.abs(want_np).max())
+    assert np.abs(got - want_np).max() / scale <= 1e-12
+    if dim in (2, 3):
+        op_pl = mk(eps, 1.0, 1e-5, h, method="pallas")
+        want_pl = np.asarray(op_pl.apply(jnp.asarray(u)))
+        assert np.abs(got - want_pl).max() / scale <= 1e-12
+
+
+def test_fft_refuses_padded_blocks():
+    op = NonlocalOp2D(3, 1.0, 1e-4, 0.02, method="fft")
+    with pytest.raises(ValueError, match="whole-domain"):
+        op.neighbor_sum_padded(jnp.zeros((20, 20)))
+    op3 = NonlocalOp3D(2, 1.0, 1e-4, 0.05, method="fft")
+    with pytest.raises(ValueError, match="whole-domain"):
+        op3.neighbor_sum_padded(jnp.zeros((12, 12, 12)))
+
+
+def test_fft_box_is_5smooth_and_padded():
+    for n, eps in [(50, 5), (511, 8), (4096, 8), (13, 2)]:
+        (b,) = spectral.fft_box((n,), eps)
+        assert b >= n + eps
+        x = b
+        for p in (2, 3, 5):
+            while x % p == 0:
+                x //= p
+        assert x == 1, f"box {b} not 5-smooth"
+
+
+# --------------------------------------------------------------------------
+# stability model (the ISSUE 8 bugfix: stable_dt is the single source)
+# --------------------------------------------------------------------------
+
+
+def test_stable_dt_model():
+    op = NonlocalOp2D(5, 1.0, 1.0, 0.02)
+    euler = stable_dt_op(op, "euler")
+    assert euler == pytest.approx(1.0 / (op.c * op.dh ** 2 * op.wsum))
+    # rkc interval ~2 s^2 (damped slightly below), monotonic in s
+    assert rkc_beta(2) == pytest.approx(2 * 4, rel=0.05)
+    assert rkc_beta(10) == pytest.approx(2 * 100, rel=0.05)
+    assert rkc_beta(5) < rkc_beta(6)
+    assert stable_dt_op(op, "rkc", 8) == pytest.approx(
+        euler * rkc_beta(8) / 2.0)
+    assert stable_dt_op(op, "expo") == np.inf
+    # the reference's truncated-to-zero 1D constant: empty spectrum
+    assert stable_dt(0.0, 0.01, 1, 81.0) == np.inf
+    with pytest.raises(ValueError):
+        stable_dt(1.0, 0.02, 2, 81.0, stepper="leapfrog")
+
+
+def test_rkc_refuses_dt_past_model():
+    op = NonlocalOp2D(5, 1.0, 1.0, 0.02)
+    bound = stable_dt_op(op, "rkc", 4)
+    bad = NonlocalOp2D(5, 1.0, bound * 1.01, 0.02)
+    with pytest.raises(ValueError, match="RKC stability bound"):
+        steppers.validate_stepper(bad, "rkc", 4)
+    ok = NonlocalOp2D(5, 1.0, bound * 0.99, 0.02)
+    steppers.validate_stepper(ok, "rkc", 4)  # just inside: accepted
+    with pytest.raises(ValueError, match="stages >= 2"):
+        steppers.validate_stepper(ok, "rkc", 1)
+
+
+def test_expo_requires_fft():
+    op = NonlocalOp2D(5, 1.0, 1e-4, 0.02, method="conv")
+    with pytest.raises(ValueError, match="method='fft'"):
+        steppers.validate_stepper(op, "expo")
+    with pytest.raises(ValueError, match="Euler-only"):
+        Solver2D(20, 20, 5, 3, backend="oracle", stepper="rkc", stages=4)
+
+
+# --------------------------------------------------------------------------
+# manufactured-solution gate for every (method, stepper) pair
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("method,stepper,stages", [
+    ("conv", "euler", 0), ("sat", "euler", 0), ("fft", "euler", 0),
+    ("pallas", "rkc", 4), ("conv", "rkc", 8), ("fft", "rkc", 8),
+])
+def test_manufactured_gate_2d(method, stepper, stages):
+    """The reference batch config (50^2, eps 5, nt 45) for every
+    (method, stepper) pair; rkc-on-pallas is the no-kernel-edits claim
+    (the stage loop sits above the method dispatch)."""
+    s = Solver2D(50, 50, 45, 5, k=1.0, dt=0.0005, dh=0.02, backend="jit",
+                 method=method, stepper=stepper, stages=stages)
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / (50 * 50) <= 1e-6, (method, stepper, s.error_l2)
+
+
+def test_manufactured_gate_2d_expo():
+    """expo gated inside its accuracy envelope: the boundary-coupling
+    defect scales ~(dt*lambda_max)^2 * |u|_boundary per step
+    (models/steppers.py docstring), so the gate config keeps
+    dt at 0.25x the Euler bound; the super-stepping exactness story is
+    the boundary-clear Richardson test below."""
+    op0 = NonlocalOp2D(5, 1.0, 1.0, 1.0 / 128)
+    dt = 0.25 * stable_dt_op(op0, "euler")
+    s = Solver2D(128, 128, 45, 5, k=1.0, dt=dt, dh=1.0 / 128,
+                 backend="jit", method="fft", stepper="expo")
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / (128 * 128) <= 1e-6, s.error_l2
+
+
+@pytest.mark.parametrize("method,stepper,stages", [
+    ("shift", "euler", 0), ("fft", "euler", 0), ("fft", "rkc", 8),
+    ("shift", "rkc", 4),
+])
+def test_manufactured_gate_1d(method, stepper, stages):
+    s = Solver1D(50, 45, 5, k=1.0, dt=0.001, dx=0.02, backend="jit",
+                 method=method, stepper=stepper, stages=stages)
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / 50 <= 1e-6, (method, stepper, s.error_l2)
+
+
+@pytest.mark.parametrize("method,stepper,stages", [
+    ("sat", "euler", 0), ("fft", "euler", 0), ("fft", "rkc", 4),
+])
+def test_manufactured_gate_3d(method, stepper, stages):
+    s = Solver3D(16, 16, 16, 20, 3, k=1.0, dt=0.0005, dh=0.0625,
+                 backend="jit", method=method, stepper=stepper,
+                 stages=stages)
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / 16 ** 3 <= 1e-6, (method, stepper, s.error_l2)
+
+
+def test_rkc_superstep_past_euler_bound():
+    """The point of the tier: the SAME horizon in 9x fewer steps at dt
+    9x the reference's (past the Euler bound), inside the contract."""
+    # reference: 45 steps at dt=5e-4; rkc: 5 steps at dt=4.5e-3
+    s = Solver2D(50, 50, 5, 5, k=1.0, dt=0.0045, dh=0.02, backend="jit",
+                 method="conv", stepper="rkc", stages=8)
+    s.test_init()
+    s.do_work()
+    assert s.error_l2 / (50 * 50) <= 1e-6, s.error_l2
+
+
+# --------------------------------------------------------------------------
+# expo exactness (boundary-clear state)
+# --------------------------------------------------------------------------
+
+
+def test_expo_exact_limit_of_euler():
+    """On a state that stays clear of the boundary, over-resolved Euler
+    converges FIRST-ORDER to the one-giant-step expo answer — i.e. expo
+    is the exact dt->0 limit (the spectral-exactness demonstration; the
+    step is 24x the Euler bound)."""
+    n, eps = 128, 3
+    h = 1.0 / n
+    T = 24 * stable_dt_op(NonlocalOp1D(eps, 1.0, 1.0, h), "euler")
+    x = np.arange(n)
+    u0 = np.exp(-((x - n / 2) ** 2) / (2 * 4.0 ** 2))
+    op_x = NonlocalOp1D(eps, 1.0, T, h, method="fft")
+    e1 = np.asarray(steppers.make_multi_step_fn(
+        op_x, 1, dtype=jnp.float64, stepper="expo")(jnp.asarray(u0), 0))
+    errs = []
+    for N in (250, 500, 1000):
+        op_eu = NonlocalOp1D(eps, 1.0, T / N, h)
+        eu = np.asarray(steppers.make_multi_step_fn(
+            op_eu, N, dtype=jnp.float64)(jnp.asarray(u0), 0))
+        errs.append(np.abs(e1 - eu).max())
+    # halving dt halves the distance to expo => expo is the limit
+    assert errs[0] / errs[1] == pytest.approx(2.0, rel=0.02)
+    assert errs[1] / errs[2] == pytest.approx(2.0, rel=0.02)
+
+
+def test_expo_one_step_any_horizon_unconditionally_stable():
+    """A dt 200x past the Euler bound: Euler diverges violently, expo
+    stays bounded and decays (lambda <= 0 end to end)."""
+    n, eps = 64, 4
+    h = 1.0 / n
+    dt_e = stable_dt_op(NonlocalOp1D(eps, 1.0, 1.0, h), "euler")
+    op = NonlocalOp1D(eps, 1.0, 200 * dt_e, h, method="fft")
+    u0 = np.random.default_rng(0).normal(size=n)
+    out = np.asarray(steppers.make_multi_step_fn(
+        op, 3, dtype=jnp.float64, stepper="expo")(jnp.asarray(u0), 0))
+    assert np.all(np.isfinite(out))
+    assert np.abs(out).max() <= np.abs(u0).max() * 1.01
+
+
+# --------------------------------------------------------------------------
+# ensemble / serve integration
+# --------------------------------------------------------------------------
+
+
+def _cases(k=1.0):
+    from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+
+    return [EnsembleCase(shape=(24, 24), nt=10, eps=3, k=k, dt=2e-4,
+                         dh=1.0 / 24, test=True) for _ in range(3)]
+
+
+def test_stepper_joins_ensemble_engine_key():
+    from nonlocalheatequation_tpu.serve.ensemble import EnsembleEngine
+
+    e1 = EnsembleEngine(method="fft", stepper="rkc", stages=4)
+    e1.run(_cases())
+    e2 = EnsembleEngine(method="fft", stepper="euler")
+    e2.run(_cases())
+    k1 = next(iter(e1._programs))
+    k2 = next(iter(e2._programs))
+    assert k1 != k2 and "rkc" in k1 and "euler" in k2
+    assert e1.report.strategies[_cases()[0].bucket_key()] == "stacked[rkc]"
+    # sibling carries the stepper (the CPU-fallback twin must solve the
+    # same integrator, and an expo sibling must keep method='fft')
+    sib = e1.sibling()
+    assert sib.stepper == "rkc" and sib.stages == 4
+    from nonlocalheatequation_tpu.serve.resilience import CpuFallback
+
+    fb = CpuFallback(EnsembleEngine(method="fft", stepper="expo"))
+    assert fb._sibling(2).method == "fft"
+    assert fb._sibling(2).stepper == "expo"
+
+
+def test_ensemble_stepper_matches_sequential_bitwise():
+    """A stepper bucket's stacked program is the per-case solo stepper
+    scan inlined — bit-identical to sequential solves by construction."""
+    from nonlocalheatequation_tpu.serve.ensemble import EnsembleEngine
+
+    cases = _cases()
+    states = EnsembleEngine(method="fft", stepper="rkc", stages=4).run(cases)
+    for case, got in zip(cases, states):
+        op = NonlocalOp2D(case.eps, case.k, case.dt, case.dh, method="fft")
+        g, lg = op.source_parts(*case.shape)
+        solo = steppers.make_multi_step_fn(
+            op, case.nt, g, lg, jnp.float64, stepper="rkc", stages=4)
+        want = np.asarray(solo(jnp.asarray(op.spatial_profile(*case.shape),
+                                           jnp.float64), 0))
+        assert np.array_equal(np.asarray(got), want)
+
+
+def test_engine_refuses_euler_only_variants_for_steppers():
+    from nonlocalheatequation_tpu.serve.ensemble import EnsembleEngine
+
+    for variant in ("carried", "superstep", "vmap"):
+        with pytest.raises(ValueError, match="Euler-only"):
+            EnsembleEngine(method="pallas", stepper="rkc", stages=4,
+                           variant=variant,
+                           ksteps=2 if variant == "superstep" else 0)
+    with pytest.raises(ValueError, match="method='fft'"):
+        EnsembleEngine(method="conv", stepper="expo")
+    with pytest.raises(ValueError, match="stages"):
+        EnsembleEngine(method="conv", stepper="rkc")
+
+
+def test_serve_fft_cases_bit_identical_to_offline():
+    """fft cases through the PR 3 pipeline == offline run() bitwise
+    (same programs, different schedule) — serving serves the spectral
+    tier on the existing machinery."""
+    from nonlocalheatequation_tpu.serve.ensemble import EnsembleEngine
+    from nonlocalheatequation_tpu.serve.server import ServePipeline
+
+    cases = _cases()
+    offline = EnsembleEngine(method="fft", stepper="rkc", stages=4).run(cases)
+    engine = EnsembleEngine(method="fft", stepper="rkc", stages=4)
+    with ServePipeline(engine=engine, depth=2, window_ms=0.0) as pipe:
+        handles = [pipe.submit(c) for c in cases]
+        pipe.drain()
+    for h, want in zip(handles, offline):
+        assert h.error is None
+        assert np.array_equal(np.asarray(h.result), np.asarray(want))
+
+
+# --------------------------------------------------------------------------
+# obs wiring + autotune method dimension
+# --------------------------------------------------------------------------
+
+
+def test_stepper_obs_gauges_and_span():
+    from nonlocalheatequation_tpu.obs import trace as obs_trace
+    from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+
+    op = NonlocalOp2D(3, 1.0, 1e-4, 1.0 / 24, method="fft")
+    before = REGISTRY.counter("/op/fft-applies").snapshot()
+    tracer = obs_trace.Tracer()
+    prev = obs_trace.set_tracer(tracer)
+    try:
+        multi = steppers.make_multi_step_fn(op, 4, dtype=jnp.float64,
+                                            stepper="rkc", stages=4)
+        multi(jnp.zeros((24, 24)), 0)
+    finally:
+        obs_trace.set_tracer(prev)
+    assert REGISTRY.gauge("/stepper/stages").snapshot() == 4
+    assert REGISTRY.gauge("/stepper/eff-dt").snapshot() == \
+        pytest.approx(1e-4)
+    assert REGISTRY.counter("/op/fft-applies").snapshot() > before
+    names = [ev["name"] for ev in tracer.chrome_trace()["traceEvents"]]
+    assert "stepper.superstep" in names
+
+
+def test_tune_method_picks_and_runs(monkeypatch, tmp_path):
+    """NLHEAT_TUNE_METHOD=1: the stencil<->fft crossover probes both and
+    the chosen program still computes the same function (<= 1e-12)."""
+    monkeypatch.setenv("NLHEAT_TUNE_METHOD", "1")
+    monkeypatch.setenv("NLHEAT_AUTOTUNE_CACHE",
+                       str(tmp_path / "tune.json"))
+    from nonlocalheatequation_tpu.utils import autotune
+
+    autotune._memory_cache.clear()
+    op = NonlocalOp2D(9, 1.0, 1e-5, 1.0 / 32, method="conv")
+    u0 = np.random.default_rng(3).normal(size=(32, 32))
+    multi = steppers.make_multi_step_fn(op, 6, dtype=jnp.float64)
+    got = np.asarray(multi(jnp.asarray(u0), 0))
+    monkeypatch.delenv("NLHEAT_TUNE_METHOD")
+    base = steppers.make_multi_step_fn(op, 6, dtype=jnp.float64)
+    want = np.asarray(base(jnp.asarray(u0), 0))
+    assert np.abs(got - want).max() <= 1e-12 * max(1.0, np.abs(want).max())
+    # the probe banked a method-ab record with both candidates timed
+    entry = next((v for k, v in autotune._memory_cache.items()
+                  if "method-ab" in k), None)
+    assert entry is not None
+    assert set(entry["ms_per_step"]) >= {"conv", "fft"}

@@ -833,6 +833,60 @@ def bench_resilience(steps: int):
          bit_identical=bool(ident))
 
 
+def bench_tta(steps: int):
+    """Time-to-accuracy A/B/C (ISSUE 8): the manufactured problem on a
+    fixed (grid, T_final, error target), solved by each stepper tier —
+    euler at the 0.8x-stable dt (the reference's only integrator), rkc
+    super-stepping (s stages, dt up to ~s^2/2 past the Euler bound), and
+    the spectral expo integrator (fft only).  Per arm the search walks
+    step counts (doubling from the arm's stability floor) to the
+    smallest count meeting the target; each row records steps_taken,
+    eff_dt, the f64-criterion error, and the non-euler rows carry
+    ``steps_to_solution_ratio`` = euler_steps/steps_taken — the
+    steps-to-solution column the round-10 table reads."""
+    from nonlocalheatequation_tpu.models import steppers as stp
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+
+    n = cfg("BT_TTA_GRID", 1024, 128)
+    eps = 8
+    stages = int(os.environ.get("BT_TTA_STAGES", 8))
+    target = float(os.environ.get("BT_TTA_TARGET", 1e-6))
+    method = "pallas" if on_tpu() else "sat"
+    op0 = NonlocalOp2D(eps, k=1.0, dt=1.0, dh=1.0 / n, method=method)
+    dt_ref = stable_dt(op0)
+    T = steps * dt_ref
+
+    def arm(stepper, nsteps, m, stages_=0):
+        op = NonlocalOp2D(eps, k=1.0, dt=T / nsteps, dh=1.0 / n, method=m)
+        g, lg = op.source_parts(n, n)
+        multi = stp.make_multi_step_fn(op, nsteps, g, lg, jnp.float32,
+                                       stepper=stepper, stages=stages_)
+        u0 = np.asarray(op.spatial_profile(n, n), np.float32)
+        sec, out = time_steps(lambda u, m_=multi: m_(jnp.asarray(u0), 0),
+                              u0, nsteps)
+        d = np.asarray(out, np.float64) - op.manufactured_solution(
+            n, n, nsteps)
+        return sec, float(np.sum(d * d)) / (n * n)
+
+    sec_e, err_e = arm("euler", steps, method)
+    emit("tta/euler", n * n, steps, sec_e, grid=n, eps=eps,
+         eff_dt=T / steps, err_l2_per_n=err_e, tta_target=target,
+         met_target=bool(err_e <= target))
+    for name, m in (("rkc", method), ("expo", "fft")):
+        st = stages if name == "rkc" else 0
+        n_run = stp.min_steps_to_target(
+            lambda n, nm=name, mm=m, s_=st: arm(nm, n, mm, s_)[1],
+            stp.superstep_floor(op0, T, name, st), steps, target,
+            log=lambda n, e, nm=name: log(
+                f"    tta {nm} trial {n} steps: err {e:.2e}"))
+        sec, err = arm(name, n_run, m, st)
+        emit(f"tta/{name}{stages if name == 'rkc' else ''}", n * n, n_run,
+             sec, grid=n, eps=eps, eff_dt=T / n_run, err_l2_per_n=err,
+             tta_target=target, met_target=bool(err <= target),
+             steps_to_solution_ratio=round(steps / n_run, 2),
+             seconds_to_target_ratio=round(sec_e / sec, 3))
+
+
 def bench_multichip(steps: int):
     """Fused-vs-collective halo A/B (round 9, ops/pallas_halo.py): the
     distributed 2D solver over ONE shared device mesh, collective halos
@@ -889,6 +943,7 @@ BENCHES = {
     "obs": bench_obs,
     "resilience": bench_resilience,
     "multichip": bench_multichip,
+    "tta": bench_tta,
 }
 
 

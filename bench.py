@@ -93,6 +93,20 @@ plus "devices"/"mesh"; on a single-chip tunnel N clamps to the devices
 actually present and the label says so; off-TPU the parent forces N
 virtual host devices so the CPU proxy exercises the real collective
 paths),
+BENCH_WARMBOOT=1 (the cold-vs-warm boot A/B — ISSUE 9,
+serve/program_store.py: each rung measures time-to-first-served-chunk
+three ways over ONE shared AOT program store directory
+(BENCH_WARMBOOT_DIR; a fresh temp dir by default) — a storeless engine
+(the honest cold boot: full trace+compile), a store-attached engine
+that populates the store, and a FRESH store-attached engine that must
+LOAD the serialized executable (zero retrace/recompile).  The rung is
+labeled "variant": "warmboot" and carries "cold_first_chunk_s" /
+"warm_first_chunk_s" / "warmboot_speedup" = cold/warm plus the store's
+"store_hits"/"store_misses" counters and "bit_identical" (warm results
+must equal the cold compile's bytes); the XLA persistent compile cache
+is pinned OFF for this rung so the cold arm is genuinely cold.  A
+leaked ambient NLHEAT_PROGRAM_STORE is scrubbed from every bench run —
+only this rung's explicit store dirs may warm a measurement),
 BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
@@ -311,7 +325,11 @@ class Best:
                 "comm", "halo_overlap", "devices", "mesh",
                 # tta rung: the time-to-accuracy evidence (ISSUE 8)
                 "stepper", "eff_dt", "steps_taken", "steps_ratio",
-                "tta", "tta_target", "tta_speedup")
+                "tta", "tta_target", "tta_speedup",
+                # warmboot rung: the AOT-program-store evidence (ISSUE 9)
+                "cold_first_chunk_s", "warm_first_chunk_s",
+                "warmboot_speedup", "store_hits", "store_misses",
+                "bit_identical")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -554,9 +572,12 @@ def main():
         os.environ["XLA_FLAGS"] = " ".join(flags)
     # NLHEAT_FAULT_PLAN joins the scrub: a fault plan leaked from a chaos
     # shell would inject failures into a headline measurement; the serve
-    # fault rung re-injects deliberately via BENCH_SERVE_FAULTS only
+    # fault rung re-injects deliberately via BENCH_SERVE_FAULTS only.
+    # NLHEAT_PROGRAM_STORE likewise: a leaked store dir would silently
+    # warm-boot every rung's "compile" — the warmboot rung attaches its
+    # own store dirs explicitly (BENCH_WARMBOOT_DIR)
     for knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP",
-                 "NLHEAT_FAULT_PLAN"):
+                 "NLHEAT_FAULT_PLAN", "NLHEAT_PROGRAM_STORE"):
         if os.environ.pop(knob, None) is not None:
             log(f"scrubbed leaked {knob} from the bench environment")
     try:
@@ -748,10 +769,22 @@ def child_probe():
 def child_measure():
     import numpy as np
 
+    warmboot = os.environ.get("BENCH_WARMBOOT") == "1"
+    if warmboot:
+        # the warmboot A/B's cold arm must be genuinely cold: the XLA
+        # persistent cache (env var exported by the opportunistic runner,
+        # BENCH_COMPILE_CACHE below) would let "cold" skip its compile
+        # and void the ratio — pop the env BEFORE jax initializes
+        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+
     import jax
 
     child_platform_override(jax)
-    child_compile_cache(jax)
+    if warmboot:
+        log("warmboot rung: XLA persistent compile cache pinned OFF "
+            "(the cold arm must pay its full trace+compile)")
+    else:
+        child_compile_cache(jax)
 
     import jax.numpy as jnp
 
@@ -840,6 +873,15 @@ def child_measure():
     if mchip == 1:
         mchip = 0  # the A/B needs a mesh; 0/1 mean off
     tta = os.environ.get("BENCH_TTA") == "1"
+    if warmboot and (tta or srv or ens or mchip
+                     or any(os.environ.get(k) for k in
+                            ("BENCH_CARRIED", "BENCH_RESIDENT",
+                             "BENCH_SUPERSTEP"))):
+        log("BENCH_WARMBOOT set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
+            "MULTICHIP/CARRIED/RESIDENT/SUPERSTEP — the warmboot rung "
+            "is its own labeled variant")
+        tta = False
+        srv = ens = mchip = 0
     if tta and (srv or ens or mchip or any(os.environ.get(k) for k in
                                            ("BENCH_CARRIED",
                                             "BENCH_RESIDENT",
@@ -877,6 +919,85 @@ def child_measure():
             dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
             op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / grid, method=method,
                               precision=PRECISION)
+            if warmboot:
+                # cold-vs-warm boot A/B (ISSUE 9, serve/program_store.py):
+                # time-to-first-served-chunk, three arms over one shared
+                # store dir.  Arm 1 (cold): a storeless engine — the
+                # honest cold boot, full trace+compile.  Arm 2
+                # (populate): a store-attached engine; persists the AOT
+                # executable when the dir doesn't already hold it (a
+                # prior heal window's entry counts — that is the point).
+                # Arm 3 (warm): a FRESH store-attached engine that must
+                # HIT — zero retrace/recompile — and whose first-chunk
+                # wall is the warm-boot number.  Results must be
+                # bit-identical across arms (the loaded executable IS
+                # the compiled bytes).
+                import shutil
+                import tempfile
+
+                from nonlocalheatequation_tpu.serve.ensemble import (
+                    EnsembleCase,
+                    EnsembleEngine,
+                )
+
+                store_dir = os.environ.get("BENCH_WARMBOOT_DIR")
+                own_dir = store_dir is None
+                if own_dir:
+                    store_dir = tempfile.mkdtemp(prefix="nlheat-warmboot-")
+                u0 = rng.normal(size=(grid, grid))
+
+                def first_chunk(store):
+                    engine = EnsembleEngine(method=method,
+                                            precision=PRECISION,
+                                            batch_sizes=(1,),
+                                            program_store=store)
+                    case = EnsembleCase(shape=(grid, grid), nt=steps,
+                                        eps=EPS, k=1.0, dt=dt,
+                                        dh=1.0 / grid, test=False, u0=u0)
+                    t0 = time.perf_counter()
+                    out = engine.run([case])[0]  # np fetch == true fence
+                    return time.perf_counter() - t0, out, engine
+
+                try:
+                    cold_s, out_cold, _ = first_chunk(None)
+                    log(f"rung {grid}^2 warmboot cold (storeless): "
+                        f"{cold_s * 1e3:.1f} ms to first chunk")
+                    pop_s, out_pop, eng_pop = first_chunk(store_dir)
+                    pop_stats = eng_pop.program_store.stats()
+                    log(f"rung {grid}^2 warmboot populate: "
+                        f"{pop_s * 1e3:.1f} ms ({pop_stats})")
+                    warm_s, out_warm, eng_warm = first_chunk(store_dir)
+                    warm_stats = eng_warm.program_store.stats()
+                    log(f"rung {grid}^2 warmboot warm: "
+                        f"{warm_s * 1e3:.1f} ms ({warm_stats})")
+                finally:
+                    if own_dir:
+                        shutil.rmtree(store_dir, ignore_errors=True)
+                bit = bool(np.array_equal(out_cold, out_warm)
+                           and np.array_equal(out_cold, out_pop))
+                if not bit:
+                    log("WARNING: warmboot arms are NOT bit-identical — "
+                        "store must never change served results")
+                value = grid * grid * steps / warm_s
+                event(
+                    event="rung",
+                    grid=grid,
+                    steps=steps,
+                    best_s=warm_s,
+                    ms_per_step=warm_s / steps * 1e3,
+                    value=value,
+                    compile_s=round(cold_s, 3),
+                    variant="warmboot",
+                    cold_first_chunk_s=round(cold_s, 4),
+                    warm_first_chunk_s=round(warm_s, 4),
+                    warmboot_speedup=round(cold_s / warm_s, 3),
+                    store_hits=warm_stats["hits"],
+                    store_misses=pop_stats["misses"],
+                    bit_identical=bit,
+                )
+                last_op = op
+                any_rung = True
+                continue
             if tta:
                 # time-to-accuracy A/B/C (ISSUE 8): a FIXED problem —
                 # the manufactured-solution test on grid^2 to the

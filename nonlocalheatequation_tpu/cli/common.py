@@ -324,6 +324,34 @@ def add_ensemble_flag(p: argparse.ArgumentParser):
     )
 
 
+def add_program_store_flag(p: argparse.ArgumentParser):
+    """--program-store: the AOT executable store (serve/program_store.py)
+    — the CLI face of the warm-boot path.  The value lands in the
+    ``NLHEAT_PROGRAM_STORE`` env knob so every layer under the CLI (the
+    solo multi-step makers, the ensemble engine, the serving pipeline,
+    the CPU fallback siblings) resolves the same store."""
+    p.add_argument(
+        "--program-store",
+        dest="program_store",
+        default=None,
+        metavar="DIR",
+        help="reuse AOT-compiled executables across sessions/replicas: "
+             "warm boots load serialized programs from DIR instead of "
+             "re-paying trace+compile (bit-identical results; loud "
+             "refusal + fresh compile on any version/topology mismatch). "
+             "DIR=1 selects the per-user default dir, 0 disables; "
+             "ambient NLHEAT_PROGRAM_STORE=DIR does the same",
+    )
+
+
+def apply_program_store(args) -> None:
+    """Publish --program-store into the env knob (before any solve/build
+    machinery constructs, so all layers agree)."""
+    ps = getattr(args, "program_store", None)
+    if ps is not None:
+        os.environ["NLHEAT_PROGRAM_STORE"] = ps
+
+
 def add_obs_flags(p: argparse.ArgumentParser):
     """The obs/ surface shared by the solve CLIs (docs/architecture.md
     "Observability"): one trace directory, one metrics file, one scrape

@@ -12,12 +12,14 @@ import numpy as np
 from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
     add_obs_flags,
+    add_program_store_flag,
     add_platform_flags,
     add_precision_flags,
     add_serve_flags,
     add_stepper_flags,
     announce_stable_dt,
     apply_platform,
+    apply_program_store,
     bool_flag,
     obs_session,
     publish_solve_metrics,
@@ -65,6 +67,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_ensemble_flag(p)
     add_serve_flags(p)
     add_obs_flags(p)
+    add_program_store_flag(p)
     return p
 
 
@@ -94,6 +97,7 @@ def main(argv=None) -> int:
         return 1
     version_banner("1d_nonlocal")
     apply_platform(args)
+    apply_program_store(args)
     if not args.test_batch:
         # ISSUE 8 bugfix: the bound actually in force, policed per stepper
         sk = stepper_kwargs(args)

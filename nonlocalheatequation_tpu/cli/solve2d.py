@@ -13,12 +13,14 @@ import numpy as np
 from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
     add_obs_flags,
+    add_program_store_flag,
     add_platform_flags,
     add_precision_flags,
     add_serve_flags,
     add_stepper_flags,
     announce_stable_dt,
     apply_platform,
+    apply_program_store,
     bool_flag,
     obs_session,
     publish_solve_metrics,
@@ -68,6 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_ensemble_flag(p)
     add_serve_flags(p)
     add_obs_flags(p)
+    add_program_store_flag(p)
     return p
 
 
@@ -103,6 +106,7 @@ def main(argv=None) -> int:
         return 1
     version_banner("2d_nonlocal")
     apply_platform(args)
+    apply_program_store(args)
     if not args.test_batch:
         # ISSUE 8 bugfix: print the stability bound actually in force
         # for the selected stepper and refuse (rc 2) an over-bound

@@ -16,11 +16,13 @@ import numpy as np
 from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
     add_obs_flags,
+    add_program_store_flag,
     add_platform_flags,
     add_precision_flags,
     add_serve_flags,
     add_stepper_flags,
     announce_stable_dt,
+    apply_program_store,
     bool_flag,
     check_same_input_state,
     cli_startup,
@@ -82,6 +84,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_ensemble_flag(p)
     add_serve_flags(p)
     add_obs_flags(p)
+    add_program_store_flag(p)
     return p
 
 
@@ -160,6 +163,7 @@ def main(argv=None) -> int:
                 "backends would run N independent solves)")
 
     multi = cli_startup(args, "3d_nonlocal", validate_multi=_need_distributed)
+    apply_program_store(args)
     if not args.test_batch:
         # ISSUE 8 bugfix: the bound actually in force, policed per stepper
         sk = stepper_kwargs(args)

@@ -6,7 +6,9 @@ read live by the load balancer (src/2d_nonlocal_distributed.cpp:112-128,
 sampled :856-863).  This module is that backbone for the TPU framework:
 one registry of named metrics that the serving reports
 (serve/server.py ``ServeReport``, serve/ensemble.py ``EnsembleReport``),
-the load-balance busy rates (parallel/load_balance.py), and the solver /
+the load-balance busy rates (parallel/load_balance.py), the AOT
+program store's hit/miss/refusal counters and load/serialize timings
+(serve/program_store.py, ``/store/*``), and the solver /
 checkpoint / autotune counters all WRITE THROUGH — the reports' fields
 are properties over registry metrics, so ``ServeReport.metrics()`` and
 the registry's Prometheus/JSON expositions read the same storage and

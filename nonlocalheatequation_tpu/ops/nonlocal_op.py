@@ -651,10 +651,19 @@ def make_multi_step_fn_base(op, nsteps: int, g=None, lg=None, dtype=None):
     operand-rounding drift; ``R=1`` degenerates to the f32 path exactly.
     The state arg is donated to XLA on TPU (utils/donation.py) so the big
     rungs stop double-buffering the input frame next to the output.
+
+    With ``NLHEAT_PROGRAM_STORE`` configured (serve/program_store.py)
+    the returned callable consults the AOT program store per (shape,
+    dtype): a warm boot loads the serialized executable — zero
+    retrace/recompile, bit-identical results — and a cold boot persists
+    this compile for the next session.  Store off (the default) returns
+    exactly the pre-store object.
     """
+    from nonlocalheatequation_tpu.serve.program_store import solo_store_jit
     from nonlocalheatequation_tpu.utils.donation import donated_jit
 
-    return donated_jit(multi_step_fn_base_unjit(op, nsteps, g, lg, dtype))
+    multi = multi_step_fn_base_unjit(op, nsteps, g, lg, dtype)
+    return solo_store_jit(op, nsteps, g, lg, dtype, multi, donated_jit)
 
 
 def multi_step_fn_base_unjit(op, nsteps: int, g=None, lg=None, dtype=None):

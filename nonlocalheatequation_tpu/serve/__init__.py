@@ -19,4 +19,12 @@ typed ``ServeError`` a quarantined request raises, the circuit breaker
 closed), and the CPU-backend fallback chunk runner — bench.py's
 ladder/watchdog discipline applied to the request path, proven by the
 deterministic injector in utils/faults.py with no real TPU.
+
+``serve/program_store.py`` is the warm-boot layer under all of them: a
+content-addressed on-disk store of AOT-compiled executables keyed by
+the full program key plus a version/topology fingerprint, so a fresh
+replica or session loads yesterday's compiles (zero retrace/recompile,
+bit-identical results) instead of re-paying them — the reference's
+compiled-binary zero-startup-cost property (PAPER.md layer map)
+recovered for the JAX stack.
 """

@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import json
 import os
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import numpy as np
@@ -60,6 +61,15 @@ from nonlocalheatequation_tpu.obs.metrics import MetricsRegistry, backed
 #: split into top-size chunks; the remainder pads up to the smallest
 #: size that fits.
 BATCH_SIZES = (1, 2, 4, 8)
+
+#: Default bound on the in-memory compiled-program cache (LRU; env
+#: ``NLHEAT_PROGRAM_CACHE_CAP`` or the ``program_cache_cap`` ctor arg
+#: override).  A long-lived pipeline serving many buckets/engines must
+#: not grow host memory without bound with compiled executables; evicted
+#: programs rebuild on next touch (or reload from the AOT program store,
+#: serve/program_store.py), and eviction can never change served results
+#: — the cache holds compiled constants, not state.
+PROGRAM_CACHE_CAP = 64
 
 
 @dataclass
@@ -107,7 +117,10 @@ class EnsembleReport:
     buckets = backed("_m_buckets")
     dispatches = backed("_m_dispatches")
     programs_built = backed("_m_programs_built")
+    programs_loaded = backed("_m_programs_loaded")
     padded_cases = backed("_m_padded_cases")
+    programs_evicted = backed("_m_programs_evicted")
+    programs_resident = backed("_m_programs_resident")
 
     def __init__(self, registry: MetricsRegistry | None = None):
         self.registry = registry if registry is not None else MetricsRegistry()
@@ -116,13 +129,26 @@ class EnsembleReport:
         self._m_buckets = r.counter("/ensemble/buckets")
         self._m_dispatches = r.counter("/ensemble/dispatches")
         self._m_programs_built = r.counter("/ensemble/programs-built")
+        # programs materialized WITHOUT a build: AOT store hits
+        # (serve/program_store.py) — programs-built keeps meaning
+        # "traced+compiled here", so a recompile watchdog stays honest
+        self._m_programs_loaded = r.counter("/ensemble/programs-loaded")
         self._m_padded_cases = r.counter("/ensemble/padded-cases")
+        # the engine's LRU program cache (build_program): resident count
+        # gauge + lifetime-exact eviction counter, under the /store
+        # namespace with the AOT-store metrics they complement
+        self._m_programs_evicted = r.counter("/store/evictions")
+        self._m_programs_resident = r.gauge("/store/resident-programs")
         self.strategies: dict = {}
 
     def summary(self) -> str:
+        # the built/loaded split stays visible here too: a fully warm
+        # boot must read "0 built + N loaded", never "0 programs"
+        loaded = (f" + {self.programs_loaded} loaded"
+                  if self.programs_loaded else "")
         return (f"{self.cases} cases -> {self.buckets} buckets, "
                 f"{self.dispatches} dispatches, "
-                f"{self.programs_built} programs "
+                f"{self.programs_built} programs built{loaded} "
                 f"({self.padded_cases} padding lanes)")
 
     def metrics(self) -> dict:
@@ -134,6 +160,7 @@ class EnsembleReport:
             "buckets": self.buckets,
             "dispatches": self.dispatches,
             "programs_built": self.programs_built,
+            "programs_loaded": self.programs_loaded,
             "padded_cases": self.padded_cases,
             "strategies": {str(k): v for k, v in self.strategies.items()},
         }
@@ -172,7 +199,9 @@ class EnsembleEngine:
     def __init__(self, method: str = "auto", precision: str = "f32",
                  dtype=None, variant: str = "auto", ksteps: int = 0,
                  batch_sizes=BATCH_SIZES, comm: str = "collective",
-                 stepper: str = "euler", stages: int = 0):
+                 stepper: str = "euler", stages: int = 0,
+                 program_store=None, program_cache_cap: int | None = None,
+                 store_backend: str | None = None):
         from nonlocalheatequation_tpu.models.steppers import STEPPERS
 
         if variant not in self.VARIANTS:
@@ -218,6 +247,17 @@ class EnsembleEngine:
         sizes = tuple(sorted({int(b) for b in batch_sizes}))
         if not sizes or sizes[0] < 1:
             raise ValueError(f"bad batch_sizes {batch_sizes!r}")
+        cap = (program_cache_cap if program_cache_cap is not None
+               else int(os.environ.get("NLHEAT_PROGRAM_CACHE_CAP") or
+                        PROGRAM_CACHE_CAP))
+        if cap < 0:
+            raise ValueError(
+                f"program_cache_cap must be >= 0, got {cap}")
+        if cap == 0:
+            # the repo-wide 0-knob convention (NLHEAT_SUPERSTEP=0,
+            # NLHEAT_PROGRAM_STORE=0, ...): 0 turns the feature OFF —
+            # for a cache CAP that means unbounded, the pre-LRU behavior
+            cap = float("inf")
         self.method = method
         self.precision = precision
         self.dtype = dtype
@@ -228,7 +268,21 @@ class EnsembleEngine:
         self.stepper = stepper
         self.stages = int(stages)
         self.report = EnsembleReport()
-        self._programs: dict = {}
+        #: LRU compiled-program cache, bounded at ``program_cache_cap``
+        #: (eviction never changes served results — see PROGRAM_CACHE_CAP)
+        self._programs: OrderedDict = OrderedDict()
+        self.program_cache_cap = cap
+        # AOT program store (serve/program_store.py): an explicit store
+        # instance, a directory path, or None (consult the env at first
+        # build).  Resolution is LAZY — build time is the execution path;
+        # a constructor must never touch the backend (wedge discipline).
+        self._program_store_arg = program_store
+        self.program_store = None
+        self._store_resolved = False
+        # sibling engines share one store NAMESPACE keyed by backend:
+        # the CPU fallback pins store_backend="cpu" so its programs can
+        # never collide with the device engine's (serve/resilience.py)
+        self.store_backend = store_backend
 
     def sibling(self, **overrides) -> "EnsembleEngine":
         """A fresh engine carrying this engine's settings (method /
@@ -241,7 +295,14 @@ class EnsembleEngine:
         kw = dict(method=self.method, precision=self.precision,
                   dtype=self.dtype, variant=self.variant,
                   ksteps=self.ksteps, batch_sizes=self.batch_sizes,
-                  comm=self.comm, stepper=self.stepper, stages=self.stages)
+                  comm=self.comm, stepper=self.stepper, stages=self.stages,
+                  # the AOT store is SHARED (one namespace, backend in the
+                  # key); the in-memory program cache and report are not
+                  program_store=(self.program_store
+                                 if self._store_resolved
+                                 else self._program_store_arg),
+                  program_cache_cap=self.program_cache_cap,
+                  store_backend=self.store_backend)
         kw.update(overrides)
         return EnsembleEngine(**kw)
 
@@ -327,11 +388,50 @@ class EnsembleEngine:
     # the schedule (when chunks close, how many dispatches are in flight,
     # when the fence happens), never the programs, which is what makes
     # served results bit-identical to run() on the same case set.
+    def adopt_report(self, report) -> None:
+        """Install a replacement report (the serving pipeline's
+        ServeReport takes over the engine's counters).  A store already
+        resolved against the OLD report's registry would keep counting
+        into the discarded registry — drop the resolution so the next
+        build re-binds ``/store/*`` to the new registry (an explicitly
+        passed ProgramStore instance keeps its own binding: the caller
+        owns that registry)."""
+        from nonlocalheatequation_tpu.serve.program_store import (
+            ProgramStore,
+        )
+
+        self.report = report
+        if self._store_resolved and not isinstance(self._program_store_arg,
+                                                   ProgramStore):
+            self._store_resolved = False
+            self.program_store = None
+
+    def _resolve_store(self):
+        """The engine's AOT program store (serve/program_store.py), or
+        None.  Resolved lazily at first build — the execution path —
+        so the constructor stays backend-free (wedge discipline); bound
+        to the report's registry so ``/store/*`` metrics surface through
+        the serving expositions."""
+        if not self._store_resolved:
+            from nonlocalheatequation_tpu.serve.program_store import (
+                resolve_store,
+            )
+
+            self.program_store = resolve_store(
+                self._program_store_arg, registry=self.report.registry)
+            self._store_resolved = True
+        return self.program_store
+
     def build_program(self, key, chunk):
         """Stage 1 (host): the chunk's compiled multi-step callable,
         cached per (bucket, size, variant, physics, dtype) — a cache hit
         costs nothing, so a pipeline can build chunk N+2's program while
-        chunk N computes on the device."""
+        chunk N computes on the device.  The cache is a bounded LRU
+        (``program_cache_cap``); with an AOT program store configured
+        (serve/program_store.py) a cold key first tries a stored
+        executable — a store hit materializes the program with ZERO
+        retrace/recompile, a miss builds as always and persists the
+        compiled executable for the next boot."""
         test = key[3]
         dtype = self._dtype()
         # stepper/stages join the program key (ISSUE 8): two engines
@@ -340,17 +440,68 @@ class EnsembleEngine:
         prog_key = (key, len(chunk), self.variant,
                     tuple(c.physics() for c in chunk), dtype.name,
                     self.comm, self.stepper, self.stages)
-        multi = self._programs.get(prog_key)
+        store = self._resolve_store()
+        cache_key = prog_key
+        if store is not None:
+            from nonlocalheatequation_tpu.utils import donation
+
+            # a store-materialized program is donation-FIXED (the AOT
+            # binary either aliases arg 0 or not), unlike the lazy
+            # per-call donated_jit wrappers the plain path caches — so
+            # the donate decision joins the in-memory key too (the solo
+            # wrapper's rule), and a depth/NLHEAT_DONATE change mid-life
+            # re-materializes instead of serving a stale donating binary
+            donate = donation.donation_on()
+            cache_key = (prog_key, donate)
+        multi = self._programs.get(cache_key)
         if multi is None:
-            # operators are only needed to BUILD a program (and for the
-            # u0 test-mode default below); a cache hit skips them
-            with obs_trace.span("ensemble.build", cat="ensemble",
-                                bucket=str(key), cases=len(chunk),
-                                variant=self.variant):
-                ops = [self._make_op(c) for c in chunk]
-                multi = self._build_program(key, chunk, ops, test, dtype)
-            self._programs[prog_key] = multi
-            self.report.programs_built += 1
+            def build():
+                # operators are only needed to BUILD a program (and for
+                # the u0 test-mode default below); a cache/store hit
+                # skips them
+                with obs_trace.span("ensemble.build", cat="ensemble",
+                                    bucket=str(key), cases=len(chunk),
+                                    variant=self.variant):
+                    ops = [self._make_op(c) for c in chunk]
+                    return self._build_program(key, chunk, ops, test,
+                                               dtype)
+
+            loaded = False
+            if store is None:
+                multi = build()
+            else:
+                sds = jax.ShapeDtypeStruct((len(chunk),) + key[0], dtype)
+                # the store key must carry MORE than prog_key: the
+                # in-memory cache is private to one engine (whose
+                # method/precision/ksteps are fixed for life), but the
+                # store is shared across engines and sessions — without
+                # these fields a bf16 engine could load an f32 engine's
+                # executable for the same bucket.  donate joins via the
+                # store digest (it changes the compiled binary).
+                store_key = repr((prog_key, self.method, self.precision,
+                                  self.ksteps))
+                multi, outcome = store.load_or_build(
+                    store_key, build, (sds, 0), donate=donate,
+                    backend=self.store_backend)
+                loaded = outcome == "hit"
+                if loaded:
+                    # _build_program never ran, so no variant label was
+                    # computed; say honestly where the program came from
+                    self.report.strategies[key] = "stored"
+            self._programs[cache_key] = multi
+            # honesty split: a store HIT materialized a program without
+            # tracing or compiling anything — counted as loaded, never
+            # as built (a recompile watchdog reads programs-built)
+            if loaded:
+                self.report.programs_loaded += 1
+            else:
+                self.report.programs_built += 1
+            while len(self._programs) > self.program_cache_cap:
+                self._programs.popitem(last=False)
+                self.report.programs_evicted += 1
+            self.report.programs_resident = len(self._programs)
+        else:
+            self._programs.move_to_end(cache_key)
         return multi
 
     def stage_inputs(self, chunk):

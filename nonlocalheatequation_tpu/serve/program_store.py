@@ -1,0 +1,518 @@
+"""AOT program store: serialized executables keyed by the engine key.
+
+The reference is an ahead-of-time-compiled HPX binary — it pays ZERO
+compile cost at startup (PAPER.md layer map).  Our JAX stack instead
+re-pays a full trace+lower+compile per ``(bucket, engine)`` program key
+on every replica and every session; the XLA persistent cache (bench.py
+PR 1) removes only the XLA half, only same-host, and still pays trace +
+lowering + cache lookup per program.  This module closes the gap: a
+content-addressed on-disk store of **AOT-compiled executables**
+(``jax.jit(fn).lower(*avals).compile()`` + executable serialization via
+:mod:`~nonlocalheatequation_tpu.utils.compat`'s ``aot_serialize`` /
+``aot_deserialize`` shims), shared across replicas and sessions, so a
+warm boot loads a stored binary and dispatches — zero retrace, zero
+recompile, and **bit-identical** results (the loaded executable IS the
+bytes a fresh compile produced; pinned by tests/test_program_store.py on
+the f64 8-virtual-device suite).
+
+Keying (never serve a wrong program):
+
+* the **digest** (file name) hashes the caller's full program key — the
+  ensemble engine passes its ``prog_key`` (grid, nt, eps, test, batch,
+  variant, physics, dtype, comm, stepper, stages; serve/ensemble.py),
+  the solo path its operator/step signature — plus the input avals, the
+  donation flag, the x64 mode, and the target backend name (sibling
+  engines share ONE store namespace keyed by backend: a CPU-fallback
+  ``conv`` program can never collide with the device engine's ``conv``).
+* the **header** carries the jax/jaxlib/package **version fingerprint**
+  (:func:`~nonlocalheatequation_tpu.utils.compat.aot_fingerprint`) and
+  the **device topology** (platform, device kind, device count, process
+  count) — verified at load with a LOUD, typed :class:`StoreRefusal` on
+  any mismatch, after which the caller falls back to a fresh compile.
+  A truncated or bit-rotted entry is refused the same way via a CRC32
+  integrity marker (the checkpoint discipline, utils/checkpoint.py).
+
+Crash/concurrency safety: entries are written with
+:func:`~nonlocalheatequation_tpu.utils.checkpoint.atomic_file`
+(same-directory host+pid-unique tmp, fsync, ``os.replace``), so N
+replica processes racing to write the same key leave one complete
+winner and readers never observe a torn file.
+
+Observability: ``/store/hits``, ``/store/misses``, ``/store/refusals``
+(labeled by reason), ``/store/load-ms`` and ``/store/serialize-ms``
+histograms in the registry the caller provides (the ensemble engine
+passes its report's registry, so ``ServeReport.metrics()`` and the
+Prometheus exposition surface them), plus ``store.load`` /
+``store.save`` spans — all build-time writes only; the timed dispatch
+path never touches the store.
+
+Env knobs: ``NLHEAT_PROGRAM_STORE`` — unset/``0``/empty = OFF (today's
+behavior, bit-identically: the callers return exactly the callables
+they always returned); ``1`` = the per-user default directory
+(``~/.cache/nlheat/program_store``); any other value = the store
+directory itself.  ``NLHEAT_PROGRAM_CACHE_CAP`` bounds the engine's
+in-memory program cache (serve/ensemble.py LRU).
+
+TRUST BOUNDARY: entries deserialize through pickle, and the CRC /
+fingerprint / topology headers are INTEGRITY checks, not authenticity
+— anyone who can write the store directory can execute code in every
+process that warm-boots from it.  Point the store only at directories
+writable solely by principals you already trust to run code here (the
+replicas themselves); store dirs are created ``0700`` and must never
+be group/world-writable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import sys
+import time
+import zlib
+
+from nonlocalheatequation_tpu.obs import trace as obs_trace
+from nonlocalheatequation_tpu.obs.metrics import MetricsRegistry
+from nonlocalheatequation_tpu.utils import compat
+from nonlocalheatequation_tpu.utils.checkpoint import atomic_file
+
+#: Entry format marker; bump on any layout change so old files refuse
+#: loudly instead of deserializing garbage.
+MAGIC = b"NLPROG1\n"
+
+#: Default store location for ``NLHEAT_PROGRAM_STORE=1``.
+DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache", "nlheat",
+                           "program_store")
+
+#: Refusal reasons (the typed, loud vocabulary the tests pin).
+REFUSE_FINGERPRINT = "fingerprint-mismatch"
+REFUSE_TOPOLOGY = "topology-mismatch"
+REFUSE_CORRUPT = "corrupt"
+REFUSE_UNSUPPORTED = "unsupported"
+
+
+class StoreRefusal(RuntimeError):
+    """The store cannot serve (or persist) this entry.  Always recovered
+    from — the caller falls back to a fresh compile, never to wrong
+    results — but LOUD: every refusal prints one stderr line and counts
+    under ``/store/refusals{reason}``."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"program store refusal [{reason}]: {detail}")
+        self.reason = reason
+        self.detail = detail
+
+
+def store_dir_from_env() -> str | None:
+    """The configured store directory, or None when the store is off
+    (unset/empty/``0``).  ``1`` selects :data:`DEFAULT_DIR`."""
+    raw = os.environ.get("NLHEAT_PROGRAM_STORE", "")
+    if raw in ("", "0"):
+        return None
+    if raw == "1":
+        return DEFAULT_DIR
+    return raw
+
+
+def topology_fingerprint(backend: str | None = None) -> dict:
+    """The device-topology half of the load-time check: platform, device
+    kind, device count, process count.  Initializes the backend — call
+    on the execution path only (the same rule as donation_on, and the
+    reason the engine resolves its store lazily at first build, never
+    in a constructor)."""
+    import jax
+
+    devices = jax.devices(backend) if backend else jax.devices()
+    return {
+        "platform": devices[0].platform,
+        "device_kind": getattr(devices[0], "device_kind", ""),
+        "devices": len(devices),
+        "processes": jax.process_count(),
+    }
+
+
+#: Env knobs that shape the TRACE itself (kernel tiling, lane-run
+#: experiments, autotune winner selection): two processes differing in
+#: any of these may build different programs for the same logical key,
+#: so they join the digest — a tile-size A/B must never be served the
+#: other arm's executable.  (NLHEAT_DONATE is covered by the explicit
+#: ``donate`` flag; NLHEAT_RESIDENT/SUPERSTEP shape paths above the
+#: store-wrapped makers but are included for safety.)
+TRACE_ENV_KNOBS = (
+    "NLHEAT_TM", "NLHEAT_LANE_RUNS", "NLHEAT_AUTOTUNE",
+    "NLHEAT_TUNE_BATCH", "NLHEAT_TUNE_PRECISION", "NLHEAT_TUNE_METHOD",
+    "NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP",
+)
+
+
+def _trace_env_desc() -> str:
+    return ";".join(f"{k}={os.environ.get(k, '')}"
+                    for k in TRACE_ENV_KNOBS)
+
+
+def _digest(key_desc: str, avals_desc: str, donate: bool,
+            backend: str) -> str:
+    h = hashlib.sha256()
+    for part in (MAGIC.decode(), key_desc, avals_desc, repr(bool(donate)),
+                 backend, repr(compat.aot_fingerprint()["x64"]),
+                 _trace_env_desc()):
+        h.update(part.encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def _avals_desc(example_args) -> str:
+    import jax
+
+    parts = []
+    for a in example_args:
+        if isinstance(a, jax.ShapeDtypeStruct):
+            parts.append(f"sds{tuple(a.shape)}:{jax.numpy.dtype(a.dtype).name}")
+        else:
+            parts.append(f"lit:{type(a).__name__}:{a!r}")
+    return ";".join(parts)
+
+
+class ProgramStore:
+    """One store directory + its counters.  Safe to share across sibling
+    engines (CPU fallback included — the backend joins the digest); all
+    methods are process-local and crash-safe, and every failure mode
+    degrades to a fresh compile.
+
+    ``registry`` receives the ``/store/*`` metrics; the ensemble engine
+    passes its report's registry so the serving expositions carry them.
+    """
+
+    def __init__(self, root: str, registry: MetricsRegistry | None = None):
+        self.root = str(root)
+        self.registry = registry if registry is not None else MetricsRegistry()
+        r = self.registry
+        self._m_hits = r.counter("/store/hits")
+        self._m_misses = r.counter("/store/misses")
+        self._m_saves = r.counter("/store/saves")
+        self._m_refusals = r.labeled("/store/refusals")
+        self._h_load_ms = r.histogram("/store/load-ms")
+        self._h_serialize_ms = r.histogram("/store/serialize-ms")
+        # AOT wholly unavailable on this build: decided once, loudly
+        self._aot_dead = not compat.aot_serialize_supported()
+        self._topo_cache: dict = {}
+
+    # -- public API ---------------------------------------------------------
+    def load_or_build(self, key_desc: str, build, example_args,
+                      donate: bool = False, backend: str | None = None):
+        """The one entry point: return ``(callable, outcome)`` where
+        outcome is ``"hit"`` (deserialized from disk — ``build`` never
+        ran: zero retrace, zero recompile), ``"miss"`` (fresh
+        AOT compile of ``build()``'s callable, persisted for the next
+        boot), or ``"plain"`` (AOT unavailable/refused — ``build()``'s
+        callable returned verbatim, today's jit-on-first-call behavior).
+
+        ``build`` returns the program callable ``(u, t0) -> u`` exactly
+        as the makers produce it; ``example_args`` are the concrete
+        avals/literals of one call (``jax.ShapeDtypeStruct`` for arrays,
+        python literals for weak-typed scalars).  ``donate`` must match
+        the donation decision the call path would make
+        (utils/donation.donation_on) — it changes the compiled binary,
+        so it joins the digest.
+        """
+        if self._aot_dead:
+            self._refuse(REFUSE_UNSUPPORTED,
+                         "no executable serialization on this JAX build",
+                         once=True)
+            return build(), "plain"
+        backend_name = self._backend_name(backend)
+        digest = _digest(key_desc, _avals_desc(example_args), donate,
+                         backend_name)
+        path = os.path.join(self.root, digest + ".aotprog")
+        loaded = self._try_load(path, backend_name)
+        if loaded is not None:
+            self._m_hits.inc()
+            return loaded, "hit"
+        self._m_misses.inc()
+        fn = build()
+        compiled = self._compile(fn, example_args, donate)
+        if compiled is None:
+            return fn, "plain"
+        self._save(path, compiled, key_desc, backend_name)
+        return compiled, "miss"
+
+    def stats(self) -> dict:
+        """Counter snapshot (bench's JSON fields read this)."""
+        return {
+            "hits": self._m_hits.value,
+            "misses": self._m_misses.value,
+            "saves": self._m_saves.value,
+            "refusals": dict(self._m_refusals),
+        }
+
+    # -- internals ----------------------------------------------------------
+    def _backend_name(self, backend: str | None) -> str:
+        if backend:
+            return backend
+        import jax
+
+        return jax.default_backend()
+
+    def _topology(self, backend_name: str) -> dict:
+        topo = self._topo_cache.get(backend_name)
+        if topo is None:
+            topo = self._topo_cache[backend_name] = topology_fingerprint(
+                backend_name)
+        return topo
+
+    def _refuse(self, reason: str, detail: str, once: bool = False) -> None:
+        if once and self._m_refusals.get(reason):
+            self._m_refusals[reason] += 1
+            return
+        self._m_refusals[reason] = self._m_refusals.get(reason, 0) + 1
+        print(f"program store refusal [{reason}]: {detail} — "
+              "falling back to a fresh compile", file=sys.stderr)
+
+    def _try_load(self, path: str, backend_name: str):
+        """A loaded executable, or None (missing entry = silent miss;
+        every OTHER failure = loud typed refusal, then None)."""
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return None
+        except OSError as e:
+            self._refuse(REFUSE_CORRUPT, f"{path}: unreadable ({e})")
+            return None
+        t0 = time.perf_counter()
+        try:
+            loaded = self._decode(raw, path, backend_name)
+        except StoreRefusal as e:
+            self._refuse(e.reason, e.detail)
+            return None
+        except Exception as e:  # noqa: BLE001 — backend rejected the bytes
+            self._refuse(REFUSE_UNSUPPORTED,
+                         f"{path}: deserialization failed "
+                         f"({type(e).__name__}: {e})")
+            return None
+        ms = (time.perf_counter() - t0) * 1e3
+        self._h_load_ms.observe(ms)
+        with obs_trace.span("store.load", cat="store", ms=round(ms, 3),
+                            path=os.path.basename(path)):
+            pass
+        return loaded
+
+    def _decode(self, raw: bytes, path: str, backend_name: str):
+        if not raw.startswith(MAGIC):
+            raise StoreRefusal(REFUSE_CORRUPT,
+                               f"{path}: bad magic (foreign or torn file)")
+        body = raw[len(MAGIC):]
+        if len(body) < 8:
+            raise StoreRefusal(REFUSE_CORRUPT, f"{path}: truncated header")
+        hlen = int.from_bytes(body[:8], "little")
+        if len(body) < 8 + hlen:
+            raise StoreRefusal(REFUSE_CORRUPT, f"{path}: truncated header")
+        try:
+            header = json.loads(body[8:8 + hlen].decode())
+        except Exception as e:
+            raise StoreRefusal(REFUSE_CORRUPT,
+                               f"{path}: unreadable header ({e})") from e
+        payload = body[8 + hlen:]
+        if len(payload) != header.get("payload_len", -1):
+            raise StoreRefusal(REFUSE_CORRUPT,
+                               f"{path}: payload truncated "
+                               f"({len(payload)} of "
+                               f"{header.get('payload_len')} bytes)")
+        if zlib.crc32(payload) != header.get("payload_crc"):
+            raise StoreRefusal(REFUSE_CORRUPT,
+                               f"{path}: payload failed its integrity "
+                               "check (torn write, disk fault)")
+        fp_now = compat.aot_fingerprint()
+        fp_saved = header.get("fingerprint", {})
+        if fp_saved != fp_now:
+            diff = {k: (fp_saved.get(k), fp_now.get(k))
+                    for k in set(fp_saved) | set(fp_now)
+                    if fp_saved.get(k) != fp_now.get(k)}
+            raise StoreRefusal(REFUSE_FINGERPRINT,
+                               f"{path}: saved under {diff} (saved, "
+                               "current) — executables never cross builds")
+        topo_now = self._topology(backend_name)
+        topo_saved = header.get("topology", {})
+        if topo_saved != topo_now:
+            diff = {k: (topo_saved.get(k), topo_now.get(k))
+                    for k in set(topo_saved) | set(topo_now)
+                    if topo_saved.get(k) != topo_now.get(k)}
+            raise StoreRefusal(REFUSE_TOPOLOGY,
+                               f"{path}: compiled for {diff} (saved, "
+                               "current) — executables never cross "
+                               "topologies")
+        blob = pickle.loads(payload)
+        return compat.aot_deserialize(blob["exe"], blob["in_tree"],
+                                      blob["out_tree"])
+
+    def _compile(self, fn, example_args, donate: bool):
+        """AOT lower+compile ``fn`` (exactly the bytes jit would build —
+        jit's own path IS lower+compile, so results are bit-identical to
+        the jit-on-first-call behavior).  Returns None (degrade to the
+        plain callable, loudly) when this program cannot AOT-compile."""
+        import jax
+
+        try:
+            jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
+            return jitted.lower(*example_args).compile()
+        except Exception as e:  # noqa: BLE001 — exotic maker output
+            self._refuse(REFUSE_UNSUPPORTED,
+                         f"AOT compile failed ({type(e).__name__}: {e}); "
+                         "running the plain jit path")
+            return None
+
+    def _save(self, path: str, compiled, key_desc: str,
+              backend_name: str) -> None:
+        """Serialize + atomically persist; failures are loud refusals,
+        never errors (the compiled program still serves this process)."""
+        t0 = time.perf_counter()
+        try:
+            exe, in_tree, out_tree = compat.aot_serialize(compiled)
+            payload = pickle.dumps(
+                {"exe": exe, "in_tree": in_tree, "out_tree": out_tree})
+        except Exception as e:  # noqa: BLE001 — backend refused
+            self._refuse(REFUSE_UNSUPPORTED,
+                         f"executable serialization failed "
+                         f"({type(e).__name__}: {e}); entry not persisted")
+            return
+        header = json.dumps({
+            "key": key_desc,
+            "backend": backend_name,
+            "fingerprint": compat.aot_fingerprint(),
+            "topology": self._topology(backend_name),
+            "payload_len": len(payload),
+            "payload_crc": zlib.crc32(payload),
+        }).encode()
+        try:
+            # 0700: the module's trust boundary (docstring) — a store
+            # entry is executable content for whoever loads it, so the
+            # dir must never open up to other principals.  Pre-existing
+            # dirs keep their mode (the operator's explicit choice).
+            os.makedirs(self.root, mode=0o700, exist_ok=True)
+            with atomic_file(path, "wb") as f:
+                f.write(MAGIC)
+                f.write(len(header).to_bytes(8, "little"))
+                f.write(header)
+                f.write(payload)
+        except OSError as e:
+            self._refuse(REFUSE_UNSUPPORTED,
+                         f"{path}: store write failed ({e}); entry not "
+                         "persisted")
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        self._h_serialize_ms.observe(ms)
+        self._m_saves.inc()
+        with obs_trace.span("store.save", cat="store", ms=round(ms, 3),
+                            bytes=len(payload),
+                            path=os.path.basename(path)):
+            pass
+
+
+def resolve_store(program_store, registry=None):
+    """The callers' one resolution rule: an explicit
+    :class:`ProgramStore` instance is used verbatim; an explicit path
+    string opens a store there; ``None`` consults
+    ``NLHEAT_PROGRAM_STORE`` (off when unset — today's behavior).
+    ``registry`` is bound only when this call constructs the store."""
+    if isinstance(program_store, ProgramStore):
+        return program_store
+    if program_store is not None:
+        return ProgramStore(str(program_store), registry=registry)
+    d = store_dir_from_env()
+    if d is None:
+        return None
+    return ProgramStore(d, registry=registry)
+
+
+# -- solo-solve wiring (ops/nonlocal_op.make_multi_step_fn_base) -------------
+
+
+def solo_key_desc(op, nsteps: int, g, lg, dtype) -> str:
+    """The solo multi-step program's identity: everything the trace
+    bakes.  The manufactured-source arrays (g, lg) are hashed — they are
+    baked constants, so two different sources are two different
+    programs."""
+    import numpy as np
+
+    spacing = getattr(op, "dh", None)
+    if spacing is None:
+        spacing = getattr(op, "dx", 0.0)
+    parts = [
+        "solo", type(op).__name__,
+        getattr(op, "method", ""),
+        repr(int(op.eps)), repr(float(op.k)), repr(float(op.dt)),
+        repr(float(spacing)),
+        getattr(op, "precision", "f32"),
+        repr(int(getattr(op, "resync_every", 0) or 0)),
+        repr(int(nsteps)),
+        "" if dtype is None else str(dtype),
+        repr(bool(getattr(op, "uniform", True))),
+    ]
+    for arr in (g, lg):
+        if arr is None:
+            parts.append("none")
+        else:
+            a = np.ascontiguousarray(np.asarray(arr))
+            parts.append(hashlib.sha256(a.tobytes()).hexdigest()
+                         + f":{a.dtype}:{a.shape}")
+    if not getattr(op, "uniform", True):
+        # a weighted influence function J is baked into the kernel too
+        w = np.ascontiguousarray(np.asarray(op.weights))
+        parts.append(hashlib.sha256(w.tobytes()).hexdigest())
+    return "|".join(parts)
+
+
+def solo_store_jit(op, nsteps: int, g, lg, dtype, multi, donated_jit):
+    """Wrap an UNJITTED solo multi-step trace for the store.  With the
+    store off (the default) this returns ``donated_jit(multi)`` — the
+    exact object (and therefore the exact behavior, bit for bit) the
+    maker returned before the store existed.  With the store on, the
+    first call per (shape, dtype) consults the store: a hit dispatches
+    the loaded executable (zero retrace/recompile); a miss AOT-compiles
+    this very trace and persists it; any refusal degrades to the
+    donated-jit path."""
+    if store_dir_from_env() is None:
+        return donated_jit(multi)
+    from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+    from nonlocalheatequation_tpu.utils import donation
+
+    djit = donated_jit(multi)  # the refusal fallback (today's path)
+    key_base = None  # computed once, lazily (hashing g/lg costs time)
+    store_box: list = []  # resolved ONCE: counters/topology accumulate
+    cache: dict = {}
+
+    def wrapper(u, t0):
+        nonlocal key_base
+        import jax
+
+        if type(t0) is not int:
+            # store programs are lowered for the weak-typed python-int
+            # t0 every solver/engine call site passes; a typed array t0
+            # (e.g. an autotune probe's jnp scalar) would be an aval
+            # mismatch on the loaded executable — run today's jit path
+            # for such calls instead of risking a call-time refusal
+            return djit(u, t0)
+        donate = donation.donation_on()
+        key = (tuple(u.shape), str(u.dtype), donate)
+        fn = cache.get(key)
+        if fn is None:
+            if not store_box:
+                # the solo path's store counters live in the process
+                # registry, like every other solo-solve metric
+                store_box.append(resolve_store(None, registry=REGISTRY))
+            store = store_box[0]
+            if store is None:  # knob flipped off after maker time
+                fn = djit
+            else:
+                if key_base is None:
+                    key_base = solo_key_desc(op, nsteps, g, lg, dtype)
+                sds = jax.ShapeDtypeStruct(u.shape, u.dtype)
+                fn, outcome = store.load_or_build(
+                    key_base, lambda: multi, (sds, 0), donate=donate)
+                if outcome == "plain":
+                    fn = djit  # keep the jit-cached path, not a raw trace
+            cache[key] = fn
+        return fn(u, t0)
+
+    return wrapper

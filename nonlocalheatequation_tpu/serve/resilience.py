@@ -221,10 +221,16 @@ class CpuFallback:
             # resolves to the vmap/stacked XLA compositions here.  comm
             # pinned to "collective" for the same reason — the fused
             # halo engine is pallas-only and a CPU fallback chunk runs
-            # unsharded anyway
+            # unsharded anyway.  store_backend pinned to "cpu": the
+            # sibling SHARES the device engine's AOT program store
+            # (serve/program_store.py — one namespace), and the backend
+            # in the key is what keeps a CPU-compiled fallback program
+            # from ever colliding with (or being served as) the device
+            # engine's program for the same bucket
             sib = self._engines[method] = e.sibling(method=method,
                                                     variant="auto",
-                                                    comm="collective")
+                                                    comm="collective",
+                                                    store_backend="cpu")
         return sib
 
     def run_chunk(self, key, padded) -> np.ndarray:

@@ -268,6 +268,35 @@ class ServeReport(EnsembleReport):
         self.window_size = window_size
         self.breaker = breaker  # the pipeline's CircuitBreaker, if any
 
+    def store(self) -> dict:
+        """The AOT-program-store block of :meth:`metrics`
+        (serve/program_store.py): hit/miss/save counters, refusals by
+        reason, load/serialize-time percentiles, plus the engine's LRU
+        program-cache occupancy (resident gauge, lifetime evictions).
+        All zeros when no store is configured — the keys are stable so
+        dashboards need no existence checks."""
+        r = self.registry
+
+        def val(name):
+            m = r.get(name)
+            return m.value if m is not None else 0
+
+        def pct(name):
+            m = r.get(name)
+            return m.percentiles() if m is not None else {}
+
+        refusals = r.get("/store/refusals")
+        return {
+            "hits": val("/store/hits"),
+            "misses": val("/store/misses"),
+            "saves": val("/store/saves"),
+            "refusals": dict(refusals) if refusals is not None else {},
+            "load_ms": pct("/store/load-ms"),
+            "serialize_ms": pct("/store/serialize-ms"),
+            "resident_programs": val("/store/resident-programs"),
+            "evictions": val("/store/evictions"),
+        }
+
     def occupancy(self) -> dict:
         """Max and time-weighted mean chunks in flight over the sampled
         span (each sample is the in-flight count right after a dispatch
@@ -333,6 +362,7 @@ class ServeReport(EnsembleReport):
             "chunks": sum(self.forced_closes.values()),
             "dispatches": self.dispatches,
             "programs_built": self.programs_built,
+            "programs_loaded": self.programs_loaded,
             "padded_cases": self.padded_cases,
             "depth": self.depth,
             "window_ms": self.window_ms,
@@ -348,6 +378,7 @@ class ServeReport(EnsembleReport):
                 sum(c["fetch_ms"] for c in self.chunk_log), 3),
             "occupancy": self.occupancy(),
             "resilience": self.resilience(),
+            "store": self.store(),
             "chunk_log": list(self.chunk_log),
         }
 
@@ -471,7 +502,12 @@ class ServePipeline:
         self._fallback: CpuFallback | None = None
         self._fallback_dead = False
         self._breaker = breaker
-        self.report = engine.report = report
+        # adopt_report, not plain assignment: an engine that already ran
+        # (pre-warmed caches) may have bound its program store's metrics
+        # to the report being replaced — the store must re-bind to THIS
+        # report's registry or pipe.metrics()["store"] goes blind
+        engine.adopt_report(report)
+        self.report = report
         self._open: dict = {}
         self._ready: list[_Chunk] = []
         self._inflight: deque[_Chunk] = deque()

@@ -89,6 +89,71 @@ def out_struct(shape, dtype, vma=None) -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+# -- AOT executable serialization (serve/program_store.py) ------------------
+# The program store persists jax.jit(...).lower(...).compile() results
+# across processes so a warm boot pays zero trace+compile (ROADMAP item 5).
+# The serialization surface has moved across JAX versions
+# (jax.experimental.serialize_executable today; absent on some plugin
+# builds), so — like shard_map above — the capability split lives here:
+# the store asks these shims and refuses LOUDLY (falling back to a fresh
+# compile, never wrong results) where the pinned jaxlib cannot serialize.
+
+try:  # the pinned jaxlib (0.4.x) and modern JAX both ship this module
+    from jax.experimental.serialize_executable import (
+        deserialize_and_load as _deserialize_and_load,
+    )
+    from jax.experimental.serialize_executable import (
+        serialize as _serialize_executable,
+    )
+except ImportError:  # pragma: no cover — plugin builds without the module
+    _serialize_executable = None
+    _deserialize_and_load = None
+
+
+def aot_serialize_supported() -> bool:
+    """Whether this JAX build can serialize/deserialize compiled
+    executables at all (the store's first gate; per-backend support is
+    still probed per compile — a backend may refuse at runtime)."""
+    return _serialize_executable is not None
+
+
+def aot_serialize(compiled):
+    """``(payload_bytes, in_tree, out_tree)`` of a ``.compile()`` result.
+    Raises whatever the backend raises on unsupported executables — the
+    program store classifies any raise as an 'unsupported' refusal."""
+    if _serialize_executable is None:
+        raise NotImplementedError(
+            "this JAX build has no jax.experimental.serialize_executable")
+    return _serialize_executable(compiled)
+
+
+def aot_deserialize(payload, in_tree, out_tree):
+    """Inverse of :func:`aot_serialize`: a loaded, callable executable."""
+    if _deserialize_and_load is None:
+        raise NotImplementedError(
+            "this JAX build has no jax.experimental.serialize_executable")
+    return _deserialize_and_load(payload, in_tree, out_tree)
+
+
+def aot_fingerprint() -> dict:
+    """The version half of the program-store key: serialized executables
+    are only valid under the exact (jax, jaxlib, package) build that
+    wrote them plus the x64 mode the trace ran under.  The store compares
+    this dict field-for-field at load and refuses loudly on mismatch
+    (topology is fingerprinted separately — it needs a live backend,
+    which this function must not touch: wedge discipline)."""
+    import jaxlib
+
+    from nonlocalheatequation_tpu import __version__
+
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "package": __version__,
+        "x64": bool(jax.config.jax_enable_x64),
+    }
+
+
 # -- real-input FFT (ops/spectral.py) ---------------------------------------
 # The pinned jaxlib (0.4.x) ships jnp.fft.rfftn/irfftn, but older builds of
 # the axon plugin stack have shipped jnp.fft trees without the real-input

@@ -21,7 +21,10 @@ for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
               "BENCH_SERVE", "BENCH_SERVE_FAULTS",
               # a leaked event-log/trace path must not make the suite
               # write telemetry files (obs/export.py, cli obs_session)
-              "NLHEAT_EVENT_LOG", "NLHEAT_TRACE", "BENCH_TRACE"):
+              "NLHEAT_EVENT_LOG", "NLHEAT_TRACE", "BENCH_TRACE",
+              # a leaked AOT store dir must not let suite programs load
+              # stale executables (or write new ones) across test runs
+              "NLHEAT_PROGRAM_STORE", "NLHEAT_PROGRAM_CACHE_CAP"):
     os.environ.pop(_knob, None)
 # "" DISABLES autotune-cache persistence (unset means the per-user default
 # file since tuning became the on-TPU default): the suite must neither read

@@ -128,6 +128,50 @@ def test_bench_tta_mode_emits_steps_to_solution():
     assert arms[rec["stepper"]]["steps"] == rec["steps_taken"]
 
 
+def test_bench_warmboot_mode_emits_cold_warm_ab(tmp_path):
+    # BENCH_WARMBOOT=1: the cold-vs-warm boot A/B over one shared AOT
+    # program store dir (ISSUE 9, serve/program_store.py).  The JSON
+    # must carry the warmboot variant, both first-chunk walls, the
+    # cold/warm speedup, a counted store hit (the warm arm must LOAD,
+    # not recompile), and the bit-identity flag — on the same one-line
+    # rc=0 ladder
+    store = tmp_path / "store"
+    proc, rec = run_bench({"BENCH_WARMBOOT": "1", "BENCH_GRID": "48",
+                           "BENCH_LADDER": "48", "BENCH_ACCURACY": "0",
+                           "BENCH_WARMBOOT_DIR": str(store)})
+    assert proc.returncode == 0
+    assert rec["value"] > 0
+    assert rec["variant"] == "warmboot"
+    assert rec["cold_first_chunk_s"] > 0
+    assert rec["warm_first_chunk_s"] > 0
+    assert rec["warmboot_speedup"] == pytest.approx(
+        rec["cold_first_chunk_s"] / rec["warm_first_chunk_s"], rel=1e-2)
+    assert rec["store_hits"] == 1
+    assert rec["store_misses"] == 1
+    assert rec["bit_identical"] is True
+    # the shared dir holds the serialized executable for the next boot
+    assert list(store.glob("*.aotprog"))
+    # a second run against the SAME dir: the populate arm now hits too
+    # (misses 0) and the gate evidence still banks
+    proc2, rec2 = run_bench({"BENCH_WARMBOOT": "1", "BENCH_GRID": "48",
+                             "BENCH_LADDER": "48", "BENCH_ACCURACY": "0",
+                             "BENCH_WARMBOOT_DIR": str(store)})
+    assert proc2.returncode == 0
+    assert rec2["store_hits"] == 1
+    assert rec2["store_misses"] == 0
+    assert rec2["warmboot_speedup"] > 0
+
+
+def test_bench_scrubs_leaked_program_store():
+    # a store dir leaked from a developer shell must not silently
+    # warm-boot a headline measurement's compiles
+    proc, rec = run_bench({"NLHEAT_PROGRAM_STORE": "/tmp/leaked-store",
+                           "BENCH_ACCURACY": "0"})
+    assert proc.returncode == 0
+    assert "scrubbed leaked NLHEAT_PROGRAM_STORE" in proc.stderr
+    assert rec["value"] > 0  # the measurement itself is unaffected
+
+
 def test_bench_multichip_mode_emits_halo_overlap():
     # BENCH_MULTICHIP=N: the sharded-solving A/B — the distributed 2D
     # solver over one shared N-device mesh, collective vs FUSED halo

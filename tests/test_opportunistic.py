@@ -23,7 +23,7 @@ ALL_STEPS = [
     "bench4096", "resident512", "carried4096", "superstep2",
     "bf16-4096", "bf16-carried4096", "ensemble8x1024", "serve8x1024",
     "servefault8x1024", "obs8x1024", "multichip1024", "fft4096",
-    "tta4096",
+    "tta4096", "warmboot1024",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
@@ -44,7 +44,8 @@ def _run(tmp_path, leave_undone, extra_env, timeout=560):
     # (same hygiene as tests/test_bench_harness.py)
     for k in ("BENCH_PLATFORM", "BENCH_CARRIED", "BENCH_RESIDENT",
               "BENCH_FAULT", "BENCH_METHOD", "BENCH_GRID", "BENCH_LADDER",
-              "BENCH_ACCURACY", "NLHEAT_TM"):
+              "BENCH_ACCURACY", "NLHEAT_TM", "BENCH_WARMBOOT",
+              "NLHEAT_PROGRAM_STORE"):
         env.pop(k, None)
     env.update(
         OPP_GATE_BACKEND="cpu",
@@ -153,6 +154,32 @@ def test_tta_step_banks_steps_to_solution_evidence(tmp_path):
     assert "fail:" not in state
     assert '"variant": "tta"' in table
     assert '"steps_ratio"' in table and '"tta"' in table
+
+
+@pytest.mark.slow  # ~45 s (a gate bench + the warmboot A/B child) — the
+# cold/warm machinery is tier-1-covered by tests/test_bench_harness.py
+# and tests/test_program_store.py; this proves the queue's gate parses
+# the speedup/hit/bit-identity fields before banking
+def test_warmboot_step_banks_store_evidence(tmp_path):
+    store_dir = tmp_path / "program_store"
+    proc, state, table, _out = _run(
+        tmp_path, "warmboot1024",
+        # the >= 2x ratio is real on CPU too (compile >> load), but a
+        # millisecond-scale proxy under CI load is noisy — keep the
+        # structural gate tight on fields, relaxed on the ratio
+        {"OPP_GRID_ENS": "24", "OPP_WB_DIR": str(store_dir),
+         "OPP_WB_MIN_SPEEDUP": "1.2"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    assert "warmboot1024\n" in state
+    assert "fail:" not in state
+    assert '"variant": "warmboot"' in table
+    assert '"warmboot_speedup"' in table
+    assert '"store_hits": 1' in table
+    assert '"bit_identical": true' in table
+    # the persistent store dir holds the serialized executable the next
+    # heal window will reuse
+    assert list(store_dir.glob("*.aotprog"))
 
 
 @pytest.mark.slow  # ~73 s: two strike rounds, each a full bench child plus

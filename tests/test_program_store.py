@@ -1,0 +1,556 @@
+"""AOT program store (serve/program_store.py) — ISSUE 9.
+
+Contracts pinned here, all on the f64 8-virtual-device CPU suite
+(tests/conftest.py):
+
+* a warm boot LOADS a stored executable with zero retrace/recompile
+  (spy counters on the engine's builder AND the store's compiler), and
+  the served results are bit-identical to a cold compile;
+* ``NLHEAT_PROGRAM_STORE=0``/unset restores pre-store behavior
+  bit-identically (and, for the solo maker, object-identically: the
+  exact donated-jit wrapper the maker returned before the store
+  existed);
+* every refusal is LOUD and typed, and always falls back to a fresh
+  compile, never to wrong results: version-fingerprint mismatch,
+  topology mismatch, truncated/corrupt entries (the checkpoint CRC
+  discipline), foreign files, deserialization failure;
+* concurrent writers (two processes, same key) leave a loadable store
+  (atomic_file: unique tmp + atomic replace);
+* the engine's in-memory program cache is a bounded LRU whose eviction
+  never changes served results.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from nonlocalheatequation_tpu.serve import program_store as ps
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase,
+    EnsembleEngine,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cases(n=3, shape=(24, 24), nt=3, eps=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        EnsembleCase(shape=shape, nt=nt, eps=eps, k=1.0, dt=1e-5,
+                     dh=1.0 / shape[0], test=False,
+                     u0=rng.normal(size=shape))
+        for _ in range(n)
+    ]
+
+
+def _entries(d):
+    return sorted(p for p in os.listdir(d) if p.endswith(".aotprog"))
+
+
+# -- hit path: zero retrace/recompile, bit-identical ------------------------
+
+
+def test_warm_boot_zero_retrace_bit_identical(tmp_path, monkeypatch):
+    cases = _cases()
+    base = EnsembleEngine(method="conv").run(cases)  # storeless oracle
+
+    builds = {"n": 0}
+    real_build = EnsembleEngine._build_program
+
+    def spy_build(self, *a, **kw):
+        builds["n"] += 1
+        return real_build(self, *a, **kw)
+
+    compiles = {"n": 0}
+    real_compile = ps.ProgramStore._compile
+
+    def spy_compile(self, *a, **kw):
+        compiles["n"] += 1
+        return real_compile(self, *a, **kw)
+
+    monkeypatch.setattr(EnsembleEngine, "_build_program", spy_build)
+    monkeypatch.setattr(ps.ProgramStore, "_compile", spy_compile)
+
+    d = str(tmp_path)
+    cold_eng = EnsembleEngine(method="conv", program_store=d)
+    cold = cold_eng.run(cases)
+    assert builds["n"] == 1 and compiles["n"] == 1
+    assert cold_eng.program_store.stats()["misses"] == 1
+    assert cold_eng.program_store.stats()["saves"] == 1
+    assert _entries(d)
+
+    warm_eng = EnsembleEngine(method="conv", program_store=d)
+    warm = warm_eng.run(cases)
+    # the warm boot never traced and never compiled: the stored
+    # executable is the program
+    assert builds["n"] == 1 and compiles["n"] == 1
+    assert warm_eng.program_store.stats() == {
+        "hits": 1, "misses": 0, "saves": 0, "refusals": {}}
+    for a, b, c in zip(base, cold, warm):
+        assert np.array_equal(a, b) and np.array_equal(a, c)
+    # honesty: a loaded program's strategy label says where it came from,
+    # and the counters split built (traced+compiled HERE) from loaded —
+    # a recompile watchdog reading programs-built must see zero on a
+    # fully warm boot
+    assert set(warm_eng.report.strategies.values()) == {"stored"}
+    assert cold_eng.report.programs_built == 1
+    assert cold_eng.report.programs_loaded == 0
+    assert warm_eng.report.programs_built == 0
+    assert warm_eng.report.programs_loaded == 1
+
+
+def test_store_off_is_todays_behavior_bit_identical(tmp_path, monkeypatch):
+    cases = _cases()
+    base = EnsembleEngine(method="conv").run(cases)
+    # explicit 0 disables even with a dir-shaped value around
+    monkeypatch.setenv("NLHEAT_PROGRAM_STORE", "0")
+    off = EnsembleEngine(method="conv")
+    got = off.run(cases)
+    assert off.program_store is None and off._store_resolved
+    for a, b in zip(base, got):
+        assert np.array_equal(a, b)
+    # the solo maker returns the EXACT pre-store object when off: the
+    # donated-jit wrapper, not a store wrapper (today's path, verbatim)
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp2D,
+        make_multi_step_fn_base,
+    )
+
+    monkeypatch.delenv("NLHEAT_PROGRAM_STORE")
+    op = NonlocalOp2D(2, k=1.0, dt=1e-5, dh=1.0 / 24, method="conv")
+    fn = make_multi_step_fn_base(op, 3)
+    assert fn.__qualname__.startswith("donated_jit")
+
+
+def test_solo_path_store_hit_bit_identical(tmp_path, monkeypatch):
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp2D,
+        make_multi_step_fn_base,
+    )
+
+    op = NonlocalOp2D(2, k=1.0, dt=1e-5, dh=1.0 / 24, method="conv")
+    u0 = np.random.default_rng(3).normal(size=(24, 24))
+    ref = np.asarray(make_multi_step_fn_base(op, 3)(jnp.asarray(u0), 0))
+
+    monkeypatch.setenv("NLHEAT_PROGRAM_STORE", str(tmp_path))
+    cold = np.asarray(make_multi_step_fn_base(op, 3)(jnp.asarray(u0), 0))
+    assert _entries(str(tmp_path))
+    warm = np.asarray(make_multi_step_fn_base(op, 3)(jnp.asarray(u0), 0))
+    assert np.array_equal(ref, cold) and np.array_equal(ref, warm)
+    # non-zero start steps reuse the same executable (t0 is an argument)
+    shifted = np.asarray(make_multi_step_fn_base(op, 3)(jnp.asarray(u0), 5))
+    assert shifted.shape == ref.shape
+
+
+# -- refusals: loud, typed, always recovered --------------------------------
+
+
+def _store_one(tmp_path):
+    """One populated store dir + the oracle results + the case set."""
+    cases = _cases()
+    eng = EnsembleEngine(method="conv", program_store=str(tmp_path))
+    out = eng.run(cases)
+    (entry,) = _entries(str(tmp_path))
+    return cases, out, os.path.join(str(tmp_path), entry)
+
+
+def _rerun(tmp_path, cases):
+    eng = EnsembleEngine(method="conv", program_store=str(tmp_path))
+    return eng.run(cases), eng.program_store.stats()
+
+
+def test_fingerprint_mismatch_refuses_and_recompiles(
+        tmp_path, monkeypatch, capsys):
+    from nonlocalheatequation_tpu.utils import compat
+
+    cases, out, _entry = _store_one(tmp_path)
+    real_fp = compat.aot_fingerprint()
+
+    def other_build():
+        fp = dict(real_fp)
+        fp["jaxlib"] = "9.9.9"
+        return fp
+
+    monkeypatch.setattr(ps.compat, "aot_fingerprint", other_build)
+    got, stats = _rerun(tmp_path, cases)
+    assert stats["hits"] == 0
+    assert stats["refusals"] == {ps.REFUSE_FINGERPRINT: 1}
+    for a, b in zip(out, got):
+        assert np.array_equal(a, b)  # fresh compile, same results
+    err = capsys.readouterr().err
+    assert "fingerprint-mismatch" in err and "falling back" in err
+
+
+def test_topology_mismatch_refuses_and_recompiles(
+        tmp_path, monkeypatch, capsys):
+    cases, out, _entry = _store_one(tmp_path)
+    real_topo = ps.topology_fingerprint()
+
+    def other_topo(backend=None):
+        t = dict(real_topo)
+        t["devices"] = 1024
+        return t
+
+    monkeypatch.setattr(ps, "topology_fingerprint", other_topo)
+    got, stats = _rerun(tmp_path, cases)
+    assert stats["hits"] == 0
+    assert stats["refusals"] == {ps.REFUSE_TOPOLOGY: 1}
+    for a, b in zip(out, got):
+        assert np.array_equal(a, b)
+    assert "topology-mismatch" in capsys.readouterr().err
+
+
+@pytest.mark.parametrize("mutate", ["truncate", "flip", "foreign"])
+def test_corrupt_entry_refuses_and_recompiles(
+        tmp_path, mutate, capsys):
+    cases, out, entry = _store_one(tmp_path)
+    raw = open(entry, "rb").read()
+    if mutate == "truncate":
+        open(entry, "wb").write(raw[: len(raw) // 2])
+    elif mutate == "flip":
+        body = bytearray(raw)
+        body[-10] ^= 0xFF  # payload bit-rot: the CRC must catch it
+        open(entry, "wb").write(bytes(body))
+    else:
+        open(entry, "wb").write(b"not a program store entry")
+    got, stats = _rerun(tmp_path, cases)
+    assert stats["hits"] == 0
+    assert stats["refusals"] == {ps.REFUSE_CORRUPT: 1}
+    for a, b in zip(out, got):
+        assert np.array_equal(a, b)
+    assert "corrupt" in capsys.readouterr().err
+    # the refused entry was re-persisted by the fresh compile and loads
+    # cleanly on the next boot
+    got2, stats2 = _rerun(tmp_path, cases)
+    assert stats2["hits"] == 1 and stats2["refusals"] == {}
+    for a, b in zip(out, got2):
+        assert np.array_equal(a, b)
+
+
+def test_unsupported_serialization_degrades_loudly(
+        tmp_path, monkeypatch, capsys):
+    # a build with no serialize_executable at all: the store refuses
+    # ONCE (loudly), every program runs the plain fresh-compile path,
+    # results are unchanged
+    monkeypatch.setattr(ps.compat, "aot_serialize_supported", lambda: False)
+    cases = _cases()
+    base = EnsembleEngine(method="conv").run(cases)
+    eng = EnsembleEngine(method="conv", program_store=str(tmp_path))
+    got = eng.run(cases)
+    for a, b in zip(base, got):
+        assert np.array_equal(a, b)
+    assert eng.program_store.stats()["refusals"] == {
+        ps.REFUSE_UNSUPPORTED: 1}
+    assert not _entries(str(tmp_path))
+    assert "unsupported" in capsys.readouterr().err
+
+
+# -- concurrency: two-process writer race -----------------------------------
+
+_RACE_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase, EnsembleEngine)
+rng = np.random.default_rng(0)
+cases = [EnsembleCase(shape=(24, 24), nt=3, eps=2, k=1.0, dt=1e-5,
+                      dh=1.0 / 24, test=False,
+                      u0=rng.normal(size=(24, 24))) for _ in range(3)]
+eng = EnsembleEngine(method="conv", program_store=sys.argv[1])
+out = eng.run(cases)
+np.save(sys.argv[2], np.stack(out))
+st = eng.program_store.stats()
+print("STATS", st["hits"], st["misses"], st["saves"])
+"""
+
+
+def test_two_process_writer_race_leaves_loadable_store(tmp_path):
+    # both processes compute the SAME key concurrently; atomic_file's
+    # host+pid-unique tmp + os.replace means both may save, the last
+    # replace wins, and no reader can ever observe a torn entry
+    d = str(tmp_path / "store")
+    env = dict(os.environ)
+    env.pop("NLHEAT_PROGRAM_STORE", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RACE_CHILD, d,
+             str(tmp_path / f"out{i}.npy")],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        for i in range(2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err[-800:]
+        assert "STATS" in out
+    a = np.load(tmp_path / "out0.npy")
+    b = np.load(tmp_path / "out1.npy")
+    assert np.array_equal(a, b)
+    assert len(_entries(d)) == 1  # one key, one complete winner
+    # a third boot must warm-load the raced entry and agree bitwise
+    cases, stats = _cases(), None
+    eng = EnsembleEngine(method="conv", program_store=d)
+    got = eng.run(cases)
+    stats = eng.program_store.stats()
+    assert stats["hits"] == 1 and stats["refusals"] == {}
+    assert np.array_equal(np.stack(got), a)
+
+
+# -- LRU program cache ------------------------------------------------------
+
+
+def test_lru_eviction_never_changes_results():
+    # two buckets, cap 1: every build evicts the other bucket's program;
+    # results must equal the uncapped engine's bit for bit
+    cases = _cases(3, shape=(24, 24)) + _cases(2, shape=(16, 16), seed=7)
+    base = EnsembleEngine(method="conv").run(cases)
+    capped = EnsembleEngine(method="conv", program_cache_cap=1)
+    got = capped.run(cases)
+    for a, b in zip(base, got):
+        assert np.array_equal(a, b)
+    assert capped.report.programs_resident == 1
+    assert capped.report.programs_evicted >= 1
+    # rerunning re-builds evicted programs transparently, same results
+    got2 = capped.run(cases)
+    for a, b in zip(base, got2):
+        assert np.array_equal(a, b)
+
+
+def test_lru_counters_in_registry_and_cap_validation():
+    eng = EnsembleEngine(method="conv", program_cache_cap=2)
+    eng.run(_cases(2))
+    r = eng.report.registry
+    assert r.get("/store/resident-programs").value == 1
+    assert r.get("/store/evictions").value == 0
+    # the repo-wide 0-knob convention: 0 = cap OFF (unbounded, the
+    # pre-LRU behavior), only negatives are malformed
+    unbounded = EnsembleEngine(method="conv", program_cache_cap=0)
+    assert unbounded.program_cache_cap == float("inf")
+    unbounded.run(_cases(2) + _cases(2, shape=(16, 16), seed=5))
+    assert unbounded.report.programs_evicted == 0
+    with pytest.raises(ValueError, match="program_cache_cap"):
+        EnsembleEngine(method="conv", program_cache_cap=-1)
+
+
+def test_lru_cap_env_knob(monkeypatch):
+    monkeypatch.setenv("NLHEAT_PROGRAM_CACHE_CAP", "1")
+    eng = EnsembleEngine(method="conv")
+    assert eng.program_cache_cap == 1
+
+
+# -- serving pipeline + fallback share one namespace ------------------------
+
+
+def test_pipeline_serves_from_store_and_reports_metrics(tmp_path):
+    from nonlocalheatequation_tpu.serve.server import ServePipeline
+
+    cases = _cases(5)
+    offline = EnsembleEngine(method="conv").run(cases)
+    d = str(tmp_path)
+    # boot 1 populates; boot 2 must serve every chunk from the store
+    for boot in range(2):
+        pipe = ServePipeline(method="conv", depth=2, window_ms=0.0,
+                             program_store=d)
+        got = pipe.serve_cases(cases)
+        m = pipe.metrics()
+        pipe.close()
+        for a, b in zip(offline, got):
+            assert np.array_equal(a, b)
+        assert set(m["store"]) == {
+            "hits", "misses", "saves", "refusals", "load_ms",
+            "serialize_ms", "resident_programs", "evictions"}
+        if boot == 1:
+            assert m["store"]["hits"] >= 1 and m["store"]["misses"] == 0
+    # the registry expositions carry the /store metrics too
+    assert "nlheat_store_hits" in pipe.report.registry.prometheus()
+
+
+def test_cpu_fallback_sibling_keys_by_backend(tmp_path):
+    # the fallback sibling shares the store NAMESPACE (one store object)
+    # but its digests pin backend="cpu" — on real hardware a TPU-compiled
+    # entry and its CPU-fallback twin can never collide.  On this CPU
+    # suite both engines resolve to the same backend, so the sibling
+    # legitimately HITS the device engine's entry (same program, same
+    # backend); the backend separation itself is pinned on the digest.
+    from nonlocalheatequation_tpu.serve.resilience import CpuFallback
+
+    d = str(tmp_path)
+    cases = _cases(2)
+    eng = EnsembleEngine(method="conv", program_store=d,
+                         batch_sizes=(1, 2))
+    out = eng.run(cases)
+    fb = CpuFallback(eng)
+    key = cases[0].bucket_key()
+    padded = eng.pad_chunk(list(cases))
+    fb_out = fb.run_chunk(key, padded)
+    sib = fb._engines["conv"]
+    assert sib.store_backend == "cpu"
+    assert sib.program_store is eng.program_store  # one shared namespace
+    assert eng.program_store.stats()["refusals"] == {}
+    for a, b in zip(out, fb_out):
+        assert np.array_equal(a, np.asarray(b))
+    # the backend is load-bearing in the key: same program key, avals,
+    # and donation, different backend -> different digest
+    assert ps._digest("k", "a", False, "tpu") != \
+        ps._digest("k", "a", False, "cpu")
+
+
+def test_engine_settings_outside_prog_key_separate_store_entries(tmp_path):
+    # the in-memory prog_key omits method/precision/ksteps (they are
+    # fixed per engine), but the shared store must key on them: two
+    # engines differing ONLY there can never load each other's
+    # executables (review finding, round 11)
+    d = str(tmp_path)
+    cases = _cases()
+    a = EnsembleEngine(method="conv", program_store=d)
+    out_a = a.run(cases)
+    assert a.program_store.stats()["misses"] == 1
+    for other in (EnsembleEngine(method="shift", program_store=d),
+                  EnsembleEngine(method="conv", precision="bf16",
+                                 program_store=d)):
+        got = other.run(cases)
+        st = other.program_store.stats()
+        assert st["hits"] == 0, f"{other.method}/{other.precision} hit!"
+        assert len(got) == len(out_a)
+    # same settings -> hit, as before
+    b = EnsembleEngine(method="conv", program_store=d)
+    out_b = b.run(cases)
+    assert b.program_store.stats()["hits"] == 1
+    for x, y in zip(out_a, out_b):
+        assert np.array_equal(x, y)
+
+
+def test_trace_env_knobs_join_the_digest(tmp_path, monkeypatch):
+    # a tile-size A/B (NLHEAT_TM) builds a DIFFERENT kernel for the same
+    # logical key: the digest must separate them so a warm boot can never
+    # serve the other arm's executable (review finding, round 11)
+    cases, _out, _entry = _store_one(tmp_path)
+    plain = ps._digest("k", "a", False, "cpu")
+    monkeypatch.setenv("NLHEAT_TM", "128")
+    assert ps._digest("k", "a", False, "cpu") != plain
+    _got, stats = _rerun(tmp_path, cases)
+    assert stats["hits"] == 0 and stats["misses"] == 1
+
+
+def test_solo_wrapper_non_int_t0_falls_back(tmp_path, monkeypatch):
+    # a typed-array t0 (e.g. an autotune probe's jnp scalar) mismatches
+    # the weak-int aval store programs are lowered for: the wrapper must
+    # route such calls through the jit path, never raise (review finding)
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp2D,
+        make_multi_step_fn_base,
+    )
+
+    op = NonlocalOp2D(2, k=1.0, dt=1e-5, dh=1.0 / 24, method="conv")
+    u0 = np.random.default_rng(3).normal(size=(24, 24))
+    monkeypatch.setenv("NLHEAT_PROGRAM_STORE", str(tmp_path))
+    fn = make_multi_step_fn_base(op, 3)
+    ref = np.asarray(fn(jnp.asarray(u0), 0))  # int t0: the store path
+    typed = np.asarray(fn(jnp.asarray(u0), jnp.int32(0)))
+    assert np.array_equal(ref, typed)
+
+
+def test_solo_store_counters_reach_process_registry(tmp_path, monkeypatch):
+    import jax.numpy as jnp
+
+    from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+    from nonlocalheatequation_tpu.ops.nonlocal_op import (
+        NonlocalOp2D,
+        make_multi_step_fn_base,
+    )
+
+    op = NonlocalOp2D(2, k=1.0, dt=1e-5, dh=1.0 / 24, method="conv")
+    u0 = np.random.default_rng(3).normal(size=(24, 24))
+    monkeypatch.setenv("NLHEAT_PROGRAM_STORE", str(tmp_path))
+    hits0 = getattr(REGISTRY.get("/store/hits"), "value", 0)
+    make_multi_step_fn_base(op, 4)(jnp.asarray(u0), 0)
+    make_multi_step_fn_base(op, 4)(jnp.asarray(u0), 0)  # fresh maker: hit
+    assert REGISTRY.get("/store/hits").value == hits0 + 1
+
+
+def test_donation_flip_rematerializes_store_backed_program(
+        tmp_path, monkeypatch):
+    # store-materialized programs are donation-FIXED binaries, unlike
+    # the lazy donated_jit wrappers the plain path caches — the donate
+    # decision must join the in-memory cache key so a donation flip
+    # (or a depth>1 pipeline pinning donation off) never dispatches a
+    # stale donating executable (review finding, round 11)
+    cases = _cases()
+    base = EnsembleEngine(method="conv").run(cases)
+    monkeypatch.setenv("NLHEAT_DONATE", "1")
+    eng = EnsembleEngine(method="conv", program_store=str(tmp_path))
+    got1 = eng.run(cases)
+    assert len(eng._programs) == 1
+    monkeypatch.setenv("NLHEAT_DONATE", "0")
+    got2 = eng.run(cases)
+    # the flip re-materialized under a new (prog_key, donate) entry
+    assert len(eng._programs) == 2
+    for a, b, c in zip(base, got1, got2):
+        assert np.array_equal(a, b) and np.array_equal(a, c)
+
+
+def test_pipeline_adopting_prewarmed_engine_keeps_store_metrics(tmp_path):
+    # an engine that ran BEFORE pipeline construction bound its store
+    # metrics to the engine report's registry; the pipeline replaces the
+    # report, and the store must re-bind — pipe.metrics()["store"] has
+    # to see the serve-time hits, not zeros (review finding, round 11)
+    from nonlocalheatequation_tpu.serve.server import ServePipeline
+
+    cases = _cases()
+    eng = EnsembleEngine(method="conv", program_store=str(tmp_path))
+    eng.run(cases)  # pre-warm: resolves the store on eng's own report
+    serve_cases = _cases(2, shape=(16, 16), seed=9)  # a fresh bucket
+    offline = EnsembleEngine(method="conv").run(serve_cases)
+    pipe = ServePipeline(engine=eng, depth=2, window_ms=0.0)
+    got = pipe.serve_cases(serve_cases)
+    m = pipe.metrics()
+    pipe.close()
+    for a, b in zip(offline, got):
+        assert np.array_equal(a, b)
+    # the serve-time store activity (fresh bucket -> miss + save) is
+    # visible through the PIPELINE's registry, not lost on the old one
+    assert m["store"]["misses"] >= 1 and m["store"]["saves"] >= 1
+
+
+# -- store internals --------------------------------------------------------
+
+
+def test_env_dir_resolution(monkeypatch):
+    monkeypatch.delenv("NLHEAT_PROGRAM_STORE", raising=False)
+    assert ps.store_dir_from_env() is None
+    monkeypatch.setenv("NLHEAT_PROGRAM_STORE", "0")
+    assert ps.store_dir_from_env() is None
+    monkeypatch.setenv("NLHEAT_PROGRAM_STORE", "1")
+    assert ps.store_dir_from_env() == ps.DEFAULT_DIR
+    monkeypatch.setenv("NLHEAT_PROGRAM_STORE", "/tmp/somewhere")
+    assert ps.store_dir_from_env() == "/tmp/somewhere"
+
+
+def test_store_spans_are_emitted(tmp_path):
+    from nonlocalheatequation_tpu.obs.trace import Tracer, set_tracer
+
+    tracer = Tracer()
+    set_tracer(tracer)
+    try:
+        cases = _cases()
+        EnsembleEngine(method="conv", program_store=str(tmp_path)).run(cases)
+        EnsembleEngine(method="conv", program_store=str(tmp_path)).run(cases)
+    finally:
+        set_tracer(None)
+    names = {e["name"] for e in tracer.events}
+    assert "store.save" in names and "store.load" in names

@@ -18,7 +18,8 @@ Env:
     utils/faults.py grammar; default "raise@1,stall@3,nan@5"),
     BT_OBS_ITERS (5, min-of iterations for the obs group's
     traced-vs-untraced A/B — the overhead ratio is a difference of two
-    near-equal walls, so it needs more samples than the big ratios)
+    near-equal walls, so it needs more samples than the big ratios),
+    BT_WB_GRID (1024 / 64, the warmboot group's cold-vs-warm boot grid)
 """
 
 from __future__ import annotations
@@ -887,6 +888,57 @@ def bench_tta(steps: int):
              seconds_to_target_ratio=round(sec_e / sec, 3))
 
 
+def bench_warmboot(steps: int):
+    """Cold-vs-warm boot A/B (ISSUE 9, serve/program_store.py):
+    time-to-first-served-chunk for one production chunk, measured three
+    ways over one shared AOT store dir — storeless (the honest cold
+    boot: full trace+compile), store-populating, and a FRESH engine
+    that must LOAD the serialized executable (zero retrace/recompile).
+    The warm row records ``warmboot_speedup`` = cold/warm plus the
+    store's hit/miss counters and ``bit_identical`` (a loaded
+    executable must reproduce the cold compile's bytes).  The XLA
+    persistent cache is not pinned off here (bench.py's rung owns the
+    calibrated ratio); this group is the machinery row."""
+    import shutil
+    import tempfile
+
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+    from nonlocalheatequation_tpu.serve.ensemble import (
+        EnsembleCase,
+        EnsembleEngine,
+    )
+
+    n = cfg("BT_WB_GRID", 1024, 64)
+    method = "pallas" if on_tpu() else "sat"
+    op = NonlocalOp2D(8, k=1.0, dt=1.0, dh=1.0 / n, method=method)
+    dt = stable_dt(op)
+    rng = np.random.default_rng(0)
+    u0 = rng.normal(size=(n, n))
+    case = EnsembleCase(shape=(n, n), nt=steps, eps=8, k=1.0, dt=dt,
+                        dh=1.0 / n, test=False, u0=u0)
+
+    def first_chunk(store):
+        engine = EnsembleEngine(method=method, batch_sizes=(1,),
+                                program_store=store)
+        t0 = time.perf_counter()
+        out = engine.run([case])[0]  # the np fetch is a true fence
+        return time.perf_counter() - t0, out, engine
+
+    store_dir = tempfile.mkdtemp(prefix="nlheat-bt-warmboot-")
+    try:
+        cold_s, out_cold, _ = first_chunk(None)
+        _pop_s, _out_pop, eng_pop = first_chunk(store_dir)
+        warm_s, out_warm, eng_warm = first_chunk(store_dir)
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+    emit("warmboot/cold", n * n, steps, cold_s, grid=n, eps=8)
+    emit("warmboot/warm", n * n, steps, warm_s, grid=n, eps=8,
+         warmboot_speedup=round(cold_s / warm_s, 4),
+         store_hits=eng_warm.program_store.stats()["hits"],
+         store_misses=eng_pop.program_store.stats()["misses"],
+         bit_identical=bool(np.array_equal(out_cold, out_warm)))
+
+
 def bench_multichip(steps: int):
     """Fused-vs-collective halo A/B (round 9, ops/pallas_halo.py): the
     distributed 2D solver over ONE shared device mesh, collective halos
@@ -944,6 +996,7 @@ BENCHES = {
     "resilience": bench_resilience,
     "multichip": bench_multichip,
     "tta": bench_tta,
+    "warmboot": bench_warmboot,
 }
 
 
@@ -966,6 +1019,9 @@ def main() -> int:
     # into evidence rows; the resilience group injects its own plan
     # explicitly (BT_FAULT_PLAN)
     os.environ.pop("NLHEAT_FAULT_PLAN", None)
+    # a leaked program-store dir would silently warm-boot every row's
+    # compile; the warmboot group attaches its own store dir explicitly
+    os.environ.pop("NLHEAT_PROGRAM_STORE", None)
     steps = int(os.environ.get("BT_STEPS", 20))
     names = [a for a in sys.argv[1:] if not a.startswith("-")] or list(BENCHES)
     log(f"backend={jax.default_backend()} devices={len(jax.devices())} "

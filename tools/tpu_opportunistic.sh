@@ -99,7 +99,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 #             tables, profile
 STEPS="bench4096 resident512 carried4096 superstep2 \
 bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 servefault8x1024 \
-obs8x1024 multichip1024 fft4096 tta4096 \
+obs8x1024 multichip1024 fft4096 tta4096 warmboot1024 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -236,6 +236,23 @@ run_step_cmd() {  # the queue's one name->command map
       # cannot bank the step.
       bench_nofb BENCH_TTA=1 BENCH_GRID="${OPP_GRID_TTA:-$GRID_LG}" \
         BENCH_LADDER="${OPP_GRID_TTA:-$GRID_LG}" BENCH_ACCURACY=0 ;;
+    warmboot1024)
+      # cold-vs-warm boot A/B (ISSUE 9, serve/program_store.py): the
+      # rung's cold arm pays a full on-device trace+compile (the rung
+      # pins the XLA persistent cache off for itself), the warm arm
+      # must LOAD a serialized AOT executable from the PERSISTENT store
+      # dir below — which also means queue steps in LATER heal windows
+      # reuse THIS window's compiles, the flaky-tunnel payoff the store
+      # exists for.  Gate (step_variant_ok): variant warmboot,
+      # warmboot_speedup >= 2 (OPP_WB_MIN_SPEEDUP), store_hits >= 1,
+      # bit_identical — a run where the store silently degraded to
+      # fresh compiles cannot bank the step.  No mkdir here: the store
+      # creates its own dir 0700 (serve/program_store.py trust
+      # boundary — a pre-made 0755 dir would defeat it).
+      bench_nofb BENCH_WARMBOOT=1 \
+        BENCH_WARMBOOT_DIR="${OPP_WB_DIR:-docs/bench/program_store}" \
+        BENCH_GRID="${OPP_GRID_ENS:-1024}" \
+        BENCH_LADDER="${OPP_GRID_ENS:-1024}" BENCH_ACCURACY=0 ;;
     superstep2-tm128)
       bench_nofb BENCH_SUPERSTEP=2 NLHEAT_TM=128 BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
@@ -388,6 +405,33 @@ for line in open(sys.argv[1]):
     if not isinstance(ratio, (int, float)) or ratio < limit:
         continue
     if arms.get(win, {}).get("met_target") is True:
+        ok = True
+sys.exit(0 if ok else 1)
+PYEOF
+      ;;
+    warmboot1024) python - "$2" <<'PYEOF'
+import json, os, sys
+# the >= 2x cold->warm first-chunk acceptance gate (ISSUE 9); the CI
+# smoke harness can relax it via OPP_WB_MIN_SPEEDUP (a millisecond-scale
+# CPU-proxy compile makes the ratio large but noisy — the smoke run
+# proves the gate STRUCTURE: variant label, a counted store hit, and
+# the bit-identity flag)
+limit = float(os.environ.get("OPP_WB_MIN_SPEEDUP", "2"))
+ok = False
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    if r.get("variant") != "warmboot":
+        continue
+    speedup, hits = r.get("warmboot_speedup"), r.get("store_hits")
+    if not isinstance(speedup, (int, float)) or speedup < limit:
+        continue
+    if isinstance(hits, int) and hits >= 1 and r.get("bit_identical") is True:
         ok = True
 sys.exit(0 if ok else 1)
 PYEOF

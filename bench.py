@@ -107,6 +107,20 @@ must equal the cold compile's bytes); the XLA persistent compile cache
 is pinned OFF for this rung so the cold arm is genuinely cold.  A
 leaked ambient NLHEAT_PROGRAM_STORE is scrubbed from every bench run —
 only this rung's explicit store dirs may warm a measurement),
+BENCH_ROUTER=N (N >= 2: the replica-fleet A/B — ISSUE 10,
+serve/router.py + serve/http.py: BENCH_ROUTER_CASES mixed-bucket
+production cases served by a 1-replica and an N-replica router over ONE
+shared AOT store dir (BENCH_ROUTER_DIR; a fresh temp dir by default) —
+the fleet arm warm-boots the single arm's compiles — then an
+offered-load sweep through the admission gate: a paced 2x-capacity
+point and a burst point that must SHED (429-shaped) instead of queueing.
+The rung is labeled "variant": "routerN" and carries "replicas" /
+"router_speedup" / "throughput_cases_s" / "accepted" / "shed" /
+"latency_ms" (paced-point accepted p50/p99 + unloaded p99) /
+"load_sweep" / "bit_identical".  Every worker gets the same fixed
+CPU-core budget in both arms — the CPU proxy of per-replica hardware;
+requires BENCH_PLATFORM=cpu, because N replica processes cannot share
+the single tunneled chip),
 BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
@@ -329,7 +343,11 @@ class Best:
                 # warmboot rung: the AOT-program-store evidence (ISSUE 9)
                 "cold_first_chunk_s", "warm_first_chunk_s",
                 "warmboot_speedup", "store_hits", "store_misses",
-                "bit_identical")
+                "bit_identical",
+                # router rung: the replica-fleet scale-out + overload-
+                # honesty evidence (ISSUE 10)
+                "replicas", "router_speedup", "throughput_cases_s",
+                "accepted", "shed", "load_sweep")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -872,14 +890,26 @@ def child_measure():
     mchip = int(os.environ.get("BENCH_MULTICHIP", 0) or 0)
     if mchip == 1:
         mchip = 0  # the A/B needs a mesh; 0/1 mean off
+    router_n = int(os.environ.get("BENCH_ROUTER", 0) or 0)
+    if router_n == 1:
+        router_n = 0  # the A/B needs a fleet; 0/1 mean off
     tta = os.environ.get("BENCH_TTA") == "1"
-    if warmboot and (tta or srv or ens or mchip
+    if warmboot and (tta or srv or ens or mchip or router_n
                      or any(os.environ.get(k) for k in
                             ("BENCH_CARRIED", "BENCH_RESIDENT",
                              "BENCH_SUPERSTEP"))):
         log("BENCH_WARMBOOT set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
-            "MULTICHIP/CARRIED/RESIDENT/SUPERSTEP — the warmboot rung "
-            "is its own labeled variant")
+            "MULTICHIP/ROUTER/CARRIED/RESIDENT/SUPERSTEP — the warmboot "
+            "rung is its own labeled variant")
+        tta = False
+        srv = ens = mchip = router_n = 0
+    if router_n and (tta or srv or ens or mchip
+                     or any(os.environ.get(k) for k in
+                            ("BENCH_CARRIED", "BENCH_RESIDENT",
+                             "BENCH_SUPERSTEP"))):
+        log("BENCH_ROUTER set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
+            "MULTICHIP/CARRIED/RESIDENT/SUPERSTEP — the router rung is "
+            "its own labeled variant")
         tta = False
         srv = ens = mchip = 0
     if tta and (srv or ens or mchip or any(os.environ.get(k) for k in
@@ -993,6 +1023,114 @@ def child_measure():
                     warmboot_speedup=round(cold_s / warm_s, 3),
                     store_hits=warm_stats["hits"],
                     store_misses=pop_stats["misses"],
+                    bit_identical=bit,
+                )
+                last_op = op
+                any_rung = True
+                continue
+            if router_n:
+                # replica-fleet A/B (ISSUE 10, serve/router.py +
+                # serve/http.py): the SAME mixed-bucket case set served
+                # by a 1-replica and an N-replica router over ONE shared
+                # AOT store dir (arm 1 populates, the fleet warm-boots),
+                # then an offered-load sweep through the admission gate
+                # (a paced 2x-capacity point + a burst point that must
+                # SHED, not queue).  Every worker gets the same fixed
+                # CPU-core budget in both arms — the CPU proxy of
+                # per-replica hardware, so the ratio measures fleet
+                # scale-out, not intra-op threading.
+                if backend == "tpu":
+                    # N replica processes cannot share the single
+                    # tunneled chip (concurrent clients wedge it); the
+                    # fleet proxy is a HOST measurement by design
+                    raise RuntimeError(
+                        "BENCH_ROUTER needs BENCH_PLATFORM=cpu: replica "
+                        "fleets assume one accelerator per worker and "
+                        "the tunneled single chip cannot host N clients")
+                import shutil
+                import tempfile
+
+                from nonlocalheatequation_tpu.serve.ensemble import (
+                    EnsembleCase,
+                )
+                from nonlocalheatequation_tpu.serve.router import (
+                    router_load_ab,
+                )
+
+                C = int(os.environ.get("BENCH_ROUTER_CASES", 16))
+                buckets = max(router_n, min(8, C))
+                # per-case COMPUTE must dominate the router's per-case
+                # submit cost (pickling u0 scales with grid^2 exactly
+                # like compute, so steps is the honest lever): with thin
+                # cases the offering side is the bottleneck, the fleet
+                # never saturates, and the overload sweep measures the
+                # parent's pickler.  Floor the scan length at ~1e8
+                # pt-steps per case (~1500 steps at 256^2, ~100 at
+                # 1024^2); BENCH_ROUTER_STEPS overrides exactly.
+                rsteps = int(os.environ.get("BENCH_ROUTER_STEPS", 0) or 0) \
+                    or max(steps, int(1e8 // (grid * grid)) or 1)
+                rcases = [
+                    EnsembleCase(shape=(grid, grid),
+                                 nt=rsteps + (i % buckets), eps=EPS,
+                                 k=1.0, dt=dt, dh=1.0 / grid, test=False,
+                                 u0=rng.normal(size=(grid, grid)))
+                    for i in range(C)]
+                store_dir = os.environ.get("BENCH_ROUTER_DIR")
+                own_dir = store_dir is None
+                if own_dir:
+                    store_dir = tempfile.mkdtemp(prefix="nlheat-router-")
+                try:
+                    ab = router_load_ab(
+                        {"method": method, "precision": PRECISION,
+                         "batch_sizes": (1,)},
+                        rcases, router_n, store_dir)
+                finally:
+                    if own_dir:
+                        shutil.rmtree(store_dir, ignore_errors=True)
+                bit = all(np.array_equal(a, b) for a, b in
+                          zip(ab["results"][1], ab["results"][router_n]))
+                if not bit:
+                    log("WARNING: router arms are NOT bit-identical — "
+                        "routing must never change served results")
+                total_steps = sum(c.nt for c in rcases)
+                wall_n = ab["walls"][router_n]
+                burst = ab["sweep"]["burst"]
+                paced = ab["sweep"]["x2"]
+                log(f"rung {grid}^2 router: 1-replica "
+                    f"{ab['walls'][1]:.2f}s vs {router_n}-replica "
+                    f"{wall_n:.2f}s ({ab['speedup']:.2f}x); burst "
+                    f"accepted {burst['accepted']}/{burst['offered']} "
+                    f"shed {burst['shed']}")
+                value = grid * grid * total_steps / wall_n
+                event(
+                    event="rung",
+                    grid=grid,
+                    steps=rsteps,
+                    best_s=wall_n,
+                    ms_per_step=wall_n / rsteps * 1e3,
+                    value=value,
+                    variant=f"router{router_n}",
+                    replicas=router_n,
+                    cases=C,
+                    router_speedup=round(ab["speedup"], 3),
+                    throughput_cases_s=round(C / wall_n, 3),
+                    accepted=burst["accepted"],
+                    shed=burst["shed"],
+                    latency_ms={
+                        "p50": round(paced["latency_s"]["p50"] * 1e3, 3),
+                        "p99": round(paced["latency_s"]["p99"] * 1e3, 3),
+                        "unloaded_p99":
+                            ab["unloaded_latency_ms"].get("p99", 0.0),
+                    },
+                    load_sweep={
+                        lbl: {"rate_hz": run["rate_hz"],
+                              "offered": run["offered"],
+                              "accepted": run["accepted"],
+                              "shed": run["shed"],
+                              "max_pending": run["max_pending"],
+                              "p99_ms": round(
+                                  run["latency_s"]["p99"] * 1e3, 3)}
+                        for lbl, run in ab["sweep"].items()},
                     bit_identical=bit,
                 )
                 last_op = op

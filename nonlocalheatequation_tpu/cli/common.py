@@ -699,6 +699,135 @@ def add_serve_flags(p: argparse.ArgumentParser):
     )
 
 
+def add_listen_flags(p: argparse.ArgumentParser):
+    """--listen/--replicas: the network front door (serve/http.py +
+    serve/router.py) — the CLI stops reading cases from stdin and
+    serves them over HTTP from a replica fleet instead."""
+    p.add_argument(
+        "--listen",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve cases over HTTP on 127.0.0.1:PORT (0 picks a free "
+             "port, printed to stderr): POST /v1/cases submits, "
+             "GET /v1/cases/<id>[?wait=1] polls/waits, .../result "
+             "fetches, /healthz and /metrics expose the fleet.  "
+             "Admission control sheds with 429 + Retry-After before "
+             "any queue can grow without bound.  The process serves "
+             "until stdin reaches EOF, then drains and exits.",
+    )
+    p.add_argument(
+        "--replicas",
+        type=int,
+        default=1,
+        metavar="N",
+        help="--listen: size of the replica fleet — N ServePipeline "
+             "worker processes behind a sticky bucket-key router "
+             "(serve/router.py); all replicas share one AOT program "
+             "store (--program-store/NLHEAT_PROGRAM_STORE) so added "
+             "or respawned workers warm-boot instead of re-tracing",
+    )
+
+
+def validate_listen_args(args) -> str | None:
+    """The front-door flags' honesty checks (caller prints + exits 1)."""
+    if args.listen is None:
+        if getattr(args, "replicas", 1) != 1:
+            return "--replicas configures the --listen fleet; add --listen"
+        return None
+    if not 0 <= args.listen <= 65535:
+        return f"--listen must be in [0, 65535] (got {args.listen})"
+    if args.replicas < 1:
+        return f"--replicas needs N >= 1 (got {args.replicas})"
+    for flag, name in ((getattr(args, "test", False), "--test"),
+                       (getattr(args, "test_batch", False), "--test_batch"),
+                       (getattr(args, "ensemble", False), "--ensemble"),
+                       (getattr(args, "serve", 0), "--serve"),
+                       (getattr(args, "checkpoint", None), "--checkpoint"),
+                       (getattr(args, "resume", False), "--resume"),
+                       (getattr(args, "results", False), "--results"),
+                       (getattr(args, "log", False), "--log")):
+        if flag:
+            return (f"--listen serves cases over HTTP; {name} belongs to "
+                    "the stdin-driven modes — drop one of them")
+    if getattr(args, "resync", 0):
+        return ("--resync is not supported with --listen (the batched "
+                "paths have no per-step precision switch)")
+    return None
+
+
+def run_listen(args, engine_kwargs) -> int:
+    """The --listen driver shared by the solve CLIs: a replica fleet
+    (serve/router.py) behind the HTTP ingestion tier (serve/http.py),
+    serving until stdin reaches EOF — the stdin-as-lifetime contract
+    lets a supervisor stop the server by closing the pipe, and an
+    interactive run by Ctrl-D.  The router registry backs --metrics-port
+    and the final metrics dump becomes the --metrics-out payload."""
+    import json as _json
+
+    from nonlocalheatequation_tpu.serve.http import IngressServer
+    from nonlocalheatequation_tpu.serve.router import ReplicaRouter
+
+    serve_kwargs = {
+        "retries": args.serve_retries,
+        "fallback": args.serve_fallback,
+        "fetch_deadline_ms": args.serve_deadline_ms or None,
+        "nan_policy": args.serve_nan_policy,
+    }
+    # depth 1 per worker: the overlap a --serve depth buys in-process is
+    # the fleet's job here (N workers ARE the in-flight chunks), and
+    # depth 1 keeps each worker on the donating schedule
+    import threading
+
+    with ReplicaRouter(replicas=args.replicas,
+                       depth=1,
+                       window_ms=args.serve_window_ms,
+                       serve_kwargs=serve_kwargs,
+                       **engine_kwargs) as router:
+        set_live_registry(router.registry)
+        # the elastic loop: pull per-replica stats (absorbing each
+        # worker's registry under /replica{r} for the scrape) and run
+        # the busy-rate add/drain policy on a fixed cadence — without
+        # this timer the fleet would never scale and the per-replica
+        # namespaces would never populate
+        stop_scaling = threading.Event()
+
+        def _scale_loop():
+            while not stop_scaling.wait(10.0):
+                try:
+                    decision = router.maybe_scale()
+                    if decision:
+                        print(f"router: elastic {decision} -> "
+                              f"{router.live_count()} replica(s)",
+                              file=sys.stderr)
+                except Exception as e:  # noqa: BLE001 — scaling is
+                    # advisory; serving must survive a failed pull
+                    print(f"router: stats/scale pull failed ({e})",
+                          file=sys.stderr)
+
+        scaler = threading.Thread(target=_scale_loop, daemon=True,
+                                  name="nlheat-router-scaler")
+        scaler.start()
+        try:
+            with IngressServer(args.listen, router) as ingress:
+                print(f"ingress: http://127.0.0.1:{ingress.port}/v1/cases "
+                      f"({args.replicas} replica(s); POST to submit, "
+                      "/healthz, /metrics; EOF on stdin stops the server)",
+                      file=sys.stderr)
+                for _line in sys.stdin:  # lifetime = stdin
+                    pass
+            # the ingress is CLOSED before the drain: new submissions
+            # must stop landing or a busy server's shutdown drain could
+            # chase a never-emptying pending set into its timeout
+        finally:
+            stop_scaling.set()
+        router.drain()
+        line = _json.dumps(router.metrics())
+        print(f"router: {line}", file=sys.stderr)
+        set_metrics_payload(line)
+    return 0
+
+
 def serve_batch(case_iter, make_solver, engine_kwargs, args):
     """The --serve driver shared by the batch CLIs: stream parsed rows
     into a :class:`~nonlocalheatequation_tpu.serve.server.ServePipeline`,

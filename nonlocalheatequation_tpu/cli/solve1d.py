@@ -11,6 +11,7 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
+    add_listen_flags,
     add_obs_flags,
     add_program_store_flag,
     add_platform_flags,
@@ -24,11 +25,13 @@ from nonlocalheatequation_tpu.cli.common import (
     obs_session,
     publish_solve_metrics,
     run_batch,
+    run_listen,
     serve_batch,
     set_live_registry,
     set_metrics_payload,
     stepper_kwargs,
     validate_obs_args,
+    validate_listen_args,
     validate_serve_args,
     validate_stepper_args,
     version_banner,
@@ -66,6 +69,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_precision_flags(p)
     add_ensemble_flag(p)
     add_serve_flags(p)
+    add_listen_flags(p)
     add_obs_flags(p)
     add_program_store_flag(p)
     return p
@@ -91,14 +95,14 @@ def main(argv=None) -> int:
               "paths have no per-step precision switch)", file=sys.stderr)
         return 1
     err = (validate_stepper_args(args) or validate_serve_args(args)
-           or validate_obs_args(args))
+           or validate_listen_args(args) or validate_obs_args(args))
     if err:
         print(err, file=sys.stderr)
         return 1
     version_banner("1d_nonlocal")
     apply_platform(args)
     apply_program_store(args)
-    if not args.test_batch:
+    if not args.test_batch and args.listen is None:
         # ISSUE 8 bugfix: the bound actually in force, policed per stepper
         sk = stepper_kwargs(args)
         rc = announce_stable_dt(1, args.k, args.eps, args.dx, args.dt,
@@ -111,6 +115,13 @@ def main(argv=None) -> int:
 
 
 def _run(args) -> int:
+    if args.listen is not None:
+        # the network front door (serve/http.py + serve/router.py): a
+        # replica fleet over the same engine settings --serve would use
+        return run_listen(
+            args, {"method": ("fft" if args.method == "fft" else "auto"),
+                   "precision": args.precision, **stepper_kwargs(args)})
+
     if args.test_batch:
         # row: nx nt eps k dt dx  (tests/1d.txt)
         def read_case(toks, pos):

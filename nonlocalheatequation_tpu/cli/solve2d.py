@@ -12,6 +12,7 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
+    add_listen_flags,
     add_obs_flags,
     add_program_store_flag,
     add_platform_flags,
@@ -25,10 +26,12 @@ from nonlocalheatequation_tpu.cli.common import (
     obs_session,
     publish_solve_metrics,
     run_batch,
+    run_listen,
     serve_batch,
     set_live_registry,
     set_metrics_payload,
     stepper_kwargs,
+    validate_listen_args,
     validate_obs_args,
     validate_serve_args,
     validate_stepper_args,
@@ -69,6 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_precision_flags(p)
     add_ensemble_flag(p)
     add_serve_flags(p)
+    add_listen_flags(p)
     add_obs_flags(p)
     add_program_store_flag(p)
     return p
@@ -100,6 +104,7 @@ def main(argv=None) -> int:
         or validate_serve_args(args, [
             (args.serve and (args.checkpoint or args.resume),
              "--checkpoint/--resume cannot be combined with --serve")])
+        or validate_listen_args(args)
         or validate_obs_args(args))
     if err:
         print(err, file=sys.stderr)
@@ -107,7 +112,7 @@ def main(argv=None) -> int:
     version_banner("2d_nonlocal")
     apply_platform(args)
     apply_program_store(args)
-    if not args.test_batch:
+    if not args.test_batch and args.listen is None:
         # ISSUE 8 bugfix: print the stability bound actually in force
         # for the selected stepper and refuse (rc 2) an over-bound
         # explicit --dt on the opted-into super-stepping integrators
@@ -123,6 +128,13 @@ def main(argv=None) -> int:
 
 def _run(args) -> int:
     from nonlocalheatequation_tpu.models.solver2d import Solver2D
+
+    if args.listen is not None:
+        # the network front door (serve/http.py + serve/router.py): a
+        # replica fleet over the same engine settings --serve would use
+        return run_listen(args, {"method": args.method,
+                                 "precision": args.precision,
+                                 **stepper_kwargs(args)})
 
     def make_solver(nx, ny, nt, eps, k, dt, dh):
         return Solver2D(nx, ny, nt, eps, nlog=args.nlog, k=k, dt=dt, dh=dh,

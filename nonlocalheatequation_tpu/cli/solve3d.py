@@ -15,6 +15,7 @@ import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
     add_ensemble_flag,
+    add_listen_flags,
     add_obs_flags,
     add_program_store_flag,
     add_platform_flags,
@@ -30,11 +31,13 @@ from nonlocalheatequation_tpu.cli.common import (
     obs_session,
     publish_solve_metrics,
     run_batch,
+    run_listen,
     serve_batch,
     set_live_registry,
     set_metrics_payload,
     stepper_kwargs,
     validate_obs_args,
+    validate_listen_args,
     validate_serve_args,
     validate_stepper_args,
 )
@@ -83,6 +86,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_precision_flags(p)
     add_ensemble_flag(p)
     add_serve_flags(p)
+    add_listen_flags(p)
     add_obs_flags(p)
     add_program_store_flag(p)
     return p
@@ -149,6 +153,10 @@ def main(argv=None) -> int:
         (args.serve and args.distributed,
          "--serve runs the serial batched engine; it cannot be combined "
          "with --distributed")])
+        or validate_listen_args(args)
+        or (args.listen is not None and args.distributed
+            and "--listen runs the serial batched engine; it cannot be "
+                "combined with --distributed")
         or validate_obs_args(args))
     if err:
         print(err, file=sys.stderr)
@@ -164,7 +172,7 @@ def main(argv=None) -> int:
 
     multi = cli_startup(args, "3d_nonlocal", validate_multi=_need_distributed)
     apply_program_store(args)
-    if not args.test_batch:
+    if not args.test_batch and args.listen is None:
         # ISSUE 8 bugfix: the bound actually in force, policed per stepper
         sk = stepper_kwargs(args)
         rc = announce_stable_dt(3, args.k, args.eps, args.dh, args.dt,
@@ -198,6 +206,13 @@ def _run(args, multi: bool) -> int:
                         ncheckpoint=args.ncheckpoint,
                         precision=args.precision,
                         resync_every=args.resync, **stepper_kwargs(args))
+
+    if args.listen is not None:
+        # the network front door (serve/http.py + serve/router.py): a
+        # replica fleet over the same engine settings --serve would use
+        return run_listen(args, {"method": args.method,
+                                 "precision": args.precision,
+                                 **stepper_kwargs(args)})
 
     if args.test_batch:
         # row: nx ny nz nt eps k dt dh

@@ -32,19 +32,35 @@ import threading
 #: — a leaked developer setting must not make the suite write files).
 EVENT_LOG_ENV = "NLHEAT_EVENT_LOG"
 
+#: Env var carrying the replica id the fleet router (serve/router.py)
+#: assigns each worker process; EventLog stamps it (with the pid) on
+#: every line so N replicas appending to one JSONL path — or N per-replica
+#: files concatenated later — merge unambiguously.
+REPLICA_ID_ENV = "NLHEAT_REPLICA_ID"
+
 
 class EventLog:
-    """Append-only JSONL event stream.  ``emit`` never raises."""
+    """Append-only JSONL event stream.  ``emit`` never raises.
 
-    def __init__(self, path: str):
+    Every line carries ``pid`` and (when the process is a fleet worker,
+    ``NLHEAT_REPLICA_ID``) ``replica`` — the merge keys for multi-replica
+    streams; explicit event fields of the same name win."""
+
+    def __init__(self, path: str, replica: str | int | None = None):
         self.path = path
         self._lock = threading.Lock()
+        if replica is None:
+            replica = os.environ.get(REPLICA_ID_ENV)
+        self._stamp = {"pid": os.getpid()}
+        if replica is not None:
+            self._stamp["replica"] = int(replica) \
+                if str(replica).isdigit() else replica
         # line-buffered append: events from a crashed run survive
         self._f = open(path, "a", buffering=1)
 
     def emit(self, **event) -> None:
         try:
-            line = json.dumps(event, default=str)
+            line = json.dumps({**self._stamp, **event}, default=str)
             with self._lock:
                 self._f.write(line + "\n")
         except Exception:  # noqa: BLE001 — observability never raises
@@ -73,8 +89,42 @@ class EventLog:
             return None
 
 
+def merged_prometheus(registries) -> str:
+    """One text exposition covering several registries (the fleet
+    router's own registry plus any per-process ones).  Family TYPE lines
+    are deduplicated on first sight; callers keep metric NAMES disjoint
+    across registries (the router's per-replica ``/replica{r}`` prefixes
+    do) so each family's samples stay contiguous as the format wants."""
+    seen: set = set()
+    lines: list[str] = []
+    for reg in registries:
+        for line in reg.prometheus().splitlines():
+            if line.startswith("# TYPE"):
+                if line in seen:
+                    continue
+                seen.add(line)
+            if line:
+                lines.append(line)
+    return "\n".join(lines) + "\n"
+
+
+def merged_snapshot_json(registries) -> str:
+    """The one-line JSON twin of :func:`merged_prometheus` (later
+    registries win on a (disjoint-by-convention) name clash)."""
+    merged: dict = {}
+    for reg in registries:
+        merged.update(reg.snapshot())
+    return json.dumps(merged, default=float)
+
+
 class MetricsServer:
-    """The ``--metrics-port`` scrape endpoint (127.0.0.1 only)."""
+    """The ``--metrics-port`` scrape endpoint (127.0.0.1 only).
+
+    ``registry`` may be a registry, a zero-arg callable returning one
+    (a live binding), or — either way — a LIST/TUPLE of registries: the
+    scrape then AGGREGATES them into one exposition (the fleet form:
+    the router's registry, already carrying absorbed ``/replica{r}``
+    snapshots, plus any sibling process-local registries)."""
 
     def __init__(self, port: int, registry):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -85,11 +135,13 @@ class MetricsServer:
             def do_GET(self):  # noqa: N802 — http.server API
                 try:
                     reg = get_registry()
+                    regs = (list(reg) if isinstance(reg, (list, tuple))
+                            else [reg])
                     if self.path.startswith("/metrics.json"):
-                        body = reg.snapshot_json().encode()
+                        body = merged_snapshot_json(regs).encode()
                         ctype = "application/json"
                     elif self.path.startswith("/metrics"):
-                        body = reg.prometheus().encode()
+                        body = merged_prometheus(regs).encode()
                         ctype = "text/plain; version=0.0.4"
                     else:
                         self.send_error(404)

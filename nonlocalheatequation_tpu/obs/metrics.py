@@ -356,6 +356,39 @@ def _num(v) -> str:
     return format(float(v), ".10g")
 
 
+def absorb_snapshot(registry: MetricsRegistry, prefix: str,
+                    snapshot: dict) -> None:
+    """Flatten a FOREIGN registry snapshot (another process's
+    ``MetricsRegistry.snapshot()``, shipped over an IPC boundary) into
+    ``registry`` under ``prefix`` — the replica router's per-replica
+    metric namespaces: a worker's ``/serve/retries`` lands as
+    ``/replica{3}/serve/retries``, so one scrape of the router registry
+    exposes the whole fleet with the replica as a Prometheus label
+    (the ``{instance}`` name grammar above).
+
+    Scalars land as gauges verbatim (a snapshot is a point-in-time copy
+    — monotonicity is the source registry's business); dict-valued
+    entries (histogram count/sum/percentiles, trail counts, labeled
+    counters) flatten one level to ``/name/<field>`` sub-gauges;
+    non-numeric leaves are skipped.  Never raises past argument errors
+    (absorbing telemetry must not fail the router)."""
+    for name, val in snapshot.items():
+        base = prefix + name
+        try:
+            if isinstance(val, bool):
+                registry.gauge(base).set(int(val))
+            elif isinstance(val, (int, float)):
+                registry.gauge(base).set(val)
+            elif isinstance(val, dict):
+                for k, v in val.items():
+                    if isinstance(v, bool):
+                        registry.gauge(f"{base}/{k}").set(int(v))
+                    elif isinstance(v, (int, float)):
+                        registry.gauge(f"{base}/{k}").set(v)
+        except Exception:  # noqa: BLE001 — e.g. a name/kind clash with a
+            continue  # router-owned metric; skip the entry, keep the rest
+
+
 #: The process-wide default registry: solver/checkpoint/autotune counters
 #: and the load-balance busy-rate gauges publish here.  Reports default
 #: to a private registry each (see the module docstring).

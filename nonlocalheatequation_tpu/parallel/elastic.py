@@ -57,6 +57,7 @@ from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, source_at
 from nonlocalheatequation_tpu.parallel.load_balance import (
+    BUSY_SCALE,
     MeasuredTelemetry,
     publish_busy_rates,
     rebalance_assignment,
@@ -67,6 +68,110 @@ from nonlocalheatequation_tpu.utils.partition_map import default_assignment
 # the 3x3 neighbor offsets in upad assembly order (top row, mid row, bottom)
 _OFFSETS = ((-1, -1), (-1, 0), (-1, 1), (0, -1), (0, 0), (0, 1),
             (1, -1), (1, 0), (1, 1))
+
+#: Fleet scale watermarks (fractions of BUSY_SCALE): the replica router
+#: adds a worker when EVERY replica's absolute busy rate sits above the
+#: high mark (the whole fleet is saturated — more tiles per locality than
+#: the balancer can smooth, the reference's grow-the-region case lifted a
+#: layer up) and drains one when every replica sits below the low mark.
+#: The wide gap between them is the hysteresis band — the fleet analog of
+#: work_realloc's 0.3 dead-band (parallel/load_balance.py DEADBAND): a
+#: rate wandering between the marks must not flap workers up and down.
+SCALE_HIGH_FRAC = 0.85
+SCALE_LOW_FRAC = 0.20
+
+
+class BusyRatePolicy:
+    """The measurement-window bookkeeping factored out of
+    ``ElasticSolver2D._rebalance`` so the replica router
+    (serve/router.py) runs the same discipline one layer up: read the
+    window's busy rates from an injectable telemetry, remember the last
+    NON-EMPTY window (after the post-decision telemetry reset, reports
+    would otherwise be vacuously zero — and an acceptance check
+    vacuously green), hand the rates to a decision, reset the window.
+    The telemetry only needs ``busy_rates(assignment)`` (and optionally
+    ``record``/``reset``) — MeasuredTelemetry/WorkTelemetry at the tile
+    level, :class:`FleetTelemetry` at the replica level."""
+
+    def __init__(self, telemetry):
+        self.telemetry = telemetry
+        self.last_rates: np.ndarray | None = None
+
+    def window_rates(self, assignment=None) -> np.ndarray:
+        """This window's rates; a non-empty window is remembered."""
+        busy = np.asarray(self.telemetry.busy_rates(assignment))
+        if busy.any():
+            self.last_rates = np.asarray(busy, dtype=np.float64)
+        return busy
+
+    def rates_or_last(self, assignment=None) -> np.ndarray:
+        """Current-window rates, falling back to the last completed
+        window's snapshot when the current window is empty (e.g. right
+        after a decision's telemetry reset)."""
+        cur = np.asarray(self.telemetry.busy_rates(assignment))
+        if cur.any() or self.last_rates is None:
+            return cur
+        return self.last_rates
+
+    def reset(self) -> None:
+        """Open a new measurement window (the reference re-reads its
+        idle-rate counters after rebalancing, :954-956)."""
+        if hasattr(self.telemetry, "reset"):
+            self.telemetry.reset()
+
+
+class FleetTelemetry:
+    """MeasuredTelemetry's fleet-level sibling: per-replica ABSOLUTE
+    busy fractions.  The tile-level MeasuredTelemetry normalizes to the
+    busiest device (rebalancing needs only the relative imbalance); a
+    scale-out decision instead needs how busy the fleet is against wall
+    clock — the HPX idle-rate semantics (busy = 10000 - idle over the
+    window), which each replica worker reports as (busy_s, span_s) of
+    its serving loop."""
+
+    def __init__(self):
+        self._rates: dict[int, float] = {}
+
+    def record_window(self, replica: int, busy_s: float,
+                      span_s: float) -> None:
+        frac = min(1.0, busy_s / span_s) if span_s > 0 else 0.0
+        self._rates[int(replica)] = BUSY_SCALE * frac
+
+    def forget(self, replica: int) -> None:
+        self._rates.pop(int(replica), None)
+
+    def rate(self, replica: int) -> float:
+        return float(self._rates.get(int(replica), 0.0))
+
+    def busy_rates(self, assignment=None) -> np.ndarray:
+        return np.asarray([self._rates[r] for r in sorted(self._rates)],
+                          dtype=np.float64)
+
+    def reset(self) -> None:
+        self._rates.clear()
+
+
+def fleet_scale_decision(busy, n_replicas: int, *, n_min: int = 1,
+                         n_max: int | None = None,
+                         low_frac: float = SCALE_LOW_FRAC,
+                         high_frac: float = SCALE_HIGH_FRAC) -> str | None:
+    """The elastic add/drain decision over one window's absolute busy
+    rates (0..BUSY_SCALE units): ``"add"`` when every replica is above
+    the high watermark and headroom exists, ``"drain"`` when every
+    replica is below the low watermark and the fleet is above its floor,
+    else None (the hysteresis band — see SCALE_HIGH_FRAC).  min/max
+    aggregation, not the mean: one idle replica disproves saturation
+    (its buckets could absorb load), one busy replica disproves
+    idleness (draining would re-route onto it)."""
+    busy = np.asarray(busy, dtype=np.float64)
+    if busy.size == 0:
+        return None
+    if (n_max is None or n_replicas < n_max) \
+            and busy.min() >= high_frac * BUSY_SCALE:
+        return "add"
+    if n_replicas > n_min and busy.max() <= low_frac * BUSY_SCALE:
+        return "drain"
+    return None
 
 
 class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
@@ -122,8 +227,12 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
                 "available; re-run the decomposition for this device count")
         # Default telemetry is MEASURED wall-clock (the reference reads real
         # idle-rate counters, never a model); WorkTelemetry remains available
-        # as an injectable test fixture for deterministic scenarios.
+        # as an injectable test fixture for deterministic scenarios.  The
+        # window bookkeeping (read rates, remember the last non-empty
+        # window, reset) lives in BusyRatePolicy — the piece the replica
+        # router reuses at fleet level (serve/router.py).
         self.telemetry = telemetry or MeasuredTelemetry(nl)
+        self._policy = BusyRatePolicy(self.telemetry)
         # The measurement clock is injectable: busy-rate TESTS swap in a
         # virtual clock advanced by the tile hook, so their assertions on
         # measured rates stop racing host load (the suite's one recurring
@@ -304,13 +413,11 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         return moved
 
     def _rebalance(self) -> int:
-        busy = self.telemetry.busy_rates(self.assignment)
-        if np.asarray(busy).any():
-            # remember the window that drove this decision: after the
-            # post-rebalance telemetry reset, busy_rates() reports would
-            # otherwise be vacuously zero (and a final-state acceptance
-            # check vacuously green)
-            self._last_window_rates = np.asarray(busy, dtype=np.float64)
+        # window_rates remembers a non-empty window: after the
+        # post-rebalance telemetry reset, busy_rates() reports would
+        # otherwise be vacuously zero (and a final-state acceptance
+        # check vacuously green)
+        busy = self._policy.window_rates(self.assignment)
         with obs_trace.span("balance.rebalance", cat="balance",
                             devices=int(np.asarray(busy).size)):
             new_assignment = rebalance_assignment(self.assignment, busy)
@@ -728,11 +835,9 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
     def busy_rates(self) -> np.ndarray:
         """Current-window measured rates; falls back to the last completed
         window's snapshot when the current window is empty (e.g. right
-        after the final rebalance's telemetry reset)."""
-        cur = np.asarray(self.telemetry.busy_rates(self.assignment))
-        if cur.any():
-            return cur
-        return getattr(self, "_last_window_rates", cur)
+        after the final rebalance's telemetry reset).  The fallback
+        discipline is BusyRatePolicy's — shared with the replica router."""
+        return self._policy.rates_or_last(self.assignment)
 
     # -- error metrics: ManufacturedMetrics2D -------------------------------
     _cmp_coordinate_prefix = True

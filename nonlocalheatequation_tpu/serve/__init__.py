@@ -27,4 +27,12 @@ replica or session loads yesterday's compiles (zero retrace/recompile,
 bit-identical results) instead of re-paying them — the reference's
 compiled-binary zero-startup-cost property (PAPER.md layer map)
 recovered for the JAX stack.
+
+``serve/router.py`` and ``serve/http.py`` are the fleet tier above:
+a sticky-bucket router owning N ServePipeline worker processes (shared
+store dir = warm caches everywhere; busy-rate elastic add/drain; death
+-> re-route, re-served bit-identically) and the HTTP ingestion front
+door with admission control (429 + Retry-After before any queue can
+grow without bound) — the reference's many-locality/idle-rate-balancer
+tier lifted to whole serving replicas.
 """

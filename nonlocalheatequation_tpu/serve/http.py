@@ -1,0 +1,435 @@
+"""HTTP ingestion tier: the fleet's front door, with admission control.
+
+obs/export.py proved the shape — a stdlib ThreadingHTTPServer on
+127.0.0.1 serving process telemetry.  This module promotes that
+machinery from telemetry to a REQUEST API over a serving backend (a
+:class:`~nonlocalheatequation_tpu.serve.router.ReplicaRouter`, or
+anything with ``submit``/``outstanding_total``/``retry_after_s``):
+
+* ``POST /v1/cases`` — submit one case (JSON body: ``shape``, ``nt``,
+  ``eps``, ``k``, ``dt``, ``dh``, optional ``test``/``u0``/
+  ``deadline_ms``/``priority``).  Returns 202 ``{"id": N}``, or **429 +
+  Retry-After** when admission control sheds.
+* ``GET /v1/cases/<id>`` — poll: ``{"status": "queued"|"done"|"failed"}``
+  plus latency/replica detail; ``?wait=1`` (optional ``&timeout_s=T``)
+  blocks until the case completes — the stream/wait form.
+* ``GET /v1/cases/<id>/result`` — the solved state: JSON
+  ``{"shape": ..., "values": [...]}`` by default (f64 round-trip-exact),
+  or raw ``.npy`` bytes with ``?bin=1``.
+* ``GET /healthz`` — liveness + fleet summary.
+* ``GET /metrics`` / ``/metrics.json`` — the backend registry's
+  Prometheus/JSON exposition (the router's registry already aggregates
+  per-replica namespaces; obs/export.py renders it).
+
+**Admission control** (:class:`AdmissionController`) sheds BEFORE the
+pipe collapses, keyed off the gauges already in the metrics registry:
+the in-flight depth (``/router/outstanding`` vs the bounded
+``max_pending`` budget) and the observed queue-wait/latency window
+(``/router/request-latency-ms``).  A shed is a 429 with a Retry-After
+computed from the observed p50 service time — never an unbounded queue,
+never a silent drop.  The router's own hard bound
+(:class:`~nonlocalheatequation_tpu.serve.router.RouterOverloaded`)
+backstops it: admission is the soft gate, the router cap the hard one,
+and both surface as 429.
+
+Bind address is 127.0.0.1 only, like the metrics endpoint: this tier
+terminates trusted localhost traffic (a reverse proxy owns the wire).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+
+from nonlocalheatequation_tpu.obs.export import (
+    merged_prometheus,
+    merged_snapshot_json,
+)
+from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+from nonlocalheatequation_tpu.serve.router import RouterOverloaded
+
+#: Completed requests retained for polling (an abandoned client must not
+#: grow the ingress's memory without bound): the most recent RESULTS_CAP
+#: finished cases stay fetchable, older ones age out (410 Gone).
+RESULTS_CAP = 4096
+
+#: Default wait bound for ``?wait=1`` (a handler thread parked forever
+#: on an abandoned connection is a slot leak).
+WAIT_TIMEOUT_S = 300.0
+
+
+class AdmissionController:
+    """The soft gate in front of the router's hard in-flight cap.
+
+    ``max_pending`` bounds the admitted-but-unfinished depth (default:
+    the backend's own ``max_outstanding`` per live replica — admission
+    then sheds exactly where the router would refuse, one request
+    earlier and politely).  ``max_queue_wait_ms`` additionally sheds
+    while the observed p50 request latency exceeds it — the queue-wait
+    form of the same promise: a request we cannot serve inside the
+    bound is refused NOW with a retry hint, not parked.
+
+    Counters land in the backend registry: ``/ingress/accepted``,
+    ``/ingress/shed``, and the ``/ingress/retry-after-s`` gauge
+    (the most recent hint)."""
+
+    def __init__(self, backend, *, max_pending: int | None = None,
+                 max_queue_wait_ms: float | None = None):
+        self.backend = backend
+        self.max_pending = max_pending
+        self.max_queue_wait_ms = max_queue_wait_ms
+        r = backend.registry
+        self._m_accepted = r.counter("/ingress/accepted")
+        self._m_shed = r.counter("/ingress/shed")
+        self._m_retry_after = r.gauge("/ingress/retry-after-s")
+
+    def _cap(self) -> int:
+        if self.max_pending is not None:
+            return int(self.max_pending)
+        return self.backend.max_outstanding * max(
+            1, self.backend.live_count())
+
+    def check(self) -> float | None:
+        """None to admit, else the Retry-After hint in seconds."""
+        pending = self.backend.outstanding_total()
+        if pending >= self._cap():
+            return self._hint(pending)
+        if self.max_queue_wait_ms is not None:
+            pct = self.backend.registry.get(
+                "/router/request-latency-ms")
+            p50 = (pct.percentiles().get("p50", 0.0)
+                   if pct is not None else 0.0)
+            if p50 > self.max_queue_wait_ms:
+                return self._hint(pending)
+        return None
+
+    def _hint(self, pending: int) -> float:
+        hint = self.backend.retry_after_s()
+        # a deep backlog needs more than one service time to clear
+        hint *= max(1.0, pending / max(1, self._cap()))
+        self._m_retry_after.set(round(hint, 3))
+        return hint
+
+    def try_submit(self, case: EnsembleCase, *, deadline_ms=None,
+                   priority: int = 0):
+        """``(request, None)`` when admitted, ``(None, retry_after_s)``
+        when shed (by this gate or the router's hard cap)."""
+        retry = self.check()
+        if retry is not None:
+            self._m_shed.inc()
+            return None, retry
+        try:
+            req = self.backend.submit(case, deadline_ms=deadline_ms,
+                                      priority=priority)
+        except RouterOverloaded as e:
+            self._m_shed.inc()
+            self._m_retry_after.set(round(e.retry_after_s, 3))
+            return None, e.retry_after_s
+        self._m_accepted.inc()
+        return req, None
+
+
+def parse_case(body: dict) -> EnsembleCase:
+    """Validate one JSON case body into an EnsembleCase — loudly: a
+    malformed submission is the CLIENT's 400, never a worker's stack
+    trace mid-chunk."""
+    try:
+        shape = tuple(int(s) for s in body["shape"])
+        if not 1 <= len(shape) <= 3 or any(s < 1 for s in shape):
+            raise ValueError(f"bad shape {shape}")
+        nt = int(body["nt"])
+        eps = int(body["eps"])
+        if nt < 1 or eps < 1:
+            raise ValueError(f"need nt >= 1 and eps >= 1 (got {nt}, {eps})")
+        case = EnsembleCase(
+            shape=shape, nt=nt, eps=eps, k=float(body["k"]),
+            dt=float(body["dt"]), dh=float(body["dh"]),
+            test=bool(body.get("test", False)))
+        deadline = body.get("deadline_ms")
+        if deadline is not None:
+            if not isinstance(deadline, (int, float)) or deadline < 0:
+                raise ValueError(
+                    f"deadline_ms must be a number >= 0, got {deadline!r}")
+        prio = body.get("priority", 0)
+        if not isinstance(prio, int) or isinstance(prio, bool):
+            raise ValueError(f"priority must be an integer, got {prio!r}")
+        u0 = body.get("u0")
+        if u0 is not None:
+            u0 = np.asarray(u0, np.float64)
+            if u0.size != int(np.prod(shape)):
+                raise ValueError(
+                    f"u0 has {u0.size} values, shape {shape} needs "
+                    f"{int(np.prod(shape))}")
+            case.u0 = u0.reshape(shape)
+        elif not case.test:
+            raise ValueError("a production (test=false) case needs u0")
+        return case
+    except KeyError as e:
+        raise ValueError(f"missing case field {e.args[0]!r}") from None
+
+
+class IngressServer:
+    """The front door: HTTP request API over a router, 127.0.0.1 only.
+
+    ``backend`` is the ReplicaRouter (owned by the caller — the server
+    never closes it); ``admission`` defaults to an
+    :class:`AdmissionController` with the router-cap budget.  ``port``
+    0 picks a free port (the resolved one is ``self.port``)."""
+
+    def __init__(self, port: int, backend, *,
+                 admission: AdmissionController | None = None,
+                 max_pending: int | None = None,
+                 max_queue_wait_ms: float | None = None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.backend = backend
+        self.admission = admission if admission is not None else \
+            AdmissionController(backend, max_pending=max_pending,
+                                max_queue_wait_ms=max_queue_wait_ms)
+        self._requests: dict[int, object] = {}
+        self._done: dict[int, None] = {}  # insertion-ordered: FIFO aging
+        self._lock = threading.Lock()
+        ingress = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "application/json",
+                       headers=()) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, code: int, obj, headers=()) -> None:
+                self._reply(code, (json.dumps(obj) + "\n").encode(),
+                            headers=headers)
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                try:
+                    ingress._post(self)
+                except Exception as e:  # noqa: BLE001 — a request must
+                    # not kill the server; the client gets the 500
+                    try:
+                        self._json(500, {"error": f"{type(e).__name__}: "
+                                                  f"{e}"})
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    ingress._get(self)
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        self._json(500, {"error": f"{type(e).__name__}: "
+                                                  f"{e}"})
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def log_message(self, *a):  # silence per-request chatter
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", int(port)), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="nlheat-ingress")
+        self._thread.start()
+
+    # -- request handling (called from handler threads) ----------------------
+    def _post(self, h) -> None:
+        if h.path.rstrip("/") != "/v1/cases":
+            h._json(404, {"error": f"no such endpoint {h.path!r}"})
+            return
+        try:
+            n = int(h.headers.get("Content-Length") or 0)
+            body = json.loads(h.rfile.read(n).decode() or "{}")
+            if not isinstance(body, dict):
+                raise ValueError(
+                    f"case body must be a JSON object, got "
+                    f"{type(body).__name__}")
+            case = parse_case(body)
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            h._json(400, {"error": str(e)})
+            return
+        req, retry = self.admission.try_submit(
+            case, deadline_ms=body.get("deadline_ms"),
+            priority=body.get("priority") or 0)
+        if req is None:
+            h._json(429, {"error": "overloaded",
+                          "retry_after_s": round(retry, 3)},
+                    headers=[("Retry-After",
+                              str(max(1, int(np.ceil(retry)))))])
+            return
+        with self._lock:
+            self._requests[req.seq] = req
+        self._sweep()
+        h._json(202, {"id": req.seq, "status": "queued"})
+
+    def _get(self, h) -> None:
+        path, _, query = h.path.partition("?")
+        params = {}
+        for kv in query.split("&"):
+            if "=" in kv:
+                k, _, v = kv.partition("=")
+                params[k] = v
+        if path == "/healthz":
+            m = self.backend.metrics()
+            h._json(200, {"ok": m["replicas"] > 0,
+                          "replicas": m["replicas"],
+                          "outstanding": m["outstanding"],
+                          "deaths": m["deaths"]})
+            return
+        if path.startswith("/metrics"):
+            regs = [self.backend.registry]
+            if path.startswith("/metrics.json"):
+                h._reply(200, merged_snapshot_json(regs).encode())
+            else:
+                h._reply(200, merged_prometheus(regs).encode(),
+                         ctype="text/plain; version=0.0.4")
+            return
+        if not path.startswith("/v1/cases/"):
+            h._json(404, {"error": f"no such endpoint {path!r}"})
+            return
+        rest = path[len("/v1/cases/"):]
+        want_result = rest.endswith("/result")
+        if want_result:
+            rest = rest[:-len("/result")]
+        try:
+            seq = int(rest)
+        except ValueError:
+            h._json(400, {"error": f"bad case id {rest!r}"})
+            return
+        with self._lock:
+            req = self._requests.get(seq)
+        if req is None:
+            h._json(410 if seq < self.backend.metrics()["cases"] else 404,
+                    {"error": f"case {seq} unknown or aged out"})
+            return
+        if params.get("wait") in ("1", "true"):
+            try:
+                timeout = float(params.get("timeout_s") or WAIT_TIMEOUT_S)
+            except ValueError:
+                h._json(400, {"error": f"bad timeout_s "
+                                       f"{params.get('timeout_s')!r}"})
+                return
+            req.done.wait(timeout)
+        if not req.done.is_set():
+            h._json(200, {"id": seq, "status": "queued",
+                          "replica": req.replica})
+            return
+        self._note_done(seq)
+        if req.error is not None:
+            h._json(200 if not want_result else 409, {
+                "id": seq, "status": "failed",
+                "classification": getattr(req.error, "classification",
+                                          "error"),
+                "error": str(req.error)})
+            return
+        if not want_result:
+            h._json(200, {"id": seq, "status": "done",
+                          "replica": req.replica,
+                          "requeues": req.requeues,
+                          "latency_s": round(req.latency_s or 0.0, 6)})
+            return
+        if params.get("bin") in ("1", "true"):
+            bio = io.BytesIO()
+            np.save(bio, req.result)
+            h._reply(200, bio.getvalue(),
+                     ctype="application/octet-stream")
+        else:
+            h._json(200, {"id": seq,
+                          "shape": list(req.result.shape),
+                          "values": req.result.ravel().tolist()})
+
+    def _note_done(self, seq: int) -> None:
+        """Age out old completed requests (bounded retention)."""
+        with self._lock:
+            self._done.setdefault(seq, None)
+            while len(self._done) > RESULTS_CAP:
+                old = next(iter(self._done))
+                del self._done[old]
+                self._requests.pop(old, None)
+
+    def _sweep(self) -> None:
+        """Move every completed-but-unnoted request into the bounded
+        done window — called on each submission, so a fire-and-forget
+        client that POSTs and never polls cannot grow ``_requests``
+        without bound (the RESULTS_CAP promise holds without relying on
+        anyone fetching).  O(retained), all bounded."""
+        with self._lock:
+            done = [seq for seq, req in self._requests.items()
+                    if req.done.is_set() and seq not in self._done]
+        for seq in done:
+            self._note_done(seq)
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def offered_load_run(admission: AdmissionController, cases, rate_hz: float,
+                     *, clock=time.monotonic, sleep=time.sleep) -> dict:
+    """Offer ``cases`` at a fixed rate through the admission gate and
+    account the outcome — the measurement loop shared by bench.py's
+    ``BENCH_ROUTER`` rung and tools/bench_table.py's ``router`` group
+    (the overload-honesty half: at an offered rate past capacity the
+    gate must shed with hints, the accepted requests must still finish,
+    and nothing may queue without bound).  Returns accepted/shed counts,
+    the accepted requests' latency percentiles, the max observed
+    in-flight depth, and the wall."""
+    backend = admission.backend
+    cases = list(cases)
+    interval = 1.0 / rate_hz if rate_hz > 0 else 0.0
+    accepted, shed = [], 0
+    max_pending = 0
+    t0 = clock()
+    next_t = t0
+    for case in cases:
+        now = clock()
+        if interval and now < next_t:
+            sleep(next_t - now)
+        next_t += interval
+        req, _retry = admission.try_submit(case)
+        if req is None:
+            shed += 1
+        else:
+            accepted.append(req)
+        max_pending = max(max_pending, backend.outstanding_total())
+    for req in accepted:
+        req.done.wait()
+    wall = clock() - t0
+    lat = sorted(r.latency_s for r in accepted if r.latency_s is not None)
+
+    def pct(p):
+        if not lat:
+            return 0.0
+        return lat[min(len(lat) - 1, int(p * (len(lat) - 1)))]
+
+    return {
+        "offered": len(cases),
+        "accepted": len(accepted),
+        "shed": shed,
+        "max_pending": max_pending,
+        "wall_s": wall,
+        "latency_s": {"p50": pct(0.50), "p90": pct(0.90),
+                      "p99": pct(0.99)},
+        "results": [r.result for r in accepted],
+    }

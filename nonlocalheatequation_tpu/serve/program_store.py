@@ -52,6 +52,15 @@ they always returned); ``1`` = the per-user default directory
 (``~/.cache/nlheat/program_store``); any other value = the store
 directory itself.  ``NLHEAT_PROGRAM_CACHE_CAP`` bounds the engine's
 in-memory program cache (serve/ensemble.py LRU).
+``NLHEAT_PROGRAM_STORE_CAP_MB`` (or the ``cap_bytes`` ctor arg) bounds
+the store DIRECTORY itself: a replica fleet sharing one dir grows it
+without bound under key diversity, so after each save the store evicts
+least-recently-USED entries (every load hit refreshes its entry's
+mtime) until the total fits, counting ``/store/gc-evictions``.  The
+delete is two-process-safe: a racing GC's missing file is someone
+else's eviction, not an error, and a reader racing a delete sees a
+plain miss (fresh compile) — never a torn load.  0/unset = unbounded
+(the repo's 0-knob convention).
 
 TRUST BOUNDARY: entries deserialize through pickle, and the CRC /
 fingerprint / topology headers are INTEGRITY checks, not authenticity
@@ -113,6 +122,19 @@ def store_dir_from_env() -> str | None:
     if raw == "1":
         return DEFAULT_DIR
     return raw
+
+
+def store_cap_from_env() -> int | None:
+    """The on-disk size cap in BYTES from ``NLHEAT_PROGRAM_STORE_CAP_MB``
+    (0/unset = unbounded, the 0-knob convention; negatives refuse)."""
+    raw = os.environ.get("NLHEAT_PROGRAM_STORE_CAP_MB", "")
+    if raw in ("", "0"):
+        return None
+    mb = float(raw)
+    if mb < 0:
+        raise ValueError(
+            f"NLHEAT_PROGRAM_STORE_CAP_MB must be >= 0, got {raw!r}")
+    return int(mb * 1024 * 1024)
 
 
 def topology_fingerprint(backend: str | None = None) -> dict:
@@ -184,7 +206,8 @@ class ProgramStore:
     passes its report's registry so the serving expositions carry them.
     """
 
-    def __init__(self, root: str, registry: MetricsRegistry | None = None):
+    def __init__(self, root: str, registry: MetricsRegistry | None = None,
+                 cap_bytes: int | None = None):
         self.root = str(root)
         self.registry = registry if registry is not None else MetricsRegistry()
         r = self.registry
@@ -192,8 +215,14 @@ class ProgramStore:
         self._m_misses = r.counter("/store/misses")
         self._m_saves = r.counter("/store/saves")
         self._m_refusals = r.labeled("/store/refusals")
+        self._m_gc_evictions = r.counter("/store/gc-evictions")
         self._h_load_ms = r.histogram("/store/load-ms")
         self._h_serialize_ms = r.histogram("/store/serialize-ms")
+        if cap_bytes is None:
+            cap_bytes = store_cap_from_env()
+        if cap_bytes is not None and cap_bytes <= 0:
+            cap_bytes = None  # 0 = unbounded, the 0-knob convention
+        self.cap_bytes = cap_bytes
         # AOT wholly unavailable on this build: decided once, loudly
         self._aot_dead = not compat.aot_serialize_supported()
         self._topo_cache: dict = {}
@@ -243,6 +272,7 @@ class ProgramStore:
             "hits": self._m_hits.value,
             "misses": self._m_misses.value,
             "saves": self._m_saves.value,
+            "gc_evictions": self._m_gc_evictions.value,
             "refusals": dict(self._m_refusals),
         }
 
@@ -293,6 +323,13 @@ class ProgramStore:
             return None
         ms = (time.perf_counter() - t0) * 1e3
         self._h_load_ms.observe(ms)
+        try:
+            # refresh the entry's recency: the GC evicts by mtime, so a
+            # hit must mark its entry as recently USED, not just
+            # recently written (LRU, not FIFO)
+            os.utime(path, None)
+        except OSError:
+            pass  # e.g. a racing GC deleted it after our read
         with obs_trace.span("store.load", cat="store", ms=round(ms, 3),
                             path=os.path.basename(path)):
             pass
@@ -407,6 +444,54 @@ class ProgramStore:
                             bytes=len(payload),
                             path=os.path.basename(path)):
             pass
+        self._gc(keep=path)
+
+    def _gc(self, keep: str | None = None) -> int:
+        """Size-capped LRU eviction over the store dir (round11
+        carried-forward: a fleet's shared dir grows without bound with
+        key diversity).  Oldest-mtime entries go first — load hits
+        refresh mtime, so mtime order IS use order; the entry just
+        written (``keep``) is never evicted by its own save.  Returns
+        the number of entries THIS process removed; a FileNotFoundError
+        mid-delete is a concurrent GC's win, skipped silently (the
+        two-process-safe delete), and any other OSError aborts the pass
+        loudly as a refusal, never an exception."""
+        if self.cap_bytes is None:
+            return 0
+        try:
+            entries = []
+            with os.scandir(self.root) as it:
+                for de in it:
+                    if not de.name.endswith(".aotprog"):
+                        continue
+                    try:
+                        st = de.stat()
+                    except FileNotFoundError:
+                        continue  # racing GC/writer: already gone
+                    entries.append((st.st_mtime, st.st_size, de.path))
+        except OSError:
+            return 0
+        total = sum(sz for _, sz, _ in entries)
+        removed = 0
+        for _mtime, sz, path in sorted(entries):
+            if total <= self.cap_bytes:
+                break
+            if keep is not None \
+                    and os.path.abspath(path) == os.path.abspath(keep):
+                continue
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                total -= sz  # another process evicted it: same outcome
+                continue
+            except OSError as e:
+                self._refuse(REFUSE_UNSUPPORTED,
+                             f"store GC cannot remove {path}: {e}")
+                break
+            total -= sz
+            removed += 1
+            self._m_gc_evictions.inc()
+        return removed
 
 
 def resolve_store(program_store, registry=None):

@@ -1,0 +1,1116 @@
+"""Replica-fleet router: N ServePipeline worker processes, one front door.
+
+The reference's top tier is many HPX localities behind an idle-rate-driven
+dynamic load balancer (src/2d_nonlocal_distributed.cpp:844-959); our
+single-process :class:`~nonlocalheatequation_tpu.serve.server.ServePipeline`
+matches the scheduler half of that story but owns exactly one backend
+client.  This module is the fleet half: a router that owns N replica
+WORKER PROCESSES — each a full ServePipeline over its own EnsembleEngine —
+and routes submitted cases across them:
+
+* **Sticky bucket routing** — a case's ensemble bucket key
+  (``EnsembleCase.bucket_key()``) is pinned to one replica the first time
+  it is seen, so every replica's bounded LRU program cache
+  (serve/ensemble.py) stays hot for the buckets it owns and never
+  compiles its neighbors'.  All replicas share one AOT program store dir
+  (``NLHEAT_PROGRAM_STORE``, serve/program_store.py), so a bucket moved
+  to (or first touched by) any replica warm-boots from the fleet's
+  compiles instead of re-tracing — the PR 9 unlock this router exists
+  for.
+* **Elastic add/drain** — each worker reports the absolute busy fraction
+  of its serving loop per stats window; the router feeds those into the
+  busy-rate policy factored out of the tile executor
+  (parallel/elastic.py :class:`~nonlocalheatequation_tpu.parallel.elastic.BusyRatePolicy`
+  + :func:`~nonlocalheatequation_tpu.parallel.elastic.fleet_scale_decision`)
+  and adds a worker when the whole fleet is saturated / drains one when
+  the whole fleet is idle — the reference's idle-rate balancer lifted
+  one layer up (regions = bucket sets, localities = replicas).  Adding a
+  replica rebalances bucket ownership toward it (the newcomer inherits
+  buckets, which it loads from the shared store: warm boot, zero
+  retrace); draining reassigns the leaver's buckets and lets its
+  in-flight cases finish.
+* **Replica death is a first-class event** — a reader thread per worker
+  notices EOF on the worker's response pipe; every case that was in
+  flight on the dead worker is RE-ROUTED to a survivor (respawning one
+  first when the fleet would drop below its floor) and re-served
+  bit-identically (results are deterministic functions of the case —
+  the same pinned contract as the pipeline's own retries).  No case is
+  lost, none is delivered twice (a case leaves the outstanding map the
+  moment its result frame is read; only cases still outstanding at
+  death re-route).  The deterministic worker-kill plan kind ``die``
+  (utils/faults.py) makes the whole path chaos-provable: the router
+  draws from its plan at each case-forward event and SIGKILLs the
+  worker a fired case was just routed to.
+
+Transport: length-prefixed pickle frames over the worker's stdin/stdout
+pipes (the worker steals fd 1 at startup so stray prints cannot corrupt
+the framing; its stderr is inherited).  The trust model is the program
+store's: the router and its workers are one principal on one host.
+
+Backpressure: the router's queues are BOUNDED — ``submit`` raises the
+typed :class:`RouterOverloaded` (with a retry-after estimate from the
+observed latency window) once ``max_outstanding`` cases per live replica
+are in flight.  The HTTP ingestion tier (serve/http.py) sheds on this
+(and on its own softer admission rule) with 429 + Retry-After before the
+fleet's pipes can collapse.
+
+Observability: the router's registry carries ``/router/*`` counters and
+gauges (cases, routed, requeued, deaths, scale events, outstanding,
+latency histogram), per-replica ``/replica{r}/busy-rate`` gauges, and —
+after each stats pull — every worker's own registry snapshot absorbed
+under ``/replica{r}`` prefixes (obs/metrics.absorb_snapshot), so ONE
+scrape of the router registry exposes the whole fleet.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import select
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+from nonlocalheatequation_tpu.obs.export import REPLICA_ID_ENV
+from nonlocalheatequation_tpu.obs.metrics import (
+    MetricsRegistry,
+    absorb_snapshot,
+)
+from nonlocalheatequation_tpu.parallel.elastic import (
+    BusyRatePolicy,
+    FleetTelemetry,
+    fleet_scale_decision,
+)
+from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+from nonlocalheatequation_tpu.serve.resilience import ServeError
+from nonlocalheatequation_tpu.utils.faults import FaultPlan
+
+#: Frame header: little-endian payload length (matches the checkpoint
+#: and program-store on-disk length fields).
+_LEN = struct.Struct("<Q")
+
+#: Default per-replica in-flight bound (cases routed but not yet
+#: delivered).  The router's queues must stay bounded no matter how fast
+#: callers submit — admission control (serve/http.py) sheds SOFTLY ahead
+#: of this hard refusal.
+MAX_OUTSTANDING = 64
+
+#: Re-routes a case may survive before completing exceptionally.  A case
+#: whose replica keeps dying is indistinguishable from a case that KILLS
+#: its replicas — unbounded re-routing would crash-loop the entire fleet
+#: on one poison request (the router-level twin of the pipeline's
+#: retry-then-quarantine budget).
+MAX_REQUEUES = 3
+
+
+def _write_frame(stream, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    stream.write(_LEN.pack(len(payload)))
+    stream.write(payload)
+    stream.flush()
+
+
+def _read_frame(stream):
+    head = stream.read(_LEN.size)
+    if len(head) < _LEN.size:
+        return None
+    n = _LEN.unpack(head)[0]
+    payload = stream.read(n)
+    if len(payload) < n:
+        return None
+    return pickle.loads(payload)
+
+
+class RouterOverloaded(RuntimeError):
+    """The router's bounded queue is full.  ``retry_after_s`` is the
+    suggested backoff (the ingress tier's Retry-After header)."""
+
+    def __init__(self, outstanding: int, cap: int, retry_after_s: float):
+        super().__init__(
+            f"router overloaded: {outstanding} cases in flight "
+            f"(cap {cap}); retry in {retry_after_s:.2f}s")
+        self.outstanding = outstanding
+        self.cap = cap
+        self.retry_after_s = retry_after_s
+
+
+class RouterRequest:
+    """One routed case: the caller's handle (a cross-process future)."""
+
+    def __init__(self, case: EnsembleCase, seq: int, submit_t: float):
+        self.case = case
+        self.seq = seq
+        self.submit_t = submit_t
+        self.deadline_ms = None
+        self.priority = 0
+        self.result: np.ndarray | None = None
+        self.error: ServeError | None = None
+        self.latency_s: float | None = None
+        self.replica: int | None = None  # current owner
+        self.requeues = 0  # times re-routed after a replica death
+        self.done = threading.Event()
+
+    def wait(self, timeout: float | None = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"case {self.seq} not served within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class _Replica:
+    """Router-side worker handle: the process, its framed pipes, the
+    reader/writer threads' state, and the bucket set it owns.
+
+    Sends are ASYNCHRONOUS: ``send`` enqueues and a dedicated writer
+    thread drains the queue into the worker's stdin pipe.  A worker
+    mid-compute stops reading its pipe, and the 64 KB pipe buffer would
+    otherwise block the ROUTER's submitting thread on the next frame —
+    throttling intake to the fleet's service rate, which makes overload
+    unobservable (the queue the admission gate bounds could never
+    form).  The router-side queue is part of the case's in-flight
+    accounting, so the bound still holds end to end."""
+
+    def __init__(self, rid: int, proc: subprocess.Popen):
+        self.rid = rid
+        self.proc = proc
+        self.sendq: "queue.Queue" = queue.Queue()
+        self.ready = threading.Event()
+        self.alive = True
+        self.closing = False  # router-initiated stop: EOF is not a death
+        self.draining = False  # no NEW buckets/cases route here
+        self.outstanding: dict[int, RouterRequest] = {}
+        self.buckets: set = set()
+        self.stats_waiters: dict[int, list] = {}  # token -> [event, box]
+        self.last_stats: dict | None = None
+
+    def send(self, obj) -> bool:
+        """Enqueue one frame for the writer thread (never blocks on the
+        pipe).  False only when the worker is already known-dead."""
+        if not self.alive:
+            return False
+        self.sendq.put(obj)
+        return True
+
+    def _writer(self) -> None:
+        """Drain the send queue into the worker's stdin.  A broken pipe
+        ends the thread quietly — the reader's EOF owns death handling.
+        The ``__kill__`` sentinel (the fault plan's ``die``) is ORDERED
+        with the frames before it: the case it spans is genuinely in
+        flight on the worker when the SIGKILL lands."""
+        while True:
+            obj = self.sendq.get()
+            if obj is None:
+                return
+            if isinstance(obj, dict) and obj.get("op") == "__kill__":
+                try:
+                    self.proc.send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+                continue
+            try:
+                _write_frame(self.proc.stdin, obj)
+            except (OSError, ValueError):
+                return
+
+
+class ReplicaRouter:
+    """Own N replica worker processes; route cases sticky-by-bucket.
+
+    ``replicas`` is the starting fleet size (also the floor unless
+    ``min_replicas`` says otherwise); ``max_replicas`` caps elastic
+    growth (default ``2 * replicas``).  ``program_store`` is the shared
+    AOT store dir every worker resolves (None = inherit the ambient
+    ``NLHEAT_PROGRAM_STORE``).  ``depth``/``window_ms``/``window_size``
+    and ``serve_kwargs`` configure each worker's ServePipeline;
+    remaining ``engine_kwargs`` its EnsembleEngine.  ``faults`` (or a
+    spec string) is the ROUTER-level deterministic plan — the ``die``
+    kind kills workers; the plan is scrubbed from worker environments so
+    it can never double-inject inside their pipelines.  ``child_env``
+    adds/overrides worker env vars (bench uses it to pin single-thread
+    XLA for an honest scale-out A/B)."""
+
+    def __init__(self, replicas: int = 1, *, depth: int = 1,
+                 window_ms: float = 2.0, window_size: int | None = None,
+                 program_store: str | None = None,
+                 max_outstanding: int = MAX_OUTSTANDING,
+                 min_replicas: int | None = None,
+                 max_replicas: int | None = None,
+                 respawn: bool = True,
+                 faults: FaultPlan | str | None = None,
+                 serve_kwargs: dict | None = None,
+                 child_env: dict | None = None,
+                 cpus_per_replica: int | None = None,
+                 registry: MetricsRegistry | None = None,
+                 spawn_timeout_s: float = 180.0,
+                 clock=time.monotonic,
+                 **engine_kwargs):
+        replicas = int(replicas)
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if max_outstanding < 1:
+            raise ValueError(
+                f"max_outstanding must be >= 1, got {max_outstanding}")
+        if isinstance(faults, str):
+            faults = FaultPlan.parse(faults)
+        self.min_replicas = int(min_replicas if min_replicas is not None
+                                else replicas)
+        self.max_replicas = int(max_replicas if max_replicas is not None
+                                else max(2 * replicas, replicas + 1))
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas ({self.min_replicas}) <= "
+                f"max_replicas ({self.max_replicas})")
+        self.max_outstanding = int(max_outstanding)
+        self.respawn = bool(respawn)
+        self.depth = int(depth)
+        self.window_ms = float(window_ms)
+        self.window_size = window_size
+        self.program_store = program_store
+        self.serve_kwargs = dict(serve_kwargs or {})
+        self.engine_kwargs = dict(engine_kwargs)
+        self.child_env = dict(child_env or {})
+        # CPU-affinity budget per worker (os.sched_setaffinity in the
+        # child): the CPU proxy of per-replica hardware — one XLA CPU
+        # process otherwise spreads over every host core and a fleet
+        # A/B on one box would measure contention, not scale-out.
+        # None = no pinning (production: each replica owns its machine)
+        self.cpus_per_replica = (int(cpus_per_replica)
+                                 if cpus_per_replica else None)
+        try:
+            self._host_cpus = sorted(os.sched_getaffinity(0))
+        except AttributeError:  # non-Linux: no pinning support
+            self._host_cpus = []
+            self.cpus_per_replica = None
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self._clock = clock
+        self._faults = faults
+        # worker backend config mirrors THIS process's jax config (pure
+        # config reads — no backend touch, the wedge discipline): the
+        # re-serve bit-identity contract needs every worker on the same
+        # platform and x64 mode as the offline oracle
+        import jax
+
+        self._platform = jax.config.jax_platforms or None
+        self._x64 = bool(jax.config.jax_enable_x64)
+        self.registry = registry if registry is not None else MetricsRegistry()
+        r = self.registry
+        self._m_cases = r.counter("/router/cases")
+        self._m_routed = r.counter("/router/routed")  # forwards, requeues incl
+        self._m_requeued = r.counter("/router/requeued")
+        self._m_deaths = r.counter("/router/deaths")
+        self._m_spawns = r.counter("/router/spawns")
+        self._m_scale_ups = r.counter("/router/scale-ups")
+        self._m_scale_downs = r.counter("/router/scale-downs")
+        self._m_replicas = r.gauge("/router/replicas")
+        self._m_outstanding = r.gauge("/router/outstanding")
+        self._m_max_outstanding = r.gauge("/router/max-outstanding")
+        self._m_max_outstanding.set(self.max_outstanding)
+        self._m_buckets = r.gauge("/router/buckets")
+        self._h_latency = r.histogram("/router/request-latency-ms")
+        self._lock = threading.RLock()
+        self._replicas: dict[int, _Replica] = {}
+        #: every admitted-but-undelivered request, keyed by seq.  The
+        #: per-replica ``outstanding`` maps are ROUTING state (who holds
+        #: the case now) and go transiently empty while a death's
+        #: orphans await re-routing; this map is the delivery ledger —
+        #: only a result/error frame (or close) removes a request, so
+        #: drain()/admission can never mistake mid-recovery for done.
+        self._pending: dict[int, RouterRequest] = {}
+        self._owner: dict = {}  # bucket key -> rid
+        self._next_rid = 0
+        self._next_seq = 0
+        self._closed = False
+        self._telemetry = FleetTelemetry()
+        self._policy = BusyRatePolicy(self._telemetry)
+        try:
+            for _ in range(replicas):
+                self._spawn()
+        except BaseException:
+            self.close()
+            raise
+
+    # -- worker lifecycle ---------------------------------------------------
+    def _spawn(self) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        env = dict(os.environ)
+        # a router-level fault plan must not leak INTO the workers'
+        # pipelines (the die kind is router vocabulary; raise/stall/nan
+        # entries would double-inject) — worker-internal chaos goes
+        # through serve_kwargs["faults"] deliberately
+        env.pop("NLHEAT_FAULT_PLAN", None)
+        env[REPLICA_ID_ENV] = str(rid)
+        env.update(self.child_env)
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "nonlocalheatequation_tpu.serve.router"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
+        rep = _Replica(rid, proc)
+        affinity = None
+        if self.cpus_per_replica and self._host_cpus:
+            k, cpus = self.cpus_per_replica, self._host_cpus
+            start = (rid * k) % len(cpus)
+            affinity = [cpus[(start + j) % len(cpus)] for j in range(k)]
+        cfg = {
+            "replica_id": rid,
+            "platform": self._platform,
+            "x64": self._x64,
+            "depth": self.depth,
+            "window_ms": self.window_ms,
+            "window_size": self.window_size,
+            "program_store": self.program_store,
+            "serve_kwargs": self.serve_kwargs,
+            "engine_kwargs": self.engine_kwargs,
+            "cpu_affinity": affinity,
+        }
+        with self._lock:
+            self._replicas[rid] = rep
+            self._m_replicas.set(self.live_count())
+        self._m_spawns.inc()
+        rep.send(cfg)
+        threading.Thread(target=rep._writer, daemon=True,
+                         name=f"nlheat-router-writer-{rid}").start()
+        threading.Thread(target=self._reader, args=(rep,), daemon=True,
+                         name=f"nlheat-router-reader-{rid}").start()
+        if not rep.ready.wait(self.spawn_timeout_s):
+            rep.closing = True
+            proc.kill()
+            raise RuntimeError(
+                f"replica {rid} did not become ready within "
+                f"{self.spawn_timeout_s:.0f}s")
+        return rid
+
+    def _reader(self, rep: _Replica) -> None:
+        """Per-worker reader thread: parse response frames until EOF,
+        then treat the EOF as a death (unless the router stopped the
+        worker itself)."""
+        stream = rep.proc.stdout
+        while True:
+            try:
+                msg = _read_frame(stream)
+            except Exception:  # noqa: BLE001 — torn frame == dead worker
+                msg = None
+            if msg is None:
+                break
+            self._on_message(rep, msg)
+        self._on_eof(rep)
+
+    def _on_message(self, rep: _Replica, msg: dict) -> None:
+        op = msg.get("op")
+        if op == "ready":
+            rep.ready.set()
+        elif op in ("result", "error"):
+            with self._lock:
+                req = rep.outstanding.get(msg["id"])
+                if req is None:  # late frame for a requeued case: the
+                    return  # survivor's copy owns delivery (no dupes)
+            # assign BEFORE removing from the ledgers: a drain()/waiter
+            # that observes the ledger empty must find the result (or
+            # error) already in place, never a half-delivered request
+            if op == "result":
+                req.result = msg["values"]
+            else:
+                req.error = ServeError(
+                    msg.get("classification", "error"), req.seq,
+                    msg.get("chunk", -1), msg.get("attempts", 0),
+                    msg.get("detail", ""))
+            req.latency_s = self._clock() - req.submit_t
+            with self._lock:
+                rep.outstanding.pop(msg["id"], None)
+                self._pending.pop(msg["id"], None)
+                self._m_outstanding.set(self.outstanding_total())
+            self._h_latency.observe(req.latency_s * 1e3)
+            req.done.set()
+        elif op == "stats":
+            waiter = rep.stats_waiters.pop(msg.get("id"), None)
+            rep.last_stats = msg
+            if waiter is not None:
+                waiter[1].append(msg)
+                waiter[0].set()
+
+    def _on_eof(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.alive = False
+            self._m_replicas.set(self.live_count())
+        rep.sendq.put(None)  # release the writer thread
+        try:
+            rep.proc.wait(timeout=10)  # EOF means exit is imminent;
+        except subprocess.TimeoutExpired:  # reap the zombie either way
+            rep.proc.kill()
+            rep.proc.wait(timeout=10)
+        for pipe_ in (rep.proc.stdin, rep.proc.stdout):
+            try:
+                pipe_.close()
+            except OSError:
+                pass
+        with self._lock:
+            if rep.closing or self._closed:
+                self._replicas.pop(rep.rid, None)
+                return
+            self._m_deaths.inc()
+            orphans = list(rep.outstanding.values())
+            rep.outstanding.clear()
+            buckets = set(rep.buckets)
+            rep.buckets.clear()
+            for key in buckets:
+                if self._owner.get(key) == rep.rid:
+                    del self._owner[key]
+            self._telemetry.forget(rep.rid)
+            self._replicas.pop(rep.rid, None)  # dead entries never
+            # accumulate across a long fleet's chaos history
+        print(f"router: replica {rep.rid} died with "
+              f"{len(orphans)} case(s) in flight; re-routing",
+              file=sys.stderr)
+        # release any stats pull blocked on the dead worker
+        for token in list(rep.stats_waiters):
+            waiter = rep.stats_waiters.pop(token, None)
+            if waiter is not None:
+                waiter[0].set()
+        if self.respawn and self.live_count() < self.min_replicas:
+            try:
+                self._spawn()
+            except Exception as e:  # noqa: BLE001 — survivors still serve
+                print(f"router: respawn after replica {rep.rid} death "
+                      f"failed ({e}); continuing with "
+                      f"{self.live_count()} replica(s)", file=sys.stderr)
+        for req in orphans:
+            req.requeues += 1
+            self._m_requeued.inc()
+            if req.requeues > MAX_REQUEUES:
+                # the fleet-level quarantine: a case still in flight
+                # after MAX_REQUEUES deaths is treated as the killer
+                print(f"router: case {req.seq} survived "
+                      f"{MAX_REQUEUES} replica deaths; quarantining",
+                      file=sys.stderr)
+                with self._lock:
+                    self._pending.pop(req.seq, None)
+                req.error = ServeError("error", req.seq, -1,
+                                       req.requeues,
+                                       "re-routed past MAX_REQUEUES "
+                                       "(replica-killing case?)")
+                req.done.set()
+                continue
+            try:
+                try:
+                    self._route(req)
+                except RouterOverloaded:
+                    # a death cannot lose work to backpressure: the hard
+                    # cap bounds CALLER intake, not recovery — force
+                    self._route(req, force=True)
+            except Exception as e:  # noqa: BLE001 — e.g. no live
+                # replicas after a failed respawn: the request must
+                # complete EXCEPTIONALLY, never hang a waiter, and the
+                # remaining orphans must still get their turn
+                print(f"router: re-route of case {req.seq} failed "
+                      f"({e}); completing exceptionally", file=sys.stderr)
+                with self._lock:
+                    self._pending.pop(req.seq, None)
+                req.error = ServeError("error", req.seq, -1, 0,
+                                       f"re-route failed: {e}")
+                req.done.set()
+
+    # -- routing ------------------------------------------------------------
+    def live_count(self) -> int:
+        return sum(1 for r in self._replicas.values() if r.alive)
+
+    def outstanding_total(self) -> int:
+        return len(self._pending)
+
+    def retry_after_s(self) -> float:
+        """Suggested backoff for a shed request: the observed p50
+        request latency (one service time frees one slot), floored so a
+        cold fleet never advertises zero."""
+        pct = self._h_latency.percentiles()
+        return max(0.05, pct.get("p50", 0.0) / 1e3)
+
+    def _pick_replica(self) -> _Replica:
+        live = [r for r in self._replicas.values()
+                if r.alive and r.ready.is_set() and not r.draining]
+        if not live:
+            live = [r for r in self._replicas.values() if r.alive]
+        if not live:
+            raise RuntimeError("router has no live replicas")
+        return min(live, key=lambda r: (len(r.buckets),
+                                        len(r.outstanding), r.rid))
+
+    def submit(self, case: EnsembleCase, *, deadline_ms: float | None = None,
+               priority: int = 0) -> RouterRequest:
+        """Route one case; returns its handle.  Raises
+        :class:`RouterOverloaded` when the fleet's bounded in-flight
+        budget is exhausted (the ingress tier turns that into 429)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("router is closed")
+            req = RouterRequest(case, self._next_seq, self._clock())
+            req.deadline_ms = deadline_ms
+            req.priority = int(priority)
+            self._next_seq += 1
+            self._pending[req.seq] = req
+            self._m_cases.inc()
+        # the pipe write happens OUTSIDE the router lock (_route's own
+        # lock covers only the bookkeeping): a full worker stdin pipe
+        # must never block the reader threads' result delivery
+        try:
+            self._route(req)
+        except BaseException:
+            # a shed (or any routing failure) must not leak the request
+            # in the delivery ledger — a leaked entry would consume
+            # in-flight capacity forever and wedge drain()
+            with self._lock:
+                self._pending.pop(req.seq, None)
+            raise
+        return req
+
+    def _route(self, req: RouterRequest, force: bool = False) -> None:
+        with self._lock:
+            cap = self.max_outstanding * max(1, self.live_count())
+            outstanding = self.outstanding_total()
+            if outstanding >= cap and not force:
+                raise RouterOverloaded(outstanding, cap,
+                                       self.retry_after_s())
+            key = req.case.bucket_key()
+            rid = self._owner.get(key)
+            rep = self._replicas.get(rid) if rid is not None else None
+            if rep is None or not rep.alive or rep.draining:
+                rep = self._pick_replica()
+                self._owner[key] = rep.rid
+                rep.buckets.add(key)
+                self._m_buckets.set(len(self._owner))
+            req.replica = rep.rid
+            rep.outstanding[req.seq] = req
+            self._m_outstanding.set(self.outstanding_total())
+            fired = (self._faults.draw([req.seq])
+                     if self._faults is not None else None)
+        sent = rep.send({"op": "case", "id": req.seq, "case": req.case,
+                         "deadline_ms": req.deadline_ms,
+                         "priority": req.priority})
+        self._m_routed.inc()
+        if fired is not None and fired.die is not None:
+            # the deterministic worker-kill: the __kill__ sentinel rides
+            # the same send queue, so the case frame lands first — the
+            # case IS in flight on rep when the SIGKILL does, and the
+            # reader's EOF re-routes it (utils/faults.py "die")
+            print(f"router: fault plan fired {fired.die.describe()} — "
+                  f"killing replica {rep.rid}", file=sys.stderr)
+            rep.send({"op": "__kill__"})
+        elif not sent:
+            # the pipe broke under us: the reader's EOF path re-routes
+            # this case with everything else that was outstanding there
+            pass
+
+    # -- completion ---------------------------------------------------------
+    def wait(self, req: RouterRequest,
+             timeout: float | None = None) -> np.ndarray:
+        return req.wait(timeout)
+
+    def drain(self, timeout_s: float = 600.0) -> None:
+        """Block until every outstanding case is delivered (deaths
+        re-route, so a draining fleet converges as long as one replica
+        can be kept alive)."""
+        deadline = self._clock() + timeout_s
+        while True:
+            with self._lock:
+                pending = list(self._pending.values())
+            if not pending:
+                return
+            if self._clock() >= deadline:
+                raise TimeoutError(
+                    f"router drain: {len(pending)} case(s) still in "
+                    f"flight after {timeout_s:.0f}s")
+            pending[0].done.wait(timeout=0.2)
+
+    def serve_cases(self, cases) -> list:
+        """Submit every case, drain, return results in submission order
+        (None for a quarantined case — its handle carries the
+        ServeError), the router twin of ``ServePipeline.serve_cases``."""
+        handles = [self.submit(c) for c in cases]
+        self.drain()
+        return [h.result for h in handles]
+
+    # -- elasticity ---------------------------------------------------------
+    def add_replica(self) -> int:
+        """Scale out by one worker and rebalance bucket ownership toward
+        it: the newcomer inherits a fair share of existing buckets from
+        the most-loaded owners (ownership is a cache-warmth heuristic,
+        never a correctness rule — any replica serves any bucket
+        bit-identically), which it warm-boots from the shared program
+        store instead of re-tracing."""
+        rid = self._spawn()
+        with self._lock:
+            rep = self._replicas[rid]
+            donors = sorted(
+                (r for r in self._replicas.values()
+                 if r.alive and r.rid != rid),
+                key=lambda r: -len(r.buckets))
+            want = len(self._owner) // max(1, self.live_count())
+            for donor in donors:
+                while len(rep.buckets) < want and donor.buckets \
+                        and len(donor.buckets) > len(rep.buckets):
+                    key = next(iter(donor.buckets))
+                    donor.buckets.discard(key)
+                    rep.buckets.add(key)
+                    self._owner[key] = rid
+        return rid
+
+    def drain_replica(self, rid: int, timeout_s: float = 600.0) -> None:
+        """Scale in: stop routing NEW work to ``rid``, reassign its
+        buckets, let its in-flight cases finish, then stop the worker."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None or not rep.alive:
+                return
+            if self.live_count() <= 1:
+                raise ValueError(
+                    "cannot drain the last live replica; add one first")
+            rep.draining = True
+            for key in list(rep.buckets):
+                rep.buckets.discard(key)
+                if self._owner.get(key) == rid:
+                    del self._owner[key]
+        deadline = self._clock() + timeout_s
+        while rep.outstanding:
+            if self._clock() >= deadline:
+                raise TimeoutError(
+                    f"replica {rid} still has {len(rep.outstanding)} "
+                    f"case(s) in flight after {timeout_s:.0f}s")
+            with self._lock:
+                pending = next(iter(rep.outstanding.values()), None)
+            if pending is not None:
+                pending.done.wait(timeout=0.2)
+        rep.closing = True
+        rep.send({"op": "stop"})
+        rep.sendq.put(None)  # writer exits after flushing the stop
+        try:
+            rep.proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            rep.proc.kill()
+        self._telemetry.forget(rid)
+        with self._lock:
+            self._m_replicas.set(self.live_count())
+
+    def refresh_stats(self, timeout_s: float = 30.0) -> dict:
+        """Pull one stats window from every live worker: per-replica
+        metrics/snapshots (absorbed into the router registry under
+        ``/replica{r}`` names) and the busy fractions feeding
+        :meth:`maybe_scale`.  Returns ``{rid: stats_frame}``."""
+        waiters = []
+        with self._lock:
+            live = [r for r in self._replicas.values()
+                    if r.alive and r.ready.is_set()]
+        for rep in live:
+            with self._lock:
+                token = self._next_seq  # shares the seq space: unique
+                self._next_seq += 1
+            ev, box = threading.Event(), []
+            rep.stats_waiters[token] = [ev, box]
+            if rep.send({"op": "stats", "id": token}):
+                waiters.append((rep, ev, box))
+        out = {}
+        deadline = self._clock() + timeout_s
+        for rep, ev, box in waiters:
+            ev.wait(max(0.0, deadline - self._clock()))
+            if not box:
+                continue
+            stats = box[0]
+            out[rep.rid] = stats
+            self._telemetry.record_window(
+                rep.rid, stats.get("busy_s", 0.0), stats.get("span_s", 0.0))
+            self.registry.gauge(
+                f"/replica{{{rep.rid}}}/busy-rate").set(
+                round(self._telemetry.rate(rep.rid), 3))
+            snap = stats.get("snapshot")
+            if snap:
+                absorb_snapshot(self.registry, f"/replica{{{rep.rid}}}",
+                                snap)
+        return out
+
+    def maybe_scale(self) -> str | None:
+        """One elastic step: pull stats, run the factored busy-rate
+        policy (parallel/elastic.py), actuate.  Returns "add"/"drain"
+        when the fleet changed, else None."""
+        self.refresh_stats()
+        busy = self._policy.window_rates()
+        decision = fleet_scale_decision(
+            busy, self.live_count(), n_min=self.min_replicas,
+            n_max=self.max_replicas)
+        if decision == "add":
+            self._m_scale_ups.inc()
+            self.add_replica()
+        elif decision == "drain":
+            with self._lock:
+                live = [r for r in self._replicas.values() if r.alive]
+                # drain the emptiest worker (fewest buckets, then fewest
+                # in-flight) — the cheapest ownership reassignment
+                victim = min(live, key=lambda r: (len(r.buckets),
+                                                  len(r.outstanding)))
+            self._m_scale_downs.inc()
+            self.drain_replica(victim.rid)
+        self._policy.reset()
+        return decision
+
+    # -- observability ------------------------------------------------------
+    def metrics(self) -> dict:
+        with self._lock:
+            live = [r.rid for r in self._replicas.values() if r.alive]
+            per_replica = {
+                r.rid: {"outstanding": len(r.outstanding),
+                        "buckets": len(r.buckets), "alive": r.alive,
+                        "draining": r.draining}
+                for r in self._replicas.values()}
+        return {
+            "replicas": len(live),
+            "live": live,
+            "cases": self._m_cases.value,
+            "routed": self._m_routed.value,
+            "requeued": self._m_requeued.value,
+            "deaths": self._m_deaths.value,
+            "spawns": self._m_spawns.value,
+            "scale_ups": self._m_scale_ups.value,
+            "scale_downs": self._m_scale_downs.value,
+            "outstanding": self.outstanding_total(),
+            "max_outstanding": self.max_outstanding,
+            "buckets": len(self._owner),
+            "request_latency_ms": self._h_latency.percentiles(),
+            "per_replica": per_replica,
+        }
+
+    def close(self) -> None:
+        """Stop the fleet.  Outstanding handles complete exceptionally
+        (a closed router must never leave a waiter blocked forever)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            reps = list(self._replicas.values())
+        for rep in reps:
+            rep.closing = True
+            if rep.alive:
+                rep.send({"op": "stop"})
+            rep.sendq.put(None)  # writer exits after flushing the stop
+        for rep in reps:
+            try:
+                rep.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                rep.proc.kill()
+            try:
+                rep.proc.stdin.close()
+            except OSError:
+                pass
+            rep.outstanding.clear()
+        # the delivery ledger: anything still undelivered completes
+        # exceptionally — a closed router must never leave a waiter
+        # blocked (orphans mid-re-route included)
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for req in pending:
+            if not req.done.is_set():
+                req.error = ServeError("error", req.seq, -1, 0,
+                                       "router closed")
+                req.done.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def router_load_ab(engine_kwargs: dict, cases, replicas: int,
+                   store_dir: str | None, *, window_ms: float = 2.0,
+                   overload_factor: float = 2.0,
+                   overload_pending: int | None = None,
+                   cpus_per_replica: int | None = None,
+                   child_env: dict | None = None) -> dict:
+    """The fleet measurement shared by bench.py (``BENCH_ROUTER``) and
+    tools/bench_table.py (``router`` group): serve the SAME case set
+    through a 1-replica and an N-replica router over ONE shared AOT
+    store dir (the single-replica arm populates it, the fleet arm
+    warm-boots — spawn cost stays honest but compile cost does not
+    multiply), then re-offer the cases at ``overload_factor`` x the
+    fleet's measured capacity through a tightly-budgeted admission gate
+    — the overload-honesty half: the gate must SHED (429-shaped) rather
+    than queue without bound, and the accepted requests' p99 must stay
+    near the unloaded p99.  Returns the walls, the speedup, both
+    arms' results (callers pin bit-identity), and the offered-load
+    accounting."""
+    from nonlocalheatequation_tpu.serve.http import (
+        AdmissionController,
+        offered_load_run,
+    )
+
+    cases = list(cases)
+    if cpus_per_replica is None:
+        # the CPU proxy of per-replica hardware: EVERY worker — the
+        # 1-replica arm's included — gets the same fixed core budget
+        # (one XLA CPU process otherwise spreads over the whole host
+        # and the A/B measures intra-op threading, not fleet scale-out)
+        try:
+            cpus_per_replica = max(
+                1, len(os.sched_getaffinity(0)) // max(2, replicas))
+        except AttributeError:
+            cpus_per_replica = None
+    if len({c.bucket_key() for c in cases}) < replicas:
+        # sticky routing pins a bucket to ONE replica: a case set with
+        # fewer buckets than replicas cannot scale out BY DESIGN, and a
+        # silently meaningless A/B must not bank numbers
+        raise ValueError(
+            f"router A/B needs >= {replicas} distinct buckets (got "
+            f"{len({c.bucket_key() for c in cases})}): sticky routing "
+            "cannot spread one bucket over the fleet")
+    walls: dict[int, float] = {}
+    results: dict[int, list] = {}
+    unloaded_lat: dict = {}
+    arms = [1, replicas] if replicas != 1 else [1]
+    for n in arms:
+        with ReplicaRouter(replicas=n, program_store=store_dir,
+                           window_ms=window_ms, child_env=child_env,
+                           cpus_per_replica=cpus_per_replica,
+                           **engine_kwargs) as router:
+            # pass 1 warms (and, arm 1, populates the shared store);
+            # pass 2 is the steady-state wall the speedup and the
+            # offered-load capacity are computed from — program
+            # compile/load time must not masquerade as serving capacity
+            results[n] = router.serve_cases(cases)
+            t0 = time.perf_counter()
+            router.serve_cases(cases)
+            walls[n] = time.perf_counter() - t0
+            if n == replicas:
+                # pass-2 samples ONLY: pass 1's first-case latencies
+                # carry the AOT store loads, and an inflated "unloaded"
+                # baseline would flatter the overload p99 comparison
+                hist = router.registry.get("/router/request-latency-ms")
+                tail = list(hist.samples)[-len(cases):]
+                unloaded_lat = {
+                    "p50": float(np.percentile(tail, 50)),
+                    "p90": float(np.percentile(tail, 90)),
+                    "p99": float(np.percentile(tail, 99)),
+                }
+    # offered-load sweep over ONE admission-gated fleet (programs warm
+    # from the store): a rate-based point at overload_factor x the
+    # measured capacity, then a burst point (no pacing at all — offered
+    # rate >> capacity by construction, so the shed path is exercised
+    # deterministically, not only when the capacity estimate is tight)
+    capacity_hz = len(cases) / walls[replicas]
+    sweep: dict[str, dict] = {}
+    with ReplicaRouter(replicas=replicas, program_store=store_dir,
+                       window_ms=window_ms, child_env=child_env,
+                       cpus_per_replica=cpus_per_replica,
+                       **engine_kwargs) as router:
+        adm = AdmissionController(
+            router,
+            max_pending=(overload_pending if overload_pending is not None
+                         else max(2, 2 * replicas)))
+        for label, rate in ((f"x{overload_factor:g}",
+                             overload_factor * capacity_hz),
+                            ("burst", 0.0)):
+            run = offered_load_run(adm, cases + cases, rate)
+            run.pop("results", None)
+            run["rate_hz"] = round(rate, 3)
+            sweep[label] = run
+    return {
+        "walls": walls,
+        "speedup": walls[1] / walls[replicas],
+        "capacity_hz": capacity_hz,
+        "results": results,
+        "unloaded_latency_ms": {k: round(v, 3)
+                                for k, v in unloaded_lat.items()},
+        "sweep": sweep,
+    }
+
+
+# -- the worker process -------------------------------------------------------
+
+
+def _worker_main() -> None:
+    """The replica worker: one ServePipeline fed by framed stdin.
+
+    Startup steals fd 1 (stray prints from any library go to stderr;
+    the frame channel is the ORIGINAL stdout, held privately), applies
+    the router's platform/x64 config before any backend touch, points
+    ``NLHEAT_PROGRAM_STORE`` at the shared store, then loops: poll
+    stdin, submit arriving cases, pump the pipeline, and — whenever the
+    intake is momentarily idle with work outstanding — drain, so
+    results flow without the caller-driven fences the in-process
+    pipeline relies on.  The loop accounts its busy wall (time inside
+    pump/drain with work outstanding) per stats window; the router
+    turns that into the fleet's busy rates."""
+    out = os.fdopen(os.dup(1), "wb")
+    os.dup2(2, 1)
+    # all stdin reads go through ONE raw-fd buffer: a BufferedReader's
+    # read-ahead on the config frame could swallow the front of the next
+    # frame and tear the protocol
+    fd = sys.stdin.fileno()
+    buf = bytearray()
+    eof = False
+
+    def read_blocking_frame():
+        nonlocal eof
+        while True:
+            while len(buf) >= _LEN.size:
+                n = _LEN.unpack(bytes(buf[:_LEN.size]))[0]
+                if len(buf) < _LEN.size + n:
+                    break
+                payload = bytes(buf[_LEN.size:_LEN.size + n])
+                del buf[:_LEN.size + n]
+                return pickle.loads(payload)
+            if eof:
+                return None
+            chunk = os.read(fd, 1 << 16)
+            if not chunk:
+                eof = True
+            else:
+                buf.extend(chunk)
+
+    cfg = read_blocking_frame()
+    if cfg is None:
+        return
+    if cfg.get("cpu_affinity"):
+        try:
+            # before the backend exists, so every XLA/Eigen pool thread
+            # inherits the budget (threads created later inherit the
+            # process affinity)
+            os.sched_setaffinity(0, set(cfg["cpu_affinity"]))
+        except (AttributeError, OSError) as e:
+            print(f"replica {cfg.get('replica_id')}: cpu affinity "
+                  f"{cfg['cpu_affinity']} not applied ({e})",
+                  file=sys.stderr)
+    import jax
+
+    if cfg.get("platform"):
+        jax.config.update("jax_platforms", cfg["platform"])
+    if cfg.get("x64") is not None:
+        jax.config.update("jax_enable_x64", bool(cfg["x64"]))
+    store = cfg.get("program_store")
+    if store is not None:
+        os.environ["NLHEAT_PROGRAM_STORE"] = str(store)
+    from nonlocalheatequation_tpu.serve.server import ServePipeline
+
+    pipe = ServePipeline(depth=cfg.get("depth", 1),
+                         window_ms=cfg.get("window_ms", 2.0),
+                         window_size=cfg.get("window_size"),
+                         **cfg.get("serve_kwargs") or {},
+                         **cfg.get("engine_kwargs") or {})
+    _write_frame(out, {"op": "ready", "replica": cfg.get("replica_id")})
+
+    outstanding: dict[int, object] = {}
+    busy_s = 0.0
+    window_t0 = time.monotonic()
+
+    def poll(timeout: float) -> list:
+        """Read every frame currently available (waiting up to
+        ``timeout`` for the first byte)."""
+        nonlocal eof
+        frames = []
+        wait = timeout
+        while not eof:
+            r, _, _ = select.select([fd], [], [], wait)
+            if not r:
+                break
+            chunk = os.read(fd, 1 << 16)
+            if not chunk:
+                eof = True
+                break
+            buf.extend(chunk)
+            wait = 0.0
+        while len(buf) >= _LEN.size:
+            n = _LEN.unpack(bytes(buf[:_LEN.size]))[0]
+            if len(buf) < _LEN.size + n:
+                break
+            payload = bytes(buf[_LEN.size:_LEN.size + n])
+            del buf[:_LEN.size + n]
+            frames.append(pickle.loads(payload))
+        return frames
+
+    def flush_done() -> None:
+        for rid_, h in list(outstanding.items()):
+            if h.result is not None:
+                _write_frame(out, {"op": "result", "id": rid_,
+                                   "values": h.result})
+            elif h.error is not None:
+                e = h.error
+                _write_frame(out, {
+                    "op": "error", "id": rid_,
+                    "classification": e.classification,
+                    "chunk": e.chunk_id, "attempts": e.attempts,
+                    "detail": str(e)})
+            else:
+                continue
+            del outstanding[rid_]
+
+    stopping = False
+    while not stopping:
+        frames = poll(0.002 if outstanding else 0.05)
+        got_case = False
+        for msg in frames:
+            op = msg.get("op")
+            if op == "case":
+                try:
+                    h = pipe.submit(msg["case"],
+                                    deadline_ms=msg.get("deadline_ms"),
+                                    priority=msg.get("priority") or 0)
+                except Exception as e:  # noqa: BLE001 — a malformed
+                    # case must complete EXCEPTIONALLY, not kill the
+                    # worker (a poison frame would otherwise crash-loop
+                    # the fleet through death -> re-route -> death)
+                    _write_frame(out, {
+                        "op": "error", "id": msg["id"],
+                        "classification": "error", "chunk": -1,
+                        "attempts": 0,
+                        "detail": f"submit refused: "
+                                  f"{type(e).__name__}: {e}"})
+                    continue
+                outstanding[msg["id"]] = h
+                got_case = True
+            elif op == "stats":
+                now = time.monotonic()
+                _write_frame(out, {
+                    "op": "stats", "id": msg.get("id"),
+                    "replica": cfg.get("replica_id"),
+                    "pid": os.getpid(),
+                    "metrics": pipe.metrics(),
+                    "snapshot": pipe.registry.snapshot(),
+                    "busy_s": busy_s,
+                    "span_s": now - window_t0,
+                })
+                busy_s = 0.0
+                window_t0 = now
+            elif op == "stop":
+                stopping = True
+        if eof:
+            stopping = True
+        if stopping:
+            break
+        t0 = time.monotonic()
+        pipe.pump()
+        if outstanding and not got_case and not buf:
+            # intake momentarily idle with work queued: flush partial
+            # windows and fence in-flight chunks so results ship now —
+            # the worker-side stand-in for the in-process caller's
+            # wait()/drain() fences
+            pipe.drain()
+        if outstanding:
+            busy_s += time.monotonic() - t0
+        flush_done()
+    try:
+        pipe.drain()
+        flush_done()
+        pipe.close()
+    except Exception:  # noqa: BLE001 — dying cleanly beats a stack trace
+        pass
+    try:
+        _write_frame(out, {"op": "bye"})
+    except OSError:
+        pass
+
+
+if __name__ == "__main__":
+    _worker_main()

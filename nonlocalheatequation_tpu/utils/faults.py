@@ -14,7 +14,7 @@ Plan grammar (env ``NLHEAT_FAULT_PLAN`` or an injected :class:`FaultPlan`)::
 
     plan  := entry ("," entry)*
     entry := kind "@" target ["x" count]
-    kind  := "raise" | "stall" | "nan"
+    kind  := "raise" | "stall" | "nan" | "die"
     target:= INT          -- fires at that dispatch-attempt index (the
                              plan's own 0-based counter of chunk
                              execution attempts, retries and fallback
@@ -53,6 +53,15 @@ Fault semantics at the pipeline's stages:
 * ``nan`` fires in the FETCH stage: the fetched buffer's lane for the
   targeted case (lane 0 for attempt-indexed entries) is overwritten
   with NaN before the supervisor's finite scan sees it.
+* ``die`` is the FLEET-level kind (serve/router.py): it fires at the
+  router's case-forward events — the attempt counter there counts case
+  forwards, not chunk dispatches — and KILLS the replica worker process
+  the case was just routed to (SIGKILL, after the case is genuinely in
+  flight there), driving the death -> re-route -> re-serve path
+  deterministically.  The in-process pipeline ignores armed ``die``
+  entries: a worker killing itself from inside its own scheduler would
+  race the router's reader thread, whereas the router-side kill is
+  ordered with the forward it spans.
 """
 
 from __future__ import annotations
@@ -63,7 +72,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-KINDS = ("raise", "stall", "nan")
+KINDS = ("raise", "stall", "nan", "die")
 
 #: Env var holding the plan spec.  bench.py SCRUBS this from its own
 #: environment (a leaked plan must never corrupt a headline run); the
@@ -118,9 +127,10 @@ class FiredFaults:
     raise_: _Entry | None = None
     stall: threading.Event | None = None
     nan: _Entry | None = None
+    die: _Entry | None = None  # fleet-level: router kills the worker
 
     def any(self) -> bool:
-        return bool(self.raise_ or self.stall or self.nan)
+        return bool(self.raise_ or self.stall or self.nan or self.die)
 
 
 #: The no-faults singleton the unplanned pipeline uses.
@@ -196,6 +206,8 @@ class FaultPlan:
                 ev = threading.Event()
                 self._stalls.append(ev)
                 fired.stall = ev
+            elif e.kind == "die":
+                fired.die = e
             else:
                 fired.nan = e
         return fired

@@ -162,6 +162,42 @@ def test_bench_warmboot_mode_emits_cold_warm_ab(tmp_path):
     assert rec2["warmboot_speedup"] > 0
 
 
+def test_bench_router_mode_emits_fleet_ab(tmp_path):
+    # BENCH_ROUTER=N: the replica-fleet A/B (ISSUE 10, serve/router.py
+    # + serve/http.py) — 1-replica vs N-replica walls over one shared
+    # store dir plus the offered-load sweep through the admission gate.
+    # The JSON must carry the router variant, the speedup, throughput,
+    # accept/shed counts, the latency percentiles, the sweep, and the
+    # bit-identity flag — on the same one-line rc=0 ladder.  Tiny grids
+    # are submit-bound (the 2.5x scale-out acceptance is the calibrated
+    # 256^2+ proxy in docs/round12.md), so this asserts the STRUCTURE,
+    # not the ratio.
+    store = tmp_path / "store"
+    proc, rec = run_bench({"BENCH_ROUTER": "2", "BENCH_GRID": "48",
+                           "BENCH_LADDER": "48", "BENCH_ACCURACY": "0",
+                           "BENCH_ROUTER_STEPS": "60",
+                           "BENCH_ROUTER_CASES": "6",
+                           "BENCH_ROUTER_DIR": str(store)},
+                          timeout=420)
+    assert proc.returncode == 0
+    assert rec["value"] > 0
+    assert rec["variant"] == "router2"
+    assert rec["replicas"] == 2
+    assert rec["cases"] == 6
+    assert rec["router_speedup"] > 0
+    assert rec["throughput_cases_s"] > 0
+    assert rec["bit_identical"] is True
+    assert set(rec["load_sweep"]) == {"x2", "burst"}
+    for point in rec["load_sweep"].values():
+        assert point["offered"] == 12
+        assert point["accepted"] + point["shed"] == point["offered"]
+        assert point["max_pending"] <= 4  # the admission bound (2*N)
+    assert {"p50", "p99", "unloaded_p99"} <= set(rec["latency_ms"])
+    # the fleet arms shared ONE store dir: the single-replica arm
+    # populated it, so the dir holds serialized executables
+    assert list(store.glob("*.aotprog"))
+
+
 def test_bench_scrubs_leaked_program_store():
     # a store dir leaked from a developer shell must not silently
     # warm-boot a headline measurement's compiles

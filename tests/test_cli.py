@@ -171,6 +171,82 @@ def test_serve_supervision_flag_refusals():
     assert r.returncode == 2 and "--serve-fallback" in r.stderr
 
 
+def test_listen_flag_refusals():
+    # ISSUE 10: the front-door flags' honesty checks — --listen excludes
+    # the stdin-driven modes, --replicas needs --listen, and the serial-
+    # engine rule carries over from --serve/--ensemble
+    r = run_cli("solve2d", ["--listen", "0", "--test_batch"], stdin="0\n")
+    assert r.returncode == 1 and "--test_batch" in r.stderr
+    r = run_cli("solve2d", ["--listen", "0", "--test"])
+    assert r.returncode == 1 and "--test belongs" in r.stderr
+    r = run_cli("solve2d", ["--replicas", "2"], stdin="")
+    assert r.returncode == 1 and "--replicas" in r.stderr \
+        and "--listen" in r.stderr
+    r = run_cli("solve2d", ["--listen", "0", "--replicas", "0"], stdin="")
+    assert r.returncode == 1 and "N >= 1" in r.stderr
+    r = run_cli("solve2d", ["--listen", "99999"], stdin="")
+    assert r.returncode == 1 and "[0, 65535]" in r.stderr
+    r = run_cli("solve3d", ["--listen", "0", "--distributed"], stdin="")
+    assert r.returncode == 1 and "--distributed" in r.stderr
+
+
+def test_listen_serves_http_and_stops_on_stdin_eof():
+    # ISSUE 10 end to end on the CLI surface: --listen starts the
+    # ingress over a worker fleet, serves a POSTed case bit-identically
+    # to the offline engine, and exits 0 when stdin reaches EOF
+    import json as _json
+    import re
+    import urllib.request
+
+    import numpy as np
+
+    from nonlocalheatequation_tpu.serve.ensemble import (
+        EnsembleCase,
+        EnsembleEngine,
+    )
+
+    rng = np.random.default_rng(7)
+    u0 = rng.normal(size=(12, 12))
+    want = EnsembleEngine(method="conv").run(
+        [EnsembleCase(shape=(12, 12), nt=3, eps=2, k=1.0, dt=1e-5,
+                      dh=1.0 / 12, test=False, u0=u0)])[0]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nonlocalheatequation_tpu.cli.solve2d",
+         "--listen", "0", "--platform", "cpu", "--x64", "1",
+         "--method", "conv"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, cwd=REPO)
+    try:
+        port = None
+        for _ in range(400):
+            line = proc.stderr.readline()
+            m = re.search(r"http://127.0.0.1:(\d+)/v1/cases", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, "ingress endpoint line never printed"
+        body = dict(shape=[12, 12], nt=3, eps=2, k=1.0, dt=1e-5,
+                    dh=1.0 / 12, u0=u0.tolist())
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/cases",
+            _json.dumps(body).encode()))
+        case_id = _json.load(r)["id"]
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/cases/{case_id}?wait=1")
+        assert _json.load(r)["status"] == "done"
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/cases/{case_id}/result")
+        res = _json.load(r)
+        assert np.array_equal(
+            np.asarray(res["values"]).reshape(res["shape"]), want)
+    finally:
+        proc.stdin.close()  # EOF = shutdown
+        rc = proc.wait(timeout=120)
+    err = proc.stderr.read()
+    assert rc == 0, err
+    assert "router:" in err and '"cases": 1' in err
+
+
 def test_serve_nan_policy_serve_restores_diverged_result_contract():
     # --serve-nan-policy serve: a deterministically divergent case is a
     # SERVED result judged by the oracle criterion (PR 3's contract) —

@@ -23,7 +23,7 @@ ALL_STEPS = [
     "bench4096", "resident512", "carried4096", "superstep2",
     "bf16-4096", "bf16-carried4096", "ensemble8x1024", "serve8x1024",
     "servefault8x1024", "obs8x1024", "multichip1024", "fft4096",
-    "tta4096", "warmboot1024",
+    "tta4096", "warmboot1024", "router8x1024",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
@@ -180,6 +180,32 @@ def test_warmboot_step_banks_store_evidence(tmp_path):
     # the persistent store dir holds the serialized executable the next
     # heal window will reuse
     assert list(store_dir.glob("*.aotprog"))
+
+
+@pytest.mark.slow  # ~60 s (a gate bench + the router fleet child, which
+# spawns worker processes) — the fleet machinery is tier-1-covered by
+# tests/test_router.py and tests/test_bench_harness.py; this proves the
+# queue's gate parses speedup/shed/bit-identity before banking, and that
+# the step's deliberate cpu-labeled rows pass its backend exemption
+def test_router_step_banks_fleet_evidence(tmp_path):
+    proc, state, table, _out = _run(
+        tmp_path, "router8x1024",
+        # tiny-grid CPU smoke: 2 replicas (17 worker spawns would eat
+        # the CI budget), a step floor that keeps per-case compute above
+        # the submit cost so the burst point genuinely sheds, and the
+        # speedup gate relaxed to structure (the 2.5x acceptance is the
+        # calibrated 256^2 proxy, docs/round12.md)
+        {"OPP_ROUTER_REPLICAS": "2", "OPP_GRID_ROUTER": "32",
+         "BENCH_ROUTER_STEPS": "600",
+         "OPP_ROUTER_MIN_SPEEDUP": "0.1"}, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    assert "router8x1024\n" in state
+    assert "fail:" not in state
+    assert '"variant": "router2"' in table
+    assert '"router_speedup"' in table
+    assert '"load_sweep"' in table
+    assert '"bit_identical": true' in table
 
 
 @pytest.mark.slow  # ~73 s: two strike rounds, each a full bench child plus

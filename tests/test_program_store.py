@@ -88,7 +88,8 @@ def test_warm_boot_zero_retrace_bit_identical(tmp_path, monkeypatch):
     # executable is the program
     assert builds["n"] == 1 and compiles["n"] == 1
     assert warm_eng.program_store.stats() == {
-        "hits": 1, "misses": 0, "saves": 0, "refusals": {}}
+        "hits": 1, "misses": 0, "saves": 0, "gc_evictions": 0,
+        "refusals": {}}
     for a, b, c in zip(base, cold, warm):
         assert np.array_equal(a, b) and np.array_equal(a, c)
     # honesty: a loaded program's strategy label says where it came from,
@@ -528,6 +529,88 @@ def test_pipeline_adopting_prewarmed_engine_keeps_store_metrics(tmp_path):
 
 
 # -- store internals --------------------------------------------------------
+
+
+def test_store_gc_evicts_lru_within_cap(tmp_path):
+    # ISSUE 10 satellite (round11 carried-forward): a fleet's shared dir
+    # grows without bound with key diversity — the store evicts
+    # least-recently-USED entries past cap_bytes, never the entry just
+    # written, counting /store/gc-evictions
+    import time as _time
+
+    d = tmp_path / "store"
+    d.mkdir()
+    store = ps.ProgramStore(str(d), cap_bytes=150)
+    now = _time.time()
+    for i in range(3):
+        p = d / f"e{i}.aotprog"
+        p.write_bytes(b"x" * 60)
+        os.utime(p, (now - 100 + i, now - 100 + i))
+    # a load hit refreshes recency: touch e0 so e1 becomes the LRU
+    os.utime(d / "e0.aotprog", None)
+    kept = d / "kept.aotprog"
+    kept.write_bytes(b"x" * 60)
+    os.utime(kept, (now - 200, now - 200))  # oldest mtime of all...
+    removed = store._gc(keep=str(kept))  # ...but never self-evicted
+    assert removed == 2
+    assert store.stats()["gc_evictions"] == 2
+    left = set(_entries(d))
+    assert "kept.aotprog" in left and "e0.aotprog" in left
+    assert left == {"kept.aotprog", "e0.aotprog"}
+    # two-process-safe delete: a file another GC already removed is a
+    # skipped eviction, not an error
+    ghost = d / "ghost.aotprog"
+    ghost.write_bytes(b"x" * 500)
+    real_remove = os.remove
+
+    def racing_remove(path):
+        if path.endswith("ghost.aotprog"):
+            real_remove(path)  # the "other process" wins first
+        real_remove(path)
+
+    import unittest.mock as mock
+
+    with mock.patch("os.remove", racing_remove):
+        store._gc()
+    assert "ghost.aotprog" not in _entries(d)
+
+
+def test_store_gc_end_to_end_saves_trigger_eviction(tmp_path, monkeypatch):
+    # real saves over a tiny cap: key diversity (distinct nt buckets)
+    # writes several entries, the cap keeps the DIR bounded, and a
+    # post-eviction engine still serves (fresh compile on the evicted
+    # key — eviction can never change results, only re-pay a compile)
+    d = tmp_path / "store"
+    monkeypatch.setenv("NLHEAT_PROGRAM_STORE_CAP_MB", "0.02")  # ~20 KB
+    store = ps.ProgramStore(str(d))
+    assert store.cap_bytes == int(0.02 * 1024 * 1024)
+    engine = EnsembleEngine(method="conv", batch_sizes=(1,),
+                            program_store=store)
+    cases = [_cases(1, nt=3 + i, seed=i)[0] for i in range(4)]
+    want = EnsembleEngine(method="conv", batch_sizes=(1,)).run(cases)
+    got = engine.run(cases)
+    assert all(np.array_equal(a, b) for a, b in zip(want, got))
+    stats = engine.program_store.stats()
+    assert stats["saves"] == 4
+    sizes = sum(os.path.getsize(os.path.join(d, p)) for p in _entries(d))
+    if stats["gc_evictions"]:  # entry size is backend-dependent; when
+        # the cap engaged, the dir must have stayed within it
+        assert sizes <= store.cap_bytes
+        # an evicted key re-serves via fresh compile, bit-identically
+        engine2 = EnsembleEngine(method="conv", batch_sizes=(1,),
+                                 program_store=ps.ProgramStore(str(d)))
+        got2 = engine2.run(cases)
+        assert all(np.array_equal(a, b) for a, b in zip(want, got2))
+
+
+def test_store_cap_env_refusals(monkeypatch):
+    monkeypatch.setenv("NLHEAT_PROGRAM_STORE_CAP_MB", "0")
+    assert ps.store_cap_from_env() is None  # 0 = unbounded (0-knob rule)
+    monkeypatch.delenv("NLHEAT_PROGRAM_STORE_CAP_MB")
+    assert ps.store_cap_from_env() is None
+    monkeypatch.setenv("NLHEAT_PROGRAM_STORE_CAP_MB", "-1")
+    with pytest.raises(ValueError, match="CAP_MB must be >= 0"):
+        ps.store_cap_from_env()
 
 
 def test_env_dir_resolution(monkeypatch):

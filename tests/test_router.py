@@ -1,0 +1,545 @@
+"""Replica-fleet front door (ISSUE 10): router + HTTP ingestion tier.
+
+Covers the tentpole contracts end to end on the CPU suite:
+
+* sticky bucket routing (same bucket -> same replica, spy-pinned via the
+  router's ownership map and the workers' own counters),
+* warm fleet boot (a replica added mid-run inherits buckets and serves
+  them from the shared AOT program store: ``store_hits >= 1``, ZERO
+  programs built — the zero-retrace spy),
+* replica-kill chaos via the deterministic ``die`` plan kind
+  (utils/faults.py): no lost results, no duplicates, re-served output
+  bit-identical to the offline ``EnsembleEngine.run()``,
+* admission control: bounded queues, 429 + Retry-After shedding
+  (deterministic via a stub backend whose completion the test controls),
+* the factored busy-rate scale policy (parallel/elastic.py) and the
+  router's elastic add/drain actuation,
+* the obs satellites: per-replica metric namespaces (absorb_snapshot),
+  the aggregated /metrics scrape, and the EventLog pid/replica stamp.
+
+Worker processes are real (subprocess + jax import each), so the fleet
+tests batch several assertions per spawned router to hold the tier-1
+budget.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from nonlocalheatequation_tpu.obs.export import EventLog, MetricsServer
+from nonlocalheatequation_tpu.obs.metrics import (
+    MetricsRegistry,
+    absorb_snapshot,
+)
+from nonlocalheatequation_tpu.parallel.elastic import (
+    BusyRatePolicy,
+    FleetTelemetry,
+    fleet_scale_decision,
+)
+from nonlocalheatequation_tpu.parallel.load_balance import BUSY_SCALE
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase,
+    EnsembleEngine,
+)
+from nonlocalheatequation_tpu.serve.http import (
+    AdmissionController,
+    IngressServer,
+    parse_case,
+)
+from nonlocalheatequation_tpu.serve.router import (
+    ReplicaRouter,
+    RouterOverloaded,
+)
+
+assert jax.config.jax_enable_x64  # the oracle contract (conftest forces it)
+
+
+def make_cases(n, grid=16, nt=4, buckets=2, seed=0):
+    """n production cases over `buckets` distinct bucket keys (nt
+    varies — the bucket key is (shape, nt, eps, test))."""
+    rng = np.random.default_rng(seed)
+    return [EnsembleCase(shape=(grid, grid), nt=nt + (i % buckets), eps=2,
+                         k=1.0, dt=1e-5, dh=1.0 / grid, test=False,
+                         u0=rng.normal(size=(grid, grid)))
+            for i in range(n)]
+
+
+def offline(cases, **kw):
+    return EnsembleEngine(method="sat", batch_sizes=(1,), **kw).run(cases)
+
+
+# ---------------------------------------------------------------------------
+# the fleet itself (real worker processes)
+# ---------------------------------------------------------------------------
+
+
+def test_sticky_routing_bit_identity_and_fleet_scrape():
+    cases = make_cases(8, buckets=2)
+    want = offline(cases)
+    with ReplicaRouter(replicas=2, method="sat",
+                       batch_sizes=(1,)) as router:
+        got = router.serve_cases(cases)
+        # bit-identical to the offline engine, in submission order
+        assert all(np.array_equal(a, b) for a, b in zip(want, got))
+        m = router.metrics()
+        assert m["cases"] == 8 and m["outstanding"] == 0
+        assert m["deaths"] == 0 and m["buckets"] == 2
+        # sticky: each bucket owned by exactly one replica, balanced
+        owners = {}
+        for c in cases:
+            key = c.bucket_key()
+            assert key in router._owner
+            owners[key] = router._owner[key]
+        assert len(set(owners.values())) == 2  # spread over the fleet
+        # a second pass reuses the SAME owners (the cache-warmth rule)
+        router.serve_cases(cases)
+        for key, rid in owners.items():
+            assert router._owner[key] == rid
+        # per-replica namespaces: a stats pull absorbs each worker's
+        # registry under /replica{r}, and busy-rate gauges appear
+        stats = router.refresh_stats()
+        assert set(stats) == {0, 1}
+        for rid, frame in stats.items():
+            assert frame["pid"] > 0 and frame["replica"] == rid
+            assert frame["metrics"]["cases"] >= 1
+        names = router.registry.names()
+        assert any(n.startswith("/replica{0}/serve/") for n in names)
+        assert any(n.startswith("/replica{1}/serve/") for n in names)
+        assert "/replica{0}/busy-rate" in names
+        # ONE scrape exposes the whole fleet (merged exposition)
+        text = router.registry.prometheus()
+        assert 'nlheat_replica_serve_depth{replica="0"}' in text
+        assert 'nlheat_replica_serve_depth{replica="1"}' in text
+
+
+def test_warm_added_replica_boots_from_shared_store(tmp_path):
+    store = str(tmp_path / "store")
+    cases = make_cases(6, buckets=2)
+    want = offline(cases)
+    with ReplicaRouter(replicas=1, method="sat", batch_sizes=(1,),
+                       program_store=store, max_replicas=2) as router:
+        got = router.serve_cases(cases)
+        assert all(np.array_equal(a, b) for a, b in zip(want, got))
+        # replica 0 populated the shared store (one save per bucket)
+        stats0 = router.refresh_stats()[0]
+        assert stats0["metrics"]["store"]["saves"] >= 2
+        # scale out mid-run: the newcomer inherits a fair share of the
+        # buckets (1 of 2) ...
+        rid = router.add_replica()
+        rep = router._replicas[rid]
+        assert len(rep.buckets) == 1
+        moved = next(iter(rep.buckets))
+        assert router._owner[moved] == rid
+        # ... and serves its first chunks from the store: store_hits
+        # >= 1 with ZERO programs built — the zero-retrace spy
+        got2 = router.serve_cases(cases)
+        assert all(np.array_equal(a, b) for a, b in zip(want, got2))
+        stats = router.refresh_stats()
+        new = stats[rid]["metrics"]
+        assert new["cases"] >= 1  # the moved bucket's cases landed here
+        assert new["store"]["hits"] >= 1
+        assert new["programs_loaded"] >= 1
+        assert new["programs_built"] == 0
+        # drain the newcomer back out: ownership reassigns, results flow
+        router.drain_replica(rid)
+        got3 = router.serve_cases(cases)
+        assert all(np.array_equal(a, b) for a, b in zip(want, got3))
+        assert router.live_count() == 1
+
+
+def test_replica_kill_chaos_reroutes_bit_identically():
+    cases = make_cases(8, buckets=2)
+    want = offline(cases)
+    # die@2: the worker the THIRD case-forward was routed to is killed
+    # with that case (and its chunk-mates) in flight
+    with ReplicaRouter(replicas=2, method="sat", batch_sizes=(1,),
+                       faults="die@2", respawn=False) as router:
+        handles = [router.submit(c) for c in cases]
+        router.drain()
+        m = router.metrics()
+        assert m["deaths"] == 1
+        assert m["requeued"] >= 1
+        # no lost results: every handle delivered exactly once, and the
+        # re-served output is bit-identical to the offline oracle
+        for h, w in zip(handles, want):
+            assert h.error is None
+            assert np.array_equal(h.result, w)
+        assert m["outstanding"] == 0
+    # respawn path: a 1-replica fleet whose only worker dies must come
+    # back (the floor) and still serve everything
+    with ReplicaRouter(replicas=1, method="sat", batch_sizes=(1,),
+                       faults="die@1", respawn=True) as router:
+        got = router.serve_cases(cases)
+        assert all(np.array_equal(a, b) for a, b in zip(want, got))
+        m = router.metrics()
+        assert m["deaths"] == 1 and m["spawns"] == 2
+        assert m["replicas"] == 1
+
+
+def test_poison_frame_classifies_without_killing_the_worker():
+    # a case the worker's pipeline refuses at submit must complete
+    # EXCEPTIONALLY (error frame) — not kill the worker, which would
+    # crash-loop the fleet through death -> re-route -> death
+    good = make_cases(2, buckets=1)
+    want = offline(good)
+    with ReplicaRouter(replicas=1, method="sat",
+                       batch_sizes=(1,)) as router:
+        # a deadline the worker's pipeline cannot arithmetic on (the
+        # HTTP tier 400s this; the router API passes it through)
+        h_bad = router.submit(good[0], deadline_ms="soon")
+        with pytest.raises(Exception, match="submit refused"):
+            h_bad.wait(timeout=60)
+        # the worker survived and keeps serving
+        got = router.serve_cases(good)
+        assert all(np.array_equal(a, b) for a, b in zip(want, got))
+        assert router.metrics()["deaths"] == 0
+        # parent-side poison (an unhashable bucket key) refuses in
+        # submit() itself without leaking a ledger entry
+        bad = EnsembleCase(shape=None, nt=3, eps=2, k=1.0, dt=1e-5,
+                           dh=0.1, test=False, u0=None)
+        with pytest.raises(TypeError):
+            router.submit(bad)
+        assert router.outstanding_total() == 0
+
+
+def test_replica_killing_case_quarantines_at_requeue_cap():
+    # the fleet-level quarantine: die@0x* kills the replica of EVERY
+    # forward, so the case's re-route budget (MAX_REQUEUES) must end the
+    # cycle with a typed error instead of respawn-looping forever
+    case = make_cases(1, buckets=1)[0]
+    with ReplicaRouter(replicas=1, method="sat", batch_sizes=(1,),
+                       faults="die@0x*", respawn=True) as router:
+        h = router.submit(case)
+        with pytest.raises(Exception, match="MAX_REQUEUES"):
+            h.wait(timeout=180)
+        m = router.metrics()
+        assert m["deaths"] >= 1 and m["outstanding"] == 0
+
+
+def test_elastic_scale_actuation(monkeypatch):
+    with ReplicaRouter(replicas=1, method="sat", batch_sizes=(1,),
+                       min_replicas=1, max_replicas=2) as router:
+        monkeypatch.setattr(router, "refresh_stats", lambda: {})
+        # every replica saturated -> add
+        router._telemetry.record_window(0, 0.95, 1.0)
+        assert router.maybe_scale() == "add"
+        assert router.live_count() == 2
+        assert router.metrics()["scale_ups"] == 1
+        # every replica idle -> drain back to the floor
+        for rep in router._replicas.values():
+            if rep.alive:
+                router._telemetry.record_window(rep.rid, 0.01, 1.0)
+        assert router.maybe_scale() == "drain"
+        assert router.live_count() == 1
+        assert router.metrics()["scale_downs"] == 1
+        # inside the hysteresis band -> no action
+        for rep in router._replicas.values():
+            if rep.alive:
+                router._telemetry.record_window(rep.rid, 0.5, 1.0)
+        assert router.maybe_scale() is None
+
+
+# ---------------------------------------------------------------------------
+# the factored policy (pure units — no processes)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_scale_decision_watermarks():
+    hi, lo = 0.9 * BUSY_SCALE, 0.1 * BUSY_SCALE
+    # all saturated + headroom -> add; at the ceiling -> hold
+    assert fleet_scale_decision([hi, hi], 2, n_max=4) == "add"
+    assert fleet_scale_decision([hi, hi], 4, n_max=4) is None
+    # ONE idle replica disproves saturation (min aggregation)
+    assert fleet_scale_decision([hi, lo], 2, n_max=4) is None
+    # all idle + above the floor -> drain; at the floor -> hold
+    assert fleet_scale_decision([lo, lo], 2) == "drain"
+    assert fleet_scale_decision([lo], 1) is None
+    # the hysteresis band holds steady
+    mid = 0.5 * BUSY_SCALE
+    assert fleet_scale_decision([mid, mid], 2, n_max=4) is None
+    assert fleet_scale_decision([], 1, n_max=4) is None
+
+
+def test_fleet_telemetry_and_policy_window_fallback():
+    t = FleetTelemetry()
+    t.record_window(0, 0.5, 1.0)
+    t.record_window(1, 2.0, 1.0)  # clamped to a full window
+    assert t.busy_rates().tolist() == [0.5 * BUSY_SCALE, BUSY_SCALE]
+    assert t.rate(1) == BUSY_SCALE
+    policy = BusyRatePolicy(t)
+    rates = policy.window_rates()
+    assert rates.any()
+    policy.reset()  # FleetTelemetry.reset clears the window ...
+    assert not t.busy_rates().any() if t.busy_rates().size else True
+    # ... but the last non-empty window still backs the reports
+    assert policy.rates_or_last().tolist() == rates.tolist()
+    t.forget(1)
+    assert t.rate(1) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# admission control + the HTTP tier (deterministic stub backend)
+# ---------------------------------------------------------------------------
+
+
+class _StubRequest:
+    def __init__(self, case, seq):
+        self.case = case
+        self.seq = seq
+        self.result = None
+        self.error = None
+        self.latency_s = None
+        self.replica = 0
+        self.requeues = 0
+        self.done = threading.Event()
+
+
+class _StubBackend:
+    """A router-shaped backend whose completion the TEST controls: cases
+    queue until ``finish(n)`` releases them — so the 2x-saturating-load
+    scenario is a deterministic sequence of events, not a timing race."""
+
+    def __init__(self, max_outstanding=4):
+        self.registry = MetricsRegistry()
+        self.max_outstanding = max_outstanding
+        self._pending = []
+        self._seq = 0
+        self._gauge = self.registry.gauge("/router/outstanding")
+        self.registry.histogram("/router/request-latency-ms").observe(100.0)
+
+    def live_count(self):
+        return 1
+
+    def outstanding_total(self):
+        return len(self._pending)
+
+    def retry_after_s(self):
+        return 0.25
+
+    def submit(self, case, deadline_ms=None, priority=0):
+        if len(self._pending) >= self.max_outstanding:
+            raise RouterOverloaded(len(self._pending),
+                                   self.max_outstanding, 0.25)
+        req = _StubRequest(case, self._seq)
+        self._seq += 1
+        self._pending.append(req)
+        self._gauge.set(len(self._pending))
+        return req
+
+    def finish(self, n=1):
+        for _ in range(n):
+            req = self._pending.pop(0)
+            req.result = np.asarray(req.case.u0, np.float64)
+            req.latency_s = 0.1
+            req.done.set()
+        self._gauge.set(len(self._pending))
+
+    def metrics(self):
+        return {"replicas": 1, "outstanding": len(self._pending),
+                "deaths": 0, "cases": self._seq}
+
+
+def test_admission_sheds_before_queues_grow():
+    backend = _StubBackend(max_outstanding=4)
+    adm = AdmissionController(backend, max_pending=4)
+    cases = make_cases(8, buckets=1)
+    granted, sheds = [], []
+    for c in cases:  # 2x the admitted budget, offered all at once
+        req, retry = adm.try_submit(c)
+        (granted if req is not None else sheds).append(retry)
+    # the queue is BOUNDED: exactly the budget admitted, the rest shed
+    # with a positive retry hint (scaled up as the backlog deepens)
+    assert len(granted) == 4 and len(sheds) == 4
+    assert backend.outstanding_total() == 4
+    assert all(r and r > 0 for r in sheds)
+    reg = backend.registry
+    assert reg.get("/ingress/accepted").value == 4
+    assert reg.get("/ingress/shed").value == 4
+    # capacity freed -> admission opens again
+    backend.finish(2)
+    req, retry = adm.try_submit(cases[0])
+    assert req is not None and retry is None
+    # the queue-wait bound sheds too: observed p50 (100 ms seeded) over
+    # a 50 ms budget refuses even with depth available
+    tight = AdmissionController(backend, max_pending=100,
+                                max_queue_wait_ms=50.0)
+    req, retry = tight.try_submit(cases[0])
+    assert req is None and retry > 0
+
+
+def test_http_ingress_end_to_end_over_stub():
+    backend = _StubBackend(max_outstanding=2)
+    ing = IngressServer(0, backend, max_pending=2)
+    try:
+        base = f"http://127.0.0.1:{ing.port}"
+        rng = np.random.default_rng(3)
+        u0 = rng.normal(size=(4, 4))
+        body = dict(shape=[4, 4], nt=3, eps=1, k=1.0, dt=1e-5, dh=0.25,
+                    u0=u0.tolist())
+
+        def post(payload):
+            try:
+                r = urllib.request.urlopen(urllib.request.Request(
+                    base + "/v1/cases", json.dumps(payload).encode()))
+                return r.status, dict(r.headers), json.load(r)
+            except urllib.error.HTTPError as e:
+                return e.code, dict(e.headers), json.load(e)
+
+        s1, _, r1 = post(body)
+        s2, _, _r2 = post(body)
+        assert (s1, s2) == (202, 202) and r1 == {"id": 0,
+                                                 "status": "queued"}
+        # 2x the budget: the third submission sheds with Retry-After
+        s3, h3, r3 = post(body)
+        assert s3 == 429
+        assert int(h3["Retry-After"]) >= 1
+        assert r3["error"] == "overloaded" and r3["retry_after_s"] > 0
+        # malformed case -> a client 400, never a worker stack trace
+        s4, _, r4 = post({"shape": [4, 4]})
+        assert s4 == 400 and "missing case field" in r4["error"]
+        # malformed scheduling fields are 400s too (they would otherwise
+        # reach — and kill — a worker process downstream)
+        s5, _, r5 = post({**body, "deadline_ms": "soon"})
+        assert s5 == 400 and "deadline_ms" in r5["error"]
+        s6, _, r6 = post({**body, "priority": "high"})
+        assert s6 == 400 and "priority" in r6["error"]
+        # a non-dict body and a bad timeout_s are client errors as well
+        s7, _, r7 = post([1, 2, 3])
+        assert s7 == 400 and "JSON object" in r7["error"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                base + "/v1/cases/0?wait=1&timeout_s=abc")
+        assert exc.value.code == 400
+        # poll while queued, then wait -> done -> fetch the result
+        r = urllib.request.urlopen(base + "/v1/cases/0")
+        assert json.load(r)["status"] == "queued"
+        backend.finish(2)
+        r = urllib.request.urlopen(base + "/v1/cases/0?wait=1&timeout_s=10")
+        assert json.load(r)["status"] == "done"
+        r = urllib.request.urlopen(base + "/v1/cases/0/result")
+        res = json.load(r)
+        got = np.asarray(res["values"]).reshape(res["shape"])
+        assert np.array_equal(got, u0)
+        # health + the aggregated scrape
+        r = urllib.request.urlopen(base + "/healthz")
+        assert json.load(r)["ok"] is True
+        r = urllib.request.urlopen(base + "/metrics")
+        text = r.read().decode()
+        assert "nlheat_ingress_shed 1" in text
+        assert "nlheat_router_outstanding" in text
+        r = urllib.request.urlopen(base + "/metrics.json")
+        assert json.load(r)["/ingress/accepted"] == 2
+        # unknown id -> 404
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/v1/cases/999")
+        assert exc.value.code == 404
+    finally:
+        ing.close()
+
+
+def test_parse_case_refusals():
+    ok = parse_case({"shape": [4], "nt": 2, "eps": 1, "k": 1.0,
+                     "dt": 1e-5, "dh": 0.25, "test": True})
+    assert ok.shape == (4,) and ok.test
+    for bad, msg in [
+        ({"shape": [4, 4], "nt": 2, "eps": 1, "k": 1, "dt": 1, "dh": 1},
+         "needs u0"),  # production case without a state
+        ({"shape": [0], "nt": 2, "eps": 1, "k": 1, "dt": 1, "dh": 1},
+         "bad shape"),
+        ({"shape": [4], "nt": 0, "eps": 1, "k": 1, "dt": 1, "dh": 1},
+         "nt >= 1"),
+        ({"shape": [4], "nt": 2, "eps": 1, "k": 1, "dt": 1, "dh": 1,
+          "u0": [1.0, 2.0]}, "u0 has 2 values"),
+        ({"nt": 2}, "missing case field"),
+    ]:
+        with pytest.raises(ValueError, match=msg):
+            parse_case(bad)
+
+
+# ---------------------------------------------------------------------------
+# obs satellites: per-replica namespaces, merged scrape, event-log stamp
+# ---------------------------------------------------------------------------
+
+
+def test_absorb_snapshot_flattens_foreign_registries():
+    src = MetricsRegistry()
+    src.counter("/serve/retries").inc(3)
+    src.gauge("/serve/depth").set(2)
+    src.histogram("/serve/request-latency-ms").observe(5.0)
+    src.labeled("/serve/faults")["hang"] = 1
+    dst = MetricsRegistry()
+    absorb_snapshot(dst, "/replica{7}", src.snapshot())
+    assert dst.get("/replica{7}/serve/retries").value == 3
+    assert dst.get("/replica{7}/serve/depth").value == 2
+    assert dst.get("/replica{7}/serve/request-latency-ms/count").value == 1
+    assert dst.get("/replica{7}/serve/faults/hang").value == 1
+    text = dst.prometheus()
+    assert 'nlheat_replica_serve_retries{replica="7"} 3' in text
+    # absorbing a refreshed snapshot UPDATES in place (gauges, no dupes)
+    src.counter("/serve/retries").inc()
+    absorb_snapshot(dst, "/replica{7}", src.snapshot())
+    assert dst.get("/replica{7}/serve/retries").value == 4
+
+
+def test_metrics_server_aggregates_registries():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("/router/cases").inc(5)
+    b.gauge("/replica{0}/serve/depth").set(1)
+    server = MetricsServer(0, [a, b])
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        text = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "nlheat_router_cases 5" in text
+        assert 'nlheat_replica_serve_depth{replica="0"} 1' in text
+        snap = json.load(urllib.request.urlopen(base + "/metrics.json"))
+        assert snap["/router/cases"] == 5
+        assert snap["/replica{0}/serve/depth"] == 1
+    finally:
+        server.close()
+
+
+def test_event_log_stamps_pid_and_replica(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    log = EventLog(str(path))
+    log.emit(event="chunk", chunk=1)
+    log.close()
+    monkeypatch.setenv("NLHEAT_REPLICA_ID", "3")
+    log = EventLog(str(path))  # replica id picked up from the env
+    log.emit(event="chunk", chunk=2)
+    log.close()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert len(lines) == 2
+    import os as _os
+
+    assert lines[0]["pid"] == _os.getpid() and "replica" not in lines[0]
+    assert lines[1] == {"pid": _os.getpid(), "replica": 3,
+                        "event": "chunk", "chunk": 2}
+
+
+# ---------------------------------------------------------------------------
+# refusals
+# ---------------------------------------------------------------------------
+
+
+def test_router_ctor_refusals():
+    with pytest.raises(ValueError, match="replicas must be >= 1"):
+        ReplicaRouter(replicas=0)
+    with pytest.raises(ValueError, match="max_outstanding"):
+        ReplicaRouter(replicas=1, max_outstanding=0)
+    with pytest.raises(ValueError, match="min_replicas"):
+        ReplicaRouter(replicas=2, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="bad fault-plan entry"):
+        ReplicaRouter(replicas=1, faults="explode@1")
+
+
+def test_router_load_ab_refuses_bucket_starvation():
+    from nonlocalheatequation_tpu.serve.router import router_load_ab
+
+    with pytest.raises(ValueError, match="distinct buckets"):
+        router_load_ab({}, make_cases(4, buckets=1), 2, None)

@@ -19,7 +19,11 @@ Env:
     BT_OBS_ITERS (5, min-of iterations for the obs group's
     traced-vs-untraced A/B — the overhead ratio is a difference of two
     near-equal walls, so it needs more samples than the big ratios),
-    BT_WB_GRID (1024 / 64, the warmboot group's cold-vs-warm boot grid)
+    BT_WB_GRID (1024 / 64, the warmboot group's cold-vs-warm boot grid),
+    BT_ROUTER_REPLICAS (4, the router group's fleet size) +
+    BT_ROUTER_GRID (512 / 128) + BT_ROUTER_CASES (16) + BT_ROUTER_STEPS
+    (200 / 800: per-case scan length — compute must dominate the
+    router's per-case submit cost or the sweep measures the pickler)
 """
 
 from __future__ import annotations
@@ -939,6 +943,60 @@ def bench_warmboot(steps: int):
          bit_identical=bool(np.array_equal(out_cold, out_warm)))
 
 
+def bench_router(steps: int):
+    """Replica-fleet scale-out + overload honesty (ISSUE 10,
+    serve/router.py + serve/http.py): the same mixed-bucket case set
+    served by a 1-replica and an N-replica router over ONE shared AOT
+    store dir (the fleet arm warm-boots the single arm's compiles),
+    then the offered-load sweep through the admission gate — the paced
+    2x-capacity point and the burst point that must SHED rather than
+    queue.  Off-TPU this is the headline CPU proxy of per-replica
+    hardware (each worker pinned to the same fixed core budget in both
+    arms); on a TPU host the group refuses — N replica processes cannot
+    share the single tunneled chip."""
+    import shutil
+    import tempfile
+
+    from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+    from nonlocalheatequation_tpu.serve.router import router_load_ab
+
+    if on_tpu():
+        log("  router: skipped on TPU (replica fleets assume one "
+            "accelerator per worker; run with BENCH_PLATFORM=cpu)")
+        return
+    replicas = int(os.environ.get("BT_ROUTER_REPLICAS", 4))
+    n = cfg("BT_ROUTER_GRID", 512, 128)
+    C = int(os.environ.get("BT_ROUTER_CASES", 16))
+    rsteps = cfg("BT_ROUTER_STEPS", 200, 800)
+    buckets = max(replicas, min(8, C))
+    rng = np.random.default_rng(0)
+    cases = [EnsembleCase(shape=(n, n), nt=rsteps + (i % buckets), eps=8,
+                          k=1.0, dt=1e-7, dh=1.0 / n, test=False,
+                          u0=rng.normal(size=(n, n)))
+             for i in range(C)]
+    store_dir = tempfile.mkdtemp(prefix="nlheat-bt-router-")
+    try:
+        ab = router_load_ab({"method": "sat", "batch_sizes": (1,)},
+                            cases, replicas, store_dir)
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+    bit = all(np.array_equal(a, b)
+              for a, b in zip(ab["results"][1], ab["results"][replicas]))
+    total_steps = sum(c.nt for c in cases)
+    emit("router/1replica", n * n * C, total_steps // C, ab["walls"][1],
+         grid=n, eps=8, replicas=1, cases=C)
+    burst = ab["sweep"]["burst"]
+    paced = ab["sweep"]["x2"]
+    emit(f"router/{replicas}replica", n * n * C, total_steps // C,
+         ab["walls"][replicas], grid=n, eps=8, replicas=replicas,
+         cases=C, router_speedup=round(ab["speedup"], 4),
+         bit_identical=bit,
+         accepted=burst["accepted"], shed=burst["shed"],
+         max_pending=burst["max_pending"],
+         paced_p99_ms=round(paced["latency_s"]["p99"] * 1e3, 3),
+         unloaded_p99_ms=ab["unloaded_latency_ms"].get("p99", 0.0))
+
+
 def bench_multichip(steps: int):
     """Fused-vs-collective halo A/B (round 9, ops/pallas_halo.py): the
     distributed 2D solver over ONE shared device mesh, collective halos
@@ -997,6 +1055,7 @@ BENCHES = {
     "multichip": bench_multichip,
     "tta": bench_tta,
     "warmboot": bench_warmboot,
+    "router": bench_router,
 }
 
 

@@ -121,6 +121,19 @@ The rung is labeled "variant": "routerN" and carries "replicas" /
 CPU-core budget in both arms — the CPU proxy of per-replica hardware;
 requires BENCH_PLATFORM=cpu, because N replica processes cannot share
 the single tunneled chip),
+BENCH_TRACE_FLEET (with BENCH_ROUTER=N: the fleet observability A/B —
+ISSUE 11, obs/trace.py + serve/router.py: the SAME mixed-bucket case
+set served by two N-replica routers over ONE shared AOT store dir,
+once untraced (TRACE_OFF forced) and once with cross-process tracing
+on (router + per-worker span tracers, trace-context frames, flow
+events), then ONE merged Perfetto fleet timeline dumped via
+dump_fleet_trace.  The rung is labeled "variant": "routerobsN" and
+carries "trace_overhead" = traced/untraced wall ratio (the PR 5
+<= 1.05 gate at fleet altitude), "spans_total" (merged fleet events),
+"merged_trace_path", "steady_state_builds" (the retrace watchdog,
+armed after the warm pass — a steady-state fleet must report 0), and
+"bit_identical"; set it to a DIRECTORY path (anything other than "1")
+to keep the merged artifact there),
 BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
@@ -347,7 +360,10 @@ class Best:
                 # router rung: the replica-fleet scale-out + overload-
                 # honesty evidence (ISSUE 10)
                 "replicas", "router_speedup", "throughput_cases_s",
-                "accepted", "shed", "load_sweep")
+                "accepted", "shed", "load_sweep",
+                # routerobs rung: the fleet-tracing evidence (ISSUE 11)
+                "spans_total", "merged_trace_path", "merged_processes",
+                "steady_state_builds")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -1079,6 +1095,66 @@ def child_measure():
                 own_dir = store_dir is None
                 if own_dir:
                     store_dir = tempfile.mkdtemp(prefix="nlheat-router-")
+                trace_fleet = os.environ.get("BENCH_TRACE_FLEET")
+                if trace_fleet:
+                    # fleet observability A/B (ISSUE 11): traced vs
+                    # untraced N-replica fleet over the shared store,
+                    # merged Perfetto timeline + retrace-watchdog
+                    # verdict — its own labeled variant, so the plain
+                    # router scale-out row is never conflated with it
+                    from nonlocalheatequation_tpu.serve.router import (
+                        router_traced_ab,
+                    )
+
+                    trace_dir = (trace_fleet if trace_fleet != "1"
+                                 else tempfile.mkdtemp(
+                                     prefix="nlheat-routerobs-"))
+                    os.makedirs(trace_dir, exist_ok=True)
+                    try:
+                        ab = router_traced_ab(
+                            {"method": method, "precision": PRECISION,
+                             "batch_sizes": (1,)},
+                            rcases, router_n, store_dir, trace_dir)
+                    finally:
+                        if own_dir:
+                            shutil.rmtree(store_dir, ignore_errors=True)
+                    bit = all(np.array_equal(a, b) for a, b in
+                              zip(ab["results"]["untraced"],
+                                  ab["results"]["traced"]))
+                    if not bit:
+                        log("WARNING: routerobs arms are NOT "
+                            "bit-identical — tracing must never change "
+                            "served results")
+                    total_steps = sum(c.nt for c in rcases)
+                    wall_t = ab["walls"]["traced"]
+                    merged = ab["merged"] or {}
+                    log(f"rung {grid}^2 routerobs: untraced "
+                        f"{ab['walls']['untraced']:.2f}s vs traced "
+                        f"{wall_t:.2f}s ({ab['trace_overhead']:.3f}x, "
+                        f"{ab['spans_total']} fleet spans, "
+                        f"{ab['steady_state_builds']} steady-state "
+                        f"builds, merged -> {merged.get('path')})")
+                    value = grid * grid * total_steps / wall_t
+                    event(
+                        event="rung",
+                        grid=grid,
+                        steps=rsteps,
+                        best_s=wall_t,
+                        ms_per_step=wall_t / rsteps * 1e3,
+                        value=value,
+                        variant=f"routerobs{router_n}",
+                        replicas=router_n,
+                        cases=C,
+                        trace_overhead=round(ab["trace_overhead"], 4),
+                        spans_total=ab["spans_total"],
+                        merged_trace_path=merged.get("path"),
+                        merged_processes=merged.get("processes"),
+                        steady_state_builds=ab["steady_state_builds"],
+                        bit_identical=bit,
+                    )
+                    last_op = op
+                    any_rung = True
+                    continue
                 try:
                     ab = router_load_ab(
                         {"method": method, "precision": PRECISION,

@@ -387,6 +387,17 @@ def add_obs_flags(p: argparse.ArgumentParser):
              "live (PORT 0 picks a free port, printed to stderr); bound "
              "to the serving pipeline's registry during --serve",
     )
+    p.add_argument(
+        "--flight-dir",
+        dest="flight_dir",
+        default=None,
+        metavar="DIR",
+        help="arm the crash flight recorder (obs/flightrec.py): a "
+             "bounded black box of recent serve/router events, dumped "
+             "to a timestamped postmortem JSON in DIR on quarantine, "
+             "breaker open, replica death, or SIGTERM (ambient "
+             "NLHEAT_FLIGHT_DIR=DIR does the same)",
+    )
 
 
 def validate_obs_args(args) -> str | None:
@@ -515,6 +526,35 @@ def obs_session(args):
         except OSError as e:
             print(f"[obs] --metrics-port {port} cannot bind ({e}); "
                   "scrape endpoint disabled", file=sys.stderr)
+    # crash flight recorder (obs/flightrec.py): installed process-
+    # globally so the serving pipeline / router pick it up at
+    # construction; SIGTERM dumps the black box before the default
+    # handler runs.  Prev recorder restored on exit (nested sessions).
+    recorder = prev_rec = prev_sigterm = None
+    flight_dir = (getattr(args, "flight_dir", None)
+                  or os.environ.get("NLHEAT_FLIGHT_DIR") or None)
+    if flight_dir:
+        import signal as _signal
+
+        from nonlocalheatequation_tpu.obs import flightrec
+
+        try:
+            recorder = flightrec.FlightRecorder(flight_dir)
+        except OSError as e:
+            print(f"[obs] --flight-dir {flight_dir!r} cannot be used "
+                  f"({e}); flight recorder disabled", file=sys.stderr)
+        else:
+            prev_rec = flightrec.set_recorder(recorder)
+            # remember the pre-session disposition: the dump handler
+            # must not outlive the session (nested/back-to-back
+            # sessions would otherwise chain stale handlers whose
+            # recorders point at closed sinks)
+            try:
+                prev_sigterm = _signal.getsignal(_signal.SIGTERM)
+            except (ValueError, OSError):
+                prev_sigterm = None
+            recorder.bind(registry=_scrape_registry)
+            flightrec.install_sigterm(recorder)
     body_raised = False
     try:
         with profiling.trace(trace_dir):
@@ -545,6 +585,17 @@ def obs_session(args):
                       file=sys.stderr)
         if server is not None:
             server.close()
+        if recorder is not None:
+            from nonlocalheatequation_tpu.obs import flightrec
+
+            flightrec.set_recorder(prev_rec)
+            if prev_sigterm is not None:
+                import signal as _signal
+
+                try:  # the handler must not outlive its session
+                    _signal.signal(_signal.SIGTERM, prev_sigterm)
+                except (ValueError, OSError, TypeError):
+                    pass
         path = getattr(args, "metrics_out", None)
         if path:
             payload = _metrics_payload[0]
@@ -779,10 +830,17 @@ def run_listen(args, engine_kwargs) -> int:
     # depth 1 keeps each worker on the donating schedule
     import threading
 
+    # --trace DIR extends to the FLEET here (ISSUE 11): the router runs
+    # its own tracer, every worker traces too, requests are trace-
+    # context-stamped end to end, and shutdown dumps ONE merged
+    # Perfetto timeline next to the per-process artifacts
+    trace_dir = (getattr(args, "trace", None)
+                 or os.environ.get("NLHEAT_TRACE") or None)
     with ReplicaRouter(replicas=args.replicas,
                        depth=1,
                        window_ms=args.serve_window_ms,
                        serve_kwargs=serve_kwargs,
+                       trace_dir=trace_dir,
                        **engine_kwargs) as router:
         set_live_registry(router.registry)
         # the elastic loop: pull per-replica stats (absorbing each
@@ -822,6 +880,13 @@ def run_listen(args, engine_kwargs) -> int:
         finally:
             stop_scaling.set()
         router.drain()
+        if trace_dir:
+            merged = router.dump_fleet_trace(
+                os.path.join(trace_dir, "fleet_trace.json"))
+            if merged:
+                print(f"fleet trace: {merged['events']} event(s) from "
+                      f"{merged['processes']} process(es) -> "
+                      f"{merged['path']}", file=sys.stderr)
         line = _json.dumps(router.metrics())
         print(f"router: {line}", file=sys.stderr)
         set_metrics_payload(line)

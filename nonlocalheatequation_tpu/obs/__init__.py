@@ -19,8 +19,20 @@ bare ``jax.profiler`` wrapper) into one subsystem:
   (their fields are properties over registry metrics), the
   load-balance busy rates, and the resilience telemetry — with
   Prometheus text exposition and a one-line JSON snapshot.
-* ``obs/export.py`` — the opt-in scrape endpoint (``--metrics-port``)
-  and the ``NLHEAT_EVENT_LOG`` JSONL event stream.
+* ``obs/export.py`` — the opt-in scrape endpoint (``--metrics-port``),
+  the ``NLHEAT_EVENT_LOG`` JSONL event stream (per-process
+  lifetime-exact ``seq`` + the multi-replica merge-sort helper), and
+  the registry-merge helpers the fleet scrape uses.
+* ``obs/flightrec.py`` — the crash flight recorder (ISSUE 11): a
+  bounded black-box ring dumped to a timestamped postmortem on replica
+  death, typed quarantine, breaker open, or SIGTERM
+  (``--flight-dir`` / ``NLHEAT_FLIGHT_DIR``).
+
+Fleet tracing (ISSUE 11): ``TraceContext`` carries one request's
+identity across ingress -> router frames -> worker
+(``X-NLHEAT-Trace``); ``merge_chrome_traces`` aligns per-process
+clocks into ONE Perfetto timeline (``ReplicaRouter.dump_fleet_trace``,
+tools/trace_merge.py).
 
 Contract everywhere: observability never raises, never adds a fence or
 device sync (host-side timestamps only; fetch timings come from fences
@@ -31,15 +43,21 @@ untouched with tracing off).
 
 from nonlocalheatequation_tpu.obs.export import (  # noqa: F401
     EventLog,
+    merge_event_streams,
     serve_metrics,
+)
+from nonlocalheatequation_tpu.obs.flightrec import (  # noqa: F401
+    FlightRecorder,
 )
 from nonlocalheatequation_tpu.obs.metrics import (  # noqa: F401
     REGISTRY,
     MetricsRegistry,
 )
 from nonlocalheatequation_tpu.obs.trace import (  # noqa: F401
+    TraceContext,
     Tracer,
     get_tracer,
+    merge_chrome_traces,
     set_tracer,
     span,
 )

@@ -23,10 +23,12 @@ when the env var is unset; emitters hold that None and skip one ``if``).
 
 from __future__ import annotations
 
+import heapq
 import json
 import os
 import sys
 import threading
+import time
 
 #: Env var naming the JSONL event-log path (scrubbed by tests/conftest.py
 #: — a leaked developer setting must not make the suite write files).
@@ -44,11 +46,19 @@ class EventLog:
 
     Every line carries ``pid`` and (when the process is a fleet worker,
     ``NLHEAT_REPLICA_ID``) ``replica`` — the merge keys for multi-replica
-    streams; explicit event fields of the same name win."""
+    streams — plus ``seq`` (a per-process lifetime-exact monotonic
+    sequence number: interleaved multi-replica logs are totally
+    orderable WITHIN each process after the fact, the ISSUE 11 bugfix)
+    and ``t`` (wall clock, the cross-process merge hint
+    :func:`merge_event_streams` heap-merges on).  Explicit event fields
+    of the same name win."""
 
-    def __init__(self, path: str, replica: str | int | None = None):
+    def __init__(self, path: str, replica: str | int | None = None,
+                 clock=time.time):
         self.path = path
         self._lock = threading.Lock()
+        self._clock = clock
+        self._seq = 0  # lifetime-exact, per-process
         if replica is None:
             replica = os.environ.get(REPLICA_ID_ENV)
         self._stamp = {"pid": os.getpid()}
@@ -60,10 +70,25 @@ class EventLog:
 
     def emit(self, **event) -> None:
         try:
-            line = json.dumps({**self._stamp, **event}, default=str)
             with self._lock:
+                seq = self._seq
+                self._seq += 1
+                line = json.dumps(
+                    {**self._stamp, "seq": seq,
+                     "t": round(self._clock(), 6), **event}, default=str)
                 self._f.write(line + "\n")
         except Exception:  # noqa: BLE001 — observability never raises
+            pass
+
+    def flush(self) -> None:
+        """Force buffered lines to disk (the flight recorder calls this
+        before a postmortem dump so the two artifacts never disagree on
+        a torn line).  Never raises."""
+        try:
+            with self._lock:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+        except Exception:  # noqa: BLE001
             pass
 
     def close(self) -> None:
@@ -87,6 +112,58 @@ class EventLog:
             print(f"[obs] {EVENT_LOG_ENV}={path!r} cannot be opened "
                   f"({e}); event log disabled", file=sys.stderr)
             return None
+
+
+def read_jsonl(path) -> list:
+    """Parse one JSONL event file tolerantly: a torn final line (a
+    crashed writer) costs that line, never the file."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+    return events
+
+
+def merge_event_streams(streams) -> list:
+    """Totally order multi-process event streams (ISSUE 11 satellite).
+
+    ``streams`` is an iterable of event-dict lists (e.g. one
+    :func:`read_jsonl` per replica file, or one combined file N
+    replicas appended to).  Events are grouped by their process
+    identity ``(pid, replica)``; WITHIN a process the per-process
+    ``seq`` is authoritative (lifetime-exact, gap-free — clock skew can
+    never reorder one process's own story); ACROSS processes the groups
+    are heap-merged on the wall-clock ``t`` stamp of each group's head.
+    Pre-seq lines (older logs) sort first within their process, in
+    arrival order."""
+    groups: dict = {}
+    for events in streams:
+        for i, ev in enumerate(events):
+            key = (ev.get("pid"), ev.get("replica"))
+            groups.setdefault(key, []).append((ev.get("seq", -1), i, ev))
+    runs = []
+    for key in sorted(groups, key=lambda k: (str(k[0]), str(k[1]))):
+        run = [ev for _seq, _i, ev in sorted(groups[key],
+                                             key=lambda x: (x[0], x[1]))]
+        runs.append(run)
+    heap = []
+    for gi, run in enumerate(runs):
+        if run:
+            heapq.heappush(heap, (run[0].get("t", 0.0) or 0.0, gi, 0))
+    out = []
+    while heap:
+        _t, gi, i = heapq.heappop(heap)
+        out.append(runs[gi][i])
+        if i + 1 < len(runs[gi]):
+            heapq.heappush(
+                heap, (runs[gi][i + 1].get("t", 0.0) or 0.0, gi, i + 1))
+    return out
 
 
 def merged_prometheus(registries) -> str:

@@ -262,6 +262,17 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
 
+    def drop_prefix(self, prefix: str) -> int:
+        """Drop every metric whose name starts with ``prefix`` — the
+        fleet-scrape staleness hook (ISSUE 11 satellite): a dead/drained
+        replica's absorbed ``/replica{r}/...`` gauges must not linger in
+        the merged exposition forever.  Returns the number dropped."""
+        with self._lock:
+            doomed = [n for n in self._metrics if n.startswith(prefix)]
+            for n in doomed:
+                del self._metrics[n]
+        return len(doomed)
+
     # -- expositions --------------------------------------------------------
     def snapshot(self) -> dict:
         """Plain name -> value dict (counters/gauges as numbers,

@@ -45,10 +45,12 @@ import time
 
 import numpy as np
 
+from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.obs.export import (
     merged_prometheus,
     merged_snapshot_json,
 )
+from nonlocalheatequation_tpu.obs.trace import TraceContext
 from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
 from nonlocalheatequation_tpu.serve.router import RouterOverloaded
 
@@ -115,16 +117,19 @@ class AdmissionController:
         return hint
 
     def try_submit(self, case: EnsembleCase, *, deadline_ms=None,
-                   priority: int = 0):
+                   priority: int = 0, trace=None):
         """``(request, None)`` when admitted, ``(None, retry_after_s)``
-        when shed (by this gate or the router's hard cap)."""
+        when shed (by this gate or the router's hard cap).  ``trace``
+        (a TraceContext) is forwarded to the backend only when present,
+        so trace-less callers and router-shaped stubs are untouched."""
         retry = self.check()
         if retry is not None:
             self._m_shed.inc()
             return None, retry
+        kw = {"trace": trace} if trace is not None else {}
         try:
             req = self.backend.submit(case, deadline_ms=deadline_ms,
-                                      priority=priority)
+                                      priority=priority, **kw)
         except RouterOverloaded as e:
             self._m_shed.inc()
             self._m_retry_after.set(round(e.retry_after_s, 3))
@@ -244,11 +249,27 @@ class IngressServer:
             name="nlheat-ingress")
         self._thread.start()
 
+    def _tracer(self):
+        """The ingress's span tracer: the backend router's (same
+        process, merged into the fleet timeline) or the ambient global
+        one.  None when tracing is off — one attribute read."""
+        tr = getattr(self.backend, "_tracer", None)
+        return tr if tr is not None else obs_trace.get_tracer()
+
     # -- request handling (called from handler threads) ----------------------
     def _post(self, h) -> None:
         if h.path.rstrip("/") != "/v1/cases":
             h._json(404, {"error": f"no such endpoint {h.path!r}"})
             return
+        # trace identity (ISSUE 11): adopt the client's X-NLHEAT-Trace
+        # header or, when tracing is on, mint one HERE — the ingress is
+        # the trace root every downstream span chains to
+        tr = self._tracer()
+        hdr = h.headers.get("X-NLHEAT-Trace")
+        ctx = TraceContext.from_header(hdr) if hdr else None
+        if ctx is None and tr is not None:
+            ctx = TraceContext.mint()
+        t0 = time.monotonic() if tr is not None else 0.0
         try:
             n = int(h.headers.get("Content-Length") or 0)
             body = json.loads(h.rfile.read(n).decode() or "{}")
@@ -262,8 +283,12 @@ class IngressServer:
             return
         req, retry = self.admission.try_submit(
             case, deadline_ms=body.get("deadline_ms"),
-            priority=body.get("priority") or 0)
+            priority=body.get("priority") or 0, trace=ctx)
         if req is None:
+            if tr is not None and ctx is not None:
+                tr.instant("ingress.shed", cat="ingress",
+                           trace=ctx.trace_id,
+                           retry_after_s=round(retry, 3))
             h._json(429, {"error": "overloaded",
                           "retry_after_s": round(retry, 3)},
                     headers=[("Retry-After",
@@ -272,7 +297,24 @@ class IngressServer:
         with self._lock:
             self._requests[req.seq] = req
         self._sweep()
-        h._json(202, {"id": req.seq, "status": "queued"})
+        headers = []
+        if ctx is not None:
+            if ctx.request is None:
+                ctx.request = req.seq
+            headers.append(("X-NLHEAT-Trace", ctx.to_header()))
+            if tr is not None:
+                # the trace ROOT: one ingress span over parse+admit+
+                # route, plus the flow START the router/worker chain
+                # hangs off (flow events tie the pids together)
+                now = time.monotonic()
+                tr.flow("request", "start", ctx.trace_id, ts=t0,
+                        cat="ingress", req=req.seq)
+                tr.complete("ingress.request", t0, now, cat="ingress",
+                            trace=ctx.trace_id, req=req.seq,
+                            replica=req.replica)
+        h._json(202, {"id": req.seq, "status": "queued",
+                      **({"trace": ctx.trace_id} if ctx is not None
+                         else {})}, headers=headers)
 
     def _get(self, h) -> None:
         path, _, query = h.path.partition("?")

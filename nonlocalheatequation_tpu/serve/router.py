@@ -77,10 +77,17 @@ import time
 
 import numpy as np
 
+from nonlocalheatequation_tpu.obs import flightrec
+from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.obs.export import REPLICA_ID_ENV
 from nonlocalheatequation_tpu.obs.metrics import (
     MetricsRegistry,
     absorb_snapshot,
+)
+from nonlocalheatequation_tpu.obs.trace import (
+    TraceContext,
+    merge_chrome_traces,
+    write_chrome_trace,
 )
 from nonlocalheatequation_tpu.parallel.elastic import (
     BusyRatePolicy,
@@ -149,6 +156,9 @@ class RouterRequest:
         self.submit_t = submit_t
         self.deadline_ms = None
         self.priority = 0
+        self.trace: TraceContext | None = None  # fleet trace identity
+        self.trace_minted = False  # router-minted (no ingress root)
+        self._flow_started = False  # first flow hop already emitted
         self.result: np.ndarray | None = None
         self.error: ServeError | None = None
         self.latency_s: float | None = None
@@ -188,8 +198,14 @@ class _Replica:
         self.draining = False  # no NEW buckets/cases route here
         self.outstanding: dict[int, RouterRequest] = {}
         self.buckets: set = set()
-        self.stats_waiters: dict[int, list] = {}  # token -> [event, box]
+        # token -> [event, box]: one waiter per pulled reply frame
+        # (stats AND trace dumps share the token space/mechanism)
+        self.stats_waiters: dict[int, list] = {}
         self.last_stats: dict | None = None
+        #: the worker's (monotonic, wall) clock pair, exchanged on the
+        #: hello frame — merge_chrome_traces aligns per-process
+        #: monotonic-epoch span timestamps with it (obs/trace.py)
+        self.clock_sync: dict | None = None
 
     def send(self, obj) -> bool:
         """Enqueue one frame for the writer thread (never blocks on the
@@ -251,6 +267,9 @@ class ReplicaRouter:
                  registry: MetricsRegistry | None = None,
                  spawn_timeout_s: float = 180.0,
                  clock=time.monotonic,
+                 tracer=None, trace_dir: str | None = None,
+                 flight_dir: str | None = None,
+                 stale_after_s: float = 60.0,
                  **engine_kwargs):
         replicas = int(replicas)
         if replicas < 1:
@@ -300,6 +319,37 @@ class ReplicaRouter:
 
         self._platform = jax.config.jax_platforms or None
         self._x64 = bool(jax.config.jax_enable_x64)
+        # fleet tracing (ISSUE 11): ``trace_dir`` turns on cross-process
+        # tracing — the router runs its own span tracer (labeled for the
+        # merged timeline) and every worker installs one too, writing
+        # per-replica trace files under trace_dir; dump_fleet_trace()
+        # merges them all into ONE Perfetto document.  Without it the
+        # router inherits the process-global tracer (None = off, the
+        # zero-cost path; TRACE_OFF forces off like ServePipeline).
+        self.trace_dir = trace_dir
+        if trace_dir is not None:
+            os.makedirs(trace_dir, exist_ok=True)
+            self._tracer = obs_trace.Tracer(label="router")
+        else:
+            self._tracer = (None if tracer is obs_trace.TRACE_OFF
+                            else tracer if tracer is not None
+                            else obs_trace.get_tracer())
+        # crash flight recorder (obs/flightrec.py): the router's own
+        # black box — worker death dumps a postmortem naming the killed
+        # replica, its in-flight cases, and each re-route decision.
+        # ``flight_dir`` explicit, else the ambient NLHEAT_FLIGHT_DIR
+        # recorder if one is installed process-globally.
+        if flight_dir is not None:
+            self._flightrec = flightrec.FlightRecorder(flight_dir)
+        else:
+            self._flightrec = flightrec.get_recorder()
+        self.flight_dir = (self._flightrec.dir
+                           if self._flightrec is not None else None)
+        # fleet-scrape staleness (ISSUE 11 satellite): absorb times per
+        # replica; dead replicas' /replica{r} gauges are labeled stale
+        # inside the window and DROPPED from the merged scrape after it
+        self.stale_after_s = float(stale_after_s)
+        self._absorb_t: dict[int, float] = {}
         self.registry = registry if registry is not None else MetricsRegistry()
         r = self.registry
         self._m_cases = r.counter("/router/cases")
@@ -330,6 +380,9 @@ class ReplicaRouter:
         self._closed = False
         self._telemetry = FleetTelemetry()
         self._policy = BusyRatePolicy(self._telemetry)
+        if self._flightrec is not None:
+            self._flightrec.bind(registry=self.registry,
+                                 inflight=self._inflight_ledger)
         try:
             for _ in range(replicas):
                 self._spawn()
@@ -370,6 +423,8 @@ class ReplicaRouter:
             "serve_kwargs": self.serve_kwargs,
             "engine_kwargs": self.engine_kwargs,
             "cpu_affinity": affinity,
+            "trace_dir": self.trace_dir,
+            "flight_dir": self.flight_dir,
         }
         with self._lock:
             self._replicas[rid] = rep
@@ -403,10 +458,30 @@ class ReplicaRouter:
             self._on_message(rep, msg)
         self._on_eof(rep)
 
+    def _inflight_ledger(self) -> list:
+        """The flight recorder's in-flight snapshot: every undelivered
+        case with its current owner (the postmortem's 'who held what'
+        answer)."""
+        try:
+            with self._lock:
+                return [{"case": req.seq, "replica": req.replica,
+                         "requeues": req.requeues}
+                        for req in self._pending.values()]
+        except Exception:  # noqa: BLE001 — observability never raises
+            return []
+
     def _on_message(self, rep: _Replica, msg: dict) -> None:
         op = msg.get("op")
         if op == "ready":
+            rep.clock_sync = msg.get("clock_sync")
             rep.ready.set()
+        elif op == "trace":
+            # a pulled fleet-trace dump: deliver to its waiter (same
+            # token mechanism as stats, without touching last_stats)
+            waiter = rep.stats_waiters.pop(msg.get("id"), None)
+            if waiter is not None:
+                waiter[1].append(msg)
+                waiter[0].set()
         elif op in ("result", "error"):
             with self._lock:
                 req = rep.outstanding.get(msg["id"])
@@ -469,6 +544,12 @@ class ReplicaRouter:
         print(f"router: replica {rep.rid} died with "
               f"{len(orphans)} case(s) in flight; re-routing",
               file=sys.stderr)
+        fr = self._flightrec
+        decisions: list = []
+        if fr is not None:
+            fr.record("replica-death", replica=rep.rid,
+                      orphans=[r.seq for r in orphans],
+                      buckets_orphaned=len(buckets))
         # release any stats pull blocked on the dead worker
         for token in list(rep.stats_waiters):
             waiter = rep.stats_waiters.pop(token, None)
@@ -497,6 +578,8 @@ class ReplicaRouter:
                                        "re-routed past MAX_REQUEUES "
                                        "(replica-killing case?)")
                 req.done.set()
+                decisions.append({"case": req.seq, "action": "quarantine",
+                                  "requeues": req.requeues})
                 continue
             try:
                 try:
@@ -505,6 +588,9 @@ class ReplicaRouter:
                     # a death cannot lose work to backpressure: the hard
                     # cap bounds CALLER intake, not recovery — force
                     self._route(req, force=True)
+                decisions.append({"case": req.seq, "action": "re-route",
+                                  "replica": req.replica,
+                                  "requeues": req.requeues})
             except Exception as e:  # noqa: BLE001 — e.g. no live
                 # replicas after a failed respawn: the request must
                 # complete EXCEPTIONALLY, never hang a waiter, and the
@@ -516,6 +602,17 @@ class ReplicaRouter:
                 req.error = ServeError("error", req.seq, -1, 0,
                                        f"re-route failed: {e}")
                 req.done.set()
+                decisions.append({"case": req.seq, "action": "failed",
+                                  "detail": str(e)})
+        if fr is not None:
+            # the black box: killed replica, its in-flight cases, and
+            # the re-route decision for each (the ISSUE 11 chaos-run
+            # acceptance — a die@ plan must leave this postmortem)
+            for d in decisions:
+                fr.record("re-route", **d)
+            fr.dump("replica-death", replica=rep.rid,
+                    orphans=[r.seq for r in orphans],
+                    decisions=decisions)
 
     # -- routing ------------------------------------------------------------
     def live_count(self) -> int:
@@ -542,16 +639,27 @@ class ReplicaRouter:
                                         len(r.outstanding), r.rid))
 
     def submit(self, case: EnsembleCase, *, deadline_ms: float | None = None,
-               priority: int = 0) -> RouterRequest:
+               priority: int = 0, trace=None) -> RouterRequest:
         """Route one case; returns its handle.  Raises
         :class:`RouterOverloaded` when the fleet's bounded in-flight
-        budget is exhausted (the ingress tier turns that into 429)."""
+        budget is exhausted (the ingress tier turns that into 429).
+        ``trace`` is the ingress-minted TraceContext; a traced router
+        mints one itself for direct (non-HTTP) submissions so the fleet
+        timeline still chains every span to a request identity."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("router is closed")
             req = RouterRequest(case, self._next_seq, self._clock())
             req.deadline_ms = deadline_ms
             req.priority = int(priority)
+            if trace is not None:
+                req.trace = trace if isinstance(trace, TraceContext) \
+                    else TraceContext.from_wire(trace)
+            elif self._tracer is not None:
+                req.trace = TraceContext.mint(request=self._next_seq)
+                req.trace_minted = True  # the router IS the trace root
+            if req.trace is not None and req.trace.request is None:
+                req.trace.request = self._next_seq
             self._next_seq += 1
             self._pending[req.seq] = req
             self._m_cases.inc()
@@ -589,9 +697,28 @@ class ReplicaRouter:
             self._m_outstanding.set(self.outstanding_total())
             fired = (self._faults.draw([req.seq])
                      if self._faults is not None else None)
+        tr = self._tracer
+        if tr is not None and req.trace is not None:
+            # the router-dispatch hop of the request's flow chain: one
+            # instant + one flow STEP at a single clock read (tracing-on
+            # only; the untraced path takes zero extra clock reads)
+            now = self._clock()
+            tr.instant("router.dispatch", ts=now, cat="router",
+                       case=req.seq, replica=rep.rid,
+                       requeue=req.requeues, trace=req.trace.trace_id)
+            # the flow chain's router hop: a router-minted trace (no
+            # ingress) roots the chain HERE ("start"); an ingress-rooted
+            # one (or any re-route) continues it ("step")
+            phase = ("start" if req.trace_minted and not req._flow_started
+                     else "step")
+            req._flow_started = True
+            tr.flow("request", phase, req.trace.trace_id, ts=now,
+                    cat="router", req=req.seq, replica=rep.rid)
         sent = rep.send({"op": "case", "id": req.seq, "case": req.case,
                          "deadline_ms": req.deadline_ms,
-                         "priority": req.priority})
+                         "priority": req.priority,
+                         "trace": (req.trace.to_wire()
+                                   if req.trace is not None else None)})
         self._m_routed.inc()
         if fired is not None and fired.die is not None:
             # the deterministic worker-kill: the __kill__ sentinel rides
@@ -696,11 +823,13 @@ class ReplicaRouter:
         with self._lock:
             self._m_replicas.set(self.live_count())
 
-    def refresh_stats(self, timeout_s: float = 30.0) -> dict:
-        """Pull one stats window from every live worker: per-replica
-        metrics/snapshots (absorbed into the router registry under
-        ``/replica{r}`` names) and the busy fractions feeding
-        :meth:`maybe_scale`.  Returns ``{rid: stats_frame}``."""
+    def _pull(self, op: str, timeout_s: float) -> dict:
+        """Broadcast one request frame (``stats``/``trace``) to every
+        live ready worker and collect the reply frames — the shared
+        token/waiter mechanism.  A failed send drops its waiter
+        immediately (never left for the death path to sweep).  Returns
+        ``{replica_handle: reply_frame}`` for the workers that
+        answered within ``timeout_s``."""
         waiters = []
         with self._lock:
             live = [r for r in self._replicas.values()
@@ -711,15 +840,25 @@ class ReplicaRouter:
                 self._next_seq += 1
             ev, box = threading.Event(), []
             rep.stats_waiters[token] = [ev, box]
-            if rep.send({"op": "stats", "id": token}):
+            if rep.send({"op": op, "id": token}):
                 waiters.append((rep, ev, box))
+            else:
+                rep.stats_waiters.pop(token, None)
         out = {}
         deadline = self._clock() + timeout_s
         for rep, ev, box in waiters:
             ev.wait(max(0.0, deadline - self._clock()))
-            if not box:
-                continue
-            stats = box[0]
+            if box:
+                out[rep] = box[0]
+        return out
+
+    def refresh_stats(self, timeout_s: float = 30.0) -> dict:
+        """Pull one stats window from every live worker: per-replica
+        metrics/snapshots (absorbed into the router registry under
+        ``/replica{r}`` names) and the busy fractions feeding
+        :meth:`maybe_scale`.  Returns ``{rid: stats_frame}``."""
+        out = {}
+        for rep, stats in self._pull("stats", timeout_s).items():
             out[rep.rid] = stats
             self._telemetry.record_window(
                 rep.rid, stats.get("busy_s", 0.0), stats.get("span_s", 0.0))
@@ -730,7 +869,41 @@ class ReplicaRouter:
             if snap:
                 absorb_snapshot(self.registry, f"/replica{{{rep.rid}}}",
                                 snap)
+                self._absorb_t[rep.rid] = self._clock()
+                self.registry.gauge(
+                    f"/replica{{{rep.rid}}}/stale").set(0)
+        self._prune_stale_replicas()
         return out
+
+    def _prune_stale_replicas(self) -> None:
+        """Fleet-scrape staleness (ISSUE 11 satellite): a dead/drained
+        replica's absorbed ``/replica{r}/...`` gauges are point-in-time
+        copies that would otherwise linger in the merged ``/metrics``
+        scrape forever.  Inside the window the replica is LABELED
+        (``/replica{r}/stale`` = 1); past ``stale_after_s`` without a
+        fresh absorb its whole namespace is DROPPED."""
+        now = self._clock()
+        with self._lock:
+            live = {r.rid for r in self._replicas.values() if r.alive}
+        for rid, t in list(self._absorb_t.items()):
+            if rid in live:
+                continue
+            if now - t >= self.stale_after_s:
+                self.registry.drop_prefix(f"/replica{{{rid}}}")
+                del self._absorb_t[rid]
+            else:
+                self.registry.gauge(f"/replica{{{rid}}}/stale").set(1)
+
+    def arm_steady_state(self) -> None:
+        """Broadcast the retrace watchdog arm (ISSUE 11 satellite) to
+        every live worker: after warm-up a steady-state fleet should
+        build ZERO new programs — each worker's ServePipeline counts and
+        warns loudly on post-arm ``programs_built`` growth."""
+        with self._lock:
+            live = [r for r in self._replicas.values()
+                    if r.alive and r.ready.is_set()]
+        for rep in live:
+            rep.send({"op": "arm"})
 
     def maybe_scale(self) -> str | None:
         """One elastic step: pull stats, run the factored busy-rate
@@ -757,6 +930,48 @@ class ReplicaRouter:
         return decision
 
     # -- observability ------------------------------------------------------
+    def dump_fleet_trace(self, path: str,
+                         timeout_s: float = 30.0) -> dict | None:
+        """Pull every live worker's span ring over the frame channel,
+        align the per-process clocks (each worker's tracer carries the
+        monotonic/wall pair exchanged on its hello frame), and write ONE
+        Perfetto-loadable Chrome trace at ``path`` — pid = replica id,
+        the router's own spans alongside, request flow events intact
+        (obs/trace.py merge_chrome_traces).  Returns a summary dict
+        ``{path, processes, events}`` or None when nothing could be
+        written (loud, never raises — a failed trace dump must not kill
+        the fleet it observed)."""
+        try:
+            docs = []
+            if self._tracer is not None:
+                docs.append(self._tracer.chrome_trace())
+            for rep, msg in self._pull("trace", timeout_s).items():
+                doc = msg.get("doc")
+                if not doc:
+                    continue
+                # clock alignment belt-and-braces: a pulled doc
+                # normally carries its tracer's clock_sync; if not,
+                # fall back to the pair this worker exchanged on its
+                # hello frame (the handshake the merge relies on)
+                meta = doc.setdefault("metadata", {})
+                if not meta.get("clock_sync") and rep.clock_sync:
+                    meta["clock_sync"] = dict(rep.clock_sync)
+                docs.append(doc)
+            if not docs:
+                print("router: dump_fleet_trace found no tracers "
+                      "(construct the router with trace_dir=...)",
+                      file=sys.stderr)
+                return None
+            merged = merge_chrome_traces(docs)
+            if not write_chrome_trace(merged, path):
+                return None
+            return {"path": path, "processes": len(docs),
+                    "events": len(merged["traceEvents"])}
+        except Exception as e:  # noqa: BLE001 — observability never raises
+            print(f"router: dump_fleet_trace failed ({e!r})",
+                  file=sys.stderr)
+            return None
+
     def metrics(self) -> dict:
         with self._lock:
             live = [r.rid for r in self._replicas.values() if r.alive]
@@ -927,6 +1142,81 @@ def router_load_ab(engine_kwargs: dict, cases, replicas: int,
     }
 
 
+def router_traced_ab(engine_kwargs: dict, cases, replicas: int,
+                     store_dir: str | None, trace_dir: str, *,
+                     window_ms: float = 2.0,
+                     cpus_per_replica: int | None = None,
+                     child_env: dict | None = None) -> dict:
+    """The fleet observability A/B shared by bench.py
+    (``BENCH_TRACE_FLEET``) and tools/bench_table.py (``routerobs``
+    group): serve the SAME case set through two N-replica routers over
+    ONE shared AOT store dir — once untraced (TRACE_OFF forced, the
+    zero-cost disabled path even under an ambient global tracer: the
+    serve_traced_ab discipline at fleet altitude) and once with
+    cross-process tracing on (router tracer + per-worker tracers +
+    trace frames + flow events).  Each arm runs a warm pass (arm 1
+    populates the store; arm 2 warm-boots) then a timed pass, so the
+    ratio isolates the tracing cost, not compiles.  The traced arm arms
+    the retrace watchdog after its warm pass (a steady-state fleet must
+    build zero new programs) and dumps the merged fleet trace.  Returns
+    walls, the overhead ratio (the PR 5 gate, now <= 1.05 at fleet
+    altitude), both arms' results (callers pin bit-identity), the
+    merged-trace summary, and the span count."""
+    cases = list(cases)
+    if cpus_per_replica is None:
+        # the same CPU proxy as router_load_ab: every worker in both
+        # arms gets one fixed core budget, so the ratio measures
+        # tracing cost, not thread-placement luck
+        try:
+            cpus_per_replica = max(
+                1, len(os.sched_getaffinity(0)) // max(2, replicas))
+        except AttributeError:
+            cpus_per_replica = None
+    walls: dict[str, float] = {}
+    results: dict[str, list] = {}
+    merged = None
+    spans_total = 0
+    steady = 0
+    for arm in ("untraced", "traced"):
+        kw = (dict(trace_dir=trace_dir) if arm == "traced"
+              else dict(tracer=obs_trace.TRACE_OFF))
+        with ReplicaRouter(replicas=replicas, program_store=store_dir,
+                           window_ms=window_ms, child_env=child_env,
+                           cpus_per_replica=cpus_per_replica, **kw,
+                           **engine_kwargs) as router:
+            results[arm] = router.serve_cases(cases)  # warm pass
+            if arm == "traced":
+                router.arm_steady_state()
+            t0 = time.perf_counter()
+            router.serve_cases(cases)
+            walls[arm] = time.perf_counter() - t0
+            if arm == "traced":
+                merged = router.dump_fleet_trace(
+                    os.path.join(trace_dir, "fleet_trace.json"))
+                # the fleet-wide span count: every process's events in
+                # the merged timeline (falls back to the router's own
+                # ring if the merge could not be written)
+                spans_total = (merged["events"] if merged else
+                               router._tracer.spans_total
+                               if router._tracer is not None else 0)
+                # the retrace watchdog's verdict: armed after the warm
+                # pass, so a steady-state fleet reports 0 here (a pull
+                # absorbs each worker's counter under /replica{r}/...)
+                router.refresh_stats()
+                steady = 0
+                for name in router.registry.names():
+                    if name.endswith("/store/steady-state-builds"):
+                        steady += int(router.registry.get(name).value)
+    return {
+        "walls": walls,
+        "trace_overhead": walls["traced"] / walls["untraced"],
+        "results": results,
+        "merged": merged,
+        "spans_total": spans_total,
+        "steady_state_builds": steady,
+    }
+
+
 # -- the worker process -------------------------------------------------------
 
 
@@ -992,6 +1282,23 @@ def _worker_main() -> None:
     store = cfg.get("program_store")
     if store is not None:
         os.environ["NLHEAT_PROGRAM_STORE"] = str(store)
+    rid = cfg.get("replica_id")
+    # fleet tracing: a traced router hands every worker a trace_dir —
+    # install the process-global tracer (so pipeline/ensemble/store
+    # spans all record) before the pipeline constructs; the ring is
+    # written per-replica at exit and pulled live by the "trace" op
+    tracer = None
+    trace_dir = cfg.get("trace_dir")
+    if trace_dir:
+        tracer = obs_trace.Tracer(label=f"replica {rid}", replica=rid)
+        obs_trace.set_tracer(tracer)
+    # crash flight recorder: per-worker black box (quarantines, breaker
+    # opens, SIGTERM all dump; SIGKILL death is the ROUTER's dump)
+    flight_dir = cfg.get("flight_dir")
+    if flight_dir:
+        rec = flightrec.FlightRecorder(flight_dir, replica=rid)
+        flightrec.set_recorder(rec)
+        flightrec.install_sigterm(rec)
     from nonlocalheatequation_tpu.serve.server import ServePipeline
 
     pipe = ServePipeline(depth=cfg.get("depth", 1),
@@ -999,7 +1306,14 @@ def _worker_main() -> None:
                          window_size=cfg.get("window_size"),
                          **cfg.get("serve_kwargs") or {},
                          **cfg.get("engine_kwargs") or {})
-    _write_frame(out, {"op": "ready", "replica": cfg.get("replica_id")})
+    _write_frame(out, {"op": "ready", "replica": rid,
+                       # the clock-offset handshake: this worker's
+                       # (monotonic, wall) pair, matching its tracer's
+                       # span timestamps — the router merges on it
+                       "clock_sync": (tracer.clock_sync if tracer
+                                      is not None else
+                                      {"monotonic": time.monotonic(),
+                                       "wall": time.time()})})
 
     outstanding: dict[int, object] = {}
     busy_s = 0.0
@@ -1056,7 +1370,9 @@ def _worker_main() -> None:
                 try:
                     h = pipe.submit(msg["case"],
                                     deadline_ms=msg.get("deadline_ms"),
-                                    priority=msg.get("priority") or 0)
+                                    priority=msg.get("priority") or 0,
+                                    trace=TraceContext.from_wire(
+                                        msg.get("trace")))
                 except Exception as e:  # noqa: BLE001 — a malformed
                     # case must complete EXCEPTIONALLY, not kill the
                     # worker (a poison frame would otherwise crash-loop
@@ -1083,6 +1399,16 @@ def _worker_main() -> None:
                 })
                 busy_s = 0.0
                 window_t0 = now
+            elif op == "trace":
+                # the fleet-trace pull: ship this worker's span ring
+                # (with its clock_sync metadata) back over the frame
+                # channel for the router's merge
+                _write_frame(out, {
+                    "op": "trace", "id": msg.get("id"), "replica": rid,
+                    "doc": (tracer.chrome_trace() if tracer is not None
+                            else None)})
+            elif op == "arm":
+                pipe.arm_steady_state()
             elif op == "stop":
                 stopping = True
         if eof:
@@ -1106,6 +1432,11 @@ def _worker_main() -> None:
         pipe.close()
     except Exception:  # noqa: BLE001 — dying cleanly beats a stack trace
         pass
+    if tracer is not None and trace_dir:
+        # the per-replica trace artifact (NLHEAT_REPLICA_ID in the
+        # path): loadable standalone, or merged by tools/trace_merge.py
+        tracer.write(os.path.join(trace_dir,
+                                  f"host_trace.replica{rid}.json"))
     try:
         _write_frame(out, {"op": "bye"})
     except OSError:

@@ -111,6 +111,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from nonlocalheatequation_tpu.obs import flightrec
 from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.obs.export import EventLog
 from nonlocalheatequation_tpu.obs.metrics import MetricsRegistry, backed
@@ -160,6 +161,9 @@ class ServeRequest:
     submit_t: float
     priority: int = 0
     deadline_t: float | None = None
+    #: fleet trace identity (obs/trace.py TraceContext) when the case
+    #: arrived through a traced front door; None otherwise (zero cost)
+    trace: object = None
     result: np.ndarray | None = None
     error: ServeError | None = None
     queue_wait_s: float | None = None  # submit -> dispatch
@@ -476,6 +480,17 @@ class ServePipeline:
                         else tracer if tracer is not None
                         else obs_trace.get_tracer())
         self._events = EventLog.from_env()
+        # the crash flight recorder (obs/flightrec.py): the process-
+        # global black box, bound to THIS pipeline's registry and
+        # in-flight ledger (one pipeline per worker process; later
+        # pipelines re-bind).  None when off — every tap is one
+        # attribute read, the obs/ discipline.
+        self._flightrec = flightrec.get_recorder()
+        if self._flightrec is not None:
+            self._flightrec.bind(registry=report.registry,
+                                 inflight=self._inflight_ledger)
+            if self._events is not None:
+                self._flightrec.add_flush(self._events.flush)
         self.registry = report.registry
         if breaker is not None:
             # mirror the breaker's lifetime-exact transition count into
@@ -515,6 +530,11 @@ class ServePipeline:
         self._next_seq = 0
         self._next_chunk = 0
         self._closed = False
+        # retrace watchdog (ISSUE 11 satellite): armed by
+        # arm_steady_state() after warm-up; any programs_built growth
+        # past the armed baseline is counted + warned loudly (a silent
+        # recompile storm is the exact failure the AOT store prevents)
+        self._steady_seen: int | None = None
 
     # -- observability emitters (obs/) --------------------------------------
     # All three are single-`if` no-ops when tracing/logging is off, emit
@@ -537,30 +557,72 @@ class ServePipeline:
         if tr is not None:
             tr.counter("serve.inflight", ts=ts, inflight=n)
 
+    def _event(self, kind: str, **fields) -> None:
+        """One discrete event, mirrored to BOTH sinks: the JSONL event
+        log and the flight recorder's ring (obs/flightrec.py).  One
+        attribute read per sink when off; never raises."""
+        if self._events is not None:
+            self._events.emit(event=kind, **fields)
+        fr = self._flightrec
+        if fr is not None:
+            fr.record(kind, **fields)
+
+    def _inflight_ledger(self) -> list:
+        """The flight recorder's in-flight snapshot: every chunk not yet
+        done, with its member case seqs (the postmortem's 'what was
+        this process holding' answer).  Bounded by depth + ready."""
+        out = []
+        try:
+            for oc in self._open.values():
+                out.append({"state": "open",
+                            "cases": [r.seq for r in oc.requests]})
+            for ch in list(self._ready):
+                out.append({"state": "ready", "chunk": ch.chunk_id,
+                            "cases": [r.seq for r in ch.requests]})
+            for ch in list(self._inflight):
+                out.append({"state": "inflight", "chunk": ch.chunk_id,
+                            "cases": [r.seq for r in ch.requests]})
+        except Exception:  # noqa: BLE001 — a racing mutation costs the
+            pass  # remainder of the ledger, never the dump
+        return out
+
     def _breaker_moved(self, frm: str, to: str, t: float) -> None:
         """CircuitBreaker transition hook: mirror into the registry, the
         trace, and the event log (the trail itself lives on the breaker,
-        surfaced by :meth:`ServeReport.resilience`)."""
+        surfaced by :meth:`ServeReport.resilience`).  A closed -> open
+        move additionally dumps the flight recorder: the breaker opening
+        IS the device path dying, and the black box should say why."""
         try:
             self.registry.counter("/breaker/transitions").inc()
             self._t_instant("breaker.transition", ts=t,
                             **{"from": frm, "to": to})
-            if self._events is not None:
-                self._events.emit(event="breaker", t=t, frm=frm, to=to)
+            # breaker_t, not t: the breaker's clock is the pipeline's
+            # (monotonic/injected) — the bare "t" stamp on every
+            # EventLog/flight-recorder line is the WALL clock the
+            # cross-process merge keys on, and an explicit field of the
+            # same name would override it with the wrong epoch
+            self._event("breaker", breaker_t=t, frm=frm, to=to)
+            fr = self._flightrec
+            if fr is not None and to == "open":
+                fr.dump("breaker-open", frm=frm, breaker_t=t)
         except Exception:  # noqa: BLE001 — observability never raises
             pass
 
     # -- intake -------------------------------------------------------------
     def submit(self, case: EnsembleCase, *, deadline_ms: float | None = None,
-               priority: int = 0) -> ServeRequest:
+               priority: int = 0, trace=None) -> ServeRequest:
         """Queue one case; returns its handle.  ``deadline_ms`` (relative
         to now) pulls the case's chunk close forward; ``priority`` orders
-        ready chunks competing for a dispatch slot."""
+        ready chunks competing for a dispatch slot.  ``trace`` is the
+        originating request's TraceContext (obs/trace.py) when the case
+        arrived through a traced front door — the fleet worker re-installs
+        it around this case's chunk stages so every span nests under the
+        ingress request; None (the default) costs nothing."""
         if self._closed:
             raise RuntimeError("pipeline is closed")
         now = self._clock()
         req = ServeRequest(case=case, seq=self._next_seq, submit_t=now,
-                           priority=int(priority), _pipe=self)
+                           priority=int(priority), trace=trace, _pipe=self)
         self._next_seq += 1
         self.report.cases += 1
         key = case.bucket_key()
@@ -664,6 +726,27 @@ class ServePipeline:
                        and self._breaker.routed_probe)
         chunk.fired = (self._faults.draw([r.seq for r in chunk.requests])
                        if self._faults is not None else NO_FAULTS)
+        # fleet tracing: install the chunk's originating TraceContext for
+        # the duration of the dispatch stages, so every span recorded
+        # inside (serve.build/dispatch AND the engine/store spans those
+        # stages emit) is stamped with the ingress request's trace id.
+        # Guarded by the tracer: the disabled path stays one attribute
+        # read, zero clock reads (the fence-discipline spy contract).
+        _ctx_installed = False
+        _ctx_prev = None
+        if self._tracer is not None:
+            _ctx = next((r.trace for r in chunk.requests
+                         if r.trace is not None), None)
+            if _ctx is not None:
+                _ctx_prev = obs_trace.set_context(_ctx)
+                _ctx_installed = True
+        try:
+            self._dispatch_body(chunk)
+        finally:
+            if _ctx_installed:
+                obs_trace.set_context(_ctx_prev)
+
+    def _dispatch_body(self, chunk: _Chunk) -> None:
         t0 = self._clock()
         try:
             if chunk.fired.raise_ is not None:
@@ -696,12 +779,11 @@ class ServePipeline:
                                  (chunk.last_failure[0] or outcome))
                 if ok:
                     self.report.fallback_chunks += 1
-                    if self._events is not None:
-                        self._events.emit(event="fallback-chunk",
-                                          chunk=chunk.chunk_id,
-                                          cases=len(chunk.requests))
+                    self._event("fallback-chunk", chunk=chunk.chunk_id,
+                                cases=len(chunk.requests))
                 return
             multi = self.engine.build_program(chunk.key, chunk.padded)
+            self._check_steady_state()
             # every attempt RE-STAGES: a fresh device input buffer per
             # dispatch, so the depth-1 donating schedule never re-reads
             # a frame a previous attempt donated away (utils/donation.py)
@@ -849,10 +931,9 @@ class ServePipeline:
                             attempt=chunk.attempts,
                             classification=classification,
                             backoff_ms=delay_s * 1e3)
-            if self._events is not None:
-                self._events.emit(event="retry", chunk=chunk.chunk_id,
-                                  attempt=chunk.attempts,
-                                  classification=classification)
+            self._event("retry", chunk=chunk.chunk_id,
+                        attempt=chunk.attempts,
+                        classification=classification)
             if delay_s > 0:
                 self.report.backoff_ms_total += delay_s * 1e3
                 self._sleep(delay_s)
@@ -900,11 +981,15 @@ class ServePipeline:
                         chunk=chunk.chunk_id,
                         classification=classification,
                         attempts=chunk.attempts)
-        if self._events is not None:
-            self._events.emit(event="quarantine", case=req.seq,
-                              chunk=chunk.chunk_id,
-                              classification=classification,
-                              attempts=chunk.attempts, detail=detail)
+        self._event("quarantine", case=req.seq, chunk=chunk.chunk_id,
+                    classification=classification,
+                    attempts=chunk.attempts, detail=detail)
+        fr = self._flightrec
+        if fr is not None:
+            # a typed ServeError quarantine is a black-box trigger: the
+            # postmortem names the poison case and what was in flight
+            fr.dump("quarantine", case=req.seq,
+                    classification=classification, detail=detail)
         chunk.state = "done"
 
     def _complete_attempt(self, chunk: _Chunk, outcome, t_fence,
@@ -929,20 +1014,36 @@ class ServePipeline:
         """Fence + fetch one in-flight chunk under supervision and
         distribute its lanes (or classify the failure)."""
         self._inflight.remove(chunk)
-        t_f0 = self._clock() if self._tracer is not None else None
-        outcome, t1, payload = self._guarded(
-            chunk, lambda: self._fetch_device(chunk))
-        ok = self._complete_attempt(chunk, outcome, t1, payload)
-        t_now = self._clock()
-        if t_f0 is not None:
-            # the fetch span reuses the fence the retire performs anyway;
-            # like serve.fallback it reports the EFFECTIVE outcome —
-            # _complete_attempt's finite scan can reclassify a
-            # fetched-ok payload as corrupt
-            self._t_span("serve.fetch", t_f0, t_now, chunk=chunk.chunk_id,
-                         attempt=chunk.attempts,
-                         outcome="ok" if ok else
-                         (chunk.last_failure[0] or outcome))
+        t_f0 = None
+        _ctx_installed = False
+        _ctx_prev = None
+        if self._tracer is not None:
+            t_f0 = self._clock()
+            # stamp the retire-side spans with the originating request's
+            # trace (the dispatch-side twin lives in _dispatch)
+            _ctx = next((r.trace for r in chunk.requests
+                         if r.trace is not None), None)
+            if _ctx is not None:
+                _ctx_prev = obs_trace.set_context(_ctx)
+                _ctx_installed = True
+        try:
+            outcome, t1, payload = self._guarded(
+                chunk, lambda: self._fetch_device(chunk))
+            ok = self._complete_attempt(chunk, outcome, t1, payload)
+            t_now = self._clock()
+            if t_f0 is not None:
+                # the fetch span reuses the fence the retire performs
+                # anyway; like serve.fallback it reports the EFFECTIVE
+                # outcome — _complete_attempt's finite scan can
+                # reclassify a fetched-ok payload as corrupt
+                self._t_span("serve.fetch", t_f0, t_now,
+                             chunk=chunk.chunk_id,
+                             attempt=chunk.attempts,
+                             outcome="ok" if ok else
+                             (chunk.last_failure[0] or outcome))
+        finally:
+            if _ctx_installed:
+                obs_trace.set_context(_ctx_prev)
         self.report.occupancy_samples.append((t_now, len(self._inflight)))
         self._t_inflight(t_now, len(self._inflight))
 
@@ -953,6 +1054,17 @@ class ServePipeline:
             r.result = np.asarray(vals[j])
             r.latency_s = t2 - r.submit_t
             self.report.request_latency_ms.append(r.latency_s * 1e3)
+        tr = self._tracer
+        if tr is not None:
+            # flow FINISH per traced request, at the retire timestamp
+            # the scheduler already took: Perfetto binds it (bp="e") to
+            # the enclosing serve.fetch/serve.fallback span, closing the
+            # ingress -> router -> worker arrow chain (obs/trace.py)
+            for r in chunk.requests:
+                if r.trace is not None:
+                    tr.flow("request", "finish", r.trace.trace_id,
+                            ts=t2, cat="serve", req=r.seq,
+                            chunk=chunk.chunk_id)
         chunk.state = "done"
         chunk.out = None
         entry = {
@@ -966,8 +1078,7 @@ class ServePipeline:
             "attempt": chunk.attempts,
         }
         self.report.chunk_log.append(entry)
-        if self._events is not None:
-            self._events.emit(event="chunk", **entry)
+        self._event("chunk", **entry)
 
     # -- completion ---------------------------------------------------------
     def wait(self, req: ServeRequest) -> np.ndarray:
@@ -1041,6 +1152,41 @@ class ServePipeline:
 
     def metrics_json(self) -> str:
         return self.report.metrics_json()
+
+    # -- retrace watchdog (ISSUE 11 satellite) ------------------------------
+    def arm_steady_state(self) -> int:
+        """Arm the recompile watchdog: a steady-state server (warmed
+        caches, AOT store hot) should build ZERO new programs — call
+        this after warm-up (the fleet router's ``arm_steady_state``
+        broadcasts it; bench/CLI drivers call it directly) and every
+        later ``programs_built`` growth increments
+        ``/store/steady-state-builds`` plus a LOUD EventLog warning and
+        flight-recorder note, so a silent recompile storm pages instead
+        of burning.  Returns the armed baseline."""
+        self._steady_seen = int(self.report.programs_built)
+        # materialize the counter at arm time: a scrape sees the key
+        # (value 0) even before any violation
+        self.registry.counter("/store/steady-state-builds")
+        return self._steady_seen
+
+    def _check_steady_state(self) -> None:
+        """Post-build hook (one int compare when armed, one attribute
+        read when not): count + warn on programs built past the armed
+        baseline."""
+        seen = self._steady_seen
+        if seen is None:
+            return
+        built = int(self.report.programs_built)
+        if built <= seen:
+            return
+        delta = built - seen
+        self._steady_seen = built
+        self.registry.counter("/store/steady-state-builds").inc(delta)
+        print(f"serve: WARNING steady-state recompile — {delta} new "
+              f"program(s) built after warm-up ({built} total); the AOT "
+              "store should have made this a load "
+              "(/store/steady-state-builds)", file=sys.stderr)
+        self._event("steady-state-build", built=built, delta=delta)
 
 
 def serve_fence_ab(engine: EnsembleEngine, cases, depth: int,

@@ -22,6 +22,7 @@ for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
               # a leaked event-log/trace path must not make the suite
               # write telemetry files (obs/export.py, cli obs_session)
               "NLHEAT_EVENT_LOG", "NLHEAT_TRACE", "BENCH_TRACE",
+              "NLHEAT_FLIGHT_DIR", "BENCH_TRACE_FLEET",
               # a leaked AOT store dir must not let suite programs load
               # stale executables (or write new ones) across test runs
               "NLHEAT_PROGRAM_STORE", "NLHEAT_PROGRAM_CACHE_CAP"):

@@ -198,6 +198,43 @@ def test_bench_router_mode_emits_fleet_ab(tmp_path):
     assert list(store.glob("*.aotprog"))
 
 
+def test_bench_trace_fleet_mode_emits_merged_timeline(tmp_path):
+    # BENCH_TRACE_FLEET (with BENCH_ROUTER=N): the fleet observability
+    # A/B (ISSUE 11) — traced vs untraced N-replica fleets over one
+    # shared store dir.  The JSON must carry the routerobs variant, the
+    # overhead ratio, the fleet span count, the merged-trace path (a
+    # Perfetto-loadable document spanning the router AND the replicas),
+    # the retrace-watchdog verdict (0 steady-state builds: the warm
+    # pass left every program resident/stored), and bit-identity — on
+    # the same one-line rc=0 ladder.
+    import json
+
+    store = tmp_path / "store"
+    tdir = tmp_path / "fleet_trace"
+    proc, rec = run_bench({"BENCH_ROUTER": "2", "BENCH_GRID": "48",
+                           "BENCH_LADDER": "48", "BENCH_ACCURACY": "0",
+                           "BENCH_ROUTER_STEPS": "60",
+                           "BENCH_ROUTER_CASES": "6",
+                           "BENCH_ROUTER_DIR": str(store),
+                           "BENCH_TRACE_FLEET": str(tdir)},
+                          timeout=420)
+    assert proc.returncode == 0
+    assert rec["value"] > 0
+    assert rec["variant"] == "routerobs2"
+    assert rec["replicas"] == 2 and rec["cases"] == 6
+    assert rec["trace_overhead"] > 0
+    assert rec["spans_total"] > 0
+    assert rec["steady_state_builds"] == 0
+    assert rec["bit_identical"] is True
+    # router + 2 replicas in the merged timeline, flows intact
+    assert rec["merged_processes"] == 3
+    doc = json.loads(open(rec["merged_trace_path"]).read())
+    events = doc["traceEvents"]
+    assert len({e.get("pid") for e in events
+                if e.get("ph") != "M"}) == 3
+    assert any(e["ph"] in ("s", "t", "f") for e in events)
+
+
 def test_bench_scrubs_leaked_program_store():
     # a store dir leaked from a developer shell must not silently
     # warm-boot a headline measurement's compiles

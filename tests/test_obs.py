@@ -104,7 +104,10 @@ def test_ring_buffer_evicts_oldest_and_keeps_exact_lifetime_count():
     assert [ev["name"] for ev in tr.events] == ["e6", "e7", "e8", "e9"]
     assert tr.spans_total == 10  # lifetime-exact through eviction
     doc = tr.chrome_trace()
-    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    # metadata carries the merge identity (clock_sync/pid) — extra
+    # top-level keys are legal Chrome trace format, ignored by Perfetto
+    assert set(doc) == {"traceEvents", "displayTimeUnit", "metadata"}
+    assert "clock_sync" in doc["metadata"]
     _check_schema(doc["traceEvents"])
 
 

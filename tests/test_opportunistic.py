@@ -23,7 +23,7 @@ ALL_STEPS = [
     "bench4096", "resident512", "carried4096", "superstep2",
     "bf16-4096", "bf16-carried4096", "ensemble8x1024", "serve8x1024",
     "servefault8x1024", "obs8x1024", "multichip1024", "fft4096",
-    "tta4096", "warmboot1024", "router8x1024",
+    "tta4096", "warmboot1024", "router8x1024", "routerobs8x1024",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
@@ -206,6 +206,37 @@ def test_router_step_banks_fleet_evidence(tmp_path):
     assert '"router_speedup"' in table
     assert '"load_sweep"' in table
     assert '"bit_identical": true' in table
+
+
+@pytest.mark.slow  # ~60 s (a gate bench + the traced fleet child) — the
+# fleet-tracing machinery is tier-1-covered by tests/test_trace_fleet.py;
+# this proves the queue's gate parses overhead/merged-trace/steady-state
+# fields, validates the merged Perfetto artifact spans >= 2 processes,
+# and that the step's deliberate cpu-labeled rows pass its exemption
+def test_routerobs_step_banks_fleet_trace_evidence(tmp_path):
+    import json
+
+    tdir = tmp_path / "fleet_trace"
+    proc, state, table, _out = _run(
+        tmp_path, "routerobs8x1024",
+        # tiny-grid CPU smoke (2 replicas, relaxed overhead limit — a
+        # millisecond-scale proxy under CI load measures timer noise;
+        # the structural gate stays tight: merged artifact, >= 2 pids,
+        # steady_state_builds == 0, bit_identical)
+        {"OPP_ROUTER_REPLICAS": "2", "OPP_GRID_ROUTER": "32",
+         "BENCH_ROUTER_STEPS": "600",
+         "OPP_ROUTEROBS_TRACE_DIR": str(tdir),
+         "OPP_ROUTEROBS_MAX_OVERHEAD": "10"}, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    assert "routerobs8x1024\n" in state
+    assert "fail:" not in state
+    assert '"variant": "routerobs2"' in table
+    assert '"trace_overhead"' in table
+    assert '"steady_state_builds": 0' in table
+    assert '"bit_identical": true' in table
+    doc = json.loads((tdir / "fleet_trace.json").read_text())
+    assert len({e.get("pid") for e in doc["traceEvents"]}) >= 2
 
 
 @pytest.mark.slow  # ~73 s: two strike rounds, each a full bench child plus

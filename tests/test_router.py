@@ -504,22 +504,27 @@ def test_metrics_server_aggregates_registries():
         server.close()
 
 
-def test_event_log_stamps_pid_and_replica(tmp_path, monkeypatch):
+def test_event_log_stamps_pid_replica_seq_and_time(tmp_path, monkeypatch):
     path = tmp_path / "events.jsonl"
-    log = EventLog(str(path))
+    log = EventLog(str(path), clock=lambda: 100.5)
     log.emit(event="chunk", chunk=1)
     log.close()
     monkeypatch.setenv("NLHEAT_REPLICA_ID", "3")
-    log = EventLog(str(path))  # replica id picked up from the env
+    log = EventLog(str(path), clock=lambda: 101.5)  # replica from env
     log.emit(event="chunk", chunk=2)
+    log.emit(event="chunk", chunk=3)
     log.close()
     lines = [json.loads(ln) for ln in path.read_text().splitlines()]
-    assert len(lines) == 2
+    assert len(lines) == 3
     import os as _os
 
     assert lines[0]["pid"] == _os.getpid() and "replica" not in lines[0]
-    assert lines[1] == {"pid": _os.getpid(), "replica": 3,
-                        "event": "chunk", "chunk": 2}
+    assert lines[1] == {"pid": _os.getpid(), "replica": 3, "seq": 0,
+                        "t": 101.5, "event": "chunk", "chunk": 2}
+    # seq is per-process lifetime-exact: the second emit of the second
+    # process is seq 1, while the FIRST process's line stays seq 0 —
+    # interleaved multi-replica logs total-order within each process
+    assert lines[0]["seq"] == 0 and lines[2]["seq"] == 1
 
 
 # ---------------------------------------------------------------------------

@@ -23,7 +23,9 @@ Env:
     BT_ROUTER_REPLICAS (4, the router group's fleet size) +
     BT_ROUTER_GRID (512 / 128) + BT_ROUTER_CASES (16) + BT_ROUTER_STEPS
     (200 / 800: per-case scan length — compute must dominate the
-    router's per-case submit cost or the sweep measures the pickler)
+    router's per-case submit cost or the sweep measures the pickler);
+    the routerobs group (ISSUE 11 traced-vs-untraced fleet A/B) shares
+    the BT_ROUTER_* knobs
 """
 
 from __future__ import annotations
@@ -997,6 +999,62 @@ def bench_router(steps: int):
          unloaded_p99_ms=ab["unloaded_latency_ms"].get("p99", 0.0))
 
 
+def bench_router_obs(steps: int):
+    """Fleet observability A/B (ISSUE 11, obs/trace.py +
+    serve/router.py router_traced_ab): the same mixed-bucket case set
+    served by two N-replica routers over ONE shared AOT store dir —
+    untraced (TRACE_OFF) vs cross-process tracing on (router + worker
+    span tracers, trace-context frames, flow events) — plus the merged
+    Perfetto fleet timeline and the retrace-watchdog verdict (armed
+    after the warm pass; a steady-state fleet must build 0 programs).
+    The traced row records ``trace_overhead`` = traced/untraced wall
+    (the PR 5 <= 1.05 gate at fleet altitude).  Off-TPU only, like the
+    router group."""
+    import shutil
+    import tempfile
+
+    from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+    from nonlocalheatequation_tpu.serve.router import router_traced_ab
+
+    if on_tpu():
+        log("  routerobs: skipped on TPU (replica fleets assume one "
+            "accelerator per worker; run with BENCH_PLATFORM=cpu)")
+        return
+    replicas = int(os.environ.get("BT_ROUTER_REPLICAS", 4))
+    n = cfg("BT_ROUTER_GRID", 512, 128)
+    C = int(os.environ.get("BT_ROUTER_CASES", 16))
+    rsteps = cfg("BT_ROUTER_STEPS", 200, 800)
+    buckets = max(replicas, min(8, C))
+    rng = np.random.default_rng(0)
+    cases = [EnsembleCase(shape=(n, n), nt=rsteps + (i % buckets), eps=8,
+                          k=1.0, dt=1e-7, dh=1.0 / n, test=False,
+                          u0=rng.normal(size=(n, n)))
+             for i in range(C)]
+    store_dir = tempfile.mkdtemp(prefix="nlheat-bt-routerobs-")
+    trace_dir = tempfile.mkdtemp(prefix="nlheat-bt-routerobs-trace-")
+    try:
+        ab = router_traced_ab({"method": "sat", "batch_sizes": (1,)},
+                              cases, replicas, store_dir, trace_dir)
+        bit = all(np.array_equal(a, b)
+                  for a, b in zip(ab["results"]["untraced"],
+                                  ab["results"]["traced"]))
+        total_steps = sum(c.nt for c in cases)
+        merged = ab["merged"] or {}
+        emit(f"routerobs/untraced{replicas}", n * n * C,
+             total_steps // C, ab["walls"]["untraced"], grid=n, eps=8,
+             replicas=replicas, cases=C)
+        emit(f"routerobs/traced{replicas}", n * n * C, total_steps // C,
+             ab["walls"]["traced"], grid=n, eps=8, replicas=replicas,
+             cases=C, trace_overhead=round(ab["trace_overhead"], 4),
+             spans_total=ab["spans_total"],
+             merged_processes=merged.get("processes"),
+             steady_state_builds=ab["steady_state_builds"],
+             bit_identical=bit)
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+        shutil.rmtree(trace_dir, ignore_errors=True)
+
+
 def bench_multichip(steps: int):
     """Fused-vs-collective halo A/B (round 9, ops/pallas_halo.py): the
     distributed 2D solver over ONE shared device mesh, collective halos
@@ -1056,6 +1114,7 @@ BENCHES = {
     "tta": bench_tta,
     "warmboot": bench_warmboot,
     "router": bench_router,
+    "routerobs": bench_router_obs,
 }
 
 

@@ -100,6 +100,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 STEPS="bench4096 resident512 carried4096 superstep2 \
 bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 servefault8x1024 \
 obs8x1024 multichip1024 fft4096 tta4096 warmboot1024 router8x1024 \
+routerobs8x1024 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -267,6 +268,23 @@ run_step_cmd() {  # the queue's one name->command map
       # the ISSUE 10 acceptance floor), shed >= 1 at the burst point,
       # bit_identical.
       bench_nofb BENCH_ROUTER="${OPP_ROUTER_REPLICAS:-8}" \
+        BENCH_PLATFORM=cpu \
+        BENCH_GRID="${OPP_GRID_ROUTER:-1024}" \
+        BENCH_LADDER="${OPP_GRID_ROUTER:-1024}" BENCH_ACCURACY=0 ;;
+    routerobs8x1024)
+      # fleet observability A/B (ISSUE 11, obs/trace.py +
+      # serve/router.py router_traced_ab): the SAME mixed-bucket case
+      # set served by two 8-replica fleets over one shared AOT store —
+      # untraced vs cross-process tracing (trace-context frames, flow
+      # events, per-worker tracers) — plus ONE merged Perfetto fleet
+      # timeline.  A HOST measurement like router8x1024 (same
+      # BENCH_PLATFORM=cpu rationale; step() exempts the backend grep).
+      # Gate (step_variant_ok): variant routerobsN, trace_overhead <=
+      # OPP_ROUTEROBS_MAX_OVERHEAD (default 1.05 — the PR 5 gate at
+      # fleet altitude), a schema-valid merged trace spanning >= 2
+      # processes, steady_state_builds == 0, bit_identical.
+      bench_nofb BENCH_ROUTER="${OPP_ROUTER_REPLICAS:-8}" \
+        BENCH_TRACE_FLEET="${OPP_ROUTEROBS_TRACE_DIR:-docs/bench/fleet_trace_$ROUND}" \
         BENCH_PLATFORM=cpu \
         BENCH_GRID="${OPP_GRID_ROUTER:-1024}" \
         BENCH_LADDER="${OPP_GRID_ROUTER:-1024}" BENCH_ACCURACY=0 ;;
@@ -453,6 +471,46 @@ for line in open(sys.argv[1]):
 sys.exit(0 if ok else 1)
 PYEOF
       ;;
+    routerobs8x1024) python - "$2" <<'PYEOF'
+import json, os, sys
+# the fleet-tracing gate (ISSUE 11): overhead <= 1.05 (the PR 5 obs
+# gate at fleet altitude; OPP_ROUTEROBS_MAX_OVERHEAD relaxes it for
+# the CI smoke harness — a millisecond-scale CPU proxy under suite
+# load measures timer noise), a Perfetto-loadable merged trace that
+# spans >= 2 processes, zero steady-state builds (the retrace
+# watchdog armed after warm-up), and the bit-identity flag.
+limit = float(os.environ.get("OPP_ROUTEROBS_MAX_OVERHEAD", "1.05"))
+ok = False
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    if not str(r.get("variant", "")).startswith("routerobs"):
+        continue
+    overhead, path = r.get("trace_overhead"), r.get("merged_trace_path")
+    if not isinstance(overhead, (int, float)) or overhead > limit or not path:
+        continue
+    if r.get("steady_state_builds") != 0 or r.get("bit_identical") is not True:
+        continue
+    try:
+        with open(path) as f:
+            events = json.load(f)["traceEvents"]
+    except Exception:
+        continue
+    # "M" process_name records legitimately carry no ts — validate them
+    # apart from the timeline events
+    timeline = [e for e in events if e.get("ph") != "M"]
+    pids = {e.get("pid") for e in timeline}
+    if timeline and len(pids) >= 2 and all(
+            e.get("ph") and "ts" in e and "pid" in e for e in timeline):
+        ok = True
+sys.exit(0 if ok else 1)
+PYEOF
+      ;;
     warmboot1024) python - "$2" <<'PYEOF'
 import json, os, sys
 # the >= 2x cold->warm first-chunk acceptance gate (ISSUE 9); the CI
@@ -503,10 +561,10 @@ step() {  # <name>: run one queue step unless already done.
   log "step $name: start"
   local run rc backend_check=step_backend_ok
   case $name in
-    router8x1024)
-      # deliberately a host measurement (see run_step_cmd): the fleet
-      # proxy pins BENCH_PLATFORM=cpu because N replica processes
-      # cannot share the single tunneled chip — its rows are cpu-
+    router8x1024 | routerobs8x1024)
+      # deliberately host measurements (see run_step_cmd): the fleet
+      # proxies pin BENCH_PLATFORM=cpu because N replica processes
+      # cannot share the single tunneled chip — their rows are cpu-
       # labeled BY DESIGN, so the on-TPU backend grep does not apply
       backend_check=true ;;
   esac

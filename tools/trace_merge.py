@@ -1,0 +1,120 @@
+"""Merge per-process Chrome trace files into ONE Perfetto timeline.
+
+The fleet's tracers each write a per-process artifact — the router's
+spans, every replica's ``host_trace.replica{r}.json`` (serve/router.py
+worker exit path), a solve CLI's ``host_trace.json`` — all stamped with
+the (monotonic, wall) ``clock_sync`` pair captured at tracer
+construction (obs/trace.py).  This tool aligns those per-process
+monotonic clocks onto the shared wall clock and emits one merged
+Chrome trace with pid = replica id and process names, so a routed
+4-replica run loads in ui.perfetto.dev as a single timeline with the
+request flow events (ingress -> router -> worker chunk) intact.
+
+``ReplicaRouter.dump_fleet_trace()`` does the same merge LIVE over the
+frame channel (including workers that never exited); this CLI is the
+offline form for artifacts already on disk.
+
+Usage:
+    python tools/trace_merge.py OUT.json IN1.json IN2.json ...
+    python tools/trace_merge.py OUT.json DIR        # every *.json in DIR
+
+Also merges JSONL event logs when given ``--events OUT.jsonl IN...``:
+multi-replica EventLog streams are totally ordered by each process's
+lifetime-exact ``seq`` (within a process) and heap-merged on the wall
+``t`` stamp (across processes) — obs/export.py merge_event_streams.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nonlocalheatequation_tpu.obs.export import (  # noqa: E402
+    merge_event_streams,
+    read_jsonl,
+)
+from nonlocalheatequation_tpu.obs.trace import (  # noqa: E402
+    merge_chrome_traces,
+    write_chrome_trace,
+)
+
+
+def expand(paths) -> list:
+    """Expand DIR arguments to their *.json files.  Returns
+    ``(path, from_dir)`` pairs: dir-globbed files are marked so the
+    loader can skip prior MERGE OUTPUTS living in the same trace_dir
+    (dump_fleet_trace writes fleet_trace.json next to the per-replica
+    artifacts — re-merging it would duplicate every event and collapse
+    the rebased timeline); explicitly named files are always taken."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend((f, True)
+                       for f in sorted(glob.glob(os.path.join(p, "*.json"))))
+        else:
+            out.append((p, False))
+    return out
+
+
+def main(argv) -> int:
+    if len(argv) >= 2 and argv[0] == "--events":
+        out_path, ins = argv[1], argv[2:]
+        if not ins:
+            print("usage: trace_merge.py --events OUT.jsonl IN.jsonl ...",
+                  file=sys.stderr)
+            return 2
+        merged = merge_event_streams(read_jsonl(p) for p in ins)
+        with open(out_path, "w") as f:
+            for ev in merged:
+                f.write(json.dumps(ev, default=str) + "\n")
+        print(f"merged {len(ins)} event stream(s), {len(merged)} "
+              f"event(s) -> {out_path}")
+        return 0
+    if len(argv) < 2:
+        print("usage: trace_merge.py OUT.json IN.json|DIR ...\n"
+              "       trace_merge.py --events OUT.jsonl IN.jsonl ...",
+              file=sys.stderr)
+        return 2
+    out_path, ins = argv[0], expand(argv[1:])
+    docs = []
+    for p, from_dir in ins:
+        if os.path.abspath(p) == os.path.abspath(out_path):
+            continue  # re-running into the same dir must not self-merge
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"skipping {p!r}: {e}", file=sys.stderr)
+            continue
+        if not (isinstance(doc, dict)
+                and doc.get("traceEvents") is not None):
+            print(f"skipping {p!r}: not a Chrome trace document",
+                  file=sys.stderr)
+            continue
+        if from_dir and "metadata" not in doc:
+            # per-process tracer artifacts always carry metadata
+            # (clock_sync/pid); a doc without it inside a globbed dir
+            # is a prior merge OUTPUT — taking it would double events
+            print(f"skipping {p!r}: already-merged document (no "
+                  "tracer metadata); name it explicitly to force",
+                  file=sys.stderr)
+            continue
+        docs.append(doc)
+    if not docs:
+        print("no loadable trace documents", file=sys.stderr)
+        return 1
+    merged = merge_chrome_traces(docs)
+    if not write_chrome_trace(merged, out_path):
+        return 1
+    print(f"merged {len(docs)} trace(s), "
+          f"{len(merged['traceEvents'])} event(s) -> {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -134,6 +134,24 @@ carries "trace_overhead" = traced/untraced wall ratio (the PR 5
 armed after the warm pass — a steady-state fleet must report 0), and
 "bit_identical"; set it to a DIRECTORY path (anything other than "1")
 to keep the merged artifact there),
+BENCH_FLEET_TCP=N (N >= 2: the worker-transport A/B + sharded big-case
+tier — ISSUE 12, serve/transport.py + serve/router.py fleet_tcp_ab:
+BENCH_FLEET_CASES mixed-bucket small cases served by an N-replica
+router over in-process PIPES and again over loopback TCP (one shared
+AOT store dir, BENCH_ROUTER_DIR; "tcp_overhead" = tcp/pipe steady-pass
+wall ratio, results pinned bit-identical across transports), then a
+mixed sweep on a TCP fleet with the gang tier up: BENCH_FLEET_SHARDED
+big cases at (2*grid)^2 — above the grid^2 shard threshold — dispatch
+to the gang replica's BENCH_FLEET_GANG-device mesh (virtual CPU
+devices on the proxy) and must return bit-identical to the offline
+distributed solve, while a paced 2x point and a burst point through
+the admission gate must SHED, not queue.  A 1-replica TCP arm measures
+the fleet speedup over sockets ("router_speedup" — the PR 10
+acceptance bar surviving the transport change).  The rung is labeled
+"variant": "fleettcpN" and carries "transport" / "tcp_overhead" /
+"router_speedup" / "sharded_cases" / "sharded" (comm, mesh, threshold)
+/ "accepted" / "shed" / "load_sweep" / "bit_identical"; requires
+BENCH_PLATFORM=cpu like BENCH_ROUTER),
 BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
@@ -363,7 +381,10 @@ class Best:
                 "accepted", "shed", "load_sweep",
                 # routerobs rung: the fleet-tracing evidence (ISSUE 11)
                 "spans_total", "merged_trace_path", "merged_processes",
-                "steady_state_builds")
+                "steady_state_builds",
+                # fleettcp rung: the worker-transport + sharded-tier
+                # evidence (ISSUE 12)
+                "transport", "tcp_overhead", "sharded_cases", "sharded")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -604,6 +625,19 @@ def main():
                  if "host_platform_device_count" not in f]
         flags.append(f"--xla_force_host_platform_device_count={mc_env}")
         os.environ["XLA_FLAGS"] = " ".join(flags)
+    # BENCH_FLEET_TCP likewise: the gang replica's mesh needs virtual
+    # devices on the CPU proxy (BENCH_FLEET_GANG, default 4) — set
+    # before any backend initializes so the measure child, every
+    # worker, AND the in-process sharded oracle see the same device set
+    ft_env = int(os.environ.get("BENCH_FLEET_TCP", 0) or 0)
+    if ft_env >= 2 and mc_env < 2:
+        gang = int(os.environ.get("BENCH_FLEET_GANG", 4) or 4)
+        if gang >= 2:
+            flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f]
+            flags.append(
+                f"--xla_force_host_platform_device_count={gang}")
+            os.environ["XLA_FLAGS"] = " ".join(flags)
     # NLHEAT_FAULT_PLAN joins the scrub: a fault plan leaked from a chaos
     # shell would inject failures into a headline measurement; the serve
     # fault rung re-injects deliberately via BENCH_SERVE_FAULTS only.
@@ -909,16 +943,33 @@ def child_measure():
     router_n = int(os.environ.get("BENCH_ROUTER", 0) or 0)
     if router_n == 1:
         router_n = 0  # the A/B needs a fleet; 0/1 mean off
+    fleet_n = int(os.environ.get("BENCH_FLEET_TCP", 0) or 0)
+    if fleet_n == 1:
+        fleet_n = 0  # the A/B needs a fleet; 0/1 mean off
+    if fleet_n and (router_n or os.environ.get("BENCH_TRACE_FLEET")):
+        log("BENCH_FLEET_TCP set: ignoring BENCH_ROUTER/TRACE_FLEET — "
+            "the fleettcp rung is its own labeled variant")
+        router_n = 0
+        os.environ.pop("BENCH_TRACE_FLEET", None)
     tta = os.environ.get("BENCH_TTA") == "1"
-    if warmboot and (tta or srv or ens or mchip or router_n
+    if warmboot and (tta or srv or ens or mchip or router_n or fleet_n
                      or any(os.environ.get(k) for k in
                             ("BENCH_CARRIED", "BENCH_RESIDENT",
                              "BENCH_SUPERSTEP"))):
         log("BENCH_WARMBOOT set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
-            "MULTICHIP/ROUTER/CARRIED/RESIDENT/SUPERSTEP — the warmboot "
-            "rung is its own labeled variant")
+            "MULTICHIP/ROUTER/FLEET_TCP/CARRIED/RESIDENT/SUPERSTEP — "
+            "the warmboot rung is its own labeled variant")
         tta = False
-        srv = ens = mchip = router_n = 0
+        srv = ens = mchip = router_n = fleet_n = 0
+    if fleet_n and (tta or srv or ens or mchip
+                    or any(os.environ.get(k) for k in
+                           ("BENCH_CARRIED", "BENCH_RESIDENT",
+                            "BENCH_SUPERSTEP"))):
+        log("BENCH_FLEET_TCP set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
+            "MULTICHIP/CARRIED/RESIDENT/SUPERSTEP — the fleettcp rung "
+            "is its own labeled variant")
+        tta = False
+        srv = ens = mchip = 0
     if router_n and (tta or srv or ens or mchip
                      or any(os.environ.get(k) for k in
                             ("BENCH_CARRIED", "BENCH_RESIDENT",
@@ -1039,6 +1090,148 @@ def child_measure():
                     warmboot_speedup=round(cold_s / warm_s, 3),
                     store_hits=warm_stats["hits"],
                     store_misses=pop_stats["misses"],
+                    bit_identical=bit,
+                )
+                last_op = op
+                any_rung = True
+                continue
+            if fleet_n:
+                # fleet-transport A/B + sharded big-case tier (ISSUE
+                # 12, serve/transport.py + serve/router.py): the SAME
+                # mixed-bucket case set served by an N-replica router
+                # over in-process pipes and over loopback TCP (one
+                # shared AOT store dir; tcp_overhead = the socket
+                # hop's steady-pass cost), then a mixed small+sharded
+                # offered-load sweep through the admission gate on a
+                # TCP fleet with the gang tier up — sharded cases must
+                # come back bit-identical to the offline distributed
+                # solve and the burst point must SHED, not queue.
+                if backend == "tpu":
+                    raise RuntimeError(
+                        "BENCH_FLEET_TCP needs BENCH_PLATFORM=cpu: "
+                        "replica fleets assume one accelerator per "
+                        "worker and the tunneled single chip cannot "
+                        "host N clients")
+                import shutil
+                import tempfile
+
+                from nonlocalheatequation_tpu.serve.ensemble import (
+                    EnsembleCase,
+                )
+                from nonlocalheatequation_tpu.serve.router import (
+                    fleet_tcp_ab,
+                )
+
+                C = int(os.environ.get("BENCH_FLEET_CASES", 16))
+                S = int(os.environ.get("BENCH_FLEET_SHARDED", 2))
+                buckets = max(fleet_n, min(8, C))
+                # the same steps floor as the router rung: per-case
+                # compute must dominate the submit cost
+                rsteps = int(os.environ.get("BENCH_ROUTER_STEPS", 0) or 0) \
+                    or max(steps, int(1e8 // (grid * grid)) or 1)
+                rcases = [
+                    EnsembleCase(shape=(grid, grid),
+                                 nt=rsteps + (i % buckets), eps=EPS,
+                                 k=1.0, dt=dt, dh=1.0 / grid, test=False,
+                                 u0=rng.normal(size=(grid, grid)))
+                    for i in range(C)]
+                # sharded cases: 2x the edge (4x the points — above the
+                # grid^2 threshold by construction), shorter scans so
+                # one gang solve stays comparable to one small case.
+                # Their dt is THEIR OWN 0.8x-stable bound: the small
+                # grid's dt is 4x over the bound at the finer dh and
+                # would honestly-but-uselessly diverge every gang solve
+                sgrid = 2 * grid
+                ssteps = max(1, rsteps // 4)
+                sprobe = NonlocalOp2D(EPS, k=1.0, dt=1.0, dh=1.0 / sgrid,
+                                      method=method)
+                sdt = 0.8 / (sprobe.c * sprobe.dh * sprobe.dh
+                             * sprobe.wsum)
+                scases = [
+                    EnsembleCase(shape=(sgrid, sgrid), nt=ssteps + i,
+                                 eps=EPS, k=1.0, dt=sdt, dh=1.0 / sgrid,
+                                 test=False,
+                                 u0=rng.normal(size=(sgrid, sgrid)))
+                    for i in range(S)]
+                gang = int(os.environ.get("BENCH_FLEET_GANG", 4) or 4)
+                store_dir = os.environ.get("BENCH_ROUTER_DIR")
+                own_dir = store_dir is None
+                if own_dir:
+                    store_dir = tempfile.mkdtemp(prefix="nlheat-fleettcp-")
+                try:
+                    ab = fleet_tcp_ab(
+                        {"method": method, "precision": PRECISION,
+                         "batch_sizes": (1,)},
+                        rcases, fleet_n, store_dir, shard_cases=scases,
+                        shard_threshold=grid * grid, gang_devices=gang)
+                finally:
+                    if own_dir:
+                        shutil.rmtree(store_dir, ignore_errors=True)
+                arms_bit = all(np.array_equal(a, b) for a, b in
+                               zip(ab["results"]["pipe"],
+                                   ab["results"]["tcp"]))
+                bit = arms_bit and ab.get("mixed_bit_identical") is True
+                sharded = ab["sharded"]  # None when BENCH_FLEET_SHARDED=0
+                if not bit:
+                    log("WARNING: fleettcp arms are NOT bit-identical — "
+                        "the transport and the case class must never "
+                        f"change served results (pipe==tcp: {arms_bit}, "
+                        f"mixed: {ab.get('mixed_bit_identical')}, "
+                        "sharded: "
+                        f"{sharded['bit_identical'] if sharded else 'off'})")
+                total_steps = sum(c.nt for c in rcases)
+                wall_t = ab["walls"]["tcp"]
+                burst = ab["sweep"]["burst"]
+                paced = ab["sweep"]["x2"]
+                log(f"rung {grid}^2 fleettcp: pipe "
+                    f"{ab['walls']['pipe']:.2f}s vs tcp {wall_t:.2f}s "
+                    f"({ab['tcp_overhead']:.3f}x; 1-replica tcp "
+                    f"{ab['walls'].get('tcp1', 0.0):.2f}s -> "
+                    f"{ab['fleet_speedup']:.2f}x fleet); "
+                    f"{ab['sharded_cases']} sharded case(s)"
+                    + (f" via {sharded['info']['comm']} on mesh "
+                       f"{sharded['info']['mesh']}" if sharded else "")
+                    + f"; burst accepted "
+                    f"{burst['accepted']}/{burst['offered']} shed "
+                    f"{burst['shed']}")
+                value = grid * grid * total_steps / wall_t
+                event(
+                    event="rung",
+                    grid=grid,
+                    steps=rsteps,
+                    best_s=wall_t,
+                    ms_per_step=wall_t / rsteps * 1e3,
+                    value=value,
+                    variant=f"fleettcp{fleet_n}",
+                    transport="tcp",
+                    replicas=fleet_n,
+                    cases=C,
+                    router_speedup=round(ab["fleet_speedup"], 3),
+                    tcp_overhead=round(ab["tcp_overhead"], 4),
+                    sharded_cases=ab["sharded_cases"],
+                    **({"sharded": {
+                        "cases": sharded["cases"],
+                        "threshold": sharded["threshold"],
+                        "grid": sgrid,
+                        "comm": sharded["info"]["comm"],
+                        "mesh": sharded["info"]["mesh"],
+                        "devices": sharded["info"]["devices"],
+                    }} if sharded else {}),
+                    accepted=burst["accepted"],
+                    shed=burst["shed"],
+                    latency_ms={
+                        "p50": round(paced["latency_s"]["p50"] * 1e3, 3),
+                        "p99": round(paced["latency_s"]["p99"] * 1e3, 3),
+                    },
+                    load_sweep={
+                        lbl: {"rate_hz": run["rate_hz"],
+                              "offered": run["offered"],
+                              "accepted": run["accepted"],
+                              "shed": run["shed"],
+                              "max_pending": run["max_pending"],
+                              "p99_ms": round(
+                                  run["latency_s"]["p99"] * 1e3, 3)}
+                        for lbl, run in ab["sweep"].items()},
                     bit_identical=bit,
                 )
                 last_op = op

@@ -778,18 +778,83 @@ def add_listen_flags(p: argparse.ArgumentParser):
              "store (--program-store/NLHEAT_PROGRAM_STORE) so added "
              "or respawned workers warm-boot instead of re-tracing",
     )
+    p.add_argument(
+        "--transport",
+        default=None,
+        choices=("pipe", "tcp"),
+        help="--listen: how the router reaches its workers — 'pipe' "
+             "(default: stdin/stdout frames, one host) or 'tcp' "
+             "(serve/transport.py: workers dial a loopback listener "
+             "with --worker-connect and speak the identical frames — "
+             "the pod-scale shape where one replica = one host/chip)",
+    )
+    p.add_argument(
+        "--worker-token",
+        default=None,
+        metavar="SECRET",
+        help="--transport tcp: shared secret checked on each worker's "
+             "hello frame (required before a SocketTransport may bind "
+             "non-loopback — the frames are pickle; see "
+             "serve/transport.py trust boundary)",
+    )
+    p.add_argument(
+        "--shard-threshold",
+        type=int,
+        default=None,
+        metavar="POINTS",
+        help="--listen (2D): grids with MORE than POINTS cells are "
+             "dispatched to the gang replica — one worker owning an "
+             "N-device mesh that solves each such case as a "
+             "space-parallel distributed run (comm=fused where the "
+             "kernel family serves it), bit-identical to the offline "
+             "distributed solver.  0/unset = off",
+    )
+    p.add_argument(
+        "--gang-devices",
+        type=int,
+        default=None,
+        metavar="N",
+        help="--shard-threshold: devices in the gang replica's mesh "
+             "(default: every device the gang worker sees)",
+    )
 
 
-def validate_listen_args(args) -> str | None:
-    """The front-door flags' honesty checks (caller prints + exits 1)."""
+def validate_listen_args(args, dim: int | None = None) -> str | None:
+    """The front-door flags' honesty checks (caller prints + exits 1).
+    ``dim`` is the calling CLI's grid rank: the sharded case class is
+    the 2D flagship tier, so solve1d/solve3d refuse --shard-threshold
+    loudly instead of silently never engaging it."""
     if args.listen is None:
         if getattr(args, "replicas", 1) != 1:
             return "--replicas configures the --listen fleet; add --listen"
+        for flag, name in ((getattr(args, "transport", None),
+                            "--transport"),
+                           (getattr(args, "worker_token", None),
+                            "--worker-token"),
+                           (getattr(args, "shard_threshold", None),
+                            "--shard-threshold"),
+                           (getattr(args, "gang_devices", None),
+                            "--gang-devices")):
+            if flag is not None:
+                return f"{name} configures the --listen fleet; add --listen"
         return None
     if not 0 <= args.listen <= 65535:
         return f"--listen must be in [0, 65535] (got {args.listen})"
     if args.replicas < 1:
         return f"--replicas needs N >= 1 (got {args.replicas})"
+    if getattr(args, "worker_token", None) is not None \
+            and (getattr(args, "transport", None) or "pipe") != "tcp":
+        return ("--worker-token authenticates --transport tcp workers; "
+                "the pipe transport is the same process tree")
+    shard = getattr(args, "shard_threshold", None)
+    if shard is not None and shard < 0:
+        return f"--shard-threshold needs POINTS >= 0 (got {shard})"
+    if shard and dim is not None and dim != 2:
+        return ("--shard-threshold dispatches big 2D grids to the gang "
+                f"replica; this CLI serves {dim}D cases — drop the flag "
+                "or use solve2d")
+    if getattr(args, "gang_devices", None) is not None and not shard:
+        return "--gang-devices sizes the gang mesh; add --shard-threshold"
     for flag, name in ((getattr(args, "test", False), "--test"),
                        (getattr(args, "test_batch", False), "--test_batch"),
                        (getattr(args, "ensemble", False), "--ensemble"),
@@ -841,6 +906,14 @@ def run_listen(args, engine_kwargs) -> int:
                        window_ms=args.serve_window_ms,
                        serve_kwargs=serve_kwargs,
                        trace_dir=trace_dir,
+                       # the fleet shape (ISSUE 12): worker transport +
+                       # the sharded big-case tier behind the router
+                       transport=(getattr(args, "transport", None)
+                                  or "pipe"),
+                       worker_token=getattr(args, "worker_token", None),
+                       shard_threshold=getattr(args, "shard_threshold",
+                                               None),
+                       gang_devices=getattr(args, "gang_devices", None),
                        **engine_kwargs) as router:
         set_live_registry(router.registry)
         # the elastic loop: pull per-replica stats (absorbing each

@@ -95,7 +95,7 @@ def main(argv=None) -> int:
               "paths have no per-step precision switch)", file=sys.stderr)
         return 1
     err = (validate_stepper_args(args) or validate_serve_args(args)
-           or validate_listen_args(args) or validate_obs_args(args))
+           or validate_listen_args(args, dim=1) or validate_obs_args(args))
     if err:
         print(err, file=sys.stderr)
         return 1

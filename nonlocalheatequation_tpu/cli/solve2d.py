@@ -104,7 +104,7 @@ def main(argv=None) -> int:
         or validate_serve_args(args, [
             (args.serve and (args.checkpoint or args.resume),
              "--checkpoint/--resume cannot be combined with --serve")])
-        or validate_listen_args(args)
+        or validate_listen_args(args, dim=2)
         or validate_obs_args(args))
     if err:
         print(err, file=sys.stderr)

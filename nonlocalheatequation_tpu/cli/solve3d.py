@@ -153,7 +153,7 @@ def main(argv=None) -> int:
         (args.serve and args.distributed,
          "--serve runs the serial batched engine; it cannot be combined "
          "with --distributed")])
-        or validate_listen_args(args)
+        or validate_listen_args(args, dim=3)
         or (args.listen is not None and args.distributed
             and "--listen runs the serial batched engine; it cannot be "
                 "combined with --distributed")

@@ -28,6 +28,8 @@ sample needs per-device wall-clock the fused program cannot expose).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -328,6 +330,140 @@ def make_gang_run_general(op, mesh: Mesh, npx: int, npy: int,
 
     return _make_run_driver(op, mesh, local_step,
                             aux_specs=(P(), P("d")), test=test)
+
+
+#: Default bound on a gang worker's solver memo (solve_case_sharded's
+#: ``solver_cache``): each entry pins TWO full-grid f64 arrays plus the
+#: solver's compiled step/runner programs, so a long-lived gang replica
+#: serving varied case signatures must evict (the ensemble engine's
+#: PROGRAM_CACHE_CAP lesson, PR 9).  Eviction never changes results —
+#: an evicted signature simply reconstructs (and recompiles) on next
+#: touch.  ``NLHEAT_GANG_SOLVER_CAP`` overrides; 0 = unbounded (the
+#: repo's 0-knob convention for cache CAPS, serve/ensemble.py).
+GANG_SOLVER_CACHE_CAP = 8
+
+
+def solve_case_sharded(case, *, ndevices: int | None = None,
+                       comm: str = "fused", method: str = "auto",
+                       precision: str = "f32", dtype=None,
+                       solver_cache: dict | None = None,
+                       cache_cap: int | None = None):
+    """Solve ONE big ensemble case as a space-parallel distributed run
+    over an N-device mesh — the router's sharded case class (ISSUE 12).
+
+    ``case`` is an :class:`~nonlocalheatequation_tpu.serve.ensemble.
+    EnsembleCase`-shaped object (shape/nt/eps/k/dt/dh/test/u0).  The
+    gang REPLICA WORKER (serve/router.py ``_gang_loop``) and the
+    offline oracle both call THIS function, so the streamed-back fleet
+    result is bit-identical to the offline
+    :class:`~nonlocalheatequation_tpu.parallel.distributed2d.
+    Solver2DDistributed` path by construction — and the test suite
+    still pins it across the process boundary.
+
+    The mesh: ``ndevices`` (None = all local devices) picked whole-
+    granule-first (parallel/mesh_axes.py :func:`pick_gang_devices` —
+    the spatial axes are ICI-ruled and must not silently stride DCN),
+    shaped by ``choose_mesh_for_grid`` (largest (mx, my) dividing the
+    grid), built through the hybrid mesh layer.  ``comm='fused'`` runs
+    the remote-DMA halo exchange (ops/pallas_halo.py) where
+    ``require_fused`` accepts the config and FALLS BACK to the
+    collective transport where it refuses (e.g. non-pallas methods) —
+    recorded honestly in the returned info dict, and numerics-neutral
+    either way (the fused path is pinned bitwise against the
+    collective oracle by the PR 6 suite).
+
+    ``solver_cache`` (a plain dict the caller owns) memoizes the
+    constructed solver — and through Solver2DDistributed's own
+    step/runner caches, its COMPILED programs — per full case
+    signature, so a fleet serving the same bucket repeatedly compiles
+    once.  The memo is a bounded LRU (``cache_cap``, default
+    :data:`GANG_SOLVER_CACHE_CAP` / ``NLHEAT_GANG_SOLVER_CAP``; 0 =
+    unbounded): every entry holds full-grid state plus compiled
+    programs, and a long-lived gang worker must not grow host memory
+    without bound under signature diversity.  Returns ``(values,
+    info)`` with ``values`` the final f64 state and ``info`` the
+    mesh/comm evidence."""
+    from nonlocalheatequation_tpu.parallel.distributed2d import (
+        Solver2DDistributed,
+        choose_mesh_for_grid,
+    )
+    from nonlocalheatequation_tpu.parallel.mesh_axes import (
+        mesh_axis_network,
+        pick_gang_devices,
+    )
+
+    shape = tuple(int(s) for s in case.shape)
+    if len(shape) != 2:
+        raise ValueError(
+            f"the sharded case class solves 2D grids (the reference's "
+            f"flagship distributed tier); got rank {len(shape)}")
+    if comm not in ("fused", "collective"):
+        raise ValueError(
+            f"comm must be 'fused' or 'collective', got {comm!r}")
+    NX, NY = shape
+    all_devs = jax.devices()
+    devs = (pick_gang_devices(min(int(ndevices), len(all_devs)), all_devs)
+            if ndevices else all_devs)
+    key = (shape, int(case.nt), int(case.eps), float(case.k),
+           float(case.dt), float(case.dh), bool(case.test),
+           comm, method, precision,
+           jnp.dtype(dtype).name if dtype is not None else None,
+           len(devs))
+    if cache_cap is None:
+        cache_cap = int(os.environ.get("NLHEAT_GANG_SOLVER_CAP")
+                        or GANG_SOLVER_CACHE_CAP)
+    if cache_cap < 0:
+        raise ValueError(f"cache_cap must be >= 0, got {cache_cap}")
+    entry = solver_cache.get(key) if solver_cache is not None else None
+    if entry is not None:
+        # LRU recency on hit (plain dicts are insertion-ordered)
+        solver_cache[key] = solver_cache.pop(key)
+    if entry is None:
+        mesh = choose_mesh_for_grid(NX, NY, devs)
+        mx, my = mesh.shape["x"], mesh.shape["y"]
+        kw = dict(nx=NX // mx, ny=NY // my, npx=mx, npy=my,
+                  nt=int(case.nt), eps=int(case.eps), k=float(case.k),
+                  dt=float(case.dt), dh=float(case.dh), mesh=mesh,
+                  method=method, precision=precision, dtype=dtype)
+        used = comm
+        try:
+            solver = Solver2DDistributed(comm=comm, **kw)
+        except ValueError:
+            if comm != "fused":
+                raise
+            # require_fused refused this config (honesty gate): the
+            # collective transport serves it with identical numerics
+            used = "collective"
+            solver = Solver2DDistributed(comm="collective", **kw)
+        entry = (solver, used)
+        if solver_cache is not None:
+            solver_cache[key] = entry
+            if cache_cap:  # 0 = unbounded (the 0-knob convention)
+                while len(solver_cache) > cache_cap:
+                    solver_cache.pop(next(iter(solver_cache)))
+    solver, used = entry
+    if case.test:
+        if case.u0 is not None:
+            raise ValueError(
+                "a sharded test case runs the manufactured profile; "
+                "custom u0 belongs to production (test=False) cases")
+        solver.test_init()
+    else:
+        if case.u0 is None:
+            raise ValueError(
+                "a production (test=False) sharded case needs an "
+                "initial state u0")
+        solver.input_init(case.u0)
+    values = np.asarray(solver.do_work(), np.float64)
+    info = {
+        "comm": used,
+        "mesh": [int(solver.mesh.shape["x"]), int(solver.mesh.shape["y"])],
+        "devices": len(devs),
+        "axes": mesh_axis_network(solver.mesh),
+    }
+    if case.test:
+        info["error_l2"] = float(solver.error_l2)
+    return values, info
 
 
 class GangExecutor:

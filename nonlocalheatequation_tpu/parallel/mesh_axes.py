@@ -124,3 +124,33 @@ def mesh_axis_network(mesh: Mesh, rules: dict | None = None) -> dict:
     """{axis: "ici" | "dcn"} for a built mesh — the docs/obs label of
     where each axis's collectives actually travel."""
     return {name: axis_rule(name, rules) for name in mesh.axis_names}
+
+
+def pick_gang_devices(n: int, devices=None) -> list:
+    """N devices for one gang/space-parallel worker, whole granules
+    first.
+
+    A gang replica's mesh carries the halo-crossing spatial axes
+    (ICI-ruled), so its device set should span as FEW granules
+    (slices/processes) as possible — taking ``devices[:n]`` from an
+    interleaved multi-granule list would silently spread a spatial
+    axis across DCN.  Devices are grouped by granule and granules are
+    consumed largest-first until n is reached; within a granule the
+    original device order is kept (the row-major reshape contract of
+    :func:`create_hybrid_mesh`)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(n)
+    if not 1 <= n <= len(devices):
+        raise ValueError(
+            f"pick_gang_devices needs 1 <= n <= {len(devices)}, got {n}")
+    groups: dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(device_granule(d), []).append(d)
+    picked: list = []
+    for _, members in sorted(groups.items(),
+                             key=lambda kv: (-len(kv[1]), kv[0])):
+        take = min(len(members), n - len(picked))
+        picked.extend(members[:take])
+        if len(picked) == n:
+            break
+    return picked

@@ -35,4 +35,16 @@ store dir = warm caches everywhere; busy-rate elastic add/drain; death
 door with admission control (429 + Retry-After before any queue can
 grow without bound) — the reference's many-locality/idle-rate-balancer
 tier lifted to whole serving replicas.
+
+``serve/transport.py`` is the wire under the router: the
+length-prefixed frame protocol factored into worker transports —
+stdin/stdout pipes (default, bit-identical to PR 10) or TCP sockets
+(workers started with ``--worker-connect host:port`` dial in behind a
+hello/token handshake), so one replica can be one remote host/chip.
+The router also owns the SECOND case class: 2D grids above its
+``shard_threshold`` dispatch to a gang replica that solves each as a
+space-parallel distributed run over an N-device mesh
+(parallel/gang.py ``solve_case_sharded``, ``comm='fused'`` where the
+kernel family serves it), streamed back over the same frames
+bit-identical to the offline ``Solver2DDistributed`` path.
 """

@@ -188,12 +188,15 @@ class EnsembleEngine:
     #: halo-exchange engines a sharded (distributed-case) bucket can ask
     #: for; part of the program key so two engines differing only in
     #: comm never share compiled programs (ops/pallas_halo.py).  HONESTY
-    #: NOTE: no current bucket builds a sharded program — every ensemble
-    #: case today is a single-device solve, so comm='fused' changes the
-    #: key (and is validated against the pallas-only rule) but not the
-    #: compiled programs; the knob exists so sharded case buckets, when
-    #: they land, bucket correctly from day one instead of silently
-    #: sharing programs across comm engines.
+    #: NOTE: no ENGINE bucket builds a sharded program — every ensemble
+    #: case this engine runs is a single-device solve, so comm='fused'
+    #: changes the key (and is validated against the pallas-only rule)
+    #: but not the compiled programs.  The sharded case class itself
+    #: lives one tier up since ISSUE 12: the replica router dispatches
+    #: grids above its ``shard_threshold`` to a GANG replica that runs
+    #: them as space-parallel distributed solves (serve/router.py +
+    #: parallel/gang.py ``solve_case_sharded``) — this knob keeps
+    #: engine bucketing correct for any future in-engine sharding.
     COMMS = ("collective", "fused")
 
     def __init__(self, method: str = "auto", precision: str = "f32",

@@ -325,10 +325,19 @@ class IngressServer:
                 params[k] = v
         if path == "/healthz":
             m = self.backend.metrics()
-            h._json(200, {"ok": m["replicas"] > 0,
-                          "replicas": m["replicas"],
-                          "outstanding": m["outstanding"],
-                          "deaths": m["deaths"]})
+            body = {"ok": m["replicas"] > 0,
+                    "replicas": m["replicas"],
+                    "outstanding": m["outstanding"],
+                    "deaths": m["deaths"]}
+            # fleet-shape evidence (ISSUE 12): which transport the
+            # workers speak and whether the sharded big-case tier is
+            # up (router-shaped stubs without the fields stay valid)
+            if m.get("transport") is not None:
+                body["transport"] = m["transport"]
+            if m.get("shard_threshold") is not None:
+                body["gang"] = len(m.get("gang") or [])
+                body["sharded_cases"] = m.get("sharded_cases", 0)
+            h._json(200, body)
             return
         if path.startswith("/metrics"):
             regs = [self.backend.registry]

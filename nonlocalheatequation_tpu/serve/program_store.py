@@ -68,7 +68,11 @@ fingerprint / topology headers are INTEGRITY checks, not authenticity
 process that warm-boots from it.  Point the store only at directories
 writable solely by principals you already trust to run code here (the
 replicas themselves); store dirs are created ``0700`` and must never
-be group/world-writable.
+be group/world-writable.  The same boundary applies ON THE WIRE: the
+router's worker frames are pickle too, so the socket transport binds
+loopback by default and refuses non-loopback listeners without a
+shared-secret token (serve/transport.py) — a fleet FS dir shared
+across hosts extends exactly this trust set, no further.
 """
 
 from __future__ import annotations

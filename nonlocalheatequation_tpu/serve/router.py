@@ -42,10 +42,30 @@ and routes submitted cases across them:
   draws from its plan at each case-forward event and SIGKILLs the
   worker a fired case was just routed to.
 
-Transport: length-prefixed pickle frames over the worker's stdin/stdout
-pipes (the worker steals fd 1 at startup so stray prints cannot corrupt
-the framing; its stderr is inherited).  The trust model is the program
-store's: the router and its workers are one principal on one host.
+Transport: length-prefixed pickle frames over a :mod:`serve.transport`
+worker transport — stdin/stdout pipes by default (the worker steals
+fd 1 at startup so stray prints cannot corrupt the framing; its stderr
+is inherited), or TCP sockets (``transport="tcp"``: workers started
+with ``--worker-connect host:port`` dial in and speak the identical
+frames, so one replica can be one remote host/chip).  The trust model
+is the program store's: the router and its workers are one principal —
+on one host over pipes/loopback, or across hosts behind the shared
+token the socket transport's hello verifies (serve/transport.py trust
+boundary).
+
+Case classes (ISSUE 12): cases at or below ``shard_threshold`` grid
+points batch onto single-chip ServePipeline replicas exactly as
+before; a 2D grid ABOVE it is dispatched to the **gang replica** — a
+worker that owns an N-device mesh and solves the case as ONE
+space-parallel distributed solve (``comm='fused'`` remote-DMA halos
+where the kernel family serves the config, the collective transport
+where ``require_fused`` refuses), streaming the result back over the
+same frame channel bit-identical to the offline
+:class:`~nonlocalheatequation_tpu.parallel.distributed2d.Solver2DDistributed`
+path (parallel/gang.py ``solve_case_sharded`` is the one adapter both
+sides call).  The router is thus the component that chooses between
+the case-parallel and space-parallel axes of the hybrid mesh layer
+(parallel/mesh_axes.py).
 
 Backpressure: the router's queues are BOUNDED — ``submit`` raises the
 typed :class:`RouterOverloaded` (with a retry-after estimate from the
@@ -68,9 +88,6 @@ import os
 import pickle
 import queue
 import select
-import signal
-import struct
-import subprocess
 import sys
 import threading
 import time
@@ -96,11 +113,16 @@ from nonlocalheatequation_tpu.parallel.elastic import (
 )
 from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
 from nonlocalheatequation_tpu.serve.resilience import ServeError
+from nonlocalheatequation_tpu.serve.transport import (
+    LEN as _LEN,
+    MAX_FRAME_BYTES,
+    WORKER_TOKEN_ENV,
+    make_transport,
+    read_frame as _read_frame,
+    write_frame as _write_frame,
+    write_json_frame,
+)
 from nonlocalheatequation_tpu.utils.faults import FaultPlan
-
-#: Frame header: little-endian payload length (matches the checkpoint
-#: and program-store on-disk length fields).
-_LEN = struct.Struct("<Q")
 
 #: Default per-replica in-flight bound (cases routed but not yet
 #: delivered).  The router's queues must stay bounded no matter how fast
@@ -114,24 +136,6 @@ MAX_OUTSTANDING = 64
 #: on one poison request (the router-level twin of the pipeline's
 #: retry-then-quarantine budget).
 MAX_REQUEUES = 3
-
-
-def _write_frame(stream, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    stream.write(_LEN.pack(len(payload)))
-    stream.write(payload)
-    stream.flush()
-
-
-def _read_frame(stream):
-    head = stream.read(_LEN.size)
-    if len(head) < _LEN.size:
-        return None
-    n = _LEN.unpack(head)[0]
-    payload = stream.read(n)
-    if len(payload) < n:
-        return None
-    return pickle.loads(payload)
 
 
 class RouterOverloaded(RuntimeError):
@@ -188,9 +192,10 @@ class _Replica:
     form).  The router-side queue is part of the case's in-flight
     accounting, so the bound still holds end to end."""
 
-    def __init__(self, rid: int, proc: subprocess.Popen):
+    def __init__(self, rid: int, handle, gang: bool = False):
         self.rid = rid
-        self.proc = proc
+        self.handle = handle  # transport WorkerHandle (pipes or socket)
+        self.gang = gang  # the sharded-case worker (N-device mesh)
         self.sendq: "queue.Queue" = queue.Queue()
         self.ready = threading.Event()
         self.alive = True
@@ -226,13 +231,10 @@ class _Replica:
             if obj is None:
                 return
             if isinstance(obj, dict) and obj.get("op") == "__kill__":
-                try:
-                    self.proc.send_signal(signal.SIGKILL)
-                except OSError:
-                    pass
+                self.handle.kill()
                 continue
             try:
-                _write_frame(self.proc.stdin, obj)
+                self.handle.send_frame(obj)
             except (OSError, ValueError):
                 return
 
@@ -263,6 +265,11 @@ class ReplicaRouter:
                  faults: FaultPlan | str | None = None,
                  serve_kwargs: dict | None = None,
                  child_env: dict | None = None,
+                 transport: str | object = "pipe",
+                 worker_token: str | None = None,
+                 shard_threshold: int | None = None,
+                 gang_devices: int | None = None,
+                 gang_comm: str = "fused",
                  cpus_per_replica: int | None = None,
                  registry: MetricsRegistry | None = None,
                  spawn_timeout_s: float = 180.0,
@@ -279,6 +286,33 @@ class ReplicaRouter:
                 f"max_outstanding must be >= 1, got {max_outstanding}")
         if isinstance(faults, str):
             faults = FaultPlan.parse(faults)
+        # the sharded big-case tier (ISSUE 12): grids above the
+        # threshold (in grid POINTS) go to the gang replica.  0 turns
+        # the tier off per the repo's 0-knob convention.
+        if shard_threshold is not None:
+            shard_threshold = int(shard_threshold)
+            if shard_threshold < 0:
+                raise ValueError(
+                    f"shard_threshold must be >= 0 (0/None = off), got "
+                    f"{shard_threshold}")
+            if shard_threshold == 0:
+                shard_threshold = None
+        self.shard_threshold = shard_threshold
+        if gang_comm not in ("fused", "collective"):
+            raise ValueError(
+                f"gang_comm must be 'fused' or 'collective', got "
+                f"{gang_comm!r}")
+        self.gang_comm = gang_comm
+        if gang_devices is not None and int(gang_devices) < 1:
+            raise ValueError(
+                f"gang_devices must be >= 1, got {gang_devices}")
+        # None = the gang worker uses every device IT sees (the router
+        # never touches a backend — wedge discipline)
+        self.gang_devices = (int(gang_devices) if gang_devices is not None
+                             else None)
+        self._transport_arg = transport
+        self._worker_token = worker_token
+        self._transport = None  # constructed just before the spawns
         self.min_replicas = int(min_replicas if min_replicas is not None
                                 else replicas)
         self.max_replicas = int(max_replicas if max_replicas is not None
@@ -354,6 +388,7 @@ class ReplicaRouter:
         r = self.registry
         self._m_cases = r.counter("/router/cases")
         self._m_routed = r.counter("/router/routed")  # forwards, requeues incl
+        self._m_sharded = r.counter("/router/sharded-cases")
         self._m_requeued = r.counter("/router/requeued")
         self._m_deaths = r.counter("/router/deaths")
         self._m_spawns = r.counter("/router/spawns")
@@ -384,14 +419,19 @@ class ReplicaRouter:
             self._flightrec.bind(registry=self.registry,
                                  inflight=self._inflight_ledger)
         try:
+            # transport construction may bind a listener: inside the
+            # cleanup scope so a failed fleet boot cannot leak the port
+            self._transport = make_transport(transport, token=worker_token)
             for _ in range(replicas):
                 self._spawn()
+            if self.shard_threshold is not None:
+                self._spawn(gang=True)
         except BaseException:
             self.close()
             raise
 
     # -- worker lifecycle ---------------------------------------------------
-    def _spawn(self) -> int:
+    def _spawn(self, gang: bool = False) -> int:
         rid = self._next_rid
         self._next_rid += 1
         env = dict(os.environ)
@@ -400,13 +440,14 @@ class ReplicaRouter:
         # entries would double-inject) — worker-internal chaos goes
         # through serve_kwargs["faults"] deliberately
         env.pop("NLHEAT_FAULT_PLAN", None)
+        # a leaked token must not outlive its transport: only the
+        # socket transport re-injects it for its own children
+        env.pop(WORKER_TOKEN_ENV, None)
         env[REPLICA_ID_ENV] = str(rid)
         env.update(self.child_env)
-        proc = subprocess.Popen(
-            [sys.executable, "-m",
-             "nonlocalheatequation_tpu.serve.router"],
-            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
-        rep = _Replica(rid, proc)
+        handle = self._transport.spawn(rid, env,
+                                       timeout_s=self.spawn_timeout_s)
+        rep = _Replica(rid, handle, gang=gang)
         affinity = None
         if self.cpus_per_replica and self._host_cpus:
             k, cpus = self.cpus_per_replica, self._host_cpus
@@ -425,7 +466,13 @@ class ReplicaRouter:
             "cpu_affinity": affinity,
             "trace_dir": self.trace_dir,
             "flight_dir": self.flight_dir,
+            "transport": self._transport.name,
         }
+        if gang:
+            # the sharded-case worker: one N-device mesh, distributed
+            # solves, comm='fused' where the kernel family serves it
+            cfg["gang"] = {"devices": self.gang_devices,
+                           "comm": self.gang_comm}
         with self._lock:
             self._replicas[rid] = rep
             self._m_replicas.set(self.live_count())
@@ -437,7 +484,7 @@ class ReplicaRouter:
                          name=f"nlheat-router-reader-{rid}").start()
         if not rep.ready.wait(self.spawn_timeout_s):
             rep.closing = True
-            proc.kill()
+            handle.kill()
             raise RuntimeError(
                 f"replica {rid} did not become ready within "
                 f"{self.spawn_timeout_s:.0f}s")
@@ -446,11 +493,14 @@ class ReplicaRouter:
     def _reader(self, rep: _Replica) -> None:
         """Per-worker reader thread: parse response frames until EOF,
         then treat the EOF as a death (unless the router stopped the
-        worker itself)."""
-        stream = rep.proc.stdout
+        worker itself).  ``recv_frame`` returns None for EOF AND for
+        any malformed/oversized/truncated length prefix or mid-frame
+        disconnect (serve/transport.py) — a socket peer writing garbage
+        classifies as replica death, never a router crash or a reader
+        thread parked on a half-frame."""
         while True:
             try:
-                msg = _read_frame(stream)
+                msg = rep.handle.recv_frame()
             except Exception:  # noqa: BLE001 — torn frame == dead worker
                 msg = None
             if msg is None:
@@ -516,16 +566,9 @@ class ReplicaRouter:
             rep.alive = False
             self._m_replicas.set(self.live_count())
         rep.sendq.put(None)  # release the writer thread
-        try:
-            rep.proc.wait(timeout=10)  # EOF means exit is imminent;
-        except subprocess.TimeoutExpired:  # reap the zombie either way
-            rep.proc.kill()
-            rep.proc.wait(timeout=10)
-        for pipe_ in (rep.proc.stdin, rep.proc.stdout):
-            try:
-                pipe_.close()
-            except OSError:
-                pass
+        # EOF means exit is imminent; reap the zombie (and close every
+        # pipe/socket stream) either way — no fd leaks under chaos
+        rep.handle.reap(timeout_s=10)
         with self._lock:
             if rep.closing or self._closed:
                 self._replicas.pop(rep.rid, None)
@@ -555,7 +598,18 @@ class ReplicaRouter:
             waiter = rep.stats_waiters.pop(token, None)
             if waiter is not None:
                 waiter[0].set()
-        if self.respawn and self.live_count() < self.min_replicas:
+        if rep.gang:
+            # the gang replica is the ONLY worker that can serve the
+            # sharded case class: respawn it regardless of the small-
+            # fleet floor, or its orphans re-route into a refusal
+            if self.respawn and self.shard_threshold is not None:
+                try:
+                    self._spawn(gang=True)
+                except Exception as e:  # noqa: BLE001
+                    print(f"router: gang respawn after replica "
+                          f"{rep.rid} death failed ({e})",
+                          file=sys.stderr)
+        elif self.respawn and self.live_count() < self.min_replicas:
             try:
                 self._spawn()
             except Exception as e:  # noqa: BLE001 — survivors still serve
@@ -616,7 +670,36 @@ class ReplicaRouter:
 
     # -- routing ------------------------------------------------------------
     def live_count(self) -> int:
-        return sum(1 for r in self._replicas.values() if r.alive)
+        """Live SMALL-CASE replicas — the fleet the sticky buckets,
+        elastic policy, and min/max floors govern.  The gang replica is
+        a different case class and is counted by :meth:`gang_live`."""
+        return sum(1 for r in self._replicas.values()
+                   if r.alive and not r.gang)
+
+    def gang_live(self) -> int:
+        return sum(1 for r in self._replicas.values()
+                   if r.alive and r.gang)
+
+    def _is_sharded(self, case) -> bool:
+        """Does this case belong to the sharded big-case class?  2D
+        grids above ``shard_threshold`` POINTS; other ranks keep the
+        single-chip path (the distributed gang solver is the 2D
+        flagship — the reference's own top tier)."""
+        if self.shard_threshold is None:
+            return False
+        try:
+            shape = tuple(int(s) for s in case.shape)
+        except (TypeError, ValueError):
+            return False
+        return (len(shape) == 2
+                and int(np.prod(shape)) > self.shard_threshold)
+
+    def _gang_rep(self) -> _Replica:
+        for r in self._replicas.values():
+            if r.gang and r.alive:
+                return r
+        raise RuntimeError(
+            "router has no live gang replica for a sharded case")
 
     def outstanding_total(self) -> int:
         return len(self._pending)
@@ -630,9 +713,11 @@ class ReplicaRouter:
 
     def _pick_replica(self) -> _Replica:
         live = [r for r in self._replicas.values()
-                if r.alive and r.ready.is_set() and not r.draining]
+                if r.alive and r.ready.is_set() and not r.draining
+                and not r.gang]
         if not live:
-            live = [r for r in self._replicas.values() if r.alive]
+            live = [r for r in self._replicas.values()
+                    if r.alive and not r.gang]
         if not live:
             raise RuntimeError("router has no live replicas")
         return min(live, key=lambda r: (len(r.buckets),
@@ -679,19 +764,28 @@ class ReplicaRouter:
 
     def _route(self, req: RouterRequest, force: bool = False) -> None:
         with self._lock:
-            cap = self.max_outstanding * max(1, self.live_count())
+            cap = self.max_outstanding * max(
+                1, self.live_count() + self.gang_live())
             outstanding = self.outstanding_total()
             if outstanding >= cap and not force:
                 raise RouterOverloaded(outstanding, cap,
                                        self.retry_after_s())
-            key = req.case.bucket_key()
-            rid = self._owner.get(key)
-            rep = self._replicas.get(rid) if rid is not None else None
-            if rep is None or not rep.alive or rep.draining:
-                rep = self._pick_replica()
-                self._owner[key] = rep.rid
-                rep.buckets.add(key)
-                self._m_buckets.set(len(self._owner))
+            if self._is_sharded(req.case):
+                # the sharded case class: one space-parallel solve on
+                # the gang replica's mesh — no sticky bucket (the gang
+                # is a singleton; its solver cache is keyed worker-side)
+                rep = self._gang_rep()
+                if req.requeues == 0:
+                    self._m_sharded.inc()
+            else:
+                key = req.case.bucket_key()
+                rid = self._owner.get(key)
+                rep = self._replicas.get(rid) if rid is not None else None
+                if rep is None or not rep.alive or rep.draining:
+                    rep = self._pick_replica()
+                    self._owner[key] = rep.rid
+                    rep.buckets.add(key)
+                    self._m_buckets.set(len(self._owner))
             req.replica = rep.rid
             rep.outstanding[req.seq] = req
             self._m_outstanding.set(self.outstanding_total())
@@ -775,7 +869,7 @@ class ReplicaRouter:
             rep = self._replicas[rid]
             donors = sorted(
                 (r for r in self._replicas.values()
-                 if r.alive and r.rid != rid),
+                 if r.alive and r.rid != rid and not r.gang),
                 key=lambda r: -len(r.buckets))
             want = len(self._owner) // max(1, self.live_count())
             for donor in donors:
@@ -794,6 +888,11 @@ class ReplicaRouter:
             rep = self._replicas.get(rid)
             if rep is None or not rep.alive:
                 return
+            if rep.gang:
+                raise ValueError(
+                    "cannot drain the gang replica: it is the only "
+                    "worker serving the sharded case class (set "
+                    "shard_threshold=None to retire the tier)")
             if self.live_count() <= 1:
                 raise ValueError(
                     "cannot drain the last live replica; add one first")
@@ -815,10 +914,7 @@ class ReplicaRouter:
         rep.closing = True
         rep.send({"op": "stop"})
         rep.sendq.put(None)  # writer exits after flushing the stop
-        try:
-            rep.proc.wait(timeout=30)
-        except subprocess.TimeoutExpired:
-            rep.proc.kill()
+        rep.handle.reap(timeout_s=30)
         self._telemetry.forget(rid)
         with self._lock:
             self._m_replicas.set(self.live_count())
@@ -860,11 +956,16 @@ class ReplicaRouter:
         out = {}
         for rep, stats in self._pull("stats", timeout_s).items():
             out[rep.rid] = stats
-            self._telemetry.record_window(
-                rep.rid, stats.get("busy_s", 0.0), stats.get("span_s", 0.0))
-            self.registry.gauge(
-                f"/replica{{{rep.rid}}}/busy-rate").set(
-                round(self._telemetry.rate(rep.rid), 3))
+            if not rep.gang:
+                # the gang replica serves a different case class: its
+                # busy window must not veto (min-aggregation) or force
+                # small-fleet scale decisions
+                self._telemetry.record_window(
+                    rep.rid, stats.get("busy_s", 0.0),
+                    stats.get("span_s", 0.0))
+                self.registry.gauge(
+                    f"/replica{{{rep.rid}}}/busy-rate").set(
+                    round(self._telemetry.rate(rep.rid), 3))
             snap = stats.get("snapshot")
             if snap:
                 absorb_snapshot(self.registry, f"/replica{{{rep.rid}}}",
@@ -919,7 +1020,8 @@ class ReplicaRouter:
             self.add_replica()
         elif decision == "drain":
             with self._lock:
-                live = [r for r in self._replicas.values() if r.alive]
+                live = [r for r in self._replicas.values()
+                        if r.alive and not r.gang]
                 # drain the emptiest worker (fewest buckets, then fewest
                 # in-flight) — the cheapest ownership reassignment
                 victim = min(live, key=lambda r: (len(r.buckets),
@@ -974,15 +1076,22 @@ class ReplicaRouter:
 
     def metrics(self) -> dict:
         with self._lock:
-            live = [r.rid for r in self._replicas.values() if r.alive]
+            live = [r.rid for r in self._replicas.values()
+                    if r.alive and not r.gang]
+            gang = [r.rid for r in self._replicas.values()
+                    if r.alive and r.gang]
             per_replica = {
                 r.rid: {"outstanding": len(r.outstanding),
                         "buckets": len(r.buckets), "alive": r.alive,
-                        "draining": r.draining}
+                        "draining": r.draining, "gang": r.gang}
                 for r in self._replicas.values()}
         return {
             "replicas": len(live),
             "live": live,
+            "gang": gang,
+            "transport": self._transport.name if self._transport else None,
+            "shard_threshold": self.shard_threshold,
+            "sharded_cases": self._m_sharded.value,
             "cases": self._m_cases.value,
             "routed": self._m_routed.value,
             "requeued": self._m_requeued.value,
@@ -1011,15 +1120,10 @@ class ReplicaRouter:
                 rep.send({"op": "stop"})
             rep.sendq.put(None)  # writer exits after flushing the stop
         for rep in reps:
-            try:
-                rep.proc.wait(timeout=30)
-            except subprocess.TimeoutExpired:
-                rep.proc.kill()
-            try:
-                rep.proc.stdin.close()
-            except OSError:
-                pass
+            rep.handle.reap(timeout_s=30)
             rep.outstanding.clear()
+        if self._transport is not None:
+            self._transport.close()
         # the delivery ledger: anything still undelivered completes
         # exceptionally — a closed router must never leave a waiter
         # blocked (orphans mid-re-route included)
@@ -1217,28 +1321,362 @@ def router_traced_ab(engine_kwargs: dict, cases, replicas: int,
     }
 
 
+def fleet_tcp_ab(engine_kwargs: dict, cases, replicas: int,
+                 store_dir: str | None, *, shard_cases=(),
+                 shard_threshold: int | None = None,
+                 gang_devices: int | None = None,
+                 window_ms: float = 2.0, overload_factor: float = 2.0,
+                 overload_pending: int | None = None,
+                 cpus_per_replica: int | None = None,
+                 child_env: dict | None = None) -> dict:
+    """The fleet-transport measurement shared by bench.py
+    (``BENCH_FLEET_TCP``) and tools/bench_table.py (``fleettcp``
+    group) — ISSUE 12's two acceptance halves in one harness:
+
+    1. **pipe vs loopback-TCP A/B**: the SAME case set served by an
+       N-replica router over in-process pipes and again over the
+       socket transport, both arms warm-booting from ONE shared AOT
+       store dir (the pipe arm populates it).  ``tcp_overhead`` is the
+       steady-pass wall ratio — the per-frame cost of the socket hop,
+       with results pinned bit-identical across transports.
+    2. **mixed small+sharded offered-load sweep**: a TCP fleet with
+       the gang tier enabled serves an interleaved stream of small
+       cases (sticky-bucket replicas) and sharded big cases (the gang
+       replica's N-device mesh), paced at ``overload_factor`` x the
+       measured capacity and then as one burst through the admission
+       gate — queues must stay bounded (shed, not grow), sharded
+       results must come back bit-identical to the offline
+       ``solve_case_sharded`` oracle, and small cases must keep their
+       fleet speedup.
+
+    Returns walls, ``tcp_overhead``, both arms' results, the sharded
+    oracle comparison, and the sweep accounting."""
+    from nonlocalheatequation_tpu.parallel.gang import solve_case_sharded
+    from nonlocalheatequation_tpu.serve.http import (
+        AdmissionController,
+        offered_load_run,
+    )
+
+    cases = list(cases)
+    shard_cases = list(shard_cases)
+    if cpus_per_replica is None:
+        # the same CPU proxy as router_load_ab: every worker in both
+        # arms gets one fixed core budget so the transport ratio
+        # measures framing+wire cost, not thread-placement luck
+        try:
+            cpus_per_replica = max(
+                1, len(os.sched_getaffinity(0)) // max(2, replicas))
+        except AttributeError:
+            cpus_per_replica = None
+    if len({c.bucket_key() for c in cases}) < replicas:
+        raise ValueError(
+            f"fleet A/B needs >= {replicas} distinct buckets (got "
+            f"{len({c.bucket_key() for c in cases})}): sticky routing "
+            "cannot spread one bucket over the fleet")
+    walls: dict[str, float] = {}
+    results: dict[str, list] = {}
+    # pipe vs tcp at fleet size, plus a 1-replica TCP arm so the fleet
+    # speedup over sockets is MEASURED (the PR 10 acceptance bar must
+    # survive the transport change, not be assumed from the pipe A/B);
+    # every arm's workers get the same per-replica core budget
+    arms = [("pipe", replicas), ("tcp", replicas)]
+    if replicas != 1:
+        arms.append(("tcp1", 1))
+    for arm, n in arms:
+        with ReplicaRouter(replicas=n,
+                           transport="pipe" if arm == "pipe" else "tcp",
+                           program_store=store_dir, window_ms=window_ms,
+                           child_env=child_env,
+                           cpus_per_replica=cpus_per_replica,
+                           **engine_kwargs) as router:
+            # pass 1 warms (and, arm pipe, populates the shared store);
+            # pass 2 is the steady wall the overhead ratio reads
+            results[arm] = router.serve_cases(cases)
+            t0 = time.perf_counter()
+            router.serve_cases(cases)
+            walls[arm] = time.perf_counter() - t0
+    out = {
+        "walls": walls,
+        "tcp_overhead": walls["tcp"] / walls["pipe"],
+        "fleet_speedup": walls.get("tcp1", walls["tcp"]) / walls["tcp"],
+        "capacity_hz": len(cases) / walls["tcp"],
+        "results": results,
+    }
+    if shard_cases and shard_threshold is None:
+        # everything offered as "small" stays small; everything in
+        # shard_cases lands above the line
+        shard_threshold = max(int(np.prod(c.shape)) for c in cases)
+    # the offline sharded oracle: THIS process, same devices/env the
+    # gang worker inherits — the bit-identity half of the case class
+    ocache: dict = {}
+    oracle = [solve_case_sharded(
+        c, ndevices=gang_devices, comm="fused",
+        method=engine_kwargs.get("method", "auto"),
+        precision=engine_kwargs.get("precision", "f32"),
+        dtype=engine_kwargs.get("dtype"), solver_cache=ocache)
+        for c in shard_cases]
+    # interleave sharded cases through the small stream so both case
+    # classes are concurrently in flight (the composition under test);
+    # with no shard cases the sweep still runs — transport-only mode
+    mixed: list = []
+    stride = max(1, len(cases) // max(1, len(shard_cases) or 1))
+    si = iter(shard_cases)
+    for i, c in enumerate(cases):
+        mixed.append(c)
+        if i % stride == stride - 1:
+            mixed.extend([s for s in [next(si, None)] if s is not None])
+    mixed.extend(si)
+    sweep: dict[str, dict] = {}
+    with ReplicaRouter(replicas=replicas, transport="tcp",
+                       shard_threshold=(shard_threshold if shard_cases
+                                        else None),
+                       gang_devices=gang_devices,
+                       program_store=store_dir, window_ms=window_ms,
+                       child_env=child_env,
+                       cpus_per_replica=cpus_per_replica,
+                       **engine_kwargs) as router:
+        got = router.serve_cases(mixed)  # warm pass + identity capture
+        by_case = {id(c): v for c, v in zip(mixed, got)}
+        small_ok = all(
+            by_case[id(c)] is not None
+            and np.array_equal(by_case[id(c)], w)
+            for c, w in zip(cases, results["tcp"]))
+        shard_ok = all(
+            by_case[id(c)] is not None
+            and np.array_equal(by_case[id(c)], w)
+            for c, (w, _info) in zip(shard_cases, oracle))
+        if not (small_ok and shard_ok):
+            # name the failing HALF: a bare false bit-identity flag is
+            # undiagnosable from the one-line JSON
+            def _why(v, w):
+                if v is None:
+                    return "no result"
+                return f"max diff {float(np.abs(v - w).max())!r}"
+
+            for i, (c, w) in enumerate(zip(cases, results["tcp"])):
+                v = by_case[id(c)]
+                if v is None or not np.array_equal(v, w):
+                    print(f"fleet_tcp_ab: mixed small case {i} deviates "
+                          f"from the tcp arm ({_why(v, w)})",
+                          file=sys.stderr)
+            for i, (c, (w, _)) in enumerate(zip(shard_cases, oracle)):
+                v = by_case[id(c)]
+                if v is None or not np.array_equal(v, w):
+                    print(f"fleet_tcp_ab: sharded case {i} deviates "
+                          f"from the offline oracle ({_why(v, w)})",
+                          file=sys.stderr)
+        adm = AdmissionController(
+            router,
+            max_pending=(overload_pending if overload_pending is not None
+                         else max(2, 2 * replicas)))
+        rate = overload_factor * out["capacity_hz"]
+        for label, r in ((f"x{overload_factor:g}", rate), ("burst", 0.0)):
+            run = offered_load_run(adm, mixed + mixed, r)
+            run.pop("results", None)
+            run["rate_hz"] = round(r, 3)
+            sweep[label] = run
+        out["sharded_cases"] = router.metrics()["sharded_cases"]
+    out["sharded"] = ({
+        "cases": len(shard_cases),
+        "threshold": shard_threshold,
+        "info": oracle[0][1],
+        "bit_identical": shard_ok,
+    } if shard_cases else None)
+    out["mixed_bit_identical"] = small_ok and shard_ok
+    out["sweep"] = sweep
+    return out
+
+
 # -- the worker process -------------------------------------------------------
 
 
-def _worker_main() -> None:
-    """The replica worker: one ServePipeline fed by framed stdin.
+def _gang_loop(cfg: dict, out, poll, eof, tracer, trace_dir,
+               ready_frame) -> None:
+    """The sharded-case worker loop: each ``case`` frame is ONE whole
+    space-parallel distributed solve over this worker's N-device mesh
+    (parallel/gang.py ``solve_case_sharded`` — the same adapter the
+    offline oracle calls, which is what makes the streamed-back result
+    bit-identical to the offline ``Solver2DDistributed`` run).  Solves
+    are synchronous — a gang replica is one case at a time by design
+    (the mesh IS the parallelism) — so the frame channel drains between
+    cases; ``stats``/``trace``/``stop`` frames queued behind a solve
+    answer when it retires, inside the router's pull timeouts.  Busy
+    accounting (wall time inside solves per stats window) feeds the
+    fleet scrape exactly like the pipeline workers', but the router
+    keeps gang windows OUT of the small-fleet scale policy."""
+    from nonlocalheatequation_tpu.obs.metrics import REGISTRY
+    from nonlocalheatequation_tpu.parallel.gang import solve_case_sharded
 
-    Startup steals fd 1 (stray prints from any library go to stderr;
-    the frame channel is the ORIGINAL stdout, held privately), applies
-    the router's platform/x64 config before any backend touch, points
-    ``NLHEAT_PROGRAM_STORE`` at the shared store, then loops: poll
-    stdin, submit arriving cases, pump the pipeline, and — whenever the
-    intake is momentarily idle with work outstanding — drain, so
+    gang = cfg.get("gang") or {}
+    rid = cfg.get("replica_id")
+    ek = cfg.get("engine_kwargs") or {}
+    # solves run on a dedicated thread so the frame loop stays LIVE
+    # mid-solve: a minutes-long sharded case must not leave the
+    # router's stats/trace pulls stalling to their timeouts (the fleet
+    # scrape would silently lose the gang on exactly the long cases
+    # this tier exists for).  Writes to the frame channel are
+    # serialized — two threads interleaving a frame would tear the
+    # protocol.
+    wlock = threading.Lock()
+
+    def send(frame) -> None:
+        with wlock:
+            _write_frame(out, frame)
+
+    slock = threading.Lock()  # covers the shared solve accounting
+    state = {"served": 0, "busy_s": 0.0, "comm": {}, "solvers": 0,
+             "active_t0": None}
+    caseq: "queue.Queue" = queue.Queue()
+    solver_cache: dict = {}
+
+    def solve_loop() -> None:
+        while True:
+            msg = caseq.get()
+            if msg is None:
+                return
+            t0 = time.monotonic()
+            with slock:
+                state["active_t0"] = t0
+            ctx = TraceContext.from_wire(msg.get("trace"))
+            prev = obs_trace.set_context(ctx)
+            try:
+                with obs_trace.span("gang.solve", cat="gang",
+                                    case=msg.get("id")):
+                    values, info = solve_case_sharded(
+                        msg["case"],
+                        ndevices=gang.get("devices"),
+                        comm=gang.get("comm", "fused"),
+                        method=ek.get("method", "auto"),
+                        precision=ek.get("precision", "f32"),
+                        dtype=ek.get("dtype"),
+                        solver_cache=solver_cache)
+                with slock:
+                    state["served"] += 1
+                    state["comm"][info["comm"]] = \
+                        state["comm"].get(info["comm"], 0) + 1
+                    state["solvers"] = len(solver_cache)
+                send({"op": "result", "id": msg["id"],
+                      "values": values, "sharded": info})
+            except Exception as e:  # noqa: BLE001 — an unservable
+                # sharded case completes EXCEPTIONALLY, never kills
+                # the gang worker (the fleet's only big-case server)
+                try:
+                    send({"op": "error", "id": msg["id"],
+                          "classification": "error", "chunk": -1,
+                          "attempts": 0,
+                          "detail": f"sharded solve refused: "
+                                    f"{type(e).__name__}: {e}"})
+                except (OSError, ValueError):
+                    return  # channel gone: the router owns recovery
+            finally:
+                obs_trace.set_context(prev)
+                with slock:
+                    state["busy_s"] += time.monotonic() - t0
+                    state["active_t0"] = None
+
+    solver = threading.Thread(target=solve_loop, daemon=True,
+                              name="nlheat-gang-solver")
+    solver.start()
+    window_t0 = time.monotonic()
+    send(ready_frame())
+    stopping = False
+    while not stopping:
+        for msg in poll(0.05):
+            op = msg.get("op")
+            if op == "case":
+                caseq.put(msg)  # one solve at a time, frame loop live
+            elif op == "stats":
+                now = time.monotonic()
+                with slock:
+                    busy_s = state["busy_s"]
+                    state["busy_s"] = 0.0
+                    if state["active_t0"] is not None:
+                        # credit the IN-FLIGHT solve's window share: a
+                        # gang mid-long-case must read busy, not idle
+                        # (a boundary-spanning solve can double-count
+                        # its pre-window slice; the telemetry clamps
+                        # busy/span at 1 and the gang is excluded from
+                        # the scale policy — observability-grade)
+                        busy_s += now - max(window_t0,
+                                            state["active_t0"])
+                    metrics = {"cases": state["served"], "gang": True,
+                               "devices": gang.get("devices"),
+                               "comm": dict(state["comm"]),
+                               "solvers": state["solvers"]}
+                send({
+                    "op": "stats", "id": msg.get("id"), "replica": rid,
+                    "pid": os.getpid(), "gang": True,
+                    "metrics": metrics,
+                    # the gang's halo traffic lands in the process
+                    # registry (/halo/bytes, /halo/exchanges) — absorbed
+                    # under /replica{r} like the pipeline workers'
+                    "snapshot": REGISTRY.snapshot(),
+                    "busy_s": busy_s, "span_s": now - window_t0,
+                })
+                window_t0 = now
+            elif op == "trace":
+                send({
+                    "op": "trace", "id": msg.get("id"), "replica": rid,
+                    "doc": (tracer.chrome_trace() if tracer is not None
+                            else None)})
+            elif op == "stop":
+                stopping = True
+        if eof():
+            stopping = True
+    # drain: finish (and deliver) every accepted case before the bye —
+    # the gang twin of the pipe worker's pipe.drain() at stop
+    caseq.put(None)
+    solver.join()
+    if tracer is not None and trace_dir:
+        tracer.write(os.path.join(trace_dir,
+                                  f"host_trace.replica{rid}.json"))
+    try:
+        send({"op": "bye"})
+    except OSError:
+        pass
+
+
+def _worker_main(connect: str | None = None) -> None:
+    """The replica worker: one ServePipeline fed by framed stdin — or,
+    with ``connect="host:port"`` (the ``--worker-connect`` CLI form), by
+    a TCP socket it DIALS into the router's transport listener, sending
+    a JSON hello (replica id + ``NLHEAT_WORKER_TOKEN``) before the
+    first pickle frame (serve/transport.py trust boundary).
+
+    Pipe mode steals fd 1 (stray prints from any library go to stderr;
+    the frame channel is the ORIGINAL stdout, held privately); socket
+    mode leaves stdio alone — the frame channel is the socket and
+    prints cannot tear it.  Either way the worker applies the router's
+    platform/x64 config before any backend touch, points
+    ``NLHEAT_PROGRAM_STORE`` at the shared store, then loops: poll the
+    frame fd, submit arriving cases, pump the pipeline, and — whenever
+    the intake is momentarily idle with work outstanding — drain, so
     results flow without the caller-driven fences the in-process
     pipeline relies on.  The loop accounts its busy wall (time inside
     pump/drain with work outstanding) per stats window; the router
-    turns that into the fleet's busy rates."""
-    out = os.fdopen(os.dup(1), "wb")
-    os.dup2(2, 1)
-    # all stdin reads go through ONE raw-fd buffer: a BufferedReader's
-    # read-ahead on the config frame could swallow the front of the next
-    # frame and tear the protocol
-    fd = sys.stdin.fileno()
+    turns that into the fleet's busy rates.  A ``gang`` config block
+    switches the worker to the sharded-case loop instead
+    (:func:`_gang_loop`)."""
+    sock = None
+    if connect is None:
+        out = os.fdopen(os.dup(1), "wb")
+        os.dup2(2, 1)
+        fd = sys.stdin.fileno()
+    else:
+        import socket as _socket
+
+        host, _, port = connect.rpartition(":")
+        sock = _socket.create_connection((host or "127.0.0.1", int(port)))
+        out = sock.makefile("wb")
+        fd = sock.fileno()
+        rid_env = os.environ.get(REPLICA_ID_ENV)
+        write_json_frame(out, {
+            "op": "hello",
+            "replica": int(rid_env) if rid_env else None,
+            "token": os.environ.get(WORKER_TOKEN_ENV)})
+    # all frame-channel reads go through ONE raw-fd buffer: a
+    # BufferedReader's read-ahead on the config frame could swallow the
+    # front of the next frame and tear the protocol
     buf = bytearray()
     eof = False
 
@@ -1247,6 +1685,9 @@ def _worker_main() -> None:
         while True:
             while len(buf) >= _LEN.size:
                 n = _LEN.unpack(bytes(buf[:_LEN.size]))[0]
+                if n > MAX_FRAME_BYTES:
+                    eof = True  # a lying prefix: die cleanly, never
+                    return None  # allocate the lie
                 if len(buf) < _LEN.size + n:
                     break
                 payload = bytes(buf[_LEN.size:_LEN.size + n])
@@ -1299,26 +1740,6 @@ def _worker_main() -> None:
         rec = flightrec.FlightRecorder(flight_dir, replica=rid)
         flightrec.set_recorder(rec)
         flightrec.install_sigterm(rec)
-    from nonlocalheatequation_tpu.serve.server import ServePipeline
-
-    pipe = ServePipeline(depth=cfg.get("depth", 1),
-                         window_ms=cfg.get("window_ms", 2.0),
-                         window_size=cfg.get("window_size"),
-                         **cfg.get("serve_kwargs") or {},
-                         **cfg.get("engine_kwargs") or {})
-    _write_frame(out, {"op": "ready", "replica": rid,
-                       # the clock-offset handshake: this worker's
-                       # (monotonic, wall) pair, matching its tracer's
-                       # span timestamps — the router merges on it
-                       "clock_sync": (tracer.clock_sync if tracer
-                                      is not None else
-                                      {"monotonic": time.monotonic(),
-                                       "wall": time.time()})})
-
-    outstanding: dict[int, object] = {}
-    busy_s = 0.0
-    window_t0 = time.monotonic()
-
     def poll(timeout: float) -> list:
         """Read every frame currently available (waiting up to
         ``timeout`` for the first byte)."""
@@ -1337,12 +1758,44 @@ def _worker_main() -> None:
             wait = 0.0
         while len(buf) >= _LEN.size:
             n = _LEN.unpack(bytes(buf[:_LEN.size]))[0]
+            if n > MAX_FRAME_BYTES:
+                eof = True  # lying prefix: die cleanly (the router
+                break  # classifies the EOF as a death)
             if len(buf) < _LEN.size + n:
                 break
             payload = bytes(buf[_LEN.size:_LEN.size + n])
             del buf[:_LEN.size + n]
             frames.append(pickle.loads(payload))
         return frames
+
+    def ready_frame() -> dict:
+        return {"op": "ready", "replica": rid,
+                # the clock-offset handshake: this worker's
+                # (monotonic, wall) pair, matching its tracer's
+                # span timestamps — the router merges on it
+                "clock_sync": (tracer.clock_sync if tracer
+                               is not None else
+                               {"monotonic": time.monotonic(),
+                                "wall": time.time()})}
+
+    if cfg.get("gang"):
+        # the sharded-case worker: no ServePipeline — one N-device
+        # mesh, whole distributed solves per case frame
+        _gang_loop(cfg, out, poll, lambda: eof, tracer, trace_dir,
+                   ready_frame)
+        return
+    from nonlocalheatequation_tpu.serve.server import ServePipeline
+
+    pipe = ServePipeline(depth=cfg.get("depth", 1),
+                         window_ms=cfg.get("window_ms", 2.0),
+                         window_size=cfg.get("window_size"),
+                         **cfg.get("serve_kwargs") or {},
+                         **cfg.get("engine_kwargs") or {})
+    _write_frame(out, ready_frame())
+
+    outstanding: dict[int, object] = {}
+    busy_s = 0.0
+    window_t0 = time.monotonic()
 
     def flush_done() -> None:
         for rid_, h in list(outstanding.items()):
@@ -1444,4 +1897,16 @@ def _worker_main() -> None:
 
 
 if __name__ == "__main__":
-    _worker_main()
+    import argparse
+
+    _ap = argparse.ArgumentParser(
+        description="replica worker child (started by ReplicaRouter; "
+                    "--worker-connect dials a SocketTransport listener "
+                    "instead of speaking frames over stdin/stdout)")
+    _ap.add_argument(
+        "--worker-connect", default=None, metavar="HOST:PORT",
+        help="dial the router's socket transport at HOST:PORT, send the "
+             "JSON hello (replica id from NLHEAT_REPLICA_ID, token from "
+             "NLHEAT_WORKER_TOKEN), then serve the identical frames the "
+             "pipe workers speak")
+    _worker_main(connect=_ap.parse_args().worker_connect)

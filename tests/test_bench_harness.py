@@ -235,6 +235,50 @@ def test_bench_trace_fleet_mode_emits_merged_timeline(tmp_path):
     assert any(e["ph"] in ("s", "t", "f") for e in events)
 
 
+def test_bench_fleet_tcp_mode_emits_transport_ab(tmp_path):
+    # BENCH_FLEET_TCP=N: the worker-transport A/B + sharded big-case
+    # tier (ISSUE 12, serve/transport.py + serve/router.py
+    # fleet_tcp_ab) — pipe vs loopback-TCP walls over one shared store
+    # dir, then the mixed small+sharded sweep on a TCP fleet with the
+    # gang replica up.  The JSON must carry the fleettcp variant, the
+    # transport label, the tcp_overhead ratio, the sharded-case
+    # accounting (comm + mesh evidence), accept/shed counts, and the
+    # bit-identity flag (pipe == tcp AND gang == offline distributed) —
+    # on the same one-line rc=0 ladder.  Tiny grids are submit-bound:
+    # this asserts STRUCTURE, not the overhead ratio.
+    store = tmp_path / "store"
+    proc, rec = run_bench({"BENCH_FLEET_TCP": "2", "BENCH_GRID": "48",
+                           "BENCH_LADDER": "48", "BENCH_ACCURACY": "0",
+                           "BENCH_ROUTER_STEPS": "60",
+                           "BENCH_FLEET_CASES": "6",
+                           "BENCH_FLEET_SHARDED": "1",
+                           "BENCH_FLEET_GANG": "2",
+                           "BENCH_ROUTER_DIR": str(store)},
+                          timeout=420)
+    assert proc.returncode == 0
+    assert rec["value"] > 0
+    assert rec["variant"] == "fleettcp2"
+    assert rec["transport"] == "tcp"
+    assert rec["replicas"] == 2 and rec["cases"] == 6
+    assert rec["tcp_overhead"] > 0
+    assert rec["router_speedup"] > 0  # the 1-replica TCP arm ran
+    # the warm pass dispatched the sharded case to the gang replica,
+    # and the sweep re-offered it (paced + burst)
+    assert rec["sharded_cases"] >= 1
+    assert rec["sharded"]["grid"] == 96
+    assert rec["sharded"]["threshold"] == 48 * 48
+    assert rec["sharded"]["comm"] in ("fused", "collective")
+    assert rec["sharded"]["devices"] == 2
+    assert rec["bit_identical"] is True
+    assert set(rec["load_sweep"]) == {"x2", "burst"}
+    for point in rec["load_sweep"].values():
+        assert point["accepted"] + point["shed"] == point["offered"]
+        assert point["max_pending"] <= 4  # the admission bound (2*N)
+    # both transport arms shared ONE store dir (the pipe arm populated
+    # it, the TCP arm warm-booted)
+    assert list(store.glob("*.aotprog"))
+
+
 def test_bench_scrubs_leaked_program_store():
     # a store dir leaked from a developer shell must not silently
     # warm-boot a headline measurement's compiles

@@ -188,6 +188,32 @@ def test_listen_flag_refusals():
     assert r.returncode == 1 and "[0, 65535]" in r.stderr
     r = run_cli("solve3d", ["--listen", "0", "--distributed"], stdin="")
     assert r.returncode == 1 and "--distributed" in r.stderr
+    # ISSUE 12: the fleet-transport + sharded-tier flags' honesty checks
+    r = run_cli("solve2d", ["--transport", "tcp"], stdin="")
+    assert r.returncode == 1 and "--transport" in r.stderr \
+        and "--listen" in r.stderr
+    r = run_cli("solve2d", ["--listen", "0", "--worker-token", "s"],
+                stdin="")
+    assert r.returncode == 1 and "--transport tcp" in r.stderr
+    r = run_cli("solve2d", ["--worker-token", "s"], stdin="")
+    assert r.returncode == 1 and "--listen" in r.stderr
+    r = run_cli("solve2d", ["--listen", "0", "--shard-threshold", "-1"],
+                stdin="")
+    assert r.returncode == 1 and "--shard-threshold" in r.stderr
+    r = run_cli("solve2d", ["--listen", "0", "--gang-devices", "4"],
+                stdin="")
+    assert r.returncode == 1 and "--shard-threshold" in r.stderr
+    # the sharded case class is the 2D flagship tier: the 1D/3D CLIs
+    # refuse the flag instead of silently never engaging it
+    r = run_cli("solve1d", ["--listen", "0", "--shard-threshold", "64"],
+                stdin="")
+    assert r.returncode == 1 and "solve2d" in r.stderr
+    r = run_cli("solve3d", ["--listen", "0", "--shard-threshold", "64"],
+                stdin="")
+    assert r.returncode == 1 and "solve2d" in r.stderr
+    r = run_cli("solve2d", ["--listen", "0", "--transport", "bogus"],
+                stdin="")
+    assert r.returncode == 2 and "--transport" in r.stderr
 
 
 def test_listen_serves_http_and_stops_on_stdin_eof():

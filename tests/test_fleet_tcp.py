@@ -1,0 +1,377 @@
+"""Pod-scale fleet (ISSUE 12): TCP worker transport + sharded gang tier.
+
+Covers the tentpole contracts end to end on the f64 8-virtual-device
+CPU suite:
+
+* loopback-TCP bit-identity: a fleet whose workers dial in over sockets
+  (``--worker-connect`` + hello) serves the same case set bit-identical
+  to the offline engine (and therefore to the in-process pipe router,
+  whose identity test_router.py pins against the same oracle),
+* warm-add of a TCP worker: the newcomer inherits buckets and serves
+  them from the shared AOT program store — ``store_hits >= 1``,
+  ``programs_built == 0`` (the zero-retrace spy, now over sockets),
+* the sharded case class: 2D grids above ``shard_threshold`` dispatch
+  to the gang replica (an N-device mesh running whole distributed
+  solves, ``comm='fused'`` where require_fused accepts) and return
+  bit-identical to the offline ``solve_case_sharded`` /
+  ``Solver2DDistributed`` path,
+* ``die@`` chaos on a socket worker MID-SHARDED-CASE: reader-EOF death
+  detection, gang respawn, lossless duplicate-free re-route — the PR 10
+  guarantees unchanged over TCP,
+* frame-protocol hardening: malformed/oversized/truncated length
+  prefixes and mid-frame disconnects read as ``None`` (replica death),
+  never a crash or a hung reader — the fuzz-style refusals next to
+  test_router.py's parse refusals,
+* the socket trust boundary: non-loopback binds refuse without a
+  token, a wrong-token hello is dropped before anything is unpickled,
+  and a garbage connection cannot crash a serving router.
+
+Worker processes are real (subprocess + jax import each), so the fleet
+tests batch several assertions per spawned router to hold the tier-1
+budget.
+"""
+
+import io
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from nonlocalheatequation_tpu.parallel.gang import solve_case_sharded
+from nonlocalheatequation_tpu.parallel.mesh_axes import pick_gang_devices
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase,
+    EnsembleEngine,
+)
+from nonlocalheatequation_tpu.serve.router import ReplicaRouter
+from nonlocalheatequation_tpu.serve.transport import (
+    LEN,
+    MAX_FRAME_BYTES,
+    PipeTransport,
+    SocketTransport,
+    make_transport,
+    read_frame,
+    write_frame,
+    write_json_frame,
+)
+
+assert jax.config.jax_enable_x64  # the oracle contract (conftest forces it)
+
+
+def make_cases(n, grid=16, nt=4, buckets=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return [EnsembleCase(shape=(grid, grid), nt=nt + (i % buckets), eps=2,
+                         k=1.0, dt=1e-5, dh=1.0 / grid, test=False,
+                         u0=rng.normal(size=(grid, grid)))
+            for i in range(n)]
+
+
+def make_sharded(n, grid=24, nt=3, seed=1):
+    """Cases above a grid=16 threshold (24^2 = 576 > 256), divisible by
+    the virtual-device mesh shapes choose_mesh_for_grid picks."""
+    rng = np.random.default_rng(seed)
+    return [EnsembleCase(shape=(grid, grid), nt=nt + i, eps=2, k=1.0,
+                         dt=1e-5, dh=1.0 / grid, test=False,
+                         u0=rng.normal(size=(grid, grid)))
+            for i in range(n)]
+
+
+def offline(cases):
+    return EnsembleEngine(method="sat", batch_sizes=(1,)).run(cases)
+
+
+# ---------------------------------------------------------------------------
+# the TCP fleet (real worker processes dialing in over loopback)
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_fleet_bit_identity_warm_add_and_garbage_conn(tmp_path):
+    store = str(tmp_path / "store")
+    cases = make_cases(6, buckets=2)
+    want = offline(cases)
+    with ReplicaRouter(replicas=1, method="sat", batch_sizes=(1,),
+                       transport="tcp", program_store=store,
+                       max_replicas=2) as router:
+        assert router.metrics()["transport"] == "tcp"
+        got = router.serve_cases(cases)
+        # bit-identical to the offline engine over sockets (the pipe
+        # router is pinned against the same oracle in test_router.py,
+        # so this also pins tcp == pipe)
+        assert all(np.array_equal(a, b) for a, b in zip(want, got))
+        # a garbage connection to the transport listener (port scanner,
+        # confused client) must not perturb the serving fleet
+        port = router._transport.port
+        with socket.create_connection(("127.0.0.1", port)) as s:
+            s.sendall(b"\xff" * 64)
+        got2 = router.serve_cases(cases)
+        assert all(np.array_equal(a, b) for a, b in zip(want, got2))
+        assert router.metrics()["deaths"] == 0
+        # warm-add over TCP: the newcomer dials in, inherits a fair
+        # share of the buckets (1 of 2), and serves it from the shared
+        # store — store_hits >= 1, ZERO programs built (the
+        # zero-retrace spy, now over sockets)
+        rid = router.add_replica()
+        assert len(router._replicas[rid].buckets) == 1
+        moved = next(iter(router._replicas[rid].buckets))
+        assert router._owner[moved] == rid
+        got3 = router.serve_cases(cases)
+        assert all(np.array_equal(a, b) for a, b in zip(want, got3))
+        stats = router.refresh_stats()
+        new = stats[rid]["metrics"]
+        assert new["cases"] >= 1
+        assert new["store"]["hits"] >= 1
+        assert new["programs_built"] == 0
+
+
+def test_gang_sharded_bit_identity_and_socket_chaos():
+    small = make_cases(2, buckets=1)
+    big = make_sharded(2)
+    want_small = offline(small)
+    # the offline oracle: the SAME adapter the gang worker calls, in
+    # THIS process on the same 8 virtual devices — method='sat' is not
+    # pallas, so require_fused refuses and the solve honestly falls
+    # back to the collective transport (recorded in info)
+    want_big = []
+    ocache: dict = {}
+    for c in big:
+        v, info = solve_case_sharded(c, ndevices=8, comm="fused",
+                                     method="sat", solver_cache=ocache)
+        assert info["comm"] == "collective"  # sat -> fused refused
+        assert info["devices"] == 8
+        want_big.append(v)
+    # die@2: the THIRD case-forward is the first sharded case — the
+    # gang replica is SIGKILLed with it in flight, mid-distributed-
+    # solve, over a socket; the reader's EOF must re-route losslessly
+    # after the gang respawn
+    with ReplicaRouter(replicas=1, method="sat", batch_sizes=(1,),
+                       transport="tcp", shard_threshold=16 * 16,
+                       gang_devices=8, faults="die@2",
+                       respawn=True) as router:
+        handles = [router.submit(c) for c in small + big]
+        router.drain(timeout_s=600)
+        m = router.metrics()
+        assert m["deaths"] == 1
+        assert m["requeued"] >= 1
+        assert m["sharded_cases"] == 2
+        assert len(m["gang"]) == 1  # the respawned gang replica
+        # no lost results, no duplicates, every result bit-identical —
+        # small to the engine oracle, sharded to the offline
+        # distributed solve
+        for h, w in zip(handles, want_small + want_big):
+            assert h.error is None
+            assert np.array_equal(h.result, w)
+        # the gang replica answers the stats pull flagged gang=True and
+        # stays OUT of the small-fleet scale telemetry
+        stats = router.refresh_stats()
+        gid = m["gang"][0]
+        assert stats[gid].get("gang") is True
+        assert stats[gid]["metrics"]["cases"] >= 1
+        assert router._telemetry.rate(gid) == 0.0  # never recorded
+        # the gang replica cannot be drained out from under the tier
+        with pytest.raises(ValueError, match="gang replica"):
+            router.drain_replica(gid)
+
+
+def test_gang_fused_engages_on_pallas():
+    # the comm='fused' half of the acceptance: a pallas-method sharded
+    # solve runs the fused halo family (require_fused accepts) and
+    # still matches the collective oracle bitwise — the PR 6 contract
+    # through the case adapter
+    case = EnsembleCase(shape=(16, 16), nt=3, eps=2, k=1.0, dt=1e-4,
+                        dh=0.02, test=True, u0=None)
+    vf, inf = solve_case_sharded(case, ndevices=8, comm="fused",
+                                 method="pallas")
+    assert inf["comm"] == "fused"
+    vc, inc = solve_case_sharded(case, ndevices=8, comm="collective",
+                                 method="pallas")
+    assert inc["comm"] == "collective"
+    assert np.array_equal(vf, vc)
+    # manufactured contract holds through the adapter
+    assert inf["error_l2"] / (16 * 16) <= 1e-6
+    # and the spatial axes ride ICI per the hybrid rules
+    assert inf["axes"] == {"x": "ici", "y": "ici"}
+
+
+# ---------------------------------------------------------------------------
+# frame-protocol hardening (fuzz-style refusals, no processes)
+# ---------------------------------------------------------------------------
+
+
+def test_frame_refusals_truncated_oversized_midframe():
+    # a healthy round trip first
+    buf = io.BytesIO()
+    write_frame(buf, {"op": "case", "id": 7})
+    buf.seek(0)
+    assert read_frame(buf) == {"op": "case", "id": 7}
+    # truncated length prefix -> None (death), not a struct error
+    assert read_frame(io.BytesIO(b"\x01\x02\x03")) is None
+    # OVERSIZED length prefix (garbage read as u64) -> None, and never
+    # a giant allocation
+    evil = LEN.pack(MAX_FRAME_BYTES + 1) + b"x"
+    assert read_frame(io.BytesIO(evil)) is None
+    # ASCII garbage where the prefix should be: reads as ~10^18 -> None
+    assert read_frame(io.BytesIO(b"GET / HTTP/1.1\r\n\r\n")) is None
+    # mid-frame disconnect (header promises more than arrives) -> None
+    short = LEN.pack(100) + b"only-ten-b"
+    assert read_frame(io.BytesIO(short)) is None
+    # empty stream == clean EOF -> None
+    assert read_frame(io.BytesIO(b"")) is None
+
+
+def test_socket_transport_token_and_hello_refusals():
+    # non-loopback bind without a token refuses at construction: the
+    # frames are pickle and the trust boundary is explicit
+    with pytest.raises(ValueError, match="token"):
+        SocketTransport(host="0.0.0.0")
+    st = SocketTransport(token="s3cret")
+    try:
+        results = {}
+
+        def accept():
+            try:
+                results["conn"] = st._accept(5, timeout_s=10)
+            except Exception as e:  # noqa: BLE001
+                results["err"] = e
+
+        t = threading.Thread(target=accept, daemon=True)
+        t.start()
+        # 1) wrong token: the hello parses but fails the HMAC compare —
+        # the connection is closed WITHOUT anything being unpickled
+        bad = socket.create_connection(("127.0.0.1", st.port))
+        f = bad.makefile("wb")
+        write_json_frame(f, {"op": "hello", "replica": 5,
+                             "token": "wrong"})
+        assert bad.recv(1) == b""  # server closed on us
+        bad.close()
+        # 2) garbage instead of a hello: dropped the same way
+        junk = socket.create_connection(("127.0.0.1", st.port))
+        junk.sendall(struct.pack("<Q", 1 << 40))  # oversized hello
+        assert junk.recv(1) == b""
+        junk.close()
+        # 3) the correct hello is accepted
+        good = socket.create_connection(("127.0.0.1", st.port))
+        gf = good.makefile("wb")
+        write_json_frame(gf, {"op": "hello", "replica": 5,
+                              "token": "s3cret"})
+        t.join(timeout=15)
+        assert "conn" in results, results.get("err")
+        # and the accepted channel speaks real pickle frames both ways
+        conn = results["conn"]
+        write_frame(conn.makefile("wb"), {"op": "ready", "replica": 5})
+        assert read_frame(good.makefile("rb")) == {"op": "ready",
+                                                   "replica": 5}
+        good.close()
+        conn.close()
+    finally:
+        st.close()
+
+
+def test_transport_resolution_refusals():
+    assert isinstance(make_transport(None), PipeTransport)
+    assert isinstance(make_transport("pipe"), PipeTransport)
+    with pytest.raises(ValueError, match="worker_token"):
+        make_transport("pipe", token="s")
+    with pytest.raises(ValueError, match="unknown transport"):
+        make_transport("carrier-pigeon")
+    st = SocketTransport(token="t")
+    try:
+        assert make_transport(st, token="t") is st
+        with pytest.raises(ValueError, match="one credential"):
+            make_transport(st, token="other")
+    finally:
+        st.close()
+    tcp = make_transport("tcp")
+    try:
+        assert tcp.name == "tcp" and tcp.host == "127.0.0.1"
+    finally:
+        tcp.close()
+
+
+# ---------------------------------------------------------------------------
+# the gang adapter + device picking (pure units)
+# ---------------------------------------------------------------------------
+
+
+def test_pick_gang_devices_whole_granules_first():
+    devs = jax.devices()  # 8 virtual CPU devices, one granule
+    assert pick_gang_devices(8) == devs
+    assert pick_gang_devices(3) == devs[:3]
+    with pytest.raises(ValueError, match="1 <= n"):
+        pick_gang_devices(0)
+    with pytest.raises(ValueError, match="1 <= n"):
+        pick_gang_devices(99)
+
+    class FakeDev:
+        def __init__(self, i, granule):
+            self.id = i
+            self.process_index = granule
+
+        def __repr__(self):
+            return f"d{self.id}@g{self.process_index}"
+
+    # two granules of 4: n=4 stays inside ONE granule (no DCN-striding
+    # spatial axis), n=6 fills the first granule then takes 2 more
+    fleet = [FakeDev(i, i // 4) for i in range(8)]
+    picked = pick_gang_devices(4, fleet)
+    assert {d.process_index for d in picked} == {0}
+    picked6 = pick_gang_devices(6, fleet)
+    assert [d.process_index for d in picked6] == [0, 0, 0, 0, 1, 1]
+
+
+def test_gang_solver_cache_is_bounded_lru():
+    # every entry pins full-grid state + compiled programs: the memo
+    # must evict (PR 9's PROGRAM_CACHE_CAP lesson) — and eviction must
+    # never change results
+    cache: dict = {}
+    cases = [EnsembleCase(shape=(24, 24), nt=2 + i, eps=2, k=1.0,
+                          dt=1e-5, dh=1 / 24, test=True, u0=None)
+             for i in range(3)]
+    outs = [solve_case_sharded(c, ndevices=2, method="sat",
+                               solver_cache=cache, cache_cap=2)[0]
+            for c in cases]
+    assert len(cache) == 2  # the oldest signature evicted
+    # a re-solve of the evicted signature reconstructs, bit-identical
+    again = solve_case_sharded(cases[0], ndevices=2, method="sat",
+                               solver_cache=cache, cache_cap=2)[0]
+    assert np.array_equal(again, outs[0])
+    with pytest.raises(ValueError, match="cache_cap"):
+        solve_case_sharded(cases[0], ndevices=2, method="sat",
+                           solver_cache={}, cache_cap=-1)
+
+
+def test_solve_case_sharded_refusals():
+    ok = make_sharded(1)[0]
+    with pytest.raises(ValueError, match="2D"):
+        solve_case_sharded(EnsembleCase(shape=(8,), nt=2, eps=1, k=1.0,
+                                        dt=1e-5, dh=0.1, test=True),
+                           ndevices=2)
+    with pytest.raises(ValueError, match="comm"):
+        solve_case_sharded(ok, comm="bogus")
+    prod = make_sharded(1)[0]
+    prod.u0 = None
+    with pytest.raises(ValueError, match="needs an"):
+        solve_case_sharded(prod, ndevices=2, method="sat")
+
+
+def test_router_sharded_ctor_refusals():
+    with pytest.raises(ValueError, match="shard_threshold"):
+        ReplicaRouter(replicas=1, shard_threshold=-1)
+    with pytest.raises(ValueError, match="gang_comm"):
+        ReplicaRouter(replicas=1, shard_threshold=64, gang_comm="bogus")
+    with pytest.raises(ValueError, match="gang_devices"):
+        ReplicaRouter(replicas=1, shard_threshold=64, gang_devices=0)
+    with pytest.raises(ValueError, match="unknown transport"):
+        ReplicaRouter(replicas=1, transport="bogus")
+    with pytest.raises(ValueError, match="worker_token"):
+        ReplicaRouter(replicas=1, worker_token="s")  # pipe + token
+
+
+def test_fleet_tcp_ab_refuses_bucket_starvation():
+    from nonlocalheatequation_tpu.serve.router import fleet_tcp_ab
+
+    with pytest.raises(ValueError, match="distinct buckets"):
+        fleet_tcp_ab({}, make_cases(4, buckets=1), 2, None)

@@ -24,6 +24,7 @@ ALL_STEPS = [
     "bf16-4096", "bf16-carried4096", "ensemble8x1024", "serve8x1024",
     "servefault8x1024", "obs8x1024", "multichip1024", "fft4096",
     "tta4096", "warmboot1024", "router8x1024", "routerobs8x1024",
+    "fleettcp8x1024",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
@@ -237,6 +238,32 @@ def test_routerobs_step_banks_fleet_trace_evidence(tmp_path):
     assert '"bit_identical": true' in table
     doc = json.loads((tdir / "fleet_trace.json").read_text())
     assert len({e.get("pid") for e in doc["traceEvents"]}) >= 2
+
+
+@pytest.mark.slow  # ~90 s (a gate bench + the pipe/TCP fleet child with
+# a gang replica) — the transport + sharded-tier machinery is tier-1-
+# covered by tests/test_fleet_tcp.py and test_bench_harness; this proves
+# the queue's gate parses tcp_overhead/sharded_cases/shed/bit-identity
+# before banking, and the step's cpu-labeled rows pass its exemption
+def test_fleettcp_step_banks_transport_evidence(tmp_path):
+    proc, state, table, _out = _run(
+        tmp_path, "fleettcp8x1024",
+        # tiny-grid CPU smoke: 2 replicas + a 2-device gang mesh, the
+        # shared step floor, and the overhead limit relaxed to
+        # structure (a millisecond-scale proxy under CI load measures
+        # timer noise, not the socket hop)
+        {"OPP_ROUTER_REPLICAS": "2", "OPP_GRID_ROUTER": "32",
+         "BENCH_ROUTER_STEPS": "600", "BENCH_FLEET_CASES": "8",
+         "BENCH_FLEET_SHARDED": "1", "BENCH_FLEET_GANG": "2",
+         "OPP_FLEETTCP_MAX_OVERHEAD": "10"}, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    assert "fleettcp8x1024\n" in state
+    assert "fail:" not in state
+    assert '"variant": "fleettcp2"' in table
+    assert '"tcp_overhead"' in table
+    assert '"sharded_cases"' in table
+    assert '"bit_identical": true' in table
 
 
 @pytest.mark.slow  # ~73 s: two strike rounds, each a full bench child plus

@@ -25,7 +25,9 @@ Env:
     (200 / 800: per-case scan length — compute must dominate the
     router's per-case submit cost or the sweep measures the pickler);
     the routerobs group (ISSUE 11 traced-vs-untraced fleet A/B) shares
-    the BT_ROUTER_* knobs
+    the BT_ROUTER_* knobs, as does the fleettcp group (ISSUE 12
+    pipe-vs-TCP transport A/B + sharded gang tier; BT_FLEET_SHARDED
+    (2) sharded cases at twice the small edge)
 """
 
 from __future__ import annotations
@@ -1055,6 +1057,79 @@ def bench_router_obs(steps: int):
         shutil.rmtree(trace_dir, ignore_errors=True)
 
 
+def bench_fleet_tcp(steps: int):
+    """Worker-transport A/B + sharded big-case tier (ISSUE 12,
+    serve/transport.py + serve/router.py fleet_tcp_ab): the same
+    mixed-bucket small case set served by an N-replica router over
+    in-process pipes and over loopback TCP (one shared AOT store dir;
+    the tcp row records ``tcp_overhead`` = tcp/pipe steady-pass wall),
+    then the mixed small+sharded offered-load sweep on a TCP fleet
+    with the gang tier up — sharded cases at (2*grid)^2 dispatch to
+    the gang replica's mesh and must return bit-identical to the
+    offline distributed solve, the burst point must SHED.  Off-TPU
+    only, like the router group (and the gang mesh needs the virtual-
+    device CPU suite or a real multi-device host)."""
+    import shutil
+    import tempfile
+
+    from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+    from nonlocalheatequation_tpu.serve.router import fleet_tcp_ab
+
+    if on_tpu():
+        log("  fleettcp: skipped on TPU (replica fleets assume one "
+            "accelerator per worker; run with BENCH_PLATFORM=cpu)")
+        return
+    replicas = int(os.environ.get("BT_ROUTER_REPLICAS", 4))
+    n = cfg("BT_ROUTER_GRID", 512, 128)
+    C = int(os.environ.get("BT_ROUTER_CASES", 16))
+    S = int(os.environ.get("BT_FLEET_SHARDED", 2))
+    rsteps = cfg("BT_ROUTER_STEPS", 200, 800)
+    buckets = max(replicas, min(8, C))
+    rng = np.random.default_rng(0)
+    cases = [EnsembleCase(shape=(n, n), nt=rsteps + (i % buckets), eps=8,
+                          k=1.0, dt=1e-7, dh=1.0 / n, test=False,
+                          u0=rng.normal(size=(n, n)))
+             for i in range(C)]
+    sn = 2 * n
+    # the sharded cases' dt is their OWN 0.8x-stable bound at the finer
+    # dh (the small-case dt would diverge every gang solve)
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+
+    sdt = stable_dt(NonlocalOp2D(8, k=1.0, dt=1.0, dh=1.0 / sn,
+                                 method="sat"))
+    scases = [EnsembleCase(shape=(sn, sn), nt=max(1, rsteps // 4) + i,
+                           eps=8, k=1.0, dt=sdt, dh=1.0 / sn,
+                           test=False, u0=rng.normal(size=(sn, sn)))
+              for i in range(S)]
+    store_dir = tempfile.mkdtemp(prefix="nlheat-bt-fleettcp-")
+    try:
+        ab = fleet_tcp_ab({"method": "sat", "batch_sizes": (1,)},
+                          cases, replicas, store_dir,
+                          shard_cases=scases, shard_threshold=n * n)
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+    bit = all(np.array_equal(a, b)
+              for a, b in zip(ab["results"]["pipe"],
+                              ab["results"]["tcp"]))
+    total_steps = sum(c.nt for c in cases)
+    emit(f"fleettcp/pipe{replicas}", n * n * C, total_steps // C,
+         ab["walls"]["pipe"], grid=n, eps=8, replicas=replicas, cases=C,
+         transport="pipe")
+    burst = ab["sweep"]["burst"]
+    paced = ab["sweep"]["x2"]
+    sharded = ab["sharded"]  # None when BT_FLEET_SHARDED=0
+    emit(f"fleettcp/tcp{replicas}", n * n * C, total_steps // C,
+         ab["walls"]["tcp"], grid=n, eps=8, replicas=replicas, cases=C,
+         transport="tcp", tcp_overhead=round(ab["tcp_overhead"], 4),
+         sharded_cases=ab["sharded_cases"],
+         **({"sharded_comm": sharded["info"]["comm"],
+             "sharded_mesh": sharded["info"]["mesh"]} if sharded else {}),
+         bit_identical=bit and ab["mixed_bit_identical"],
+         accepted=burst["accepted"], shed=burst["shed"],
+         max_pending=burst["max_pending"],
+         paced_p99_ms=round(paced["latency_s"]["p99"] * 1e3, 3))
+
+
 def bench_multichip(steps: int):
     """Fused-vs-collective halo A/B (round 9, ops/pallas_halo.py): the
     distributed 2D solver over ONE shared device mesh, collective halos
@@ -1115,6 +1190,7 @@ BENCHES = {
     "warmboot": bench_warmboot,
     "router": bench_router,
     "routerobs": bench_router_obs,
+    "fleettcp": bench_fleet_tcp,
 }
 
 

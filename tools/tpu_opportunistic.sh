@@ -100,7 +100,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 STEPS="bench4096 resident512 carried4096 superstep2 \
 bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 servefault8x1024 \
 obs8x1024 multichip1024 fft4096 tta4096 warmboot1024 router8x1024 \
-routerobs8x1024 \
+routerobs8x1024 fleettcp8x1024 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -285,6 +285,25 @@ run_step_cmd() {  # the queue's one name->command map
       # processes, steady_state_builds == 0, bit_identical.
       bench_nofb BENCH_ROUTER="${OPP_ROUTER_REPLICAS:-8}" \
         BENCH_TRACE_FLEET="${OPP_ROUTEROBS_TRACE_DIR:-docs/bench/fleet_trace_$ROUND}" \
+        BENCH_PLATFORM=cpu \
+        BENCH_GRID="${OPP_GRID_ROUTER:-1024}" \
+        BENCH_LADDER="${OPP_GRID_ROUTER:-1024}" BENCH_ACCURACY=0 ;;
+    fleettcp8x1024)
+      # worker-transport A/B + sharded gang tier (ISSUE 12,
+      # serve/transport.py + serve/router.py fleet_tcp_ab): the SAME
+      # mixed-bucket case set served over in-process pipes and over
+      # loopback TCP (one shared AOT store dir; tcp_overhead is the
+      # socket hop's steady-pass cost), then the mixed small+sharded
+      # offered-load sweep on a TCP fleet with the gang tier up —
+      # sharded (2*grid)^2 cases on the gang replica's virtual-device
+      # mesh, bit-identical to the offline distributed solve, burst
+      # point must SHED.  A HOST measurement like router8x1024 (same
+      # BENCH_PLATFORM=cpu rationale; step() exempts the backend
+      # grep).  Gate (step_variant_ok): variant fleettcpN,
+      # tcp_overhead <= OPP_FLEETTCP_MAX_OVERHEAD (default 1.5 — the
+      # socket hop must not eat the fleet speedup), sharded_cases >= 1,
+      # shed >= 1, bit_identical.
+      bench_nofb BENCH_FLEET_TCP="${OPP_ROUTER_REPLICAS:-8}" \
         BENCH_PLATFORM=cpu \
         BENCH_GRID="${OPP_GRID_ROUTER:-1024}" \
         BENCH_LADDER="${OPP_GRID_ROUTER:-1024}" BENCH_ACCURACY=0 ;;
@@ -511,6 +530,37 @@ for line in open(sys.argv[1]):
 sys.exit(0 if ok else 1)
 PYEOF
       ;;
+    fleettcp8x1024) python - "$2" <<'PYEOF'
+import json, os, sys
+# the ISSUE 12 gate: the socket hop must not eat the fleet speedup
+# (tcp_overhead <= OPP_FLEETTCP_MAX_OVERHEAD, default 1.5 — a
+# millisecond-scale CPU proxy is noisy, so the smoke harness can relax
+# it), at least one sharded case actually dispatched to the gang
+# replica, overload honesty (shed >= 1 at the burst point), and the
+# bit-identity flag (pipe == tcp AND gang == offline distributed).
+limit = float(os.environ.get("OPP_FLEETTCP_MAX_OVERHEAD", "1.5"))
+ok = False
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    if not str(r.get("variant", "")).startswith("fleettcp"):
+        continue
+    overhead = r.get("tcp_overhead")
+    if not isinstance(overhead, (int, float)) or overhead > limit:
+        continue
+    sharded, shed = r.get("sharded_cases"), r.get("shed")
+    if not isinstance(sharded, int) or sharded < 1:
+        continue
+    if isinstance(shed, int) and shed >= 1 and r.get("bit_identical") is True:
+        ok = True
+sys.exit(0 if ok else 1)
+PYEOF
+      ;;
     warmboot1024) python - "$2" <<'PYEOF'
 import json, os, sys
 # the >= 2x cold->warm first-chunk acceptance gate (ISSUE 9); the CI
@@ -561,7 +611,7 @@ step() {  # <name>: run one queue step unless already done.
   log "step $name: start"
   local run rc backend_check=step_backend_ok
   case $name in
-    router8x1024 | routerobs8x1024)
+    router8x1024 | routerobs8x1024 | fleettcp8x1024)
       # deliberately host measurements (see run_step_cmd): the fleet
       # proxies pin BENCH_PLATFORM=cpu because N replica processes
       # cannot share the single tunneled chip — their rows are cpu-

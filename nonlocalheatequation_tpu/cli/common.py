@@ -124,7 +124,11 @@ def add_stepper_flags(p: argparse.ArgumentParser):
         help="--stepper rkc: internal stage count s >= 2 (0 picks the "
              "default 8); the stability interval grows ~2*s^2, so dt up "
              "to ~s^2/2 past the Euler bound costs s operator "
-             "evaluations — a net ~s/2 fewer applies to a fixed horizon",
+             "evaluations — a net ~s/2 fewer applies to a fixed horizon."
+             "  --stepper expo: S >= 1 arms the low-rank boundary "
+             "correction (S midpoint-Duhamel substeps of the collar "
+             "commutator, models/steppers.py; 0 = the interior-exact "
+             "legacy step)",
     )
 
 
@@ -151,9 +155,12 @@ def validate_stepper_args(args) -> str | None:
         return ("--stepper expo integrates in the spectral domain; it "
                 "requires --method fft (rkc super-steps every other "
                 "method)")
-    if args.stages and args.stepper != "rkc":
-        return ("--superstep-stages is an rkc knob; --stepper "
-                f"{args.stepper} takes no stage count")
+    if args.stages and args.stepper == "euler":
+        return ("--superstep-stages configures the rkc stage count or "
+                "the expo boundary correction; --stepper euler takes "
+                "no stage count")
+    if args.stages < 0:
+        return f"--superstep-stages must be >= 0 (got {args.stages})"
     if args.stepper == "rkc" and args.stages != 0 and args.stages < 2:
         return ("--stepper rkc needs --superstep-stages >= 2 "
                 f"(or 0 = default; got {args.stages})")

@@ -18,11 +18,15 @@ import numpy as np
 from nonlocalheatequation_tpu.cli.common import (
     add_platform_flags,
     add_precision_flags,
+    add_stepper_flags,
+    announce_stable_dt,
     bool_flag,
     check_same_input_state,
     cli_startup,
     guard_multihost_stdin,
     run_batch,
+    stepper_kwargs,
+    validate_stepper_args,
 )
 
 
@@ -64,6 +68,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "the interior sweep; needs --method pallas)")
     p.add_argument("--method", default="auto",
                    choices=("auto", "conv", "shift", "sat", "pallas"))
+    add_stepper_flags(p)
     p.add_argument("--log", action="store_true")
     p.add_argument("--checkpoint", default=None,
                    help="checkpoint file to write every --ncheckpoint steps")
@@ -142,6 +147,34 @@ def main(argv=None) -> int:
               "paths; run the serial solver, or --precision bf16 "
               "without --resync", file=sys.stderr)
         return 1
+    # the distributed stepper tier (ISSUE 13): rkc's stage loop runs
+    # above the halo exchange (parallel/stepper_halo.py) on the SPMD
+    # path; expo and the elastic executor are refused loudly — this CLI
+    # used to silently ignore the stepper axis entirely
+    if args.stepper == "expo":
+        print("--stepper expo integrates the whole-domain spectral "
+              "symbol and cannot serve sharded blocks; run it on the "
+              "serial solve2d CLI (--stepper rkc super-steps the "
+              "distributed path)", file=sys.stderr)
+        return 1
+    if args.stepper != "euler" and use_elastic:
+        print("--stepper rkc runs on the SPMD distributed path; the "
+              "elastic executor (partition maps / --nbalance / "
+              "--test_load_balance) steps with Euler — drop one of "
+              "them", file=sys.stderr)
+        return 1
+    err0 = validate_stepper_args(args)
+    if err0:
+        print(err0, file=sys.stderr)
+        return 1
+    if not args.test_batch:
+        # the bound actually in force (rkc's beta(s), not Euler's),
+        # policed at rc 2 for the opted-into steppers (ISSUE 8 policy)
+        sk = stepper_kwargs(args)
+        rc = announce_stable_dt(2, args.k, args.eps, dh, args.dt,
+                                sk["stepper"], sk["stages"])
+        if rc is not None:
+            return rc
     # --superstep on the elastic path: gang stretches exchange one
     # K*eps-wide halo per K steps (gang.make_gang_run_superstep — the
     # communication-avoiding schedule under arbitrary placement); measured
@@ -194,6 +227,7 @@ def main(argv=None) -> int:
             checkpoint_path=args.checkpoint, ncheckpoint=args.ncheckpoint,
             superstep=args.superstep, precision=args.precision,
             resync_every=args.resync, comm=args.comm,
+            **stepper_kwargs(args),
         )
 
     if args.test_batch:

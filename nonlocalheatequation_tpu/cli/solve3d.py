@@ -108,9 +108,13 @@ def main(argv=None) -> int:
         print("--method fft serves whole-domain solves only; "
               "--distributed needs pallas/sat/shift", file=sys.stderr)
         return 1
-    if args.stepper != "euler" and args.distributed:
-        print("--stepper rkc/expo runs on the serial jit solver; the "
-              "distributed scan is Euler-only for now", file=sys.stderr)
+    if args.stepper == "expo" and args.distributed:
+        # rkc now super-steps the distributed scan (ISSUE 13,
+        # parallel/stepper_halo.py); expo stays whole-domain-only
+        print("--stepper expo integrates the whole-domain spectral "
+              "symbol and cannot serve sharded blocks; drop "
+              "--distributed (--stepper rkc super-steps the "
+              "distributed path)", file=sys.stderr)
         return 1
     err0 = validate_stepper_args(args)
     if err0:
@@ -199,7 +203,8 @@ def _run(args, multi: bool) -> int:
                                        ncheckpoint=args.ncheckpoint,
                                        superstep=args.superstep,
                                        precision=args.precision,
-                                       comm=args.comm)
+                                       comm=args.comm,
+                                       **stepper_kwargs(args))
         return Solver3D(nx, ny, nz, nt, eps, nlog=args.nlog, k=k, dt=dt,
                         dh=dh, backend=args.backend, method=args.method,
                         checkpoint_path=args.checkpoint,

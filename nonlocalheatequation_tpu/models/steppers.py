@@ -36,6 +36,23 @@ through Solver1D/2D/3D (``stepper=euler|rkc|expo``):
   (zero when the state stays clear of the boundary).  Time-dependent
   sources are frozen at the step start (first order), matching rkc.
 
+  ``stages`` arms the LOW-RANK BOUNDARY CORRECTION (ISSUE 13; the
+  docs/round10.md carried-forward item): with the true generator
+  A = Pi L Pi (Pi the collar projection, L the circulant symbol) and
+  the computed one B = L, Duhamel gives
+  ``e^{A dt} = e^{B dt} + int_0^dt e^{B(dt-s)} (A - B) e^{A s} ds`` and
+  the commutator ``D = A - B`` is supported on the eps-collar band —
+  low-rank relative to the grid.  ``stages = S >= 1`` evaluates that
+  integral by the propagator-damped midpoint quadrature
+  ``(dt/2) * e^{B dt/2} D e^{B dt/2}`` over S substeps of dt/S (the
+  half weight accounts for the e^{As} -> e^{Bs} substitution — measured
+  AND modeled; the damping by e^{B dt/2} is what keeps the correction
+  bounded at the huge dt*|lambda| this integrator exists for).
+  Measured on the boundary-loaded 1D probe: the collar defect drops
+  ~8-16x at dt <= the Euler bound and 3-6x at 9-20x past it with S=1,
+  another ~3x per S doubling (docs/round15.md).  ``stages=0`` (the
+  default) is the legacy interior-exact step, bit-identical.
+
 The manufactured-solution contract ``error_l2/#points <= 1e-6`` holds
 for every (method, stepper) pair at the reference configs
 (tests/test_spectral.py); the NumPy ``oracle`` backend stays Euler-only
@@ -215,33 +232,51 @@ def _make_rkc_step(op, g, lg, dtype, stages):
     return step
 
 
-def _expo_tables(op, shape, dtype):
-    """Baked (E, P) = (e^{lambda*dt}, dt*phi1(lambda*dt)) for the expo
-    step, computed in float64 on the host (np.expm1 keeps phi1 =
-    expm1(z)/z exact through z -> 0; the z ~ 0 series covers the DC mode
-    where lambda = 0 exactly) and cast once to the compute dtype."""
+def _expo_tables(op, shape, dtype, sub_dt=None, correction=False):
+    """Baked spectral tables for the expo step, computed in float64 on
+    the host (np.expm1 keeps phi1 = expm1(z)/z exact through z -> 0; the
+    z ~ 0 series covers the DC mode where lambda = 0 exactly) and cast
+    once to the compute dtype: ``(E, P)`` = (e^{lambda*dt},
+    dt*phi1(lambda*dt)) at the (sub)step size, plus — with the boundary
+    correction armed — ``Eh`` = e^{lambda*dt/2} (the midpoint-quadrature
+    damping) and the symbol ``lam`` itself (the commutator's operator
+    applies)."""
     from nonlocalheatequation_tpu.ops.spectral import operator_symbol
 
     lam = operator_symbol(op, shape)
-    z = lam * op.dt
+    dt = op.dt if sub_dt is None else sub_dt
+    z = lam * dt
     small = np.abs(z) < 1e-12
     z_safe = np.where(small, 1.0, z)
     phi1 = np.where(small, 1.0 + z / 2.0, np.expm1(z_safe) / z_safe)
     E = np.exp(z)
-    P = op.dt * phi1
+    P = dt * phi1
     real = jnp.zeros((), dtype).real.dtype
-    return jnp.asarray(E, real), jnp.asarray(P, real)
+    out = (jnp.asarray(E, real), jnp.asarray(P, real))
+    if correction:
+        out = out + (jnp.asarray(np.exp(0.5 * z), real),
+                     jnp.asarray(lam, real))
+    return out
 
 
-def _make_expo_step(op, g, lg, dtype):
+def _make_expo_step(op, g, lg, dtype, stages: int = 0):
     """(u, t) -> u after ONE dt via spectral ETD1 (module docstring).
-    The collar is re-imposed every step by the zero-embedding itself."""
+    The collar is re-imposed every step by the zero-embedding itself.
+
+    ``stages = S >= 1`` arms the low-rank boundary correction: the step
+    runs S corrected substeps of dt/S, each adding the propagator-damped
+    midpoint Duhamel quadrature ``(sub/2) e^{L sub/2} D e^{L sub/2}`` of
+    the collar-projection commutator ``D v = Pi L Pi v - L v`` (module
+    docstring derivation; ~4x the transforms of the plain step per
+    substep).  ``stages=0`` is the legacy interior-exact step,
+    bit-identical by construction."""
     from nonlocalheatequation_tpu.ops.spectral import fft_box
     from nonlocalheatequation_tpu.utils.compat import irfftn, rfftn
 
     validate_stepper(op, "expo")
     test = g is not None
     dt = op.dt
+    S = max(0, int(stages))
     if test:
         g = np.asarray(g, np.float64)
         lg = np.asarray(lg, np.float64)
@@ -252,17 +287,48 @@ def _make_expo_step(op, g, lg, dtype):
         box = fft_box(u.shape, op.eps)
         key = (u.shape, jnp.dtype(u.dtype).name)
         if key not in tables:
-            tables[key] = _expo_tables(op, u.shape, u.dtype)
-        E, P = tables[key]
+            tables[key] = _expo_tables(op, u.shape, u.dtype,
+                                       sub_dt=dt / max(1, S),
+                                       correction=bool(S))
         pad = [(0, b - s_) for s_, b in zip(u.shape, box)]
-        uh = rfftn(jnp.pad(op._operand(u), pad))
-        uh = E * uh
+        dom = tuple(slice(0, s_) for s_ in u.shape)
+        bh = None
         if test:
             b_t = source_at(jnp.asarray(g, u.dtype),
                             jnp.asarray(lg, u.dtype), t, dt)
-            uh = uh + P * rfftn(jnp.pad(b_t, pad))
-        out = irfftn(uh, s=box)
-        return out[tuple(slice(0, s_) for s_ in u.shape)]
+            bh = rfftn(jnp.pad(b_t, pad))
+        uh = rfftn(jnp.pad(op._operand(u), pad))
+        if not S:
+            E, P = tables[key]
+            uh = E * uh
+            if test:
+                uh = uh + P * bh
+            return irfftn(uh, s=box)[dom]
+        E, P, Eh, lam = tables[key]
+        sub = dt / S
+
+        def project(v):
+            # Pi: re-impose the volumetric collar (zero outside the
+            # domain block of the periodic box)
+            return jnp.pad(v[dom], pad)
+
+        cur_h = uh
+        for i in range(S):
+            mid_h = Eh * cur_h
+            base_h = Eh * mid_h  # = E * cur_h, via the damped midpoint
+            if test:
+                base_h = base_h + P * bh
+            mid = irfftn(mid_h, s=box)
+            # D(mid) = Pi L Pi mid - L mid: the collar-projection
+            # commutator, supported on the eps boundary band (low-rank)
+            d = project(irfftn(lam * rfftn(project(mid)), s=box)) \
+                - irfftn(lam * mid_h, s=box)
+            cur_h = base_h + (0.5 * sub) * (Eh * rfftn(d))
+            if i + 1 < S:
+                # the projected propagator: collar re-zeroed between
+                # substeps, exactly as the step boundary does
+                cur_h = rfftn(project(irfftn(cur_h, s=box)))
+        return irfftn(cur_h, s=box)[dom]
 
     return step
 
@@ -276,7 +342,7 @@ def make_step_fn(op, g=None, lg=None, dtype=None, stepper: str = "euler",
     validate_stepper(op, stepper, stages)
     if stepper == "rkc":
         return _make_rkc_step(op, g, lg, dtype, stages)
-    return _make_expo_step(op, g, lg, dtype)
+    return _make_expo_step(op, g, lg, dtype, stages)
 
 
 def _maybe_tune_method(op, g):

@@ -39,6 +39,9 @@ from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D, source_at
 from nonlocalheatequation_tpu.parallel.halo import halo_pad_2d
 from nonlocalheatequation_tpu.parallel.mesh import grid_sharding, make_mesh
+from nonlocalheatequation_tpu.parallel.stepper_halo import (
+    validate_dist_stepper as _validate_dist_stepper,
+)
 from nonlocalheatequation_tpu.parallel.multihost import fetch_global, put_global
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
 
@@ -89,6 +92,8 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         precision: str = "f32",
         resync_every: int = 0,
         comm: str = "collective",
+        stepper: str = "euler",
+        stages: int = 0,
     ):
         self.nx, self.ny, self.npx, self.npy = int(nx), int(ny), int(npx), int(npy)
         self.NX, self.NY = self.nx * self.npx, self.ny * self.npy
@@ -129,6 +134,17 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         # apply_padded/neighbor_sum_padded call rounds its operand there
         self.op = NonlocalOp2D(eps, k, dt, dh, method=method,
                                precision=precision)
+        # stepper tier (ISSUE 13): rkc's Verwer stage loop sits ABOVE
+        # the halo exchange (parallel/stepper_halo.py) — every stage is
+        # one eps-halo apply, so the fused/collective transports serve
+        # it unchanged; with superstep K > 1 the stages batch into
+        # communication-avoiding groups of K.  expo is refused: its
+        # spectral embedding is whole-domain (a sharded block's halo
+        # carries neighbor data, not the zero collar — ops/spectral.py
+        # honesty boundary); the NumPy oracle has no distributed twin,
+        # so there is no oracle-backend rule to repeat here.
+        self.stepper, self.stages = _validate_dist_stepper(
+            self.op, stepper, stages)
         self.mesh = mesh if mesh is not None else choose_mesh_for_grid(self.NX, self.NY)
         self.logger = logger
         self.dtype = dtype
@@ -214,7 +230,41 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         # the shallower remainder program and K == 1 segments
         src_halo = (self.ksteps - 1) * eps
 
-        if self.ksteps == 1:
+        if self.stepper == "rkc":
+            # the distributed stepper tier (parallel/stepper_halo.py):
+            # the Verwer stage loop above the exchange — per-stage
+            # fused/collective applies at ksteps == 1, communication-
+            # avoiding stage batches of K at ksteps > 1.  One program
+            # advances ONE dt, so the runner scans it per step (the
+            # ksteps arg here is the Euler-levels count and is always 1
+            # for rkc).
+            from nonlocalheatequation_tpu.parallel.stepper_halo import (
+                make_rkc_perstage_step,
+                make_rkc_stagebatch_step,
+            )
+
+            if self.ksteps == 1:
+                if self.comm == "fused":
+                    from nonlocalheatequation_tpu.ops.pallas_halo import (
+                        make_fused_apply,
+                    )
+
+                    apply_blk = make_fused_apply(op, mesh_shape,
+                                                 ("x", "y"))
+                else:
+                    def apply_blk(u_blk):
+                        return op.apply_padded(
+                            halo_pad_2d(u_blk, eps, mesh_shape))
+                local_step = make_rkc_perstage_step(
+                    op, self.stages, apply_blk, self.test)
+            else:
+                local_step = make_rkc_stagebatch_step(
+                    op, self.stages, self.ksteps,
+                    lambda x, w: halo_pad_2d(x, w, mesh_shape),
+                    ("x", "y"), (NX, NY), self.test, src_halo)
+            in_specs = ((spec, spec, spec, P()) if self.test
+                        else (spec, P()))
+        elif self.ksteps == 1:
             if self.comm == "fused":
                 # the fused-exchange operator (ops/pallas_halo.py):
                 # remote-DMA halos inside the kernel on TPU, the same
@@ -358,7 +408,13 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
             mesh_shape, block, self.eps,
             "fused" if transport == "rdma" else "collective", itemsize)
         ndev = int(np.prod(mesh_shape))
-        rounds = -(-steps // self.ksteps)  # one exchange per (super)step
+        if self.stepper == "rkc":
+            # one exchange round per stage BATCH (ceil(s/K) per step;
+            # per-stage at K == 1) — stats keep the eps-band basis the
+            # Euler superstep uses, so the counters stay comparable
+            rounds = steps * -(-self.stages // self.ksteps)
+        else:
+            rounds = -(-steps // self.ksteps)  # one per (super)step
         REGISTRY.counter("/halo/exchanges").inc(
             rounds * stats["messages"] * ndev)
         REGISTRY.counter("/halo/bytes").inc(
@@ -394,8 +450,11 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
             # in the trace, which a mesh spanning processes cannot do.
             # A segment of `count` steps runs q supersteps of K plus one
             # shallower remainder superstep (K == 1 is today's per-step
-            # scan unchanged: q = count, r = 0).
-            K = max(1, min(self.ksteps, count))
+            # scan unchanged: q = count, r = 0).  An rkc step advances
+            # ONE dt (ksteps batches STAGES inside it), so its runner is
+            # always the per-step scan.
+            K = (1 if self.stepper == "rkc"
+                 else max(1, min(self.ksteps, count)))
             q, r = divmod(count, K)
             rkey = (count, self.test)
             run = self._runner_cache.get(rkey)

@@ -25,6 +25,9 @@ from nonlocalheatequation_tpu.models.metrics import ManufacturedMetrics2D
 from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp3D, source_at
 from nonlocalheatequation_tpu.parallel.halo import halo_pad_nd
 from nonlocalheatequation_tpu.parallel.mesh import grid_sharding_3d, make_mesh_3d
+from nonlocalheatequation_tpu.parallel.stepper_halo import (
+    validate_dist_stepper as _validate_dist_stepper,
+)
 from nonlocalheatequation_tpu.parallel.multihost import fetch_global, put_global
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
 
@@ -78,6 +81,8 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         superstep: int = 1,
         precision: str = "f32",
         comm: str = "collective",
+        stepper: str = "euler",
+        stages: int = 0,
     ):
         self.NX, self.NY, self.NZ = int(NX), int(NY), int(NZ)
         self.nt, self.eps, self.nlog = int(nt), int(eps), int(nlog)
@@ -87,6 +92,11 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         self.ksteps = max(1, int(superstep))
         self.op = NonlocalOp3D(eps, k, dt, dh, method=method,
                                precision=precision)
+        # stepper tier (ISSUE 13): see Solver2DDistributed — rkc's stage
+        # loop above the exchange, ksteps > 1 = stage batches; expo
+        # refused (whole-domain spectral embedding)
+        self.stepper, self.stages = _validate_dist_stepper(
+            self.op, stepper, stages)
         self.mesh = (
             mesh if mesh is not None
             else choose_mesh_for_grid_3d(self.NX, self.NY, self.NZ)
@@ -146,7 +156,35 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         NX, NY, NZ = self.NX, self.NY, self.NZ
         src_halo = (self.ksteps - 1) * eps  # see the 2D solver
 
-        if self.ksteps == 1:
+        if self.stepper == "rkc":
+            # the distributed stepper tier — see the 2D solver's branch
+            # (parallel/stepper_halo.py is dimension-generic)
+            from nonlocalheatequation_tpu.parallel.stepper_halo import (
+                make_rkc_perstage_step,
+                make_rkc_stagebatch_step,
+            )
+
+            if self.ksteps == 1:
+                if self.comm == "fused":
+                    from nonlocalheatequation_tpu.ops.pallas_halo import (
+                        make_fused_apply,
+                    )
+
+                    apply_blk = make_fused_apply(op, mesh_shape, names)
+                else:
+                    def apply_blk(u_blk):
+                        return op.apply_padded(
+                            halo_pad_nd(u_blk, eps, mesh_shape, names))
+                local_step = make_rkc_perstage_step(
+                    op, self.stages, apply_blk, self.test)
+            else:
+                local_step = make_rkc_stagebatch_step(
+                    op, self.stages, self.ksteps,
+                    lambda x, w: halo_pad_nd(x, w, mesh_shape, names),
+                    names, (NX, NY, NZ), self.test, src_halo)
+            in_specs = ((spec, spec, spec, P()) if self.test
+                        else (spec, P()))
+        elif self.ksteps == 1:
             if self.comm == "fused":
                 # fused-exchange operator (ops/pallas_halo.py): see the
                 # 2D solver — remote-DMA halos in-kernel on TPU, the
@@ -272,7 +310,11 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
             mesh_shape, block, self.eps,
             "fused" if transport == "rdma" else "collective", itemsize)
         ndev = int(np.prod(mesh_shape))
-        rounds = -(-steps // self.ksteps)
+        if self.stepper == "rkc":
+            # see the 2D solver: one round per stage batch
+            rounds = steps * -(-self.stages // self.ksteps)
+        else:
+            rounds = -(-steps // self.ksteps)
         REGISTRY.counter("/halo/exchanges").inc(
             rounds * stats["messages"] * ndev)
         REGISTRY.counter("/halo/bytes").inc(
@@ -302,8 +344,11 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
             # source arrays enter as jit ARGUMENTS, not closure constants:
             # a constant capture would try to materialize the whole array
             # in the trace, which a mesh spanning processes cannot do.
-            # count steps = q supersteps of K + one shallower remainder.
-            K = max(1, min(self.ksteps, count))
+            # count steps = q supersteps of K + one shallower remainder
+            # (an rkc step advances ONE dt — ksteps batches stages
+            # inside it, so its runner is always the per-step scan).
+            K = (1 if self.stepper == "rkc"
+                 else max(1, min(self.ksteps, count)))
             q, r = divmod(count, K)
             step_K = get_step(K)
             step_r = get_step(r) if r else None

@@ -346,6 +346,8 @@ GANG_SOLVER_CACHE_CAP = 8
 def solve_case_sharded(case, *, ndevices: int | None = None,
                        comm: str = "fused", method: str = "auto",
                        precision: str = "f32", dtype=None,
+                       stepper: str = "euler", stages: int = 0,
+                       superstep: int = 1,
                        solver_cache: dict | None = None,
                        cache_cap: int | None = None):
     """Solve ONE big ensemble case as a space-parallel distributed run
@@ -371,6 +373,18 @@ def solve_case_sharded(case, *, ndevices: int | None = None,
     recorded honestly in the returned info dict, and numerics-neutral
     either way (the fused path is pinned bitwise against the
     collective oracle by the PR 6 suite).
+
+    ``stepper``/``stages`` thread the super-stepping tier through the
+    sharded case class (ISSUE 13): ``stepper='rkc'`` runs the Verwer
+    stage loop above the per-stage halo exchange
+    (parallel/stepper_halo.py — fused transports serve it unchanged),
+    so fleet-served big cases take dt up to beta(s)/2 past the Euler
+    bound; ``superstep`` K > 1 batches the stages into
+    communication-avoiding groups.  The tier keeps the adapter
+    contract: the gang worker and the offline oracle call THIS function
+    with the same arguments, so sharded rkc results stream back
+    bit-identical to the offline distributed-rkc solve.  ``expo`` is
+    refused by the solver (whole-domain spectral embedding).
 
     ``solver_cache`` (a plain dict the caller owns) memoizes the
     constructed solver — and through Solver2DDistributed's own
@@ -408,7 +422,7 @@ def solve_case_sharded(case, *, ndevices: int | None = None,
            float(case.dt), float(case.dh), bool(case.test),
            comm, method, precision,
            jnp.dtype(dtype).name if dtype is not None else None,
-           len(devs))
+           len(devs), stepper, int(stages), int(superstep))
     if cache_cap is None:
         cache_cap = int(os.environ.get("NLHEAT_GANG_SOLVER_CAP")
                         or GANG_SOLVER_CACHE_CAP)
@@ -424,7 +438,9 @@ def solve_case_sharded(case, *, ndevices: int | None = None,
         kw = dict(nx=NX // mx, ny=NY // my, npx=mx, npy=my,
                   nt=int(case.nt), eps=int(case.eps), k=float(case.k),
                   dt=float(case.dt), dh=float(case.dh), mesh=mesh,
-                  method=method, precision=precision, dtype=dtype)
+                  method=method, precision=precision, dtype=dtype,
+                  stepper=stepper, stages=int(stages),
+                  superstep=int(superstep))
         used = comm
         try:
             solver = Solver2DDistributed(comm=comm, **kw)
@@ -461,6 +477,10 @@ def solve_case_sharded(case, *, ndevices: int | None = None,
         "devices": len(devs),
         "axes": mesh_axis_network(solver.mesh),
     }
+    if stepper != "euler":
+        # super-stepping evidence for the fleet telemetry / bench gates
+        info["stepper"] = stepper
+        info["stages"] = int(stages)
     if case.test:
         info["error_l2"] = float(solver.error_l2)
     return values, info

@@ -1,0 +1,211 @@
+"""Distributed super-stepping: the RKC stage loop over the halo mesh.
+
+PR 7 put the stepper tier (models/steppers.py) above the single-device
+method dispatch; this module puts it above the DISTRIBUTED transports
+(ISSUE 13, ROADMAP item 3 — the two biggest speedups finally meet).
+The key structural fact: every RKC stage is exactly one eps-halo
+operator apply, so the stage loop composes with the existing exchange
+machinery unchanged:
+
+* **Per-stage exchange** (``ksteps == 1``) — each stage's RHS is the
+  solver's own ``apply_blk`` (``halo_pad + apply_padded`` on the
+  collective transport, the remote-DMA fused kernel on ``comm='fused'``,
+  ops/pallas_halo.py).  The Verwer recurrence is evaluated with exactly
+  the expression order of the single-device ``_make_rkc_step``
+  (models/steppers.py), so per-stage distributed RKC matches the
+  single-device RKC oracle the way the Euler per-step path matches the
+  serial oracle — elementwise-identical programs over an exchange that
+  reconstructs the same neighborhoods (pinned <= 1e-12 by
+  tests/test_distributed_rkc.py, fused AND collective).
+* **Stage batches** (``ksteps = K > 1``) — the communication-avoiding
+  composition: ONE exchange ROUND per batch of B = K stages (a
+  (B*eps)-wide halo on the leading carry plus a ((B-1)*eps)-wide one on
+  the trailing carry — two independent band sets launched together, one
+  dependency point), then B local stages on shrinking margins (eps per
+  stage), with the volumetric collar re-zeroed and
+  ``optimization_barrier``-pinned on every intermediate margin — the
+  distributed Euler superstep's trapezoidal schedule
+  (parallel/distributed2d.py ``_superstep``) applied to STAGES within
+  one dt instead of steps.  Exchange rounds per timestep drop from s to
+  ceil(s/K) while exchanged bytes rise ~(2 - 1/K)x — the classic
+  latency-for-bandwidth trade of every communication-avoiding schedule,
+  the right direction on the ~64 ms-per-dispatch tunnel and on DCN-edge
+  meshes.  Ring cells owned by neighbors are recomputed locally from
+  the same values with the same elementwise program, so results agree
+  with the per-stage form to the <= 1e-12 oracle contract (the level
+  order shifts last-ulp rounding, exactly like the Euler superstep).
+
+Sources are frozen at the step start (first order, matching the
+single-device scheme): every stage of a timestep reads the source at
+the SAME t, which is also why the stage-batch form needs only the
+``(ksteps-1)*eps``-wide pre-padded source ring the Euler superstep
+already prepares (``_prep_sources``).
+
+Dimension-generic: the 2D and 3D distributed solvers pass their own
+``pad``/axis names/global extents; everything here works on tuples.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from nonlocalheatequation_tpu.models.steppers import (
+    STEPPERS,
+    _rkc_coeffs,
+    validate_stepper,
+)
+from nonlocalheatequation_tpu.ops.nonlocal_op import source_at
+
+
+def validate_dist_stepper(op, stepper: str, stages: int) -> tuple:
+    """Stepper validation for the DISTRIBUTED solvers: the single-device
+    model checks (models/steppers.validate_stepper — unknown names, rkc
+    stage count, the rkc dt-vs-beta(s) stability bound) plus the
+    distributed-tier rule: ``expo`` is refused because its spectral
+    embedding is exact only for the whole-domain zero collar — a sharded
+    block's halo carries neighbor data (ops/spectral.py honesty
+    boundary), and rkc owns the distributed super-stepping claim.
+    Returns the canonical ``(stepper, stages)`` pair."""
+    if stepper not in STEPPERS:
+        raise ValueError(
+            f"unknown stepper {stepper!r}; one of {STEPPERS}")
+    if stepper == "expo":
+        raise ValueError(
+            "stepper='expo' integrates the whole-domain spectral symbol "
+            "and cannot serve sharded blocks (their halos carry neighbor "
+            "data, not the zero collar); run expo on the serial solver — "
+            "rkc super-steps the distributed path")
+    validate_stepper(op, stepper, stages)
+    return stepper, int(stages)
+
+
+def make_rkc_perstage_step(op, stages: int, apply_blk, test: bool):
+    """The per-stage-exchange RKC block step: ``(u_blk, [g_blk, lg_blk,]
+    t) -> u_blk`` after ONE dt, where every stage RHS is one
+    ``apply_blk`` call (one halo exchange — fused or collective, the
+    caller's choice).  Expression order mirrors the single-device
+    ``_make_rkc_step`` exactly (the 1e-12 oracle contract rides on it).
+    """
+    co = _rkc_coeffs(stages)
+    s = co["s"]
+    dt = op.dt
+
+    def step(u_blk, *rest):
+        if test:
+            g_blk, lg_blk, t = rest
+        else:
+            (t,) = rest
+
+        def rhs(y):
+            du = apply_blk(y)
+            if test:
+                du = du + source_at(g_blk, lg_blk, t, dt)
+            return du
+
+        y_prev2 = u_blk
+        y_prev = u_blk + (co["mut"][1] * dt) * rhs(u_blk)
+        for j in range(2, s + 1):
+            y = (co["mu"][j] * y_prev + co["nu"][j] * y_prev2
+                 + (co["mut"][j] * dt) * rhs(y_prev))
+            y_prev2, y_prev = y_prev, y
+        return y_prev
+
+    return step
+
+
+def make_rkc_stagebatch_step(op, stages: int, ksteps: int, pad,
+                             axis_names, grid_N, test: bool,
+                             src_halo: int):
+    """The communication-avoiding RKC block step: stages grouped into
+    batches of ``ksteps``, one exchange round per batch (the module
+    docstring's schedule and byte accounting).  ``pad(x, w)`` is the solver's halo
+    transport (``halo_pad_2d``/``halo_pad_nd`` partials), ``axis_names``
+    the mesh axis names (block origin via ``lax.axis_index``),
+    ``grid_N`` the global extents (the volumetric collar mask), and
+    ``src_halo`` the pre-padded source ring width ``(ksteps-1)*eps``
+    (test mode receives the ring-padded ``gp``/``lgp`` blocks the Euler
+    superstep's ``_prep_sources`` builds).  Signature:
+    ``(u_blk, [gp_blk, lgp_blk,] t) -> u_blk`` after ONE dt."""
+    co = _rkc_coeffs(stages)
+    s = co["s"]
+    K = int(ksteps)
+    eps = int(op.eps)
+    dt = op.dt
+    nd = len(axis_names)
+
+    def step(u_blk, *rest):
+        if test:
+            gp, lgp, t = rest
+        else:
+            (t,) = rest
+        bshape = u_blk.shape
+        origin = tuple(lax.axis_index(nm) * b
+                       for nm, b in zip(axis_names, bshape))
+
+        def crop(arr, m_from: int, m_to: int):
+            d = m_from - m_to
+            starts = (d,) * nd
+            return lax.slice(
+                arr, starts,
+                tuple(d + b + 2 * m_to for b in bshape))
+
+        def mask_collar(arr, m: int):
+            # volumetric BC on intermediates: margin cells outside the
+            # global domain stay zero at every stage, and the barrier
+            # pins the stage boundary (the Euler superstep's ulp rule)
+            ok = None
+            for ax, (start, Ngl) in enumerate(zip(origin, grid_N)):
+                c = (start - m) + lax.broadcasted_iota(
+                    jnp.int32, arr.shape, ax)
+                in_ax = (c >= 0) & (c < Ngl)
+                ok = in_ax if ok is None else ok & in_ax
+            arr = jnp.where(ok, arr, jnp.zeros_like(arr))
+            return lax.optimization_barrier(arr)
+
+        def src_at_margin(m: int):
+            o = src_halo - m
+            starts = (o,) * nd
+            limits = tuple(o + b + 2 * m for b in bshape)
+            return (lax.slice(gp, starts, limits),
+                    lax.slice(lgp, starts, limits))
+
+        j = 1  # next stage to run (1..s)
+        y_prev = u_blk  # margin 0 at batch entry
+        y_prev2 = None
+        while j <= s:
+            B = min(K, s - j + 1)
+            # the batch's exchange round: both carries' bands launch
+            # together (independent ppermutes, one dependency point)
+            Pp = pad(y_prev, B * eps)
+            p_m = B * eps
+            Pq, q_m = (None, 0)
+            if y_prev2 is not None and B > 1:
+                Pq, q_m = pad(y_prev2, (B - 1) * eps), (B - 1) * eps
+            elif y_prev2 is not None:
+                Pq, q_m = y_prev2, 0
+            for i in range(B):
+                m = (B - 1 - i) * eps
+                du = op.apply_padded(Pp)  # margin p_m -> p_m - eps == m
+                if test:
+                    gs, lgs = src_at_margin(m)
+                    # every stage reads the source at the STEP's t (the
+                    # single-device scheme freezes it there too)
+                    du = du + source_at(gs, lgs, t, dt)
+                base = crop(Pp, p_m, m)
+                if j == 1:
+                    y = base + (co["mut"][1] * dt) * du
+                else:
+                    y = (co["mu"][j] * base
+                         + co["nu"][j] * crop(Pq, q_m, m)
+                         + (co["mut"][j] * dt) * du)
+                if m > 0:
+                    y = mask_collar(y, m)
+                Pq, q_m = Pp, p_m
+                Pp, p_m = y, m
+                j += 1
+            y_prev = Pp  # margin 0 (the batch's last stage)
+            y_prev2 = crop(Pq, q_m, 0) if Pq is not None else None
+        return y_prev
+
+    return step

@@ -309,6 +309,29 @@ class EnsembleEngine:
         kw.update(overrides)
         return EnsembleEngine(**kw)
 
+    def engine_key(self) -> tuple:
+        """This engine's position on the picker's axes (serve/picker.py
+        ``EngineChoice.key()``): the pool key the serving pipeline
+        routes picked cases by."""
+        return (self.stepper, self.stages, self.method, self.precision)
+
+    def engine_for(self, stepper: str, stages: int, method: str,
+                   precision: str) -> "EnsembleEngine":
+        """A sibling configured for a PICKED engine (serve/picker.py):
+        the stepper x stages x method x precision axes overridden, the
+        variant forced to 'auto' (an operator-pinned Euler-only variant
+        must not refuse a picked rkc bucket) and the superstep depth
+        kept only where it applies (the Euler pallas schedules).
+        Returns ``self`` when the pick IS this engine's configuration —
+        the common case of a fleet whose default engine already
+        matches."""
+        if (stepper, int(stages), method, precision) == self.engine_key():
+            return self
+        return self.sibling(
+            stepper=stepper, stages=int(stages), method=method,
+            precision=precision, variant="auto",
+            ksteps=self.ksteps if stepper == "euler" else 0)
+
     # -- case -> operator ---------------------------------------------------
     def _make_op(self, case: EnsembleCase):
         from nonlocalheatequation_tpu.ops.nonlocal_op import (

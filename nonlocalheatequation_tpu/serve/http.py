@@ -6,10 +6,19 @@ machinery from telemetry to a REQUEST API over a serving backend (a
 :class:`~nonlocalheatequation_tpu.serve.router.ReplicaRouter`, or
 anything with ``submit``/``outstanding_total``/``retry_after_s``):
 
-* ``POST /v1/cases`` — submit one case (JSON body: ``shape``, ``nt``,
-  ``eps``, ``k``, ``dt``, ``dh``, optional ``test``/``u0``/
-  ``deadline_ms``/``priority``).  Returns 202 ``{"id": N}``, or **429 +
-  Retry-After** when admission control sheds.
+* ``POST /v1/cases`` — submit one case.  Two forms (ISSUE 13):
+  the EXPLICIT form (JSON body: ``shape``, ``nt``, ``eps``, ``k``,
+  ``dt``, ``dh``, optional ``test``/``u0``/``deadline_ms``/
+  ``priority``) runs the fleet's default engine at the caller's
+  schedule; the PICKED form replaces ``nt``/``dt`` with ``T_final`` +
+  ``accuracy`` (error_l2/#points target) and lets the engine picker
+  (serve/picker.py) choose the cheapest stepper x stages x method x
+  precision meeting accuracy — and ``deadline_ms``, which in this form
+  also bounds the modeled compute.  Returns 202 ``{"id": N}`` (picked
+  form adds the chosen ``engine``/``nt``/``dt`` evidence), **422**
+  when no engine meets accuracy+deadline (the picker refuses loudly,
+  never silently serves a miss), or **429 + Retry-After** when
+  admission control sheds.
 * ``GET /v1/cases/<id>`` — poll: ``{"status": "queued"|"done"|"failed"}``
   plus latency/replica detail; ``?wait=1`` (optional ``&timeout_s=T``)
   blocks until the case completes — the stream/wait form.
@@ -52,6 +61,7 @@ from nonlocalheatequation_tpu.obs.export import (
 )
 from nonlocalheatequation_tpu.obs.trace import TraceContext
 from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+from nonlocalheatequation_tpu.serve.picker import PickerRefusal, pick_engine
 from nonlocalheatequation_tpu.serve.router import RouterOverloaded
 
 #: Completed requests retained for polling (an abandoned client must not
@@ -117,16 +127,20 @@ class AdmissionController:
         return hint
 
     def try_submit(self, case: EnsembleCase, *, deadline_ms=None,
-                   priority: int = 0, trace=None):
+                   priority: int = 0, trace=None, engine=None):
         """``(request, None)`` when admitted, ``(None, retry_after_s)``
         when shed (by this gate or the router's hard cap).  ``trace``
-        (a TraceContext) is forwarded to the backend only when present,
-        so trace-less callers and router-shaped stubs are untouched."""
+        (a TraceContext) and ``engine`` (a picked
+        :class:`~nonlocalheatequation_tpu.serve.picker.EngineChoice`)
+        are forwarded to the backend only when present, so plain
+        callers and router-shaped stubs are untouched."""
         retry = self.check()
         if retry is not None:
             self._m_shed.inc()
             return None, retry
         kw = {"trace": trace} if trace is not None else {}
+        if engine is not None:
+            kw["engine"] = engine
         try:
             req = self.backend.submit(case, deadline_ms=deadline_ms,
                                       priority=priority, **kw)
@@ -277,13 +291,20 @@ class IngressServer:
                 raise ValueError(
                     f"case body must be a JSON object, got "
                     f"{type(body).__name__}")
-            case = parse_case(body)
+            case, picked = self._parse_body(body)
+        except PickerRefusal as e:
+            # no engine meets accuracy+deadline: the request's contract
+            # is unservable — a client 422 naming the best infeasible
+            # candidate, never a silently-slow or silently-wrong solve
+            h._json(422, {"error": str(e), "refused": "picker"})
+            return
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             h._json(400, {"error": str(e)})
             return
         req, retry = self.admission.try_submit(
             case, deadline_ms=body.get("deadline_ms"),
-            priority=body.get("priority") or 0, trace=ctx)
+            priority=body.get("priority") or 0, trace=ctx,
+            engine=picked)
         if req is None:
             if tr is not None and ctx is not None:
                 tr.instant("ingress.shed", cat="ingress",
@@ -312,9 +333,62 @@ class IngressServer:
                 tr.complete("ingress.request", t0, now, cat="ingress",
                             trace=ctx.trace_id, req=req.seq,
                             replica=req.replica)
-        h._json(202, {"id": req.seq, "status": "queued",
-                      **({"trace": ctx.trace_id} if ctx is not None
-                         else {})}, headers=headers)
+        resp = {"id": req.seq, "status": "queued"}
+        if picked is not None:
+            # the pick's evidence: which engine serves the case and the
+            # schedule it chose — auditable, never a black box
+            resp["engine"] = picked.wire()
+            resp["nt"] = picked.steps
+            resp["dt"] = picked.dt
+        if ctx is not None:
+            resp["trace"] = ctx.trace_id
+        h._json(202, resp, headers=headers)
+
+    def _parse_body(self, body: dict):
+        """Both POST forms (module docstring): returns ``(case,
+        picked)`` — picked None for the explicit nt/dt form.  The
+        picked form routes accuracy/T_final through the engine picker
+        with the fleet's engine base and, for a case bound for the
+        sharded tier, the stencil-only candidate axis (the spectral
+        embedding cannot serve halo-padded blocks)."""
+        if "accuracy" not in body and "T_final" not in body:
+            return parse_case(body), None
+        for bad in ("nt", "dt"):
+            if bad in body:
+                raise ValueError(
+                    f"a picked-engine case names the contract "
+                    f"(T_final + accuracy), not the schedule: drop "
+                    f"{bad!r} — the picker chooses dt/steps — or drop "
+                    "accuracy/T_final for the explicit form")
+        for need in ("accuracy", "T_final"):
+            if need not in body:
+                raise ValueError(
+                    f"the picked form needs both T_final and accuracy "
+                    f"(missing {need!r})")
+        shape = tuple(int(s) for s in body["shape"])
+        eps = int(body["eps"])
+        k = float(body["k"])
+        dh = float(body["dh"])
+        T_final = float(body["T_final"])
+        accuracy = float(body["accuracy"])
+        deadline = body.get("deadline_ms")
+        if deadline is not None and (
+                not isinstance(deadline, (int, float)) or deadline <= 0):
+            raise ValueError(
+                f"deadline_ms must be a number > 0, got {deadline!r}")
+        thr = getattr(self.backend, "shard_threshold", None)
+        sharded = (thr is not None and len(shape) == 2
+                   and int(np.prod(shape)) > thr)
+        ek = getattr(self.backend, "engine_kwargs", None) or {}
+        picked = pick_engine(
+            shape, eps, k, dh, T_final, accuracy,
+            deadline_ms=deadline, method=ek.get("method", "auto"),
+            allow_fft=not sharded)
+        case = parse_case({
+            k2: v for k2, v in body.items()
+            if k2 not in ("accuracy", "T_final")
+        } | {"nt": picked.steps, "dt": picked.dt})
+        return case, picked
 
     def _get(self, h) -> None:
         path, _, query = h.path.partition("?")

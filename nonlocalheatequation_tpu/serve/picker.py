@@ -1,0 +1,357 @@
+"""Deadline-aware engine picker: (physics, grid, T_final, accuracy,
+deadline_ms) -> the cheapest engine that meets both targets.
+
+The serving stack used to make USERS name kernels: a request carried
+``nt``/``dt`` plus whatever ``--stepper/--method/--precision`` the fleet
+was launched with, and picking the 50x-fewer-steps integrator (PR 7) or
+the stencil<->fft crossover (``utils/autotune.pick_op_method``) was the
+operator's job.  This module is that autotune dimension generalized
+across **stepper x stages x method x precision** (ISSUE 13) and closed
+over the request's real contract — an accuracy target and a deadline:
+
+* **Stability model** — ``ops/constants.stable_dt`` (the single source
+  of truth since ISSUE 8) caps each candidate's dt at the benches' 0.8x
+  headroom (``models/steppers.superstep_floor``'s rule); expo is
+  unconditionally stable (floor 1 step).
+* **Accuracy model** — every shipped stepper is first order, so the
+  manufactured-solution class (``u = cos(2 pi t) G(x)``, the protocol
+  every test/bench case runs) carries a closed-form time-discretization
+  error: local truncation ``(2 pi)^2 dt^2 / 2`` accumulated over
+  ``T/dt`` steps gives ``err(x, T) ~ 0.5 T (2 pi)^2 dt G(x)``, hence
+  ``error_l2/#points ~ (0.5 T (2 pi)^2 dt)^2 mean(G^2)`` with
+  ``mean(G^2) = 0.5^d`` for the cosine-product profile.  The model is
+  applied with :data:`ERR_SAFETY` margin and was checked against
+  measured errors (factor ~2 conservative at the probe configs,
+  docs/round15.md); a candidate whose modeled error exceeds
+  ``accuracy`` at its stability-capped dt is INFEASIBLE — the picker
+  never gambles accuracy for the deadline.  bf16 candidates carry the
+  tier's measured error floor (``constants.BF16_L2_BUDGET``) on top.
+  expo is time-exact in the interior but its collar defect has no
+  closed per-request model, so expo candidates are opt-in
+  (``allow_expo`` / ``NLHEAT_PICK_EXPO=1`` — the caller asserts the
+  interior envelope; the stages arg arms the boundary correction).
+* **Cost model** — steps x operator applies per step (s for rkc, 1 for
+  euler, ~3.5 fft-equivalents per corrected expo substage) x
+  per-apply milliseconds.  Rates come from ``rate_fn`` when the caller
+  has one, else from the autotuner's persisted probe records
+  (:func:`record_rate_fn` — the tuned ms_per_step entries keyed by
+  device kind), else from the analytic proxy (stencil
+  ``O(N (2 eps + 1)^d)``, fft ``O(N_box log N_box)``) whose CONSTANTS
+  are relative-cost-grade: good enough to rank candidates, honest
+  enough for a deadline only to the order of magnitude — which is why
+  the refusal message names the model used.  The default is
+  deliberately backend-free: the picker runs in the ROUTER/ingress
+  process, which must never touch a JAX backend (the wedge
+  discipline), so looking up the device kind is the caller's opt-in.
+
+The selection is the cheapest feasible candidate; when nothing meets
+both targets the picker REFUSES loudly (:class:`PickerRefusal` names
+the best accuracy-feasible candidate and what it would cost) — it
+never silently serves an engine that misses the accuracy target.
+
+Env knobs (scrubbed in tests/conftest.py): ``NLHEAT_PICK_STAGES`` — the
+rkc stage ladder (comma list, default ``4,8,16,32``);
+``NLHEAT_PICK_EXPO=1`` — include the expo candidates.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+
+#: Default rkc stage ladder the picker enumerates (beta(s) ~ 2 s^2:
+#: dt reach ~15x/61x/246x/990x the Euler bound at the 0.8x headroom).
+STAGE_LADDER = (4, 8, 16, 32)
+
+#: Safety factor on the manufactured-class error model: the model
+#: neglects the diffusive decay of accumulated truncation error (it
+#: OVERestimates ~2x at the probe configs), so the margin guards the
+#: other direction — constant slop on unusually boundary-loaded or
+#: long-horizon requests.  A candidate is feasible only when
+#: ``ERR_SAFETY * modeled_error <= accuracy``.
+ERR_SAFETY = 4.0
+
+#: Analytic per-apply cost constants (nanoseconds per point-op), the
+#: backend-free fallback rate model.  Relative-cost grade; see the
+#: module docstring for the honesty boundary.
+NS_PER_STENCIL_POINT = 0.6
+NS_PER_FFT_POINT = 4.0
+
+#: Operator applies per corrected expo substage (the midpoint Duhamel
+#: correction costs ~3.5 fft round trips per substep; the plain step 1).
+EXPO_CORR_APPLIES = 3.5
+
+#: bf16 operand windows halve the bandwidth of the memory-bound stencil
+#: reads; the analytic model credits the tier conservatively.
+BF16_RATE = 0.7
+
+
+class PickerRefusal(ValueError):
+    """No engine meets the request's accuracy + deadline.  Loud by
+    design: the picker must never quietly select an engine that misses
+    the accuracy target, and a deadline nothing can meet is the
+    CLIENT's 422, not a silently slow solve."""
+
+    def __init__(self, message: str, best=None):
+        super().__init__(message)
+        self.best = best  # the cheapest accuracy-feasible EngineChoice
+
+
+@dataclass(frozen=True)
+class EngineChoice:
+    """One picked engine: the ensemble-engine settings plus the step
+    schedule (dt, steps) and the model's evidence (est_ms, est_err,
+    rate source) — everything a worker needs to run the case and a
+    client needs to audit the pick."""
+
+    stepper: str
+    stages: int
+    method: str
+    precision: str
+    dt: float
+    steps: int
+    est_ms: float
+    est_err: float
+    rates: str  # "measured" | "records" | "analytic"
+
+    def engine_kwargs(self) -> dict:
+        """The EnsembleEngine/sibling settings of this choice."""
+        return {"stepper": self.stepper, "stages": self.stages,
+                "method": self.method, "precision": self.precision}
+
+    def key(self) -> tuple:
+        """The engine-pool key (serve/server.py ``_engine_for``)."""
+        return (self.stepper, self.stages, self.method, self.precision)
+
+    def wire(self) -> dict:
+        """Frame/JSON form (serve/router.py case frames, the ingress
+        response)."""
+        return {"stepper": self.stepper, "stages": self.stages,
+                "method": self.method, "precision": self.precision,
+                "dt": self.dt, "steps": self.steps,
+                "est_ms": self.est_ms,
+                "est_err": self.est_err, "rates": self.rates}
+
+    @classmethod
+    def from_wire(cls, d):
+        if d is None:
+            return None
+        return cls(stepper=str(d["stepper"]), stages=int(d["stages"]),
+                   method=str(d["method"]), precision=str(d["precision"]),
+                   dt=float(d["dt"]), steps=int(d["steps"]),
+                   est_ms=float(d.get("est_ms", 0.0)),
+                   est_err=float(d.get("est_err", 0.0)),
+                   rates=str(d.get("rates", "analytic")))
+
+
+def _wsum(dim: int, eps: int) -> float:
+    import numpy as np
+
+    from nonlocalheatequation_tpu.ops.stencil import (
+        horizon_mask_1d,
+        horizon_mask_2d,
+        horizon_mask_3d,
+    )
+
+    mask = {1: horizon_mask_1d, 2: horizon_mask_2d,
+            3: horizon_mask_3d}[dim](eps)
+    return float(np.asarray(mask, np.float64).sum())
+
+
+def _c_const(dim: int, k: float, eps: int, h: float) -> float:
+    from nonlocalheatequation_tpu.ops import constants as C
+
+    return {1: C.c_1d, 2: C.c_2d, 3: C.c_3d}[dim](k, eps, h)
+
+
+def analytic_rate_fn(method: str, shape, eps: int,
+                     precision: str) -> float:
+    """Per-apply milliseconds from the backend-free analytic proxy
+    (module docstring honesty note): stencil O(N (2 eps + 1)^d), fft
+    O(N_box log2 N_box)."""
+    n = 1
+    for s in shape:
+        n *= int(s)
+    if method == "fft":
+        from nonlocalheatequation_tpu.ops.spectral import fft_box
+
+        nb = 1
+        for s in fft_box(shape, eps):
+            nb *= int(s)
+        ms = nb * max(1.0, math.log2(nb)) * NS_PER_FFT_POINT * 1e-6
+    else:
+        ms = n * (2 * eps + 1) ** len(shape) * NS_PER_STENCIL_POINT * 1e-6
+        if precision == "bf16":
+            ms *= BF16_RATE
+    return ms
+
+
+def record_rate_fn(device_kind: str, dtype_name: str = "float32",
+                   version: str | None = None):
+    """A rate_fn over the autotuner's persisted probe records
+    (utils/autotune file cache): per-apply ms from each method's
+    ``per-step`` entry where one exists, the analytic proxy otherwise.
+    ``device_kind`` is the CALLER's knowledge (a worker that already
+    touched its backend, a bench that measured) — the picker itself
+    stays backend-free."""
+    from nonlocalheatequation_tpu.utils.autotune import _load_file_cache
+
+    if version is None:
+        from nonlocalheatequation_tpu import __version__ as version
+    cache = _load_file_cache()
+
+    def rate(method, shape, eps, precision):
+        key = "/".join(
+            [f"v{version}", device_kind, method,
+             "x".join(str(int(s)) for s in shape), f"eps{eps}",
+             dtype_name]
+            + ([f"prec-{precision}"] if precision != "f32" else []))
+        entry = cache.get(key) or {}
+        ms = (entry.get("ms_per_step") or {}).get("per-step")
+        if isinstance(ms, (int, float)) and not isinstance(ms, bool):
+            return float(ms)
+        return analytic_rate_fn(method, shape, eps, precision)
+
+    return rate
+
+
+def _stage_ladder() -> tuple:
+    env = os.environ.get("NLHEAT_PICK_STAGES")
+    if not env:
+        return STAGE_LADDER
+    try:
+        ladder = tuple(sorted({int(t) for t in env.split(",") if t.strip()}))
+    except ValueError:
+        raise ValueError(
+            f"NLHEAT_PICK_STAGES must be a comma list of ints, got "
+            f"{env!r}") from None
+    if not ladder or any(s < 2 for s in ladder):
+        raise ValueError(
+            f"NLHEAT_PICK_STAGES needs stage counts >= 2, got {env!r}")
+    return ladder
+
+
+def modeled_error(dim: int, T_final: float, dt: float) -> float:
+    """The manufactured-class time-discretization error model (module
+    docstring): ``(0.5 T (2 pi)^2 dt)^2 * 0.5^d`` — error_l2/#points
+    units, the repo's accuracy currency."""
+    amp = 0.5 * T_final * (2.0 * math.pi) ** 2 * dt
+    return amp * amp * 0.5 ** dim
+
+
+def pick_engine(shape, eps: int, k: float, dh: float, T_final: float,
+                accuracy: float, deadline_ms: float | None = None, *,
+                method: str = "auto", rate_fn=None,
+                stages_ladder=None, allow_expo: bool | None = None,
+                allow_fft: bool = True,
+                expo_stages: int = 2) -> EngineChoice:
+    """The cheapest (stepper, stages, method, precision) engine meeting
+    ``accuracy`` (error_l2/#points, the manufactured contract's units)
+    and ``deadline_ms`` (None = no deadline) for a solve of ``T_final``
+    physical time on ``shape`` — or :class:`PickerRefusal`.
+
+    ``method`` is the fleet's stencil base ('auto' models as the conv/
+    sat stencil); the fft twin competes unless ``allow_fft=False`` (the
+    ingress disables it — and with it expo — for cases bound for the
+    SHARDED tier, whose halo-padded blocks the spectral embedding
+    cannot serve).  ``rate_fn(method, shape, eps, precision) -> ms`` is
+    the caller's measured cost model; default analytic (backend-free).
+    """
+    from nonlocalheatequation_tpu.ops.constants import (
+        BF16_L2_BUDGET,
+        stable_dt,
+    )
+
+    shape = tuple(int(s) for s in shape)
+    dim = len(shape)
+    if T_final <= 0:
+        raise ValueError(f"T_final must be > 0, got {T_final}")
+    if accuracy <= 0:
+        raise ValueError(f"accuracy must be > 0, got {accuracy}")
+    if deadline_ms is not None and deadline_ms <= 0:
+        raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+    rates_label = "measured" if rate_fn is not None else "analytic"
+    if rate_fn is None:
+        rate_fn = analytic_rate_fn
+    if allow_expo is None:
+        allow_expo = os.environ.get("NLHEAT_PICK_EXPO") == "1"
+    ladder = tuple(stages_ladder) if stages_ladder else _stage_ladder()
+    wsum = _wsum(dim, eps)
+    c = _c_const(dim, k, eps, dh)
+    stencil = method if method not in ("auto", "fft") else "auto"
+    if not allow_fft:
+        if method == "fft":
+            raise ValueError(
+                "allow_fft=False (a sharded-tier case) with a fleet "
+                "whose base method IS fft — no servable candidate axis")
+        methods = [stencil]
+        allow_expo = False  # expo is fft-only
+    else:
+        methods = [stencil, "fft"] if stencil != "fft" else ["fft"]
+
+    # accuracy cap on dt: ERR_SAFETY * model(dt) <= accuracy
+    dt_acc = math.sqrt(accuracy / (ERR_SAFETY * 0.5 ** dim)) / (
+        0.5 * T_final * (2.0 * math.pi) ** 2)
+
+    candidates: list[EngineChoice] = []
+    steppers = [("euler", 0)] + [("rkc", s) for s in ladder]
+    for m in methods:
+        for prec in ("f32", "bf16"):
+            if prec == "bf16" and (m == "fft"
+                                   or accuracy < ERR_SAFETY
+                                   * BF16_L2_BUDGET):
+                # the tier's measured error floor must fit inside the
+                # target with the same margin; the spectral path has no
+                # bf16 operand-window implementation
+                continue
+            for stepper, stages in steppers:
+                bound = stable_dt(c, dh, dim, wsum, stepper=stepper,
+                                  stages=stages)
+                dt = min(0.8 * bound, dt_acc)  # superstep_floor headroom
+                if not math.isfinite(dt) or dt <= 0:
+                    continue
+                steps = max(1, math.ceil(T_final / dt))
+                dt = T_final / steps
+                err = modeled_error(dim, T_final, dt)
+                if prec == "bf16":
+                    err = err + BF16_L2_BUDGET
+                if ERR_SAFETY * err > accuracy:
+                    continue  # infeasible: accuracy is never gambled
+                applies = steps * (stages if stepper == "rkc" else 1)
+                est_ms = applies * rate_fn(m, shape, eps, prec)
+                candidates.append(EngineChoice(
+                    stepper=stepper, stages=stages, method=m,
+                    precision=prec, dt=dt, steps=steps, est_ms=est_ms,
+                    est_err=err, rates=rates_label))
+    if allow_expo:
+        S = max(0, int(expo_stages))
+        # time-exact inside the interior envelope (caller-asserted);
+        # one step to any horizon, unconditionally stable
+        applies = max(1.0, EXPO_CORR_APPLIES * S)
+        candidates.append(EngineChoice(
+            stepper="expo", stages=S, method="fft", precision="f32",
+            dt=T_final, steps=1,
+            est_ms=applies * rate_fn("fft", shape, eps, "f32"),
+            est_err=0.0, rates=rates_label))
+
+    if not candidates:
+        raise PickerRefusal(
+            f"no engine meets accuracy {accuracy:g} for T_final="
+            f"{T_final:g} on {shape} (dt cap {dt_acc:g} from the "
+            f"{rates_label} error model; even the finest stable step "
+            "models past the target)")
+    candidates.sort(key=lambda ch: (ch.est_ms, ch.steps, ch.stages))
+    if deadline_ms is not None:
+        feasible = [ch for ch in candidates if ch.est_ms <= deadline_ms]
+        if not feasible:
+            best = candidates[0]
+            raise PickerRefusal(
+                f"no engine meets deadline {deadline_ms:g} ms at "
+                f"accuracy {accuracy:g} on {shape}: the cheapest "
+                f"accuracy-feasible engine ({best.stepper}"
+                f"[s={best.stages}]/{best.method}/{best.precision}, "
+                f"{best.steps} steps) models {best.est_ms:.1f} ms "
+                f"({best.rates} rates)", best=best)
+        return feasible[0]
+    return candidates[0]

@@ -112,6 +112,7 @@ from nonlocalheatequation_tpu.parallel.elastic import (
     fleet_scale_decision,
 )
 from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+from nonlocalheatequation_tpu.serve.picker import EngineChoice
 from nonlocalheatequation_tpu.serve.resilience import ServeError
 from nonlocalheatequation_tpu.serve.transport import (
     LEN as _LEN,
@@ -160,6 +161,9 @@ class RouterRequest:
         self.submit_t = submit_t
         self.deadline_ms = None
         self.priority = 0
+        #: picked engine (serve/picker.py EngineChoice) riding the case
+        #: frame to the worker; None = the fleet's default engine
+        self.engine = None
         self.trace: TraceContext | None = None  # fleet trace identity
         self.trace_minted = False  # router-minted (no ingress root)
         self._flow_started = False  # first flow hop already emitted
@@ -389,6 +393,7 @@ class ReplicaRouter:
         self._m_cases = r.counter("/router/cases")
         self._m_routed = r.counter("/router/routed")  # forwards, requeues incl
         self._m_sharded = r.counter("/router/sharded-cases")
+        self._m_picked = r.counter("/router/picked-cases")
         self._m_requeued = r.counter("/router/requeued")
         self._m_deaths = r.counter("/router/deaths")
         self._m_spawns = r.counter("/router/spawns")
@@ -724,19 +729,28 @@ class ReplicaRouter:
                                         len(r.outstanding), r.rid))
 
     def submit(self, case: EnsembleCase, *, deadline_ms: float | None = None,
-               priority: int = 0, trace=None) -> RouterRequest:
+               priority: int = 0, trace=None,
+               engine=None) -> RouterRequest:
         """Route one case; returns its handle.  Raises
         :class:`RouterOverloaded` when the fleet's bounded in-flight
         budget is exhausted (the ingress tier turns that into 429).
         ``trace`` is the ingress-minted TraceContext; a traced router
         mints one itself for direct (non-HTTP) submissions so the fleet
-        timeline still chains every span to a request identity."""
+        timeline still chains every span to a request identity.
+        ``engine`` is a picked engine (serve/picker.py
+        ``EngineChoice``): it rides the case frame — a pipeline worker
+        serves the case from its engine pool, the gang worker threads
+        the picked stepper/method through ``solve_case_sharded`` — so
+        BOTH case classes honor the pick; None is the fleet default."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("router is closed")
             req = RouterRequest(case, self._next_seq, self._clock())
             req.deadline_ms = deadline_ms
             req.priority = int(priority)
+            if engine is not None:
+                req.engine = engine
+                self._m_picked.inc()
             if trace is not None:
                 req.trace = trace if isinstance(trace, TraceContext) \
                     else TraceContext.from_wire(trace)
@@ -811,6 +825,10 @@ class ReplicaRouter:
         sent = rep.send({"op": "case", "id": req.seq, "case": req.case,
                          "deadline_ms": req.deadline_ms,
                          "priority": req.priority,
+                         # the picked engine rides the frame (wire dict,
+                         # not the dataclass — frames stay plain data)
+                         "engine": (req.engine.wire()
+                                    if req.engine is not None else None),
                          "trace": (req.trace.to_wire()
                                    if req.trace is not None else None)})
         self._m_routed.inc()
@@ -1542,13 +1560,27 @@ def _gang_loop(cfg: dict, out, poll, eof, tracer, trace_dir,
             try:
                 with obs_trace.span("gang.solve", cat="gang",
                                     case=msg.get("id")):
+                    # the picked engine (serve/picker.py) overrides the
+                    # fleet defaults per case — the sharded class honors
+                    # the pick too (ISSUE 13); expo/fft never reach here
+                    # (the ingress restricts sharded picks to stencil
+                    # methods, and solve_case_sharded refuses loudly if
+                    # one does)
+                    pe = msg.get("engine") or {}
                     values, info = solve_case_sharded(
                         msg["case"],
                         ndevices=gang.get("devices"),
                         comm=gang.get("comm", "fused"),
-                        method=ek.get("method", "auto"),
-                        precision=ek.get("precision", "f32"),
+                        method=pe.get("method",
+                                      ek.get("method", "auto")),
+                        precision=pe.get("precision",
+                                         ek.get("precision", "f32")),
                         dtype=ek.get("dtype"),
+                        stepper=pe.get("stepper",
+                                       ek.get("stepper", "euler")),
+                        stages=int(pe.get("stages",
+                                          ek.get("stages", 0) or 0)),
+                        superstep=int(ek.get("superstep", 1) or 1),
                         solver_cache=solver_cache)
                 with slock:
                     state["served"] += 1
@@ -1825,7 +1857,11 @@ def _worker_main(connect: str | None = None) -> None:
                                     deadline_ms=msg.get("deadline_ms"),
                                     priority=msg.get("priority") or 0,
                                     trace=TraceContext.from_wire(
-                                        msg.get("trace")))
+                                        msg.get("trace")),
+                                    # picked engine (serve/picker.py):
+                                    # served from the pipeline's pool
+                                    engine=EngineChoice.from_wire(
+                                        msg.get("engine")))
                 except Exception as e:  # noqa: BLE001 — a malformed
                     # case must complete EXCEPTIONALLY, not kill the
                     # worker (a poison frame would otherwise crash-loop

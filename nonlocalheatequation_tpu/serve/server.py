@@ -164,6 +164,9 @@ class ServeRequest:
     #: fleet trace identity (obs/trace.py TraceContext) when the case
     #: arrived through a traced front door; None otherwise (zero cost)
     trace: object = None
+    #: engine-pool key when the case carries a PICKED engine
+    #: (serve/picker.py); None = the pipeline's default engine
+    engine_sel: tuple | None = None
     result: np.ndarray | None = None
     error: ServeError | None = None
     queue_wait_s: float | None = None  # submit -> dispatch
@@ -176,7 +179,10 @@ class ServeRequest:
 
 
 class _OpenChunk:
-    """A bucket's accumulating chunk (not yet closed)."""
+    """A bucket's accumulating chunk (not yet closed).  ``key`` is the
+    OPEN-chunk key ``(bucket_key, engine_sel)`` — picked-engine cases
+    (serve/picker.py) never share a chunk with default-engine cases of
+    the same bucket, because the two compile different programs."""
 
     def __init__(self, key, opened_t):
         self.key = key
@@ -198,9 +204,11 @@ class _Chunk:
     looping back to ready on a supervised retry or being superseded by
     its two bisection halves."""
 
-    def __init__(self, chunk_id, key, requests, priority, closed_by):
+    def __init__(self, chunk_id, key, requests, priority, closed_by,
+                 engine_sel=None):
         self.chunk_id = chunk_id
-        self.key = key
+        self.key = key  # the BUCKET key (engine.build_program's shape)
+        self.engine_sel = engine_sel  # picked-engine pool key, or None
         self.requests = requests
         self.priority = priority
         self.closed_by = closed_by
@@ -516,6 +524,12 @@ class ServePipeline:
         self._fallback_on = bool(fallback)
         self._fallback: CpuFallback | None = None
         self._fallback_dead = False
+        #: picked-engine pool (serve/picker.py): engine_sel key ->
+        #: sibling engine sharing this pipeline's report/registry, plus
+        #: each sibling's own CPU fallback (a fallback chunk must run
+        #: the CHUNK's integrator, not the default engine's)
+        self._engines: dict = {}
+        self._fallbacks: dict = {}
         self._breaker = breaker
         # adopt_report, not plain assignment: an engine that already ran
         # (pre-warmed caches) may have bound its program store's metrics
@@ -610,28 +624,40 @@ class ServePipeline:
 
     # -- intake -------------------------------------------------------------
     def submit(self, case: EnsembleCase, *, deadline_ms: float | None = None,
-               priority: int = 0, trace=None) -> ServeRequest:
+               priority: int = 0, trace=None,
+               engine=None) -> ServeRequest:
         """Queue one case; returns its handle.  ``deadline_ms`` (relative
         to now) pulls the case's chunk close forward; ``priority`` orders
         ready chunks competing for a dispatch slot.  ``trace`` is the
         originating request's TraceContext (obs/trace.py) when the case
         arrived through a traced front door — the fleet worker re-installs
         it around this case's chunk stages so every span nests under the
-        ingress request; None (the default) costs nothing."""
+        ingress request; None (the default) costs nothing.  ``engine``
+        is a picked engine (serve/picker.py ``EngineChoice``, or its
+        ``.key()`` tuple): the case is served by the matching sibling
+        from the pipeline's engine pool — same supervision, same
+        schedule, its own compiled programs; None (the default) is the
+        pipeline's engine, today's behavior bit for bit."""
         if self._closed:
             raise RuntimeError("pipeline is closed")
         now = self._clock()
+        sel = None
+        if engine is not None:
+            sel = engine.key() if hasattr(engine, "key") else tuple(engine)
+            if sel == self.engine.engine_key():
+                sel = None  # the pick IS the default engine
         req = ServeRequest(case=case, seq=self._next_seq, submit_t=now,
-                           priority=int(priority), trace=trace, _pipe=self)
+                           priority=int(priority), trace=trace,
+                           engine_sel=sel, _pipe=self)
         self._next_seq += 1
         self.report.cases += 1
-        key = case.bucket_key()
-        if key not in self._seen_keys:
-            self._seen_keys.add(key)
+        okey = (case.bucket_key(), sel)
+        if okey not in self._seen_keys:
+            self._seen_keys.add(okey)
             self.report.buckets += 1
-        oc = self._open.get(key)
+        oc = self._open.get(okey)
         if oc is None:
-            oc = self._open[key] = _OpenChunk(key, now)
+            oc = self._open[okey] = _OpenChunk(okey, now)
         oc.requests.append(req)
         oc.priority = max(oc.priority, req.priority)
         if deadline_ms is not None:
@@ -639,7 +665,7 @@ class ServePipeline:
             oc.deadline_t = (req.deadline_t if oc.deadline_t is None
                              else min(oc.deadline_t, req.deadline_t))
         if len(oc.requests) >= self.window_size:
-            self._close(key, "size")
+            self._close(okey, "size")
         self.pump()
         return req
 
@@ -661,9 +687,11 @@ class ServePipeline:
             else:
                 self._retire(self._inflight[0])
 
-    def _close(self, key, why: str) -> _Chunk:
-        oc = self._open.pop(key)
-        chunk = _Chunk(self._next_chunk, key, oc.requests, oc.priority, why)
+    def _close(self, okey, why: str) -> _Chunk:
+        oc = self._open.pop(okey)
+        bucket, sel = okey
+        chunk = _Chunk(self._next_chunk, bucket, oc.requests, oc.priority,
+                       why, engine_sel=sel)
         self._next_chunk += 1
         for r in oc.requests:
             r._chunk = chunk
@@ -710,6 +738,36 @@ class ServePipeline:
                 self._fallback_dead = True
         return self._fallback
 
+    def _engine_for(self, sel) -> EnsembleEngine:
+        """The chunk's engine: the pipeline's own for ``sel`` None, else
+        the picked sibling from the pool (built once per engine key;
+        adopt_report shares this pipeline's counters/registry, so the
+        metrics dumps stay one report)."""
+        if sel is None:
+            return self.engine
+        e = self._engines.get(sel)
+        if e is None:
+            e = self.engine.engine_for(*sel)
+            if e is not self.engine:
+                e.adopt_report(self.report)
+            self._engines[sel] = e
+        return e
+
+    def _fallback_for(self, chunk: _Chunk) -> CpuFallback | None:
+        """The chunk's CPU fallback: the default one for default-engine
+        chunks; a per-pick sibling otherwise (a fallback must run the
+        chunk's OWN integrator/method or the result would be a
+        different scheme wearing the pick's name)."""
+        if chunk.engine_sel is None:
+            return self._ensure_fallback()
+        if self._ensure_fallback() is None:
+            return None  # no CPU backend at all (probe failed)
+        fb = self._fallbacks.get(chunk.engine_sel)
+        if fb is None:
+            fb = CpuFallback(self._engine_for(chunk.engine_sel))
+            self._fallbacks[chunk.engine_sel] = fb
+        return fb
+
     def _dispatch(self, chunk: _Chunk) -> None:
         """One supervised execution attempt: route, arm injected faults,
         pad (once per chunk) + build + stage + dispatch through the
@@ -748,12 +806,13 @@ class ServePipeline:
 
     def _dispatch_body(self, chunk: _Chunk) -> None:
         t0 = self._clock()
+        engine = self._engine_for(chunk.engine_sel)
         try:
             if chunk.fired.raise_ is not None:
                 raise InjectedFault(chunk.fired.raise_,
                                     self._faults.attempt - 1)
             if chunk.padded is None:
-                chunk.padded = self.engine.pad_chunk(
+                chunk.padded = engine.pad_chunk(
                     [r.case for r in chunk.requests])
             if chunk.route == "fallback":
                 chunk.build_s = 0.0
@@ -782,15 +841,15 @@ class ServePipeline:
                     self._event("fallback-chunk", chunk=chunk.chunk_id,
                                 cases=len(chunk.requests))
                 return
-            multi = self.engine.build_program(chunk.key, chunk.padded)
+            multi = engine.build_program(chunk.key, chunk.padded)
             self._check_steady_state()
             # every attempt RE-STAGES: a fresh device input buffer per
             # dispatch, so the depth-1 donating schedule never re-reads
             # a frame a previous attempt donated away (utils/donation.py)
-            U0 = self.engine.stage_inputs(chunk.padded)
+            U0 = engine.stage_inputs(chunk.padded)
             chunk.build_s = self._clock() - t0
             chunk.dispatch_t = self._clock()
-            chunk.out = self.engine.dispatch_chunk(multi, U0)  # async
+            chunk.out = engine.dispatch_chunk(multi, U0)  # async
         except Exception as e:  # noqa: BLE001 — classified, never fatal
             if self._tracer is not None:
                 self._t_span("serve.build", t0, self._clock(),
@@ -839,7 +898,7 @@ class ServePipeline:
         # no stall wait here: the only caller runs deadline-free, and
         # _guarded's no-deadline path classifies an armed stall before
         # this body is ever entered
-        vals = self._ensure_fallback().run_chunk(chunk.key, chunk.padded)
+        vals = self._fallback_for(chunk).run_chunk(chunk.key, chunk.padded)
         return self._clock(), vals
 
     def _guarded(self, chunk: _Chunk, fn, deadline_s="use-default"):
@@ -959,7 +1018,8 @@ class ServePipeline:
         fc = self.report.forced_closes
         for part in (chunk.requests[:mid], chunk.requests[mid:]):
             half = _Chunk(self._next_chunk, chunk.key, part,
-                          chunk.priority, "bisect")
+                          chunk.priority, "bisect",
+                          engine_sel=chunk.engine_sel)
             self._next_chunk += 1
             for r in part:
                 r._chunk = half
@@ -1089,7 +1149,8 @@ class ServePipeline:
         while req.result is None and req.error is None:
             ch = req._chunk
             if ch is None:
-                self._close(req.case.bucket_key(), "wait")
+                self._close((req.case.bucket_key(), req.engine_sel),
+                            "wait")
             elif ch.state == "ready":
                 if len(self._inflight) >= self.depth:
                     self._retire(self._inflight[0])

@@ -152,6 +152,25 @@ acceptance bar surviving the transport change).  The rung is labeled
 "router_speedup" / "sharded_cases" / "sharded" (comm, mesh, threshold)
 / "accepted" / "shed" / "load_sweep" / "bit_identical"; requires
 BENCH_PLATFORM=cpu like BENCH_ROUTER),
+BENCH_TTA_FLEET=1 (fleet-level time-to-accuracy — ISSUE 13,
+parallel/stepper_halo.py + serve/picker.py: ONE fleet (1 pipeline
+replica + the gang tier on BENCH_FLEET_GANG virtual devices) serves
+the SAME fixed sharded problem — grid^2 to the horizon T = steps *
+dt_euler at the BENCH_TTA_TARGET accuracy (default the repo contract
+1e-6) — twice: once at the user-named Euler schedule and once at the
+engine the PICKER chooses (rkc super-stepping where the accuracy model
+allows it; the sharded tier's candidate axis is stencil-only).  The
+picked arm's fleet result must come back bit-identical to the offline
+solve_case_sharded oracle with the picked stepper threaded through,
+and its measured manufactured error must actually meet the target (the
+picker's promise, recorded as "met_target").  A small-tier mixed sweep
+then serves BENCH_TTA_FLEET_CASES cases picker-chosen vs user-named
+through the same fleet.  The rung is labeled "variant": "ttafleet" and
+carries "steps_ratio" (euler steps / picked steps) / "tta_speedup"
+(euler wall / picked wall) / "picker_engine" / "picker_speedup" (the
+mixed sweep's named/picked wall ratio) / "sharded" (comm, mesh,
+stepper) / "met_target" / "bit_identical"; requires BENCH_PLATFORM=cpu
+like BENCH_ROUTER — a fleet is a host measurement),
 BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
@@ -384,7 +403,11 @@ class Best:
                 "steady_state_builds",
                 # fleettcp rung: the worker-transport + sharded-tier
                 # evidence (ISSUE 12)
-                "transport", "tcp_overhead", "sharded_cases", "sharded")
+                "transport", "tcp_overhead", "sharded_cases", "sharded",
+                # ttafleet rung: the fleet time-to-accuracy + engine-
+                # picker evidence (ISSUE 13)
+                "stages", "picker_engine", "picker_speedup",
+                "picker_small", "sweep_cases", "met_target")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -630,7 +653,8 @@ def main():
     # before any backend initializes so the measure child, every
     # worker, AND the in-process sharded oracle see the same device set
     ft_env = int(os.environ.get("BENCH_FLEET_TCP", 0) or 0)
-    if ft_env >= 2 and mc_env < 2:
+    ttf_env = os.environ.get("BENCH_TTA_FLEET") == "1"
+    if (ft_env >= 2 or ttf_env) and mc_env < 2:
         gang = int(os.environ.get("BENCH_FLEET_GANG", 4) or 4)
         if gang >= 2:
             flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
@@ -644,8 +668,12 @@ def main():
     # NLHEAT_PROGRAM_STORE likewise: a leaked store dir would silently
     # warm-boot every rung's "compile" — the warmboot rung attaches its
     # own store dirs explicitly (BENCH_WARMBOOT_DIR)
+    # NLHEAT_PICK_* likewise: a picker ladder / expo opt-in leaked from
+    # a developer shell would silently reroute the ttafleet rung's
+    # engine choice — the rung's label must mean the DEFAULT policy
     for knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP",
-                 "NLHEAT_FAULT_PLAN", "NLHEAT_PROGRAM_STORE"):
+                 "NLHEAT_FAULT_PLAN", "NLHEAT_PROGRAM_STORE",
+                 "NLHEAT_PICK_STAGES", "NLHEAT_PICK_EXPO"):
         if os.environ.pop(knob, None) is not None:
             log(f"scrubbed leaked {knob} from the bench environment")
     try:
@@ -952,13 +980,24 @@ def child_measure():
         router_n = 0
         os.environ.pop("BENCH_TRACE_FLEET", None)
     tta = os.environ.get("BENCH_TTA") == "1"
-    if warmboot and (tta or srv or ens or mchip or router_n or fleet_n
+    ttafleet = os.environ.get("BENCH_TTA_FLEET") == "1"
+    if warmboot and (tta or ttafleet or srv or ens or mchip or router_n
+                     or fleet_n
                      or any(os.environ.get(k) for k in
                             ("BENCH_CARRIED", "BENCH_RESIDENT",
                              "BENCH_SUPERSTEP"))):
-        log("BENCH_WARMBOOT set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
+        log("BENCH_WARMBOOT set: ignoring BENCH_TTA/TTA_FLEET/SERVE/"
+            "ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/CARRIED/RESIDENT/"
+            "SUPERSTEP — the warmboot rung is its own labeled variant")
+        tta = ttafleet = False
+        srv = ens = mchip = router_n = fleet_n = 0
+    if ttafleet and (tta or srv or ens or mchip or router_n or fleet_n
+                     or any(os.environ.get(k) for k in
+                            ("BENCH_CARRIED", "BENCH_RESIDENT",
+                             "BENCH_SUPERSTEP"))):
+        log("BENCH_TTA_FLEET set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
             "MULTICHIP/ROUTER/FLEET_TCP/CARRIED/RESIDENT/SUPERSTEP — "
-            "the warmboot rung is its own labeled variant")
+            "the ttafleet rung is its own labeled variant")
         tta = False
         srv = ens = mchip = router_n = fleet_n = 0
     if fleet_n and (tta or srv or ens or mchip
@@ -1090,6 +1129,156 @@ def child_measure():
                     warmboot_speedup=round(cold_s / warm_s, 3),
                     store_hits=warm_stats["hits"],
                     store_misses=pop_stats["misses"],
+                    bit_identical=bit,
+                )
+                last_op = op
+                any_rung = True
+                continue
+            if ttafleet:
+                # fleet-level time-to-accuracy (ISSUE 13,
+                # parallel/stepper_halo.py + serve/picker.py): the SAME
+                # fixed sharded problem — grid^2 to T = steps*dt_euler
+                # at the 1e-6 target — served by ONE fleet twice: at
+                # the user-named Euler schedule and at the engine the
+                # picker chooses (rkc super-stepping through the gang's
+                # distributed stage loop).  The picked arm must stream
+                # back bit-identical to the offline sharded oracle with
+                # the picked stepper, and its measured error must meet
+                # the target the picker promised.  A small-tier mixed
+                # sweep then compares picker-chosen vs user-named walls
+                # through the same fleet.
+                if backend == "tpu":
+                    raise RuntimeError(
+                        "BENCH_TTA_FLEET needs BENCH_PLATFORM=cpu: a "
+                        "replica fleet is a host measurement and the "
+                        "tunneled single chip cannot host its workers")
+                from nonlocalheatequation_tpu.parallel.gang import (
+                    solve_case_sharded,
+                )
+                from nonlocalheatequation_tpu.serve.ensemble import (
+                    EnsembleCase,
+                )
+                from nonlocalheatequation_tpu.serve.picker import (
+                    PickerRefusal,
+                    pick_engine,
+                )
+                from nonlocalheatequation_tpu.serve.router import (
+                    ReplicaRouter,
+                )
+
+                target = float(os.environ.get("BENCH_TTA_TARGET", 1e-6))
+                gang = int(os.environ.get("BENCH_FLEET_GANG", 4) or 4)
+                T = steps * dt
+                shape = (grid, grid)
+                thr = grid * grid // 2  # grid^2 IS the sharded class
+                # the picker's sharded-arm choice (stencil-only axis —
+                # the spectral embedding cannot serve halo blocks); a
+                # refusal here is a rung error, never a silent euler
+                ch = pick_engine(shape, EPS, 1.0, 1.0 / grid, T,
+                                 target, method=method,
+                                 allow_fft=False)
+                case_e = EnsembleCase(shape=shape, nt=steps, eps=EPS,
+                                      k=1.0, dt=dt, dh=1.0 / grid,
+                                      test=True)
+                case_r = EnsembleCase(shape=shape, nt=ch.steps,
+                                      eps=EPS, k=1.0, dt=ch.dt,
+                                      dh=1.0 / grid, test=True)
+                # the offline oracle of the picked arm: bit-identity
+                # evidence AND the measured-error check of the
+                # picker's accuracy promise
+                want_r, info_r = solve_case_sharded(
+                    case_r, ndevices=gang, comm="fused", method=method,
+                    precision=ch.precision,  # the gang honors the pick;
+                    # the oracle must run the SAME scheme
+                    stepper=ch.stepper, stages=ch.stages)
+                met = bool(info_r.get("error_l2", float("inf"))
+                           / (grid * grid) <= target)
+                if not met:
+                    log(f"WARNING: picked engine missed the accuracy "
+                        f"target ({info_r.get('error_l2')} l2 vs "
+                        f"{target:g}) — the picker's model needs "
+                        "recalibration")
+                # the small tier's mixed sweep: picker-chosen (fft
+                # allowed) vs user-named Euler, same physics
+                sg = max(8, grid // 2)
+                sprobe = NonlocalOp2D(EPS, k=1.0, dt=1.0, dh=1.0 / sg,
+                                      method=method)
+                sdt = 0.8 / (sprobe.c * sprobe.dh * sprobe.dh
+                             * sprobe.wsum)
+                ssteps = max(1, steps // 2)
+                sT = ssteps * sdt
+                M = int(os.environ.get("BENCH_TTA_FLEET_CASES", 4))
+                named = [EnsembleCase(shape=(sg, sg), nt=ssteps,
+                                      eps=EPS, k=1.0, dt=sdt,
+                                      dh=1.0 / sg, test=True)
+                         for _ in range(M)]
+                try:
+                    sch = pick_engine((sg, sg), EPS, 1.0, 1.0 / sg, sT,
+                                      target, method=method)
+                except PickerRefusal as e:
+                    raise RuntimeError(
+                        f"picker refused the small tier: {e}") from None
+                picked = [EnsembleCase(shape=(sg, sg), nt=sch.steps,
+                                       eps=EPS, k=1.0, dt=sch.dt,
+                                       dh=1.0 / sg, test=True)
+                          for _ in range(M)]
+                with ReplicaRouter(replicas=1, depth=1, window_ms=1.0,
+                                   method=method, precision=PRECISION,
+                                   batch_sizes=(1,),
+                                   shard_threshold=thr,
+                                   gang_devices=gang) as router:
+                    def timed(cases_, engine=None):
+                        # warm pass (compiles), then the timed pass
+                        for c in cases_:
+                            router.submit(c, engine=engine).wait(600)
+                        t0 = time.perf_counter()
+                        hs = [router.submit(c, engine=engine)
+                              for c in cases_]
+                        outs = [h.wait(600) for h in hs]
+                        return time.perf_counter() - t0, outs
+
+                    wall_e, _ = timed([case_e])
+                    wall_r, outs_r = timed([case_r], engine=ch)
+                    bit = bool(np.array_equal(outs_r[0], want_r))
+                    if not bit:
+                        log("WARNING: picked sharded arm is NOT "
+                            "bit-identical to the offline oracle")
+                    named_wall, _ = timed(named)
+                    picked_wall, _ = timed(picked, engine=sch)
+                picker_engine = (f"{ch.stepper}[s={ch.stages}]/"
+                                 f"{ch.method}/{ch.precision}")
+                log(f"rung {grid}^2 ttafleet: euler {steps} steps "
+                    f"{wall_e:.2f}s vs picked {picker_engine} "
+                    f"{ch.steps} step(s) {wall_r:.2f}s "
+                    f"(steps_ratio {steps / ch.steps:.1f}x, speedup "
+                    f"{wall_e / wall_r:.2f}x); mixed sweep named "
+                    f"{named_wall:.2f}s vs picked {picked_wall:.2f}s")
+                value = grid * grid * steps / wall_e
+                event(
+                    event="rung",
+                    grid=grid,
+                    steps=steps,
+                    best_s=wall_e,
+                    ms_per_step=wall_e / steps * 1e3,
+                    value=value,
+                    variant="ttafleet",
+                    stepper=ch.stepper,
+                    stages=ch.stages,
+                    picker_engine=picker_engine,
+                    steps_taken=ch.steps,
+                    steps_ratio=round(steps / ch.steps, 2),
+                    tta_speedup=round(wall_e / wall_r, 3),
+                    tta_target=target,
+                    picker_speedup=round(named_wall / picked_wall, 3),
+                    picker_small=(f"{sch.stepper}[s={sch.stages}]/"
+                                  f"{sch.method}/{sch.precision}"),
+                    sweep_cases=M,
+                    sharded={"comm": info_r["comm"],
+                             "mesh": info_r["mesh"],
+                             "devices": info_r["devices"],
+                             "threshold": thr,
+                             "stepper": info_r.get("stepper", "euler")},
+                    met_target=met,
                     bit_identical=bit,
                 )
                 last_op = op

@@ -230,41 +230,10 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         # the shallower remainder program and K == 1 segments
         src_halo = (self.ksteps - 1) * eps
 
-        if self.stepper == "rkc":
-            # the distributed stepper tier (parallel/stepper_halo.py):
-            # the Verwer stage loop above the exchange — per-stage
-            # fused/collective applies at ksteps == 1, communication-
-            # avoiding stage batches of K at ksteps > 1.  One program
-            # advances ONE dt, so the runner scans it per step (the
-            # ksteps arg here is the Euler-levels count and is always 1
-            # for rkc).
-            from nonlocalheatequation_tpu.parallel.stepper_halo import (
-                make_rkc_perstage_step,
-                make_rkc_stagebatch_step,
-            )
-
-            if self.ksteps == 1:
-                if self.comm == "fused":
-                    from nonlocalheatequation_tpu.ops.pallas_halo import (
-                        make_fused_apply,
-                    )
-
-                    apply_blk = make_fused_apply(op, mesh_shape,
-                                                 ("x", "y"))
-                else:
-                    def apply_blk(u_blk):
-                        return op.apply_padded(
-                            halo_pad_2d(u_blk, eps, mesh_shape))
-                local_step = make_rkc_perstage_step(
-                    op, self.stages, apply_blk, self.test)
-            else:
-                local_step = make_rkc_stagebatch_step(
-                    op, self.stages, self.ksteps,
-                    lambda x, w: halo_pad_2d(x, w, mesh_shape),
-                    ("x", "y"), (NX, NY), self.test, src_halo)
-            in_specs = ((spec, spec, spec, P()) if self.test
-                        else (spec, P()))
-        elif self.ksteps == 1:
+        apply_blk = None
+        if self.ksteps == 1:
+            # ONE transport selection serves both per-step Euler and
+            # per-stage rkc (the stage loop sits above it unchanged)
             if self.comm == "fused":
                 # the fused-exchange operator (ops/pallas_halo.py):
                 # remote-DMA halos inside the kernel on TPU, the same
@@ -279,6 +248,30 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
                 def apply_blk(u_blk):
                     return op.apply_padded(
                         halo_pad_2d(u_blk, eps, mesh_shape))
+        if self.stepper == "rkc":
+            # the distributed stepper tier (parallel/stepper_halo.py):
+            # the Verwer stage loop above the exchange — per-stage
+            # fused/collective applies at ksteps == 1, communication-
+            # avoiding stage batches of K at ksteps > 1.  One program
+            # advances ONE dt, so the runner scans it per step (the
+            # ksteps arg here is the Euler-levels count and is always 1
+            # for rkc).
+            from nonlocalheatequation_tpu.parallel.stepper_halo import (
+                make_rkc_perstage_step,
+                make_rkc_stagebatch_step,
+            )
+
+            if self.ksteps == 1:
+                local_step = make_rkc_perstage_step(
+                    op, self.stages, apply_blk, self.test)
+            else:
+                local_step = make_rkc_stagebatch_step(
+                    op, self.stages, self.ksteps,
+                    lambda x, w: halo_pad_2d(x, w, mesh_shape),
+                    ("x", "y"), (NX, NY), self.test, src_halo)
+            in_specs = ((spec, spec, spec, P()) if self.test
+                        else (spec, P()))
+        elif self.ksteps == 1:
             if self.test:
                 def local_step(u_blk, g_blk, lg_blk, t):
                     du = apply_blk(u_blk) + source_at(

@@ -156,35 +156,10 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         NX, NY, NZ = self.NX, self.NY, self.NZ
         src_halo = (self.ksteps - 1) * eps  # see the 2D solver
 
-        if self.stepper == "rkc":
-            # the distributed stepper tier — see the 2D solver's branch
-            # (parallel/stepper_halo.py is dimension-generic)
-            from nonlocalheatequation_tpu.parallel.stepper_halo import (
-                make_rkc_perstage_step,
-                make_rkc_stagebatch_step,
-            )
-
-            if self.ksteps == 1:
-                if self.comm == "fused":
-                    from nonlocalheatequation_tpu.ops.pallas_halo import (
-                        make_fused_apply,
-                    )
-
-                    apply_blk = make_fused_apply(op, mesh_shape, names)
-                else:
-                    def apply_blk(u_blk):
-                        return op.apply_padded(
-                            halo_pad_nd(u_blk, eps, mesh_shape, names))
-                local_step = make_rkc_perstage_step(
-                    op, self.stages, apply_blk, self.test)
-            else:
-                local_step = make_rkc_stagebatch_step(
-                    op, self.stages, self.ksteps,
-                    lambda x, w: halo_pad_nd(x, w, mesh_shape, names),
-                    names, (NX, NY, NZ), self.test, src_halo)
-            in_specs = ((spec, spec, spec, P()) if self.test
-                        else (spec, P()))
-        elif self.ksteps == 1:
+        apply_blk = None
+        if self.ksteps == 1:
+            # one transport selection for per-step Euler AND per-stage
+            # rkc (see the 2D solver)
             if self.comm == "fused":
                 # fused-exchange operator (ops/pallas_halo.py): see the
                 # 2D solver — remote-DMA halos in-kernel on TPU, the
@@ -198,6 +173,25 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
                 def apply_blk(u_blk):
                     return op.apply_padded(
                         halo_pad_nd(u_blk, eps, mesh_shape, names))
+        if self.stepper == "rkc":
+            # the distributed stepper tier — see the 2D solver's branch
+            # (parallel/stepper_halo.py is dimension-generic)
+            from nonlocalheatequation_tpu.parallel.stepper_halo import (
+                make_rkc_perstage_step,
+                make_rkc_stagebatch_step,
+            )
+
+            if self.ksteps == 1:
+                local_step = make_rkc_perstage_step(
+                    op, self.stages, apply_blk, self.test)
+            else:
+                local_step = make_rkc_stagebatch_step(
+                    op, self.stages, self.ksteps,
+                    lambda x, w: halo_pad_nd(x, w, mesh_shape, names),
+                    names, (NX, NY, NZ), self.test, src_halo)
+            in_specs = ((spec, spec, spec, P()) if self.test
+                        else (spec, P()))
+        elif self.ksteps == 1:
             if self.test:
                 def local_step(u_blk, g_blk, lg_blk, t):
                     du = apply_blk(u_blk) + source_at(

@@ -365,12 +365,20 @@ class IngressServer:
                 raise ValueError(
                     f"the picked form needs both T_final and accuracy "
                     f"(missing {need!r})")
-        shape = tuple(int(s) for s in body["shape"])
-        eps = int(body["eps"])
-        k = float(body["k"])
-        dh = float(body["dh"])
-        T_final = float(body["T_final"])
-        accuracy = float(body["accuracy"])
+        try:
+            shape = tuple(int(s) for s in body["shape"])
+            eps = int(body["eps"])
+            k = float(body["k"])
+            dh = float(body["dh"])
+            T_final = float(body["T_final"])
+            accuracy = float(body["accuracy"])
+        except KeyError as e:
+            # parse_case's rule: a missing field is the CLIENT's 400,
+            # never a 500-shaped KeyError
+            raise ValueError(
+                f"missing case field {e.args[0]!r}") from None
+        if not 1 <= len(shape) <= 3 or any(s < 1 for s in shape):
+            raise ValueError(f"bad shape {shape}")
         deadline = body.get("deadline_ms")
         if deadline is not None and (
                 not isinstance(deadline, (int, float)) or deadline <= 0):

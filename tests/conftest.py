@@ -25,7 +25,12 @@ for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
               "NLHEAT_FLIGHT_DIR", "BENCH_TRACE_FLEET",
               # a leaked AOT store dir must not let suite programs load
               # stale executables (or write new ones) across test runs
-              "NLHEAT_PROGRAM_STORE", "NLHEAT_PROGRAM_CACHE_CAP"):
+              "NLHEAT_PROGRAM_STORE", "NLHEAT_PROGRAM_CACHE_CAP",
+              # a leaked picker ladder / expo opt-in / fleet-TTA knob
+              # must not silently reroute the engine-picker tests
+              # (serve/picker.py) or arm the ttafleet bench rung
+              "NLHEAT_PICK_STAGES", "NLHEAT_PICK_EXPO",
+              "BENCH_TTA_FLEET"):
     os.environ.pop(_knob, None)
 # "" DISABLES autotune-cache persistence (unset means the per-user default
 # file since tuning became the on-TPU default): the suite must neither read

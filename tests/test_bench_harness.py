@@ -279,6 +279,36 @@ def test_bench_fleet_tcp_mode_emits_transport_ab(tmp_path):
     assert list(store.glob("*.aotprog"))
 
 
+def test_bench_tta_fleet_mode_emits_picker_evidence():
+    # BENCH_TTA_FLEET=1: the fleet time-to-accuracy + engine-picker
+    # rung (ISSUE 13, parallel/stepper_halo.py + serve/picker.py) — the
+    # same fixed sharded problem served euler-named vs picker-chosen
+    # through a 1-replica + gang fleet, plus the small-tier mixed
+    # sweep.  eps 2 at 32^2 puts the accuracy-capped dt well past the
+    # Euler bound, so the picker genuinely picks rkc and the JSON must
+    # carry the ttafleet variant, the >= 10x steps_ratio, the picked
+    # engine label, met_target (the picker's accuracy promise,
+    # MEASURED) and the gang bit-identity — on the one-line rc=0 ladder
+    proc, rec = run_bench({"BENCH_TTA_FLEET": "1", "BENCH_GRID": "32",
+                           "BENCH_LADDER": "32", "BENCH_EPS": "2",
+                           "BENCH_STEPS": "20", "BENCH_ACCURACY": "0",
+                           "BENCH_FLEET_GANG": "2"}, timeout=420)
+    assert proc.returncode == 0
+    assert rec["value"] > 0
+    assert rec["variant"] == "ttafleet"
+    assert rec["stepper"] == "rkc" and rec["stages"] >= 2
+    assert rec["picker_engine"].startswith("rkc[")
+    assert rec["steps_ratio"] >= 10
+    assert rec["steps_taken"] * rec["steps_ratio"] == rec["steps"]
+    assert rec["tta_speedup"] > 0
+    assert rec["met_target"] is True
+    assert rec["bit_identical"] is True
+    assert rec["picker_speedup"] > 0  # the mixed sweep ran both arms
+    assert rec["sharded"]["stepper"] == "rkc"
+    assert rec["sharded"]["devices"] == 2
+    assert rec["sharded"]["threshold"] == 32 * 32 // 2
+
+
 def test_bench_scrubs_leaked_program_store():
     # a store dir leaked from a developer shell must not silently
     # warm-boot a headline measurement's compiles
@@ -287,6 +317,19 @@ def test_bench_scrubs_leaked_program_store():
     assert proc.returncode == 0
     assert "scrubbed leaked NLHEAT_PROGRAM_STORE" in proc.stderr
     assert rec["value"] > 0  # the measurement itself is unaffected
+
+
+def test_bench_scrubs_leaked_picker_knobs():
+    # a leaked picker ladder / expo opt-in would silently reroute the
+    # ttafleet rung's engine choice (ISSUE 13) — the same honesty scrub
+    # as the store knob above
+    proc, rec = run_bench({"NLHEAT_PICK_STAGES": "2",
+                           "NLHEAT_PICK_EXPO": "1",
+                           "BENCH_ACCURACY": "0"})
+    assert proc.returncode == 0
+    assert "scrubbed leaked NLHEAT_PICK_STAGES" in proc.stderr
+    assert "scrubbed leaked NLHEAT_PICK_EXPO" in proc.stderr
+    assert rec["value"] > 0
 
 
 def test_bench_multichip_mode_emits_halo_overlap():

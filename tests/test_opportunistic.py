@@ -24,7 +24,7 @@ ALL_STEPS = [
     "bf16-4096", "bf16-carried4096", "ensemble8x1024", "serve8x1024",
     "servefault8x1024", "obs8x1024", "multichip1024", "fft4096",
     "tta4096", "warmboot1024", "router8x1024", "routerobs8x1024",
-    "fleettcp8x1024",
+    "fleettcp8x1024", "ttafleet8x512",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
@@ -263,6 +263,30 @@ def test_fleettcp_step_banks_transport_evidence(tmp_path):
     assert '"variant": "fleettcp2"' in table
     assert '"tcp_overhead"' in table
     assert '"sharded_cases"' in table
+    assert '"bit_identical": true' in table
+
+
+@pytest.mark.slow  # ~60 s (a gate bench + the fleet-TTA child with a
+# gang replica) — the stepper/picker machinery itself is tier-1-covered
+# by tests/test_distributed_rkc.py and test_bench_harness; this proves
+# the queue's gate parses steps_ratio/met_target/bit_identical before
+# banking, and the step's cpu-labeled rows pass the backend-grep
+# exemption like router8x1024
+def test_ttafleet_step_banks_picker_evidence(tmp_path):
+    proc, state, table, _out = _run(
+        tmp_path, "ttafleet8x512",
+        # tiny-grid smoke: eps 2 at 32^2 puts the accuracy-capped dt
+        # well past the Euler bound, so the picker genuinely picks rkc
+        # and the >= 10x steps_ratio floor holds even at smoke scale
+        {"OPP_GRID_TTAFLEET": "32", "BENCH_EPS": "2",
+         "BENCH_STEPS": "20", "BENCH_FLEET_GANG": "2"}, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    assert "ttafleet8x512\n" in state
+    assert "fail:" not in state
+    assert '"variant": "ttafleet"' in table
+    assert '"picker_engine"' in table
+    assert '"met_target": true' in table
     assert '"bit_identical": true' in table
 
 

@@ -1130,6 +1130,69 @@ def bench_fleet_tcp(steps: int):
          paced_p99_ms=round(paced["latency_s"]["p99"] * 1e3, 3))
 
 
+def bench_fleet_tta(steps: int):
+    """Fleet time-to-accuracy + engine picker (ISSUE 13,
+    parallel/stepper_halo.py + serve/picker.py): the SAME fixed sharded
+    problem — grid^2 to T = steps * dt_euler at the BT_TTA_TARGET
+    accuracy — served by a 1-replica + gang fleet twice: at the
+    user-named Euler schedule and at the engine the picker chooses (rkc
+    super-stepping through the gang's distributed stage loop; the
+    sharded candidate axis is stencil-only).  The picked row records
+    ``steps_ratio``/``tta_speedup``, its bit-identity against the
+    in-process ``solve_case_sharded`` oracle with the picked stepper
+    threaded, and ``met_target`` — the picker's accuracy promise,
+    measured.  Off-TPU only, like the router/fleettcp groups."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+    from nonlocalheatequation_tpu.parallel.gang import solve_case_sharded
+    from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+    from nonlocalheatequation_tpu.serve.picker import pick_engine
+    from nonlocalheatequation_tpu.serve.router import ReplicaRouter
+
+    if on_tpu():
+        log("  ttafleet: skipped on TPU (replica fleets assume one "
+            "accelerator per worker; run with BENCH_PLATFORM=cpu)")
+        return
+    n = cfg("BT_TTAFLEET_GRID", 512, 64)
+    eps = 8
+    target = float(os.environ.get("BT_TTA_TARGET", 1e-6))
+    dt_e = stable_dt(NonlocalOp2D(eps, k=1.0, dt=1.0, dh=1.0 / n,
+                                  method="sat"))
+    T = steps * dt_e
+    ch = pick_engine((n, n), eps, 1.0, 1.0 / n, T, target,
+                     method="sat", allow_fft=False)
+    case_e = EnsembleCase(shape=(n, n), nt=steps, eps=eps, k=1.0,
+                          dt=dt_e, dh=1.0 / n, test=True)
+    case_r = EnsembleCase(shape=(n, n), nt=ch.steps, eps=eps, k=1.0,
+                          dt=ch.dt, dh=1.0 / n, test=True)
+    want_r, info = solve_case_sharded(case_r, comm="fused", method="sat",
+                                      precision=ch.precision,
+                                      stepper=ch.stepper,
+                                      stages=ch.stages)
+    met = bool(info.get("error_l2", float("inf")) / (n * n) <= target)
+    with ReplicaRouter(replicas=1, depth=1, window_ms=1.0, method="sat",
+                       batch_sizes=(1,),
+                       shard_threshold=n * n // 2) as router:
+        def timed(case, engine=None):
+            router.submit(case, engine=engine).wait(600)  # warm/compile
+            t0 = time.perf_counter()
+            out = router.submit(case, engine=engine).wait(600)
+            return time.perf_counter() - t0, out
+
+        wall_e, _ = timed(case_e)
+        wall_r, out_r = timed(case_r, engine=ch)
+    emit("ttafleet/euler-gang", n * n, steps, wall_e, grid=n, eps=eps,
+         stepper="euler", tta_target=target)
+    emit("ttafleet/picked-gang", n * n, ch.steps, wall_r, grid=n,
+         eps=eps,
+         picker_engine=f"{ch.stepper}[s={ch.stages}]/{ch.method}/"
+                       f"{ch.precision}",
+         steps_ratio=round(steps / ch.steps, 2),
+         tta_speedup=round(wall_e / wall_r, 3), tta_target=target,
+         met_target=met,
+         bit_identical=bool(np.array_equal(out_r, want_r)),
+         sharded_comm=info["comm"], sharded_mesh=info["mesh"])
+
+
 def bench_multichip(steps: int):
     """Fused-vs-collective halo A/B (round 9, ops/pallas_halo.py): the
     distributed 2D solver over ONE shared device mesh, collective halos
@@ -1191,6 +1254,7 @@ BENCHES = {
     "router": bench_router,
     "routerobs": bench_router_obs,
     "fleettcp": bench_fleet_tcp,
+    "ttafleet": bench_fleet_tta,
 }
 
 

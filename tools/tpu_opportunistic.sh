@@ -100,7 +100,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 STEPS="bench4096 resident512 carried4096 superstep2 \
 bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 servefault8x1024 \
 obs8x1024 multichip1024 fft4096 tta4096 warmboot1024 router8x1024 \
-routerobs8x1024 fleettcp8x1024 \
+routerobs8x1024 fleettcp8x1024 ttafleet8x512 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -307,6 +307,23 @@ run_step_cmd() {  # the queue's one name->command map
         BENCH_PLATFORM=cpu \
         BENCH_GRID="${OPP_GRID_ROUTER:-1024}" \
         BENCH_LADDER="${OPP_GRID_ROUTER:-1024}" BENCH_ACCURACY=0 ;;
+    ttafleet8x512)
+      # fleet time-to-accuracy + engine picker (ISSUE 13,
+      # parallel/stepper_halo.py + serve/picker.py): the SAME fixed
+      # sharded 512^2 problem served by one fleet at the user-named
+      # Euler schedule and at the picker's choice (rkc super-stepping
+      # through the gang's distributed stage loop), plus the small-tier
+      # picker-vs-named mixed sweep.  A HOST measurement like
+      # router8x1024 (same BENCH_PLATFORM=cpu rationale; step() exempts
+      # the backend grep).  Gate (step_variant_ok): variant ttafleet,
+      # steps_ratio >= OPP_TTAFLEET_MIN_RATIO (default 10 — the ISSUE
+      # 13 acceptance floor), met_target (the picker's accuracy promise
+      # measured, never gambled), bit_identical (fleet rkc == offline
+      # sharded oracle).
+      bench_nofb BENCH_TTA_FLEET=1 \
+        BENCH_PLATFORM=cpu \
+        BENCH_GRID="${OPP_GRID_TTAFLEET:-512}" \
+        BENCH_LADDER="${OPP_GRID_TTAFLEET:-512}" BENCH_ACCURACY=0 ;;
     superstep2-tm128)
       bench_nofb BENCH_SUPERSTEP=2 NLHEAT_TM=128 BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
@@ -561,6 +578,34 @@ for line in open(sys.argv[1]):
 sys.exit(0 if ok else 1)
 PYEOF
       ;;
+    ttafleet8x512) python - "$2" <<'PYEOF'
+import json, os, sys
+# the ISSUE 13 gate: fewer steps x more chips honestly — steps_ratio
+# (euler steps / picked steps) >= OPP_TTAFLEET_MIN_RATIO (default 10,
+# the acceptance floor; the smoke harness can relax it), the picker's
+# accuracy promise MEASURED (met_target — a pick that misses the target
+# voids the row), and the fleet-served picked arm bit-identical to the
+# offline sharded oracle with the picked stepper threaded through.
+limit = float(os.environ.get("OPP_TTAFLEET_MIN_RATIO", "10"))
+ok = False
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    if r.get("variant") != "ttafleet":
+        continue
+    ratio = r.get("steps_ratio")
+    if not isinstance(ratio, (int, float)) or ratio < limit:
+        continue
+    if r.get("met_target") is True and r.get("bit_identical") is True:
+        ok = True
+sys.exit(0 if ok else 1)
+PYEOF
+      ;;
     warmboot1024) python - "$2" <<'PYEOF'
 import json, os, sys
 # the >= 2x cold->warm first-chunk acceptance gate (ISSUE 9); the CI
@@ -611,7 +656,7 @@ step() {  # <name>: run one queue step unless already done.
   log "step $name: start"
   local run rc backend_check=step_backend_ok
   case $name in
-    router8x1024 | routerobs8x1024 | fleettcp8x1024)
+    router8x1024 | routerobs8x1024 | fleettcp8x1024 | ttafleet8x512)
       # deliberately host measurements (see run_step_cmd): the fleet
       # proxies pin BENCH_PLATFORM=cpu because N replica processes
       # cannot share the single tunneled chip — their rows are cpu-

@@ -320,16 +320,20 @@ class EnsembleEngine:
         """A sibling configured for a PICKED engine (serve/picker.py):
         the stepper x stages x method x precision axes overridden, the
         variant forced to 'auto' (an operator-pinned Euler-only variant
-        must not refuse a picked rkc bucket) and the superstep depth
-        kept only where it applies (the Euler pallas schedules).
-        Returns ``self`` when the pick IS this engine's configuration —
-        the common case of a fleet whose default engine already
-        matches."""
+        must not refuse a picked rkc bucket), the comm engine dropped
+        to 'collective' when the picked method is not pallas (the fused
+        halo family is pallas-only and the ctor refuses the pair — a
+        fused fleet must still serve a picked fft/conv case), and the
+        superstep depth kept only where it applies (the Euler pallas
+        schedules).  Returns ``self`` when the pick IS this engine's
+        configuration — the common case of a fleet whose default
+        engine already matches."""
         if (stepper, int(stages), method, precision) == self.engine_key():
             return self
         return self.sibling(
             stepper=stepper, stages=int(stages), method=method,
             precision=precision, variant="auto",
+            comm=self.comm if method == "pallas" else "collective",
             ksteps=self.ksteps if stepper == "euler" else 0)
 
     # -- case -> operator ---------------------------------------------------

@@ -365,37 +365,36 @@ class IngressServer:
                 raise ValueError(
                     f"the picked form needs both T_final and accuracy "
                     f"(missing {need!r})")
-        try:
-            shape = tuple(int(s) for s in body["shape"])
-            eps = int(body["eps"])
-            k = float(body["k"])
-            dh = float(body["dh"])
-            T_final = float(body["T_final"])
-            accuracy = float(body["accuracy"])
-        except KeyError as e:
-            # parse_case's rule: a missing field is the CLIENT's 400,
-            # never a 500-shaped KeyError
-            raise ValueError(
-                f"missing case field {e.args[0]!r}") from None
-        if not 1 <= len(shape) <= 3 or any(s < 1 for s in shape):
-            raise ValueError(f"bad shape {shape}")
-        deadline = body.get("deadline_ms")
-        if deadline is not None and (
-                not isinstance(deadline, (int, float)) or deadline <= 0):
-            raise ValueError(
-                f"deadline_ms must be a number > 0, got {deadline!r}")
-        thr = getattr(self.backend, "shard_threshold", None)
-        sharded = (thr is not None and len(shape) == 2
-                   and int(np.prod(shape)) > thr)
+        # validate every NON-schedule field through parse_case first
+        # (placeholder schedule): ONE validator, shared with the
+        # explicit form verbatim — missing fields, bad-rank shapes,
+        # eps < 1, u0/test rules are all the client's 400 here too
+        base = {k2: v for k2, v in body.items()
+                if k2 not in ("accuracy", "T_final")}
+        parse_case(base | {"nt": 1, "dt": 1.0})
+        shape = tuple(int(s) for s in body["shape"])
+        eps = int(body["eps"])
+        k = float(body["k"])
+        dh = float(body["dh"])
+        if not dh > 0:
+            # the one rule the explicit form has no stake in: the
+            # picker's stability constant divides by (eps*dh)
+            raise ValueError(f"dh must be > 0, got {dh}")
+        T_final = float(body["T_final"])
+        accuracy = float(body["accuracy"])
+        # T_final/accuracy/deadline_ms positivity: pick_engine's own
+        # refusals (ValueError -> the client's 400)
+        # the ROUTER's own predicate (one rule, no drift): a case the
+        # router would route to the gang must pick on the stencil-only
+        # axis; router-shaped stubs without the method are never sharded
+        is_sharded = getattr(self.backend, "is_sharded", None)
+        sharded = bool(is_sharded(shape)) if is_sharded else False
         ek = getattr(self.backend, "engine_kwargs", None) or {}
         picked = pick_engine(
             shape, eps, k, dh, T_final, accuracy,
-            deadline_ms=deadline, method=ek.get("method", "auto"),
-            allow_fft=not sharded)
-        case = parse_case({
-            k2: v for k2, v in body.items()
-            if k2 not in ("accuracy", "T_final")
-        } | {"nt": picked.steps, "dt": picked.dt})
+            deadline_ms=body.get("deadline_ms"),
+            method=ek.get("method", "auto"), allow_fft=not sharded)
+        case = parse_case(base | {"nt": picked.steps, "dt": picked.dt})
         return case, picked
 
     def _get(self, h) -> None:

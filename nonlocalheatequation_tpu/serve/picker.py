@@ -213,6 +213,7 @@ def record_rate_fn(device_kind: str, dtype_name: str = "float32",
             return float(ms)
         return analytic_rate_fn(method, shape, eps, precision)
 
+    rate.provenance = "records"  # the EngineChoice.rates audit label
     return rate
 
 
@@ -271,9 +272,14 @@ def pick_engine(shape, eps: int, k: float, dh: float, T_final: float,
         raise ValueError(f"accuracy must be > 0, got {accuracy}")
     if deadline_ms is not None and deadline_ms <= 0:
         raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
-    rates_label = "measured" if rate_fn is not None else "analytic"
+    # cost-model provenance for the audit trail: an injected rate_fn is
+    # the caller's measurement unless it declares otherwise (the
+    # record_rate_fn closure tags itself "records")
     if rate_fn is None:
         rate_fn = analytic_rate_fn
+        rates_label = "analytic"
+    else:
+        rates_label = getattr(rate_fn, "provenance", "measured")
     if allow_expo is None:
         allow_expo = os.environ.get("NLHEAT_PICK_EXPO") == "1"
     ladder = tuple(stages_ladder) if stages_ladder else _stage_ladder()
@@ -290,25 +296,37 @@ def pick_engine(shape, eps: int, k: float, dh: float, T_final: float,
     else:
         methods = [stencil, "fft"] if stencil != "fft" else ["fft"]
 
-    # accuracy cap on dt: ERR_SAFETY * model(dt) <= accuracy
-    dt_acc = math.sqrt(accuracy / (ERR_SAFETY * 0.5 ** dim)) / (
-        0.5 * T_final * (2.0 * math.pi) ** 2)
+    # accuracy cap on dt per error floor (the bf16 tier carries its
+    # measured floor INSIDE the budget, so an accuracy-capped bf16
+    # candidate gets a genuinely smaller dt instead of being generated
+    # and then unconditionally rejected by its own feasibility check):
+    # ERR_SAFETY * (model(dt) + floor) <= accuracy
+    def dt_cap(floor: float = 0.0) -> float:
+        budget = accuracy / ERR_SAFETY - floor
+        if budget <= 0:
+            return 0.0
+        return math.sqrt(budget / 0.5 ** dim) / (
+            0.5 * T_final * (2.0 * math.pi) ** 2)
 
+    dt_acc = dt_cap()
     candidates: list[EngineChoice] = []
     steppers = [("euler", 0)] + [("rkc", s) for s in ladder]
     for m in methods:
         for prec in ("f32", "bf16"):
-            if prec == "bf16" and (m == "fft"
-                                   or accuracy < ERR_SAFETY
-                                   * BF16_L2_BUDGET):
-                # the tier's measured error floor must fit inside the
-                # target with the same margin; the spectral path has no
-                # bf16 operand-window implementation
-                continue
+            cap = dt_acc
+            if prec == "bf16":
+                if m == "fft":
+                    # the spectral path has no bf16 operand windows
+                    continue
+                cap = dt_cap(BF16_L2_BUDGET)
+                if cap <= 0:
+                    # the tier's measured error floor alone exceeds
+                    # the budget at the safety margin
+                    continue
             for stepper, stages in steppers:
                 bound = stable_dt(c, dh, dim, wsum, stepper=stepper,
                                   stages=stages)
-                dt = min(0.8 * bound, dt_acc)  # superstep_floor headroom
+                dt = min(0.8 * bound, cap)  # superstep_floor headroom
                 if not math.isfinite(dt) or dt <= 0:
                     continue
                 steps = max(1, math.ceil(T_final / dt))
@@ -336,11 +354,13 @@ def pick_engine(shape, eps: int, k: float, dh: float, T_final: float,
             est_err=0.0, rates=rates_label))
 
     if not candidates:
+        # the accuracy cap comes from the closed-form manufactured
+        # error model, never the rate model — name it correctly
         raise PickerRefusal(
             f"no engine meets accuracy {accuracy:g} for T_final="
             f"{T_final:g} on {shape} (dt cap {dt_acc:g} from the "
-            f"{rates_label} error model; even the finest stable step "
-            "models past the target)")
+            "manufactured-class error model at ERR_SAFETY margin; "
+            "even the finest stable step models past the target)")
     candidates.sort(key=lambda ch: (ch.est_ms, ch.steps, ch.stages))
     if deadline_ms is not None:
         feasible = [ch for ch in candidates if ch.est_ms <= deadline_ms]

@@ -685,19 +685,26 @@ class ReplicaRouter:
         return sum(1 for r in self._replicas.values()
                    if r.alive and r.gang)
 
-    def _is_sharded(self, case) -> bool:
-        """Does this case belong to the sharded big-case class?  2D
-        grids above ``shard_threshold`` POINTS; other ranks keep the
-        single-chip path (the distributed gang solver is the 2D
-        flagship — the reference's own top tier)."""
+    def is_sharded(self, shape) -> bool:
+        """Does a grid of ``shape`` belong to the sharded big-case
+        class?  2D grids above ``shard_threshold`` POINTS; other ranks
+        keep the single-chip path (the distributed gang solver is the
+        2D flagship — the reference's own top tier).  PUBLIC because
+        the ingress picker gates its candidate axis on the SAME
+        predicate (serve/http.py — an fft pick must never route to the
+        gang, whose halo-padded blocks the spectral embedding cannot
+        serve); one predicate, no drift."""
         if self.shard_threshold is None:
             return False
         try:
-            shape = tuple(int(s) for s in case.shape)
+            shape = tuple(int(s) for s in shape)
         except (TypeError, ValueError):
             return False
         return (len(shape) == 2
                 and int(np.prod(shape)) > self.shard_threshold)
+
+    def _is_sharded(self, case) -> bool:
+        return self.is_sharded(getattr(case, "shape", None))
 
     def _gang_rep(self) -> _Replica:
         for r in self._replicas.values():
@@ -1580,7 +1587,6 @@ def _gang_loop(cfg: dict, out, poll, eof, tracer, trace_dir,
                                        ek.get("stepper", "euler")),
                         stages=int(pe.get("stages",
                                           ek.get("stages", 0) or 0)),
-                        superstep=int(ek.get("superstep", 1) or 1),
                         solver_cache=solver_cache)
                 with slock:
                     state["served"] += 1

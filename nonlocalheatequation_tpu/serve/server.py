@@ -806,8 +806,12 @@ class ServePipeline:
 
     def _dispatch_body(self, chunk: _Chunk) -> None:
         t0 = self._clock()
-        engine = self._engine_for(chunk.engine_sel)
         try:
+            # INSIDE the classifying try: a picked-sibling construction
+            # error must fail the chunk through the supervised
+            # retry/bisect/quarantine path, never unwind out of pump()
+            # with the chunk already popped from the ready queue
+            engine = self._engine_for(chunk.engine_sel)
             if chunk.fired.raise_ is not None:
                 raise InjectedFault(chunk.fired.raise_,
                                     self._faults.attempt - 1)

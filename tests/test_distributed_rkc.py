@@ -340,6 +340,17 @@ def test_picker_unit_table():
     just_tight = pick_engine((32, 32), eps, k, dh, T,
                              BF16_L2_BUDGET, rate_fn=flat_rate())
     assert just_tight.precision == "f32"
+    # accuracy-CAPPED bf16: the tier's floor rides inside the budget
+    # (a smaller dt), instead of the candidate being generated and then
+    # unconditionally rejected by its own feasibility check
+    coarse = pick_engine((32, 32), eps, k, 0.05, 30 * euler_bound(
+        eps, k, 0.05), 1e-4, rate_fn=flat_rate())
+    assert coarse.precision == "bf16"
+    from nonlocalheatequation_tpu.serve.picker import modeled_error
+
+    assert ERR_SAFETY * (modeled_error(2, 30 * euler_bound(eps, k, 0.05),
+                                       coarse.dt)
+                         + BF16_L2_BUDGET) <= 1e-4 * (1 + 1e-12)
     # wire round trip (the router frame form)
     assert EngineChoice.from_wire(ch.wire()) == ch
     # expo: opt-in only, one step, fft
@@ -357,6 +368,29 @@ def test_picker_env_ladder(monkeypatch):
     monkeypatch.setenv("NLHEAT_PICK_STAGES", "1,4")
     with pytest.raises(ValueError, match="NLHEAT_PICK_STAGES"):
         pick_engine((32, 32), eps, k, dh, T, 1e-6, rate_fn=flat_rate())
+
+
+def test_picked_sibling_on_fused_fleet_drops_comm():
+    # a comm='fused' (pallas) fleet must still serve a picked non-pallas
+    # engine: the sibling drops to the collective transport instead of
+    # refusing at construction (the fused family is pallas-only)
+    base = EnsembleEngine(method="pallas", comm="fused")
+    sib = base.engine_for("rkc", 8, "fft", "f32")
+    assert (sib.method, sib.comm) == ("fft", "collective")
+    # a pallas pick keeps the fleet's fused engine
+    sib2 = base.engine_for("rkc", 8, "pallas", "f32")
+    assert sib2.comm == "fused"
+    # and a supervised pipeline classifies (not crashes on) a picked
+    # engine whose construction fails outright
+    with ServePipeline(method="auto", depth=1, window_ms=0.0,
+                       retries=0, fallback=False) as pipe:
+        h = pipe.submit(
+            EnsembleCase(shape=(16, 16), nt=2, eps=2, k=1.0, dt=1e-5,
+                         dh=0.05, test=True),
+            engine=("expo", 0, "conv", "f32"))  # expo needs fft: refuses
+        pipe.drain()
+        assert h.error is not None  # quarantined, pipeline alive
+        assert h.error.classification == "error"
 
 
 def test_picker_served_bit_identical_to_offline_sibling():
@@ -466,7 +500,14 @@ def test_gang_sharded_rkc_socket_and_http_picked_form():
             # KeyError (parse_case's contract, kept by the new form)
             for bad in ({"T_final": T, "accuracy": 1e-6},
                         {"shape": [4, 4, 4, 4], "eps": eps, "k": k,
-                         "dh": dh, "T_final": T, "accuracy": 1e-6}):
+                         "dh": dh, "T_final": T, "accuracy": 1e-6},
+                        # eps=0 / dh=0 would divide the picker's
+                        # stability constant by zero — client 400s
+                        {"shape": [16, 16], "eps": 0, "k": k, "dh": dh,
+                         "T_final": T, "accuracy": 1e-6, "test": True},
+                        {"shape": [16, 16], "eps": eps, "k": k,
+                         "dh": 0, "T_final": T, "accuracy": 1e-6,
+                         "test": True}):
                 with pytest.raises(urllib.error.HTTPError) as ei3:
                     post(bad)
                 assert ei3.value.code == 400

@@ -67,7 +67,7 @@ assert err < 1e-12
 # -- elastic (arbitrary tile placement): the gang superstep -----------------
 from nonlocalheatequation_tpu.parallel.elastic import ElasticSolver2D
 
-ndev = len(jax.devices())
+ndev = len(device_list())
 asg = np.arange(9).reshape(3, 3) % max(1, min(ndev, 4))  # any placement
 e = ElasticSolver2D(10, 10, 3, 3, nt=9, eps=3, k=0.5, dt=1e-5, dh=1.0 / 30,
                     assignment=asg, superstep=2)
@@ -85,6 +85,7 @@ from nonlocalheatequation_tpu.ops.unstructured import (
     UnstructuredNonlocalOp,
     UnstructuredSolver,
 )
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 rng = np.random.default_rng(0)
 m = 32
@@ -93,7 +94,7 @@ gxx, gyy = np.meshgrid(np.arange(m) * h, np.arange(m) * h, indexing="ij")
 pts = np.stack([gxx.ravel(), gyy.ravel()], 1)
 pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
 uop = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-6, vol=h * h)
-shop = ShardedUnstructuredOp(uop, devices=jax.devices()[: min(ndev, 4)])
+shop = ShardedUnstructuredOp(uop, devices=device_list()[: min(ndev, 4)])
 if shop.superstep_fits(2):
     ss = UnstructuredSolver(shop, nt=9, backend="jit", superstep=2)
     ou = UnstructuredSolver(uop, nt=9, backend="oracle")

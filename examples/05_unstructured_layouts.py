@@ -30,6 +30,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+from nonlocalheatequation_tpu.utils.devices import device_list
+
 
 def main() -> int:
     if "--platform" in sys.argv:
@@ -84,7 +86,7 @@ def main() -> int:
     # shard block (they grow like ~3.6*m while blocks shrink like m^2/S,
     # so very large device pools on this small demo cloud honestly fall
     # back to the edge layout)
-    ndev = len(jax.devices())
+    ndev = len(device_list())
     if ndev > 1:
         sh = ShardedUnstructuredOp(op)
         got = np.asarray(sh.apply(jnp.asarray(u)))

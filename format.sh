@@ -15,12 +15,16 @@ MODE="fix"
 PY_TARGETS=(nonlocalheatequation_tpu tests tools bench.py __graft_entry__.py)
 
 if command -v ruff >/dev/null 2>&1; then
+  # full curated lint (pyflakes/bugbear/isort — [tool.ruff.lint] in
+  # pyproject.toml), not just import order: the generic half of the
+  # invariant wall (ISSUE 14).  The repo-specific half is graftlint,
+  # run separately: `python -m tools.lint` (CI runs both).
   if [[ "$MODE" == "check" ]]; then
     ruff format --check "${PY_TARGETS[@]}"
-    ruff check --select I "${PY_TARGETS[@]}"
+    ruff check "${PY_TARGETS[@]}"
   else
     ruff format "${PY_TARGETS[@]}"
-    ruff check --select I --fix "${PY_TARGETS[@]}"
+    ruff check --fix "${PY_TARGETS[@]}"
   fi
 else
   echo "ruff not found; skipping python formatting" >&2

@@ -28,6 +28,7 @@ from nonlocalheatequation_tpu.cli.common import (
     stepper_kwargs,
     validate_stepper_args,
 )
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -190,9 +191,9 @@ def main(argv=None) -> int:
         if use_elastic:
             from nonlocalheatequation_tpu.parallel.elastic import ElasticSolver2D
 
-            devices = jax.devices()[:args.devices] if args.devices else None
+            devices = device_list()[:args.devices] if args.devices else None
             place = assignment
-            ndev = len(devices or jax.devices())
+            ndev = len(devices or device_list())
             if place is not None and int(np.max(place)) >= ndev:
                 # Fewer devices than the map's owners: fold owners onto the
                 # available devices, the way the reference's distributed ctest
@@ -220,7 +221,7 @@ def main(argv=None) -> int:
             )
 
             mesh = choose_mesh_for_grid(
-                nx * npx, ny * npy, jax.devices()[:args.devices])
+                nx * npx, ny * npy, device_list()[:args.devices])
         return Solver2DDistributed(
             nx, ny, npx, npy, nt, eps, nlog=args.nlog,
             k=k, dt=dt, dh=dh, mesh=mesh, method=args.method,

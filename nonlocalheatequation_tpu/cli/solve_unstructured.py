@@ -30,6 +30,7 @@ from nonlocalheatequation_tpu.cli.common import (
     cli_startup,
     guard_multihost_stdin,
 )
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -78,7 +79,7 @@ def main(argv=None) -> int:
         # unset (None, not an explicit --devices 1): single device on a
         # plain launch, the whole pod on a multi-process one — an explicit
         # count is always honored
-        args.devices = len(jax.devices()) if multi else 1
+        args.devices = len(device_list()) if multi else 1
 
     from nonlocalheatequation_tpu.ops.unstructured import (
         ShardedUnstructuredOp,
@@ -118,7 +119,7 @@ def main(argv=None) -> int:
         op.dt = dt
     the_op = op
     if args.devices > 1:
-        devs = jax.devices()[: args.devices]
+        devs = device_list()[: args.devices]
         from jax.sharding import Mesh
 
         the_op = ShardedUnstructuredOp(
@@ -139,7 +140,7 @@ def main(argv=None) -> int:
         # a misconfigured --superstep (single device, edges layout,
         # K*pad > block) gets the same clean one-line refusal as the
         # other CLI launch-mode checks, not a traceback
-        raise SystemExit(str(e))
+        raise SystemExit(str(e)) from None
     if args.test:
         s.test_init()
     else:

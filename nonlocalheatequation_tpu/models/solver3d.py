@@ -2,8 +2,10 @@
 there; SURVEY.md section 7 stretch item).  Same structure as Solver2D:
 ``oracle`` backend is NumPy f64 ground truth, ``jit`` runs the whole time
 loop as one lax.scan program.  The discretization applies the reference's
-recipe (rasterized eps-ball, volumetric boundary, forward Euler,
-manufactured-solution testing contract) once more per axis.
+2D recipe (rasterized eps-ball, volumetric boundary, the forward-Euler
+time loop of src/2d_nonlocal_serial.cpp:273-303, manufactured-solution
+testing contract per src/2d_nonlocal_serial.cpp:96-113) once more per
+axis.
 """
 
 from __future__ import annotations

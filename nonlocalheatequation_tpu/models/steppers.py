@@ -1,7 +1,8 @@
 """Time-stepper tier: beat the forward-Euler stability limit.
 
-The reference integrates with forward Euler everywhere (PAPER.md section
-0), so dt is capped at 1/(c*h^d*Wsum) — at 4096^2 that is ~1.2e-7 and
+The reference integrates with forward Euler everywhere (the
+``u += dt * (L(u) + b)`` update of src/2d_nonlocal_serial.cpp:281-283;
+PAPER.md section 0), so dt is capped at 1/(c*h^d*Wsum) — at 4096^2 that is ~1.2e-7 and
 *steps-to-solution*, not per-step throughput, gates every real answer
 (ROADMAP item 2).  This module is the stepper abstraction threaded
 through Solver1D/2D/3D (``stepper=euler|rkc|expo``):

@@ -455,7 +455,7 @@ def merge_chrome_traces(docs) -> dict:
                               "pid": int(name_pid), "tid": 0,
                               "args": {"name": str(label)}})
     t0 = None
-    for events, off_us, pid in offsets:
+    for events, off_us, _pid in offsets:
         for ev in events:
             ts = ev.get("ts")
             if isinstance(ts, (int, float)):

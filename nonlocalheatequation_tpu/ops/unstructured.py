@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from nonlocalheatequation_tpu.parallel.multihost import put_global
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 
 def _load_native():
@@ -442,7 +443,7 @@ class ShardedUnstructuredOp:
         self.inner = op
         self.n, self.dt = op.n, op.dt
         if mesh is None:
-            devices = list(devices if devices is not None else jax.devices())
+            devices = list(devices if devices is not None else device_list())
             mesh = Mesh(np.asarray(devices), ("p",))
         self.mesh = mesh
         S = int(mesh.devices.size)

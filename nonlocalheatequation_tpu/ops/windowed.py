@@ -58,7 +58,6 @@ windowed/ELL paths when offsets don't cluster.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp

@@ -44,11 +44,12 @@ from nonlocalheatequation_tpu.parallel.stepper_halo import (
 )
 from nonlocalheatequation_tpu.parallel.multihost import fetch_global, put_global
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 
 def choose_mesh_for_grid(NX: int, NY: int, devices=None) -> Mesh:
     """Largest mesh (mx, my) with mx | NX, my | NY and mx*my <= #devices."""
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else device_list())
     n = len(devices)
     best = (1, 1)
     for mx in range(1, min(NX, n) + 1):

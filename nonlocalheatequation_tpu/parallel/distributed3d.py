@@ -30,11 +30,12 @@ from nonlocalheatequation_tpu.parallel.stepper_halo import (
 )
 from nonlocalheatequation_tpu.parallel.multihost import fetch_global, put_global
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 
 def choose_mesh_for_grid_3d(NX: int, NY: int, NZ: int, devices=None) -> Mesh:
     """Largest mesh (mx, my, mz) whose shape divides the grid, product <= #devices."""
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else device_list())
     n = len(devices)
     best = (1, 1, 1)
 

@@ -63,6 +63,7 @@ from nonlocalheatequation_tpu.parallel.load_balance import (
     rebalance_assignment,
 )
 from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
+from nonlocalheatequation_tpu.utils.devices import device_list
 from nonlocalheatequation_tpu.utils.partition_map import default_assignment
 
 # the 3x3 neighbor offsets in upad assembly order (top row, mid row, bottom)
@@ -215,7 +216,7 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         # through op.apply_padded); no resync on the tiled schedules
         self.op = NonlocalOp2D(eps, k, dt, dh, method=method,
                                precision=precision)
-        self.devices = list(devices if devices is not None else jax.devices())
+        self.devices = list(devices if devices is not None else device_list())
         nl = len(self.devices)
         if assignment is None:
             assignment = default_assignment(self.npx, self.npy, nl)
@@ -624,6 +625,7 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
                 new_tiles[key] = out
                 outs.append(out)
             for o in outs:
+                # lint-ok: W4 per-tile sync for busy-rate telemetry (a scalar-sum fetch per tile would add a device round-trip); tunnel-accurate walls come from bench.py's fence
                 o.block_until_ready()
             self.telemetry.record(d, self._measure_clock() - t0)
         self._tiles = new_tiles
@@ -700,7 +702,6 @@ class ElasticSolver2D(CheckpointMixin, ManufacturedMetrics2D):
         if self._use_fused:
             self._build_batch_plan()
             self._batch_tiles()
-        nl = len(self.devices)
         measured = self.measure and hasattr(self.telemetry, "record")
         window_len = self.measure_window if self.nbalance else self.nt
         prev_in_window = False

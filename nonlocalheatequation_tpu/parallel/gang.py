@@ -41,6 +41,7 @@ from nonlocalheatequation_tpu.utils.compat import shard_map
 # the assembly-order contract: gang halo assembly must mirror the batched
 # bstep band-for-band (the bit-identical guarantee), so share its offsets
 from nonlocalheatequation_tpu.parallel.elastic import _OFFSETS
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 
 class GangPlan:
@@ -415,7 +416,7 @@ def solve_case_sharded(case, *, ndevices: int | None = None,
         raise ValueError(
             f"comm must be 'fused' or 'collective', got {comm!r}")
     NX, NY = shape
-    all_devs = jax.devices()
+    all_devs = device_list()
     devs = (pick_gang_devices(min(int(ndevices), len(all_devs)), all_devs)
             if ndevices else all_devs)
     key = (shape, int(case.nt), int(case.eps), float(case.k),

@@ -12,10 +12,10 @@ from __future__ import annotations
 
 import numpy as np
 
-import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nonlocalheatequation_tpu.parallel.mesh_axes import create_hybrid_mesh
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 
 def factor_devices(n: int) -> tuple[int, int]:
@@ -41,7 +41,7 @@ def make_mesh(
       reference's partition-map file: tile (i,j) is owned by device
       assignment[i,j].  Must be a bijection onto the device set.
     """
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else device_list())
     if assignment is not None:
         ids = np.asarray(assignment)
         if sorted(ids.ravel().tolist()) != sorted(d.id for d in devices):
@@ -87,7 +87,7 @@ def make_mesh_3d(
     devices=None,
 ) -> Mesh:
     """3D mesh with axes ('x', 'y', 'z') for the 3D distributed solver."""
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else device_list())
     if mx is None or my is None or mz is None:
         mx, my, mz = factor_devices_3d(len(devices))
     if mx * my * mz > len(devices):

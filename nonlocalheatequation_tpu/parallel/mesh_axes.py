@@ -30,8 +30,9 @@ from __future__ import annotations
 
 import numpy as np
 
-import jax
 from jax.sharding import Mesh
+
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 #: logical axis -> "ici" | "dcn".  ``case`` is the ensemble batch axis
 #: (serve/ensemble.py); the rest are the spatial / slot axes of
@@ -97,7 +98,7 @@ def create_hybrid_mesh(
     if len(axis_names) != len(shape):
         raise ValueError(
             f"axis_names {axis_names} and shape {shape} disagree in rank")
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else device_list())
     n = int(np.prod(shape)) if shape else 1
     if n > len(devices):
         raise ValueError(
@@ -138,7 +139,7 @@ def pick_gang_devices(n: int, devices=None) -> list:
     consumed largest-first until n is reached; within a granule the
     original device order is kept (the row-major reshape contract of
     :func:`create_hybrid_mesh`)."""
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else device_list())
     n = int(n)
     if not 1 <= n <= len(devices):
         raise ValueError(

@@ -33,6 +33,8 @@ import numpy as np
 
 import jax
 
+from nonlocalheatequation_tpu.utils.devices import device_list
+
 
 def _already_initialized() -> bool:
     """Has jax.distributed.initialize already run in this process?
@@ -166,7 +168,7 @@ def _replicate(x) -> np.ndarray:
     if mesh is None or getattr(mesh, "empty", True):
         from jax.sharding import Mesh
 
-        mesh = Mesh(np.asarray(jax.devices()), ("p",))
+        mesh = Mesh(np.asarray(device_list()), ("p",))
     fn = _REPLICATE_CACHE.get(mesh)
     if fn is None:
         fn = jax.jit(lambda a: a,
@@ -221,7 +223,7 @@ def assert_same_on_all_hosts(x, tag: str = "value") -> None:
     # process; the callback materializes only ADDRESSABLE shards, so each
     # row carries the digest of the process owning that device
     rep_dev = {}
-    for d in jax.devices():
+    for d in device_list():
         rep_dev.setdefault(d.process_index, d)
     reps = [rep_dev[p] for p in sorted(rep_dev)]
     mesh = Mesh(np.asarray(reps), ("p",))

@@ -3,6 +3,10 @@
 PR 7 put the stepper tier (models/steppers.py) above the single-device
 method dispatch; this module puts it above the DISTRIBUTED transports
 (ISSUE 13, ROADMAP item 3 — the two biggest speedups finally meet).
+The exchange each stage rides is the reference's per-step neighbor-band
+protocol (``add_neighbour_rectangle``,
+src/2d_nonlocal_distributed.cpp:982-992, as ported by parallel/halo.py);
+the stage batches below amortize exactly those rounds.
 The key structural fact: every RKC stage is exactly one eps-halo
 operator apply, so the stage loop composes with the existing exchange
 machinery unchanged:

@@ -89,6 +89,7 @@ from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.obs.metrics import MetricsRegistry
 from nonlocalheatequation_tpu.utils import compat
 from nonlocalheatequation_tpu.utils.checkpoint import atomic_file
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 #: Entry format marker; bump on any layout change so old files refuse
 #: loudly instead of deserializing garbage.
@@ -149,7 +150,7 @@ def topology_fingerprint(backend: str | None = None) -> dict:
     in a constructor)."""
     import jax
 
-    devices = jax.devices(backend) if backend else jax.devices()
+    devices = device_list(backend) if backend else device_list()
     return {
         "platform": devices[0].platform,
         "device_kind": getattr(devices[0], "device_kind", ""),

@@ -45,6 +45,8 @@ from collections import deque
 
 import numpy as np
 
+from nonlocalheatequation_tpu.utils.devices import device_list
+
 #: Fault classifications the supervisor assigns to a failed attempt.
 CLASS_ERROR = "error"  # dispatch/fetch raised
 CLASS_HANG = "hang"  # fetch missed its deadline
@@ -204,10 +206,8 @@ class CpuFallback:
         self._device = None
 
     def _cpu_device(self):
-        import jax
-
         if self._device is None:
-            self._device = jax.devices("cpu")[0]
+            self._device = device_list("cpu")[0]
         return self._device
 
     def _sibling(self, dim: int):

@@ -119,7 +119,6 @@ from nonlocalheatequation_tpu.serve.transport import (
     MAX_FRAME_BYTES,
     WORKER_TOKEN_ENV,
     make_transport,
-    read_frame as _read_frame,
     write_frame as _write_frame,
     write_json_frame,
 )
@@ -405,19 +404,23 @@ class ReplicaRouter:
         self._m_max_outstanding.set(self.max_outstanding)
         self._m_buckets = r.gauge("/router/buckets")
         self._h_latency = r.histogram("/router/request-latency-ms")
+        # the router's shared state is written from the caller's thread,
+        # every per-replica reader thread, and the elastic scale loop;
+        # the guarded_by annotations are ENFORCED by graftlint L1
+        # (tools/lint/locks.py)
         self._lock = threading.RLock()
-        self._replicas: dict[int, _Replica] = {}
+        self._replicas: dict[int, _Replica] = {}  # guarded_by: self._lock
         #: every admitted-but-undelivered request, keyed by seq.  The
         #: per-replica ``outstanding`` maps are ROUTING state (who holds
         #: the case now) and go transiently empty while a death's
         #: orphans await re-routing; this map is the delivery ledger —
         #: only a result/error frame (or close) removes a request, so
         #: drain()/admission can never mistake mid-recovery for done.
-        self._pending: dict[int, RouterRequest] = {}
-        self._owner: dict = {}  # bucket key -> rid
-        self._next_rid = 0
-        self._next_seq = 0
-        self._closed = False
+        self._pending: dict[int, RouterRequest] = {}  # guarded_by: self._lock
+        self._owner: dict = {}  # bucket key -> rid; guarded_by: self._lock
+        self._next_rid = 0  # guarded_by: self._lock
+        self._next_seq = 0  # guarded_by: self._lock
+        self._closed = False  # guarded_by: self._lock
         self._telemetry = FleetTelemetry()
         self._policy = BusyRatePolicy(self._telemetry)
         if self._flightrec is not None:
@@ -437,8 +440,11 @@ class ReplicaRouter:
 
     # -- worker lifecycle ---------------------------------------------------
     def _spawn(self, gang: bool = False) -> int:
-        rid = self._next_rid
-        self._next_rid += 1
+        with self._lock:
+            # concurrent spawns are real (a reader thread's respawn
+            # racing add_replica): the id draw must be atomic
+            rid = self._next_rid
+            self._next_rid += 1
         env = dict(os.environ)
         # a router-level fault plan must not leak INTO the workers'
         # pipelines (the die kind is router vocabulary; raise/stall/nan

@@ -52,7 +52,6 @@ from __future__ import annotations
 import hmac
 import ipaddress
 import json
-import os
 import pickle
 import signal
 import socket
@@ -285,7 +284,7 @@ class SocketTransport:
         self.port = self._srv.getsockname()[1]
         #: connections that helloed for a replica nobody asked for YET
         #: (two concurrent spawns can accept each other's workers)
-        self._parked: dict[int, socket.socket] = {}
+        self._parked: dict[int, socket.socket] = {}  # guarded_by: self._lock
         #: serializes the accept loop: _spawn can run concurrently (a
         #: reader thread's respawn racing an elastic add_replica), and
         #: the listener's settimeout/accept pair is not thread-safe to

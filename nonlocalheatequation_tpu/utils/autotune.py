@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from nonlocalheatequation_tpu.obs import trace as obs_trace
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 # probe length: long enough to amortize per-call dispatch into the same
 # regime the real run sees (the tunnel adds ~64 ms per call,
@@ -277,7 +278,7 @@ def pick_batched_multi_step_fn(ops, nsteps: int, shape, dtype,
 
     key = "/".join([
         f"v{__version__}",
-        jax.devices()[0].device_kind, getattr(op0, "method", "?"),
+        device_list()[0].device_kind, getattr(op0, "method", "?"),
         "x".join(map(str, shape)), f"eps{op0.eps}", dtype.name,
         f"batch{len(ops)}",
     ] + ([f"prec-{getattr(op0, 'precision', 'f32')}"]
@@ -370,7 +371,7 @@ def pick_op_method(op, shape, dtype):
     precision = getattr(op, "precision", "f32")
     key = "/".join([
         f"v{__version__}",
-        jax.devices()[0].device_kind, "method-ab",
+        device_list()[0].device_kind, "method-ab",
         f"{op.method}-vs-fft",
         "x".join(map(str, shape)), f"eps{op.eps}", dtype.name,
     ] + ([f"prec-{precision}"] if precision != "f32" else []))
@@ -436,7 +437,7 @@ def pick_multi_step_fn(op, nsteps: int, shape, dtype):
     precision = getattr(op, "precision", "f32")
     key = "/".join([
         f"v{__version__}",
-        jax.devices()[0].device_kind, getattr(op, "method", "?"),
+        device_list()[0].device_kind, getattr(op, "method", "?"),
         "x".join(map(str, shape)), f"eps{op.eps}", dtype.name,
     ] + ([f"prec-{precision}"] if precision != "f32" else []))
     cands = dict(candidates(op, shape, nsteps, dtype))

@@ -1,0 +1,46 @@
+"""The single sanctioned accessor for the backend's device list.
+
+``jax.devices()`` initializes the backend on first call, and over the
+axon TPU tunnel that initialization can HANG for hours when the tunnel
+is wedged (docs/bench/README.md "Wedge trigger") — it cannot be retried,
+timed out, or safely interrupted from the calling process.  The repo's
+wedge discipline therefore confines raw device queries to the
+wedge-proof entry points, which probe the backend in sacrificial
+subprocesses with budgets and a CPU fallback ladder:
+
+* ``bench.py`` (the probe ladder; see its module docstring),
+* ``__graft_entry__.py`` (``entry()`` / ``dryrun_multichip``),
+* ``tools/tpu_sanity.py`` (its own subprocess-per-check process model),
+
+and to THIS module, which every other call site goes through.  The
+functions here add no behavior — they exist so that "who can touch the
+backend" is one grep plus a lint rule, not a repo-wide review.
+graftlint rule W1 (tools/lint/rules.py) flags any other
+``jax.devices()`` / ``jax.device_count()`` call.
+
+Calling these is an EXECUTION-PATH act, same as ``donation_on()``
+(utils/donation.py): never call from a constructor or program-build
+path — solvers take ``devices=`` parameters and default them at the
+execution boundary (CLI main / do_work), which is where these helpers
+belong.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def device_list(backend: str | None = None) -> list:
+    """``list(jax.devices(backend))`` — the sanctioned spelling.
+
+    Initializes the backend (wedge-sensitive over the tunnel): call on
+    the execution path only.  ``backend=None`` means the default
+    backend, exactly like ``jax.devices()``.
+    """
+    return list(jax.devices(backend) if backend else jax.devices())
+
+
+def device_count(backend: str | None = None) -> int:
+    """``len(device_list(backend))`` — the sanctioned spelling of
+    ``jax.device_count()``.  Same execution-path-only contract."""
+    return len(device_list(backend))

@@ -186,7 +186,7 @@ def read_vtu_point_data(path: str) -> dict[str, np.ndarray]:
         dtype = {v: k for k, v in _VTK_TYPES.items()}[vtk_type]
         raw = base64.b64decode(payload.strip())
         if compress:
-            header_len = 32  # 4 x UInt64
+            # header: 4 x UInt64 (32 raw bytes = 44 base64 chars)
             header = struct.unpack("<4Q", base64.b64decode(payload.strip()[:44]))
             comp = base64.b64decode(payload.strip()[44:])
             data = zlib.decompress(comp)[: header[1]]

@@ -66,7 +66,6 @@ def test_rebalance_converges_from_reference_fixture():
 
 def test_rebalance_never_empties_a_device():
     a = imbalanced_map()
-    busy = np.array([400.0, 10000.0])
     for _ in range(10):
         a = lb.rebalance_assignment(a, lb.WorkTelemetry(2).busy_rates(a))
         assert (np.bincount(a.ravel(), minlength=2) >= 1).all()

@@ -1,0 +1,4 @@
+"""Namespace package marker so ``python -m tools.lint`` resolves from the
+repo root.  The scripts in this directory remain directly runnable
+(``python tools/gen_docs.py``); nothing imports ``tools`` as a library
+except the lint package and its tests."""

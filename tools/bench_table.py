@@ -49,6 +49,8 @@ if os.environ.get("BENCH_PLATFORM"):
 
 import jax.numpy as jnp  # noqa: E402
 
+from nonlocalheatequation_tpu.utils.devices import device_list  # noqa: E402
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -208,7 +210,7 @@ def bench_dist2d(steps: int):
         sec = _time_dist_solver(s, steps)
         name = "2d/distributed" if K == 1 else f"2d/distributed-superstep{K}"
         emit(name, n * n, steps, sec, grid=n, eps=8,
-             devices=len(jax.devices()), mesh=dict(s.mesh.shape))
+             devices=len(device_list()), mesh=dict(s.mesh.shape))
 
 
 def bench_scaling(steps: int):
@@ -221,7 +223,7 @@ def bench_scaling(steps: int):
 
     block = cfg("BT_SCALE_BLOCK", 2048, 256)  # per-device block edge
     method = "pallas" if on_tpu() else "sat"
-    ndev_all = len(jax.devices())
+    ndev_all = len(device_list())
     counts = [c for c in (1, 2, 4, 8) if c <= ndev_all]
     if counts != [1, 2, 4, 8]:
         log(f"    only {ndev_all} device(s): sweep truncated to {counts} "
@@ -231,7 +233,7 @@ def bench_scaling(steps: int):
         mx = {1: 1, 2: 2, 4: 2, 8: 4}[ndev]
         my = ndev // mx
         NX, NY = block * mx, block * my
-        mesh = make_mesh(mx, my, jax.devices()[:ndev])
+        mesh = make_mesh(mx, my, device_list()[:ndev])
         s = Solver2DDistributed(NX, NY, 1, 1, nt=steps, eps=8, k=1.0,
                                 dt=1e-7, dh=1.0 / NX, method=method,
                                 dtype=jnp.float32, mesh=mesh)
@@ -330,7 +332,7 @@ def bench_unstructured(steps: int):
          p_mib=round(wplan.p_bytes_f32 / 2**20))
 
     # sharded halo forms (multi-device only): boundary-export vs full gather
-    if len(jax.devices()) > 1:
+    if len(device_list()) > 1:
         from nonlocalheatequation_tpu.ops.unstructured import (
             ShardedUnstructuredOp,
         )
@@ -347,7 +349,7 @@ def bench_unstructured(steps: int):
             sec, _ = time_steps(multi, u0, steps)
             emit(f"unstructured/sharded/{halo}", op.n, steps, sec,
                  nodes=op.n, edges=len(op.tgt),
-                 devices=len(jax.devices()),
+                 devices=len(device_list()),
                  # the gather form always moves the whole state
                  comm_ratio=(round(sh.halo_comm_ratio, 4)
                              if halo == "export" else 1.0))
@@ -364,7 +366,7 @@ def bench_unstructured(steps: int):
 
             sec, _ = time_steps(multi_o, u0, steps)
             emit("unstructured/sharded/offsets", op.n, steps, sec,
-                 nodes=op.n, edges=len(op.tgt), devices=len(jax.devices()),
+                 nodes=op.n, edges=len(op.tgt), devices=len(device_list()),
                  comm_ratio=round(sh.halo_comm_ratio, 4))
 
             # communication-avoiding superstep on the same sharded op:
@@ -383,7 +385,7 @@ def bench_unstructured(steps: int):
                 sec, _ = time_steps(multi_ss, u0, nblocks * 2)
                 emit("unstructured/sharded/offsets-superstep2", op.n,
                      nblocks * 2, sec, nodes=op.n, edges=len(op.tgt),
-                     devices=len(jax.devices()), superstep=2,
+                     devices=len(device_list()), superstep=2,
                      comm_ratio=round(sh.halo_comm_ratio, 4))
             else:
                 log("    offsets-superstep2: does not fit "
@@ -434,7 +436,7 @@ def bench_elastic(steps: int):
             e.do_work()
             best = min(best, time.perf_counter() - t0)
         emit(label, n * n, steps, best, grid=n, eps=8,
-             tiles=ntiles * ntiles, devices=len(jax.devices()),
+             tiles=ntiles * ntiles, devices=len(device_list()),
              spmd_ms_per_step=spmd_sec / steps * 1e3,
              elastic_over_spmd=best / spmd_sec,
              **({"superstep": ksup} if ksup > 1 else {}))
@@ -489,7 +491,7 @@ def bench_elastic_general(steps: int):
             e.do_work()
             best = min(best, time.perf_counter() - t0)
         emit(label, n * n, steps, best, grid=n, eps=eps,
-             tiles=ntiles * ntiles, devices=len(jax.devices()))
+             tiles=ntiles * ntiles, devices=len(device_list()))
 
 
 def bench_autotune(steps: int):
@@ -1215,9 +1217,9 @@ def bench_multichip(steps: int):
     )
 
     n = cfg("BT_MC_GRID", 2048, 64)
-    ndev = len(jax.devices())
+    ndev = len(device_list())
     mx, my = factor_devices(ndev)
-    mesh = make_mesh(mx, my, jax.devices())
+    mesh = make_mesh(mx, my, device_list())
     walls = {}
     for comm in ("collective", "fused"):
         s = Solver2DDistributed(n, n, 1, 1, nt=steps, eps=8, k=1.0,
@@ -1282,7 +1284,7 @@ def main() -> int:
     os.environ.pop("NLHEAT_PROGRAM_STORE", None)
     steps = int(os.environ.get("BT_STEPS", 20))
     names = [a for a in sys.argv[1:] if not a.startswith("-")] or list(BENCHES)
-    log(f"backend={jax.default_backend()} devices={len(jax.devices())} "
+    log(f"backend={jax.default_backend()} devices={len(device_list())} "
         f"steps={steps}")
     failed = 0
     for name in names:

@@ -1,0 +1,40 @@
+"""graftlint — repo-specific static analysis for the invariants that
+pytest cannot see.
+
+The repo's load-bearing invariants (CLAUDE.md, docs/bench/README.md
+"Wedge trigger", docs/architecture.md "Invariant wall") are enforced
+here by AST-based rules, the analog of the reference project's
+clang-tidy/CI wall (SURVEY.md section CI):
+
+==== =====================================================================
+rule invariant
+==== =====================================================================
+W1   no bare ``jax.devices()``/``jax.device_count()`` outside the
+     wedge-proof wrappers (bench.py, __graft_entry__.py,
+     utils/devices.py) — a raw call can hang for hours on a wedged
+     tunnel (rules.py)
+W2   no ``os.environ["JAX_PLATFORMS"]`` writes — the axon plugin
+     ignores the env var; force CPU with
+     ``jax.config.update("jax_platforms", "cpu")`` (rules.py)
+W3   no f64 ``lax.scan``/``fori_loop`` with an explicit float64 operand
+     and no platform guard — f64 scans wedge the TPU (rules.py)
+W4   no ``block_until_ready`` as a fence — it returns early over the
+     tunnel; fence with ``float(jnp.sum(x))`` (rules.py)
+K1   every program-altering EnsembleEngine constructor knob must flow
+     into the program/store key in ``build_program`` — a missing
+     dimension silently serves a stale compiled program from the
+     PR-9 store (enginekey.py)
+P1   parity-relevant modules (ops/, models/, parallel/) must cite a
+     reference ``file:line`` in their module docstring (rules.py)
+L1   attributes annotated ``# guarded_by: self._lock`` in the threaded
+     serve tier must be mutated under that lock (locks.py)
+==== =====================================================================
+
+Entry point: ``python -m tools.lint`` (see __main__.py).  Per-line
+suppression: ``# lint-ok: RULE reason``.  Grandfathered findings live in
+tools/lint/baseline.json with a reason string each; the CLI fails on any
+finding not in the baseline AND on stale baseline entries, so the
+baseline can only shrink.
+"""
+
+from tools.lint.core import Finding, Suppressions, load_baseline  # noqa: F401

@@ -1,0 +1,244 @@
+"""graftlint CLI — ``python -m tools.lint`` from the repo root.
+
+    python -m tools.lint                 # lint the repo, baseline applied
+    python -m tools.lint --fix           # apply the mechanical W1 rewrite
+    python -m tools.lint --no-baseline   # show grandfathered findings too
+    python -m tools.lint --write-baseline  # regenerate baseline skeleton
+    python -m tools.lint path.py ...     # restrict to specific files
+
+Exit codes: 0 clean, 1 findings (new, stale-baseline drift, or a
+reason-less ``# lint-ok``), 2 usage error.  CI runs the bare form: any
+new finding and any stale baseline entry fails the job, so the baseline
+can only shrink (docs/architecture.md "Invariant wall").
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import sys
+from pathlib import Path
+
+from tools.lint import enginekey, locks, rules
+from tools.lint.core import (
+    Finding,
+    Suppressions,
+    apply_baseline,
+    load_baseline,
+)
+
+ROOT = Path(__file__).resolve().parents[2]
+
+#: file sets, repo-relative.  Tests are deliberately out of scope: they
+#: run under tests/conftest.py's forced-CPU config where the wedge rules
+#: cannot bite, and fixtures under tests/lint_fixtures/ must stay
+#: violating on purpose.
+SCAN_GLOBS = (
+    "nonlocalheatequation_tpu/**/*.py",
+    "tools/**/*.py",
+    "examples/*.py",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+#: the wedge-proof device-probe entry points (see utils/devices.py):
+#: the ONLY files allowed to touch jax.devices()/device_count() raw
+W1_ALLOW = {
+    "bench.py",
+    "__graft_entry__.py",
+    "nonlocalheatequation_tpu/utils/devices.py",
+}
+
+#: parity-citation scope (CLAUDE.md): the numerics packages whose code
+#: mirrors reference behavior.  Package __init__ re-export shims carry
+#: no parity logic.
+P1_PREFIXES = ("nonlocalheatequation_tpu/ops/",
+               "nonlocalheatequation_tpu/models/",
+               "nonlocalheatequation_tpu/parallel/")
+
+#: the threaded serve tier under L1 (annotation-driven; see locks.py)
+L1_FILES = ("nonlocalheatequation_tpu/serve/router.py",
+            "nonlocalheatequation_tpu/serve/server.py",
+            "nonlocalheatequation_tpu/serve/transport.py")
+
+ENSEMBLE = "nonlocalheatequation_tpu/serve/ensemble.py"
+PICKER = "nonlocalheatequation_tpu/serve/picker.py"
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def rel(p: Path) -> str:
+    return p.resolve().relative_to(ROOT).as_posix()
+
+
+def iter_files(explicit: list[str]) -> list[Path]:
+    if explicit:
+        return [Path(p) for p in explicit]
+    out: list[Path] = []
+    for g in SCAN_GLOBS:
+        out.extend(sorted(ROOT.glob(g)))
+    # dedup (tools/**/*.py matches tools/lint/* too — scanned, fine)
+    seen, files = set(), []
+    for p in out:
+        r = rel(p)
+        if r not in seen and p.is_file():
+            seen.add(r)
+            files.append(p)
+    return files
+
+
+def scan_file(path: Path) -> list[Finding]:
+    r = rel(path)
+    src = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("E0", r, e.lineno or 1, f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    sup = Suppressions(src)
+    found: list[Finding] = []
+    if r not in W1_ALLOW:
+        found += rules.rule_w1(r, src, tree, lines)
+    found += rules.rule_w2(r, src, tree, lines)
+    found += rules.rule_w3(r, src, tree, lines)
+    found += rules.rule_w4(r, src, tree, lines)
+    if r.startswith(P1_PREFIXES) and not r.endswith("__init__.py"):
+        found += rules.rule_p1(r, src, tree, lines)
+    if r in L1_FILES:
+        found += locks.check_locks(r, src, tree)
+    kept = [f for f in found if not sup.active(f.rule, f.line)]
+    for line, rule in sup.unreasoned:
+        kept.append(Finding(
+            rule, r, line,
+            "`# lint-ok` without a reason — suppressions must say why "
+            "(`# lint-ok: RULE <reason>`)", _line(lines, line)))
+    return kept
+
+
+def _line(lines: list[str], n: int) -> str:
+    return lines[n - 1].strip() if 0 < n <= len(lines) else ""
+
+
+def apply_w1_fix(path: Path, findings: list[Finding]) -> int:
+    """The mechanical W1 rewrite: jax.devices -> device_list,
+    jax.device_count -> device_count on flagged lines, plus the import.
+    Returns the number of rewritten lines."""
+    lineset = {f.line for f in findings
+               if f.rule == "W1" and f.fixable and rel(path) == f.path}
+    if not lineset:
+        return 0
+    src = path.read_text(encoding="utf-8")
+    lines = src.splitlines(keepends=True)
+    n = 0
+    for i in sorted(lineset):
+        old = lines[i - 1]
+        new = old.replace("jax.devices(", "device_list(") \
+                 .replace("jax.device_count(", "device_count(")
+        if new != old:
+            lines[i - 1] = new
+            n += 1
+    if n == 0:
+        return 0
+    text = "".join(lines)
+    names = sorted({w for w in ("device_list", "device_count")
+                    if w + "(" in text})
+    imp = ("from nonlocalheatequation_tpu.utils.devices import "
+           + ", ".join(names) + "\n")
+    if "utils.devices import" not in text:
+        tree = ast.parse(src)
+        last = 0
+        for node in tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                last = node.end_lineno or node.lineno
+        lines.insert(last, imp)
+        text = "".join(lines)
+    path.write_text(text, encoding="utf-8")
+    return n
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="graftlint: the repo's invariant wall "
+                    "(tools/lint/__init__.py for the rule table)")
+    ap.add_argument("paths", nargs="*",
+                    help="restrict the scan to these files")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply the mechanical W1 device-wrapper rewrite")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="grandfathered-findings file (default: "
+                         "tools/lint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as a baseline skeleton "
+                         "(reasons must then be filled in by hand)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        import tools.lint as pkg
+
+        print(pkg.__doc__)
+        return 0
+
+    findings: list[Finding] = []
+    for path in iter_files(args.paths):
+        findings += scan_file(path)
+    # cross-file checks run on the canonical files regardless of the
+    # path restriction (they are cheap and K1 is never baselined)
+    if not args.paths:
+        findings += enginekey.check_engine_key(str(ROOT / ENSEMBLE),
+                                               str(ROOT / PICKER),
+                                               rel_path=ENSEMBLE)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    if args.fix:
+        fixed = 0
+        by_path: dict[str, list[Finding]] = {}
+        for f in findings:
+            by_path.setdefault(f.path, []).append(f)
+        for p, fs in by_path.items():
+            fixed += apply_w1_fix(ROOT / p, fs)
+        print(f"lint --fix: rewrote {fixed} line(s); re-run to verify")
+        return 0
+
+    if args.write_baseline:
+        skel = [f.baseline_entry() for f in findings if f.rule != "K1"]
+        Path(args.baseline).write_text(
+            json.dumps(skel, indent=2) + "\n", encoding="utf-8")
+        print(f"wrote {len(skel)} entries to {args.baseline} "
+              "(fill in the reason strings; K1 findings are never "
+              "baselined — fix them)")
+        return 1 if any(f.rule == "K1" for f in findings) else 0
+
+    entries = []
+    if not args.no_baseline and Path(args.baseline).is_file():
+        try:
+            entries = load_baseline(args.baseline)
+        except ValueError as e:
+            print(f"lint: {e}", file=sys.stderr)
+            return 2
+    if any(e["rule"] == "K1" for e in entries):
+        print("lint: K1 findings may not be baselined (a stale program "
+              "store key is a wrong-results bug) — fix them or extend "
+              "NONPROGRAM_KNOBS with a reviewed reason", file=sys.stderr)
+        return 2
+    split = apply_baseline(findings, entries)
+
+    for f in split.new:
+        print(f.render())
+    for e in split.stale:
+        print(f"{e['path']}: stale baseline entry ({e['rule']}: "
+              f"{e['code'][:60]}) — the finding is gone; remove it from "
+              f"{args.baseline}")
+    status = (f"lint: {len(split.new)} finding(s), "
+              f"{len(split.grandfathered)} grandfathered, "
+              f"{len(split.stale)} stale baseline entr(y/ies)")
+    print(status)
+    return 1 if (split.new or split.stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

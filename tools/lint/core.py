@@ -1,0 +1,113 @@
+"""graftlint plumbing: findings, per-line suppressions, the baseline.
+
+A finding is matched against the baseline by ``(rule, path, code)`` —
+``code`` is the stripped source line — never by line NUMBER, so an
+unrelated edit above a grandfathered finding does not break the match.
+Identical lines in one file consume baseline entries by count.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    msg: str
+    code: str = ""  # stripped source line the finding anchors to
+    fixable: bool = False
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.msg}"
+
+    def baseline_entry(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "code": self.code,
+                "reason": "FILL IN: why this finding is acceptable"}
+
+
+#: ``# lint-ok: W4 some reason`` — suppresses RULE on that line (or, as
+#: a standalone comment line, on the next line).  The reason is
+#: mandatory: a bare ``# lint-ok: W4`` still counts as a finding
+#: (rendered with a tell-me-why message) so suppressions stay auditable.
+_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*([A-Z]\d+)\s*(.*)")
+
+
+class Suppressions:
+    """Per-file map of line number -> set of suppressed rules."""
+
+    def __init__(self, src: str):
+        self.by_line: dict[int, set[str]] = {}
+        self.unreasoned: list[tuple[int, str]] = []
+        for i, text in enumerate(src.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rule, reason = m.group(1), m.group(2).strip()
+            if not reason:
+                self.unreasoned.append((i, rule))
+                continue
+            # a standalone comment line annotates the statement below it
+            line = i + 1 if text[: m.start()].strip() == "" else i
+            self.by_line.setdefault(line, set()).add(rule)
+
+    def active(self, rule: str, line: int) -> bool:
+        return rule in self.by_line.get(line, set())
+
+
+def load_baseline(path) -> list[dict]:
+    """Read baseline.json: a list of {rule, path, code, reason} dicts.
+    Refuses loudly on schema drift — a malformed baseline silently
+    matching nothing would surface as a wall of 'new' findings."""
+    with open(path, encoding="utf-8") as fh:
+        entries = json.load(fh)
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: baseline must be a JSON list")
+    for e in entries:
+        missing = {"rule", "path", "code", "reason"} - set(e)
+        if missing:
+            raise ValueError(
+                f"{path}: baseline entry {e!r} missing keys {sorted(missing)}")
+        if not str(e["reason"]).strip():
+            raise ValueError(
+                f"{path}: baseline entry for {e['path']} ({e['rule']}) "
+                "has an empty reason — every grandfathered finding needs "
+                "a justification string")
+    return entries
+
+
+@dataclass
+class BaselineMatch:
+    new: list[Finding] = field(default_factory=list)
+    grandfathered: list[Finding] = field(default_factory=list)
+    stale: list[dict] = field(default_factory=list)
+
+
+def apply_baseline(findings: list[Finding],
+                   entries: list[dict]) -> BaselineMatch:
+    """Split findings into new vs grandfathered and report stale
+    entries.  Matching key is (rule, path, code); duplicate keys are
+    consumed by count so two identical grandfathered lines in one file
+    need two entries."""
+    budget: dict[tuple, int] = {}
+    for e in entries:
+        k = (e["rule"], e["path"], e["code"])
+        budget[k] = budget.get(k, 0) + 1
+    out = BaselineMatch()
+    for f in findings:
+        k = (f.rule, f.path, f.code)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            out.grandfathered.append(f)
+        else:
+            out.new.append(f)
+    for e in entries:
+        k = (e["rule"], e["path"], e["code"])
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            out.stale.append(e)
+    return out

@@ -1,0 +1,203 @@
+"""K1 — engine-key completeness for the AOT program store.
+
+Every EnsembleEngine constructor knob that alters the compiled program
+(stepper, precision, comm, method, variant, ksteps, stages, dtype, mesh
+shape via the bucket key) must flow into the program/store key built in
+``build_program`` (serve/ensemble.py) — a missing dimension makes the
+PR-9 program store (serve/program_store.py) silently serve a STALE
+compiled executable for the other setting of that knob, which is a
+wrong-results bug, not a perf bug.  K1 is therefore never baselined
+(ISSUE 14): it must end at zero findings.
+
+Method: diff the ``__init__`` parameters of EnsembleEngine against the
+``self.<attr>`` names reachable from the ``prog_key`` / ``store_key``
+assignment expressions in ``build_program`` (one level of
+``self._helper()`` indirection is resolved, which covers the
+``dtype -> self._dtype() -> self.dtype`` hop), modulo the documented
+allowlist of genuinely non-program knobs below.
+
+A second, cross-file check pins the picker contract: every axis
+``serve/picker.py``'s ``EngineChoice.engine_kwargs()`` can vary must be
+one of the key-covered knobs — otherwise a picked engine could differ
+from the default engine in a dimension the store cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding
+
+#: ctor knobs that deliberately do NOT join the program key, each with
+#: the reason reviewed at rule-introduction time.  Adding a knob here
+#: is a code-reviewed claim that it cannot change the compiled program.
+NONPROGRAM_KNOBS = {
+    "batch_sizes": "padding sizes only select len(chunk), which IS a "
+                   "prog_key dimension",
+    "program_store": "where programs persist, not what they compute",
+    "program_cache_cap": "in-memory LRU bound; eviction re-builds the "
+                         "identical program",
+    "store_backend": "joins the store digest via load_or_build's "
+                     "backend= parameter (program_store.py), not the "
+                     "in-memory key",
+}
+
+_KEY_NAMES = ("prog_key", "store_key", "cache_key")
+
+
+class _SelfAttrs(ast.NodeVisitor):
+    """Collect ``self.X`` attribute reads and ``self._helper()`` calls
+    in an expression subtree."""
+
+    def __init__(self):
+        self.attrs: set[str] = set()
+        self.helper_calls: set[str] = set()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            self.attrs.add(node.attr)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self":
+            self.helper_calls.add(f.attr)
+        self.generic_visit(node)
+
+
+def _find_class(tree: ast.Module, name: str) -> ast.ClassDef | None:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _find_method(cls: ast.ClassDef, name: str) -> ast.FunctionDef | None:
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _covered_attrs(cls: ast.ClassDef, build: ast.FunctionDef) -> set[str]:
+    """self attrs reachable from the key assignments in build_program,
+    resolving same-function local names and one level of self-method
+    indirection."""
+    # local name -> value expressions assigned to it in build_program
+    local_values: dict[str, list[ast.expr]] = {}
+    for node in ast.walk(build):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    local_values.setdefault(t.id, []).append(node.value)
+
+    seen_locals: set[str] = set()
+    attrs: set[str] = set()
+    helpers: set[str] = set()
+
+    def absorb(expr: ast.expr) -> None:
+        v = _SelfAttrs()
+        v.visit(expr)
+        attrs.update(v.attrs)
+        helpers.update(v.helper_calls)
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in local_values \
+                    and n.id not in seen_locals:
+                seen_locals.add(n.id)
+                for sub in local_values[n.id]:
+                    absorb(sub)
+
+    for key_name in _KEY_NAMES:
+        for expr in local_values.get(key_name, []):
+            seen_locals.add(key_name)
+            absorb(expr)
+
+    # one level of indirection: prog_key uses dtype = self._dtype(),
+    # whose body reads self.dtype — credit those attrs too
+    for h in helpers:
+        m = _find_method(cls, h)
+        if m is not None:
+            v = _SelfAttrs()
+            v.visit(m)
+            attrs.update(v.attrs)
+    return attrs
+
+
+def check_engine_key(ensemble_path: str, picker_path: str | None = None,
+                     rel_path: str | None = None) -> list[Finding]:
+    """Run K1 against an ensemble.py (and optionally picker.py) source
+    file.  ``rel_path`` overrides the path findings are reported under
+    (the regression test runs this on a mutated copy)."""
+    rel = rel_path or ensemble_path
+    with open(ensemble_path, encoding="utf-8") as fh:
+        src = fh.read()
+    tree = ast.parse(src)
+    cls = _find_class(tree, "EnsembleEngine")
+    if cls is None:
+        return [Finding("K1", rel, 1,
+                        "class EnsembleEngine not found — the K1 checker "
+                        "must be updated alongside any engine rename")]
+    init = _find_method(cls, "__init__")
+    build = _find_method(cls, "build_program")
+    if init is None or build is None:
+        return [Finding("K1", rel, cls.lineno,
+                        "EnsembleEngine.__init__/build_program not found "
+                        "— the K1 checker must be updated alongside any "
+                        "engine refactor")]
+    knobs = [a.arg for a in init.args.args if a.arg != "self"]
+    covered = _covered_attrs(cls, build)
+    out = []
+    for knob in knobs:
+        if knob in NONPROGRAM_KNOBS or knob in covered:
+            continue
+        out.append(Finding(
+            "K1", rel, build.lineno,
+            f"engine knob {knob!r} does not flow into the program/store "
+            "key in build_program — the program store would serve a "
+            "stale executable across a change of this knob; add "
+            f"self.{knob} to prog_key/store_key, or (only if it provably "
+            "cannot alter the compiled program) to "
+            "tools/lint/enginekey.NONPROGRAM_KNOBS with a reason",
+            code=f"def build_program(...)  # missing: {knob}"))
+    stale_allow = [k for k in NONPROGRAM_KNOBS if k not in knobs]
+    for knob in stale_allow:
+        out.append(Finding(
+            "K1", rel, init.lineno,
+            f"NONPROGRAM_KNOBS entry {knob!r} matches no "
+            "EnsembleEngine.__init__ parameter — remove the stale "
+            "allowlist entry (tools/lint/enginekey.py)",
+            code=f"def __init__(...)  # stale allowlist: {knob}"))
+
+    if picker_path is not None:
+        out.extend(_check_picker(picker_path, knobs))
+    return out
+
+
+def _check_picker(picker_path: str, knobs: list[str]) -> list[Finding]:
+    with open(picker_path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read())
+    cls = _find_class(tree, "EngineChoice")
+    if cls is None:
+        return [Finding("K1", picker_path, 1,
+                        "class EngineChoice not found — the K1 picker "
+                        "check must be updated alongside any rename")]
+    kwargs = _find_method(cls, "engine_kwargs")
+    if kwargs is None:
+        return [Finding("K1", picker_path, cls.lineno,
+                        "EngineChoice.engine_kwargs not found — the K1 "
+                        "picker check must be updated")]
+    out = []
+    for node in ast.walk(kwargs):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                        and k.value not in knobs:
+                    out.append(Finding(
+                        "K1", picker_path, node.lineno,
+                        f"EngineChoice.engine_kwargs() key {k.value!r} is "
+                        "not an EnsembleEngine constructor knob — a "
+                        "picked engine would vary in a dimension the "
+                        "program store cannot key on",
+                        code=f"engine_kwargs()  # unknown: {k.value}"))
+    return out

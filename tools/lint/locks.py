@@ -1,0 +1,155 @@
+"""L1 — annotation-driven lock discipline for the threaded serve tier.
+
+The router/transport classes (serve/router.py, serve/transport.py) are
+mutated from many thread entry points: the caller's thread, one reader
+thread per replica, per-replica writer threads, the elastic scale loop,
+and HTTP ingress threads.  Attributes shared across those entry points
+declare their guard in ``__init__``::
+
+    self._pending = {}   # guarded_by: self._lock
+
+and L1 enforces the declaration: every later MUTATION of a guarded
+attribute (assignment, augmented assignment, subscript store/delete, or
+a mutating method call — append/pop/clear/update/...) must sit lexically
+inside ``with self._lock:`` (the declared expression, textually), or in
+a method whose ``def`` line carries ``# locked: self._lock`` asserting
+the caller holds the lock.
+
+Known limits, by design: reads are not checked (the repo's pattern is
+copy-under-lock, asserted by tests), aliasing (``p = self._pending``)
+is not tracked, and only annotated attributes are checked — the rule is
+a declared-invariant enforcer, not an escape analysis.  ``__init__``
+itself is exempt (construction happens-before thread start).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint.core import Finding
+
+_GUARD_RE = re.compile(r"#.*\bguarded_by:\s*([\w\.\[\]'\"]+)")
+_HELD_RE = re.compile(r"#.*\blocked:\s*([\w\.\[\]'\"]+)")
+
+#: method calls that mutate their receiver (dict/list/set/OrderedDict)
+MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+            "clear", "update", "setdefault", "add", "discard",
+            "move_to_end", "appendleft", "popleft"}
+
+
+def _lock_expr(node: ast.expr) -> str:
+    return ast.unparse(node).replace(" ", "")
+
+
+def _parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    par: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            par[child] = node
+    return par
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _mutation_target(node: ast.AST) -> tuple[str, int] | None:
+    """(attr, lineno) when ``node`` mutates ``self.<attr>``."""
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                return attr, node.lineno
+            if isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+                if attr is not None:
+                    return attr, node.lineno
+    if isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+                if attr is not None:
+                    return attr, node.lineno
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in MUTATORS:
+        attr = _self_attr(node.func.value)
+        if attr is not None:
+            return attr, node.lineno
+    return None
+
+
+def _under_lock(node: ast.AST, lock: str,
+                parents: dict[ast.AST, ast.AST]) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                if _lock_expr(item.context_expr) == lock:
+                    return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            return False  # a nested def runs on its own thread/schedule
+        cur = parents.get(cur)
+    return False
+
+
+def check_locks(path: str, src: str, tree: ast.Module) -> list[Finding]:
+    lines = src.splitlines()
+    out: list[Finding] = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        init = next((m for m in cls.body if isinstance(m, ast.FunctionDef)
+                     and m.name == "__init__"), None)
+        if init is None:
+            continue
+        # declarations: `self.X = ... # guarded_by: <lock>` in __init__
+        guards: dict[str, str] = {}
+        for node in ast.walk(init):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                m = _GUARD_RE.search(lines[node.lineno - 1]) if \
+                    node.lineno <= len(lines) else None
+                if m:
+                    guards[attr] = m.group(1).replace(" ", "")
+        if not guards:
+            continue
+        parents = _parents(cls)
+        for meth in [m for m in cls.body
+                     if isinstance(m, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                     and m.name != "__init__"]:
+            held = _HELD_RE.search(lines[meth.lineno - 1]) if \
+                meth.lineno <= len(lines) else None
+            held_lock = held.group(1).replace(" ", "") if held else None
+            for node in ast.walk(meth):
+                hit = _mutation_target(node)
+                if hit is None or hit[0] not in guards:
+                    continue
+                attr, lineno = hit
+                lock = guards[attr]
+                if held_lock == lock:
+                    continue
+                if _under_lock(node, lock, parents):
+                    continue
+                code = (lines[lineno - 1].strip()
+                        if lineno <= len(lines) else "")
+                out.append(Finding(
+                    "L1", path, lineno,
+                    f"{cls.name}.{attr} is declared `# guarded_by: "
+                    f"{lock}` but is mutated in {meth.name}() outside "
+                    f"`with {lock}:` — wrap the mutation, or mark the "
+                    f"method `# locked: {lock}` if every caller "
+                    "provably holds the lock",
+                    code=code))
+    return out

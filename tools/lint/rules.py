@@ -1,0 +1,237 @@
+"""graftlint AST rules W1-W4 (wedge discipline) and P1 (parity
+citations).  Each rule is a function ``(path, src, tree, lines) ->
+list[Finding]``; scoping (which files a rule applies to) lives in
+__main__.py so the rules stay testable on bare fixture files.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint.core import Finding
+
+# -- shared helpers ---------------------------------------------------------
+
+
+def _code(lines: list[str], lineno: int) -> str:
+    try:
+        return lines[lineno - 1].strip()
+    except IndexError:
+        return ""
+
+
+def _is_jax_attr(node: ast.expr, attrs: set[str]) -> str | None:
+    """``jax.devices`` / ``jax.device_count`` style attribute access on
+    the plain name ``jax``; returns the attribute name or None."""
+    if (isinstance(node, ast.Attribute) and node.attr in attrs
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"):
+        return node.attr
+    return None
+
+
+# -- W1: bare device queries ------------------------------------------------
+
+_W1_ATTRS = {"devices", "device_count", "local_devices",
+             "local_device_count"}
+_W1_MSG = ("bare jax.{attr}() initializes the backend and can hang for "
+           "hours on a wedged tunnel; go through "
+           "nonlocalheatequation_tpu.utils.devices ({repl}) or one of "
+           "the wedge-proof entry points (bench.py, __graft_entry__.py)")
+#: mechanical rewrite targets for --fix
+W1_FIX = {"devices": "device_list", "device_count": "device_count",
+          "local_devices": "device_list", "local_device_count":
+          "device_count"}
+
+
+def rule_w1(path: str, src: str, tree: ast.AST,
+            lines: list[str]) -> list[Finding]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        attr = _is_jax_attr(node.func, _W1_ATTRS)
+        if attr:
+            out.append(Finding(
+                "W1", path, node.lineno,
+                _W1_MSG.format(attr=attr, repl=W1_FIX[attr]),
+                _code(lines, node.lineno),
+                fixable=attr in ("devices", "device_count")))
+    return out
+
+
+# -- W2: JAX_PLATFORMS env writes ------------------------------------------
+
+_W2_MSG = ('writing os.environ["JAX_PLATFORMS"] is dead code on the axon '
+           "TPU plugin (the env var is IGNORED, docs/bench/README.md); "
+           'force a platform with jax.config.update("jax_platforms", ...) '
+           "before first backend touch")
+
+
+def _is_environ(node: ast.expr) -> bool:
+    """os.environ (or bare environ imported from os)."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ" \
+            and isinstance(node.value, ast.Name) and node.value.id == "os":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _const_platform_key(node: ast.expr) -> bool:
+    return isinstance(node, ast.Constant) and node.value == "JAX_PLATFORMS"
+
+
+def rule_w2(path: str, src: str, tree: ast.AST,
+            lines: list[str]) -> list[Finding]:
+    out = []
+    for node in ast.walk(tree):
+        hit = None
+        # os.environ["JAX_PLATFORMS"] = ... (plain and augmented)
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Subscript) and _is_environ(t.value) \
+                        and _const_platform_key(t.slice):
+                    hit = node
+        # os.environ.setdefault/update/pop? — only the writing forms
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            fn = node.func
+            if fn.attr in ("setdefault", "update") and _is_environ(fn.value):
+                blob = ast.dump(node)
+                if "JAX_PLATFORMS" in blob:
+                    hit = node
+            if fn.attr == "putenv" and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "os" and node.args \
+                    and _const_platform_key(node.args[0]):
+                hit = node
+        if hit is not None:
+            out.append(Finding("W2", path, hit.lineno, _W2_MSG,
+                               _code(lines, hit.lineno)))
+    return out
+
+
+# -- W3: f64 scan/fori_loop without a platform guard ------------------------
+
+_W3_MSG = ("{fn} with an explicit float64 operand and no platform guard "
+           "in the enclosing scope — an f64 scan on the TPU wedges the "
+           "tunnel (docs/bench/README.md); guard on "
+           "jax.default_backend()/device .platform or keep the dtype "
+           "backend-derived")
+
+_F64_MARKERS = ("float64", "f64")
+
+
+def _has_f64_marker(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr == "float64":
+            return True
+        if isinstance(n, ast.Name) and n.id == "float64":
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and n.value in _F64_MARKERS:
+            return True
+    return False
+
+
+def _has_platform_guard(scope: ast.AST) -> bool:
+    """Any platform interrogation in the enclosing scope counts as a
+    guard: the author demonstrably knows there IS a platform split.
+    Recognized: jax.default_backend(), a .platform attribute read, a
+    jax.config.update("jax_platforms", ...) call, a BENCH_PLATFORM /
+    JAX_PLATFORMS env read."""
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call) and _is_jax_attr(
+                n.func, {"default_backend"}):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "platform":
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and n.value in ("jax_platforms", "BENCH_PLATFORM",
+                                "JAX_PLATFORMS"):
+            return True
+    return False
+
+
+def rule_w3(path: str, src: str, tree: ast.AST,
+            lines: list[str]) -> list[Finding]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Attribute) and fn.attr in ("scan",
+                                                         "fori_loop"):
+            base = fn.value
+            # lax.scan / jax.lax.scan (and fori_loop) spellings
+            if (isinstance(base, ast.Name) and base.id == "lax") or (
+                    isinstance(base, ast.Attribute) and base.attr == "lax"):
+                name = f"lax.{fn.attr}"
+        if name is None:
+            continue
+        # the call's OWN argument subtree must name float64 explicitly;
+        # dtype-inherited scans (the normal repo idiom) are out of scope
+        # by design — this rule catches the spelled-out foot-gun, the
+        # test suite's bit-identity contracts catch the rest
+        if not any(_has_f64_marker(a) for a in
+                   list(node.args) + [kw.value for kw in node.keywords]):
+            continue
+        # a guard anywhere in the module clears it: the author
+        # demonstrably split on platform somewhere, and a finer-grained
+        # reachability claim would overreach for an AST heuristic
+        if _has_platform_guard(tree):
+            continue
+        out.append(Finding("W3", path, node.lineno,
+                           _W3_MSG.format(fn=name),
+                           _code(lines, node.lineno)))
+    return out
+
+
+# -- W4: block_until_ready as a fence --------------------------------------
+
+_W4_MSG = ("block_until_ready() returns before execution finishes over "
+           "the axon tunnel (bench.py) — fence with a scalar "
+           "float(jnp.sum(x)) fetch; if this call is synchronization "
+           "rather than timing, annotate it `# lint-ok: W4 <why>`")
+
+
+def rule_w4(path: str, src: str, tree: ast.AST,
+            lines: list[str]) -> list[Finding]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "block_until_ready":
+            out.append(Finding("W4", path, node.lineno, _W4_MSG,
+                               _code(lines, node.lineno)))
+    return out
+
+
+# -- P1: parity citations ---------------------------------------------------
+
+#: the repo's citation forms: src/2d_nonlocal_serial.cpp:213,
+#: problem_description.tex:131-158, README.md:64-72, ...
+CITATION_RE = re.compile(
+    r"\S+?\.(?:cc|cpp|hpp|h|py|tex|md|txt|yml|cfg|cmake|sh):\d+")
+
+_P1_MSG = ("parity-relevant module carries no reference file:line "
+           "citation in its module docstring (CLAUDE.md: cite reference "
+           "file:line for parity-relevant code); add e.g. "
+           "`src/2d_nonlocal_serial.cpp:213` or, for a genuine "
+           "framework extension, cite the blueprint section that "
+           "defines its contract (SURVEY.md / problem_description.tex "
+           "with line numbers)")
+
+
+def rule_p1(path: str, src: str, tree: ast.AST,
+            lines: list[str]) -> list[Finding]:
+    doc = ast.get_docstring(tree) or ""
+    if CITATION_RE.search(doc):
+        return []
+    return [Finding("P1", path, 1, _P1_MSG, _code(lines, 1))]
+
+
+ALL_RULES = {"W1": rule_w1, "W2": rule_w2, "W3": rule_w3, "W4": rule_w4,
+             "P1": rule_p1}

@@ -49,6 +49,8 @@ import numpy as np
 
 import jax
 
+from nonlocalheatequation_tpu.utils.devices import device_list
+
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
@@ -59,7 +61,6 @@ def _field(rng, shape):
 
 def run_spmd(rng):
     """Grid SPMD: superstep K vs per-step on a random mesh/tile/nt."""
-    from nonlocalheatequation_tpu.models.solver2d import Solver2D
     from nonlocalheatequation_tpu.parallel.distributed2d import (
         Solver2DDistributed,
     )
@@ -75,7 +76,7 @@ def run_spmd(rng):
     nt = int(rng.integers(3, 8))
     test = bool(rng.integers(0, 2))
     kw = dict(eps=eps, k=1.0, dt=1e-4, dh=1.0 / nx,
-              mesh=make_mesh(mx, my, jax.devices("cpu")[:ndev]))
+              mesh=make_mesh(mx, my, device_list("cpu")[:ndev]))
     a = Solver2DDistributed(nx, ny, 1, 1, nt=nt, **kw)
     b = Solver2DDistributed(nx, ny, 1, 1, nt=nt, superstep=K, **kw)
     if test:
@@ -96,7 +97,7 @@ def run_gang(rng):
     from nonlocalheatequation_tpu.parallel.elastic import ElasticSolver2D
 
     ndev = int(rng.choice([2, 4, 8]))
-    devices = jax.devices("cpu")[:ndev]
+    devices = device_list("cpu")[:ndev]
     eps = int(rng.integers(2, 4))
     K = int(rng.integers(2, 4))
     tile = int(rng.integers(max(5, K * eps), 11))
@@ -137,7 +138,7 @@ def run_unstructured(rng):
     pts = np.stack([xs.ravel(), ys.ravel()], axis=1)
     pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
     uop = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-6, vol=h * h)
-    sh = ShardedUnstructuredOp(uop, devices=jax.devices("cpu")[:ndev])
+    sh = ShardedUnstructuredOp(uop, devices=device_list("cpu")[:ndev])
     K = int(rng.integers(2, 4))
     if sh.layout != "offsets" or not sh.superstep_fits(K):
         raise ValueError(f"draw does not fit: layout={sh.layout} K={K}")
@@ -184,7 +185,7 @@ def invalid_spmd(rng):
         f"spmd nbalance={nb}",
         lambda: Solver2DDistributed(
             8, 8, 1, 1, nt=3, eps=2, k=1.0, dt=1e-4, dh=0.125, nbalance=nb,
-            mesh=make_mesh(2, 2, jax.devices("cpu")[:4])))
+            mesh=make_mesh(2, 2, device_list("cpu")[:4])))
 
 
 def invalid_gang(rng):
@@ -199,7 +200,7 @@ def invalid_gang(rng):
         f"gang tile={tile} < K*eps={K * eps}",
         lambda: ElasticSolver2D(
             tile, tile, 2, 2, nt=3, eps=eps, k=1.0, dt=1e-4, dh=0.02,
-            devices=jax.devices("cpu")[:2], nlog=10 ** 9, superstep=K))
+            devices=device_list("cpu")[:2], nlog=10 ** 9, superstep=K))
 
 
 def invalid_unstructured(rng):
@@ -216,7 +217,7 @@ def invalid_unstructured(rng):
     pts = np.stack([xs.ravel(), ys.ravel()], axis=1)
     pts += rng.uniform(-0.2 * h, 0.2 * h, pts.shape)
     uop = UnstructuredNonlocalOp(pts, 3.0 * h, k=1.0, dt=1e-6, vol=h * h)
-    sh = ShardedUnstructuredOp(uop, devices=jax.devices("cpu")[:4])
+    sh = ShardedUnstructuredOp(uop, devices=device_list("cpu")[:4])
     K = int(rng.integers(50, 100))  # K*pad > block at every drawn m
     assert not sh.superstep_fits(K)
     return _assert_refused(

@@ -34,6 +34,7 @@ from nonlocalheatequation_tpu.ops.nonlocal_op import (
     make_multi_step_fn_base,
 )
 from nonlocalheatequation_tpu.utils import autotune
+from nonlocalheatequation_tpu.utils.devices import device_list
 
 # -- single chip: autotune the variant for this shape -----------------------
 n, eps, steps = 128, 4, 8
@@ -85,7 +86,6 @@ from nonlocalheatequation_tpu.ops.unstructured import (
     UnstructuredNonlocalOp,
     UnstructuredSolver,
 )
-from nonlocalheatequation_tpu.utils.devices import device_list
 
 rng = np.random.default_rng(0)
 m = 32

@@ -1,0 +1,18 @@
+"""L1 bad: a guarded attribute mutated off-lock from a second thread
+entry point."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = {}  # guarded_by: self._lock
+        self._done = 0  # guarded_by: self._lock
+
+    def submit(self, k, v):
+        with self._lock:
+            self._pending[k] = v
+
+    def on_reader_thread(self, k):
+        self._pending.pop(k, None)  # off-lock mutation: the bug
+        self._done += 1  # off-lock augmented assignment: also the bug

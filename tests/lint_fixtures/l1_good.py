@@ -1,0 +1,29 @@
+"""L1 good: every mutation under the declared lock, or in a method
+annotated as called-with-lock-held."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = {}  # guarded_by: self._lock
+        self._done = 0  # guarded_by: self._lock
+        self._hits = 0  # unguarded by declaration: single-thread stat
+
+    def submit(self, k, v):
+        with self._lock:
+            self._pending[k] = v
+
+    def on_reader_thread(self, k):
+        with self._lock:
+            self._pending.pop(k, None)
+            self._done += 1
+
+    def _sweep(self, keys):  # locked: self._lock
+        for k in keys:
+            del self._pending[k]
+
+    def count(self):
+        self._hits += 1  # undeclared attr: L1 has no opinion
+        with self._lock:
+            return len(self._pending)

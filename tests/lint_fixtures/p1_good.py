@@ -1,0 +1,6 @@
+"""A parity-relevant module citing its reference behavior precisely
+(the operator loop of src/2d_nonlocal_serial.cpp:213-221)."""
+
+
+def apply(u):
+    return u

@@ -1,0 +1,6 @@
+"""W1 bad: bare device queries outside the wedge-proof wrappers."""
+import jax
+
+ndev = len(jax.devices())
+count = jax.device_count()
+first_cpu = jax.devices("cpu")[0]

@@ -1,0 +1,9 @@
+"""W1 good: device queries via the sanctioned accessor."""
+import jax
+
+from nonlocalheatequation_tpu.utils.devices import device_count, device_list
+
+ndev = len(device_list())
+count = device_count()
+first_cpu = device_list("cpu")[0]
+backend = jax.default_backend()  # not a device query; never flagged

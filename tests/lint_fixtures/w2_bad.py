@@ -1,0 +1,6 @@
+"""W2 bad: JAX_PLATFORMS env writes (ignored by the axon plugin)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.update({"JAX_PLATFORMS": "cpu"})

@@ -1,0 +1,9 @@
+"""W2 good: platform forced through the config API; unrelated env
+writes stay unflagged."""
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+os.environ["NLHEAT_DONATE"] = "0"  # unrelated knob: not W2's business
+platform = os.environ.get("JAX_PLATFORMS")  # a READ is fine

@@ -1,0 +1,16 @@
+"""W3 bad: an explicit-f64 scan with no platform guard anywhere."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def run(xs):
+    def body(c, x):
+        return c + x, c
+
+    init = jnp.zeros((4,), dtype=jnp.float64)
+    return lax.scan(body, init, xs)
+
+
+def count(n):
+    return lax.fori_loop(0, n, lambda i, c: c + i,
+                         jnp.asarray(0.0, "float64"))

@@ -1,0 +1,20 @@
+"""W3 good: backend-derived dtypes, or an explicit f64 scan behind a
+platform guard."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def run(xs, dtype):
+    # dtype inherited from the caller/backend: out of W3's scope
+    def body(c, x):
+        return c + x, c
+
+    return lax.scan(body, jnp.zeros((4,), dtype=dtype), xs)
+
+
+def run_f64_guarded(xs):
+    if jax.default_backend() == "tpu":
+        raise RuntimeError("f64 scan refused on the TPU (wedge trigger)")
+    init = jnp.zeros((4,), dtype=jnp.float64)
+    return lax.scan(lambda c, x: (c + x, c), init, xs)

@@ -1,0 +1,12 @@
+"""W4 bad: block_until_ready as a benchmark fence."""
+import time
+
+import jax.numpy as jnp
+
+
+def time_steps(step, u, n):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        u = step(u)
+    u.block_until_ready()
+    return time.perf_counter() - t0, jnp.sum(u)

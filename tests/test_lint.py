@@ -1,0 +1,411 @@
+"""graftlint (tools/lint): per-rule fixtures, the suppression and
+baseline machinery, the K1 engine-key completeness checker (including
+the delete-one-dimension regression the PR-9 program store motivates),
+the L1 lock-discipline checker, and the CLI end to end — which pins the
+ISSUE 14 acceptance bar: ``python -m tools.lint`` exits 0 on this repo.
+"""
+
+import ast
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.lint import enginekey, locks, rules  # noqa: E402
+from tools.lint.core import (  # noqa: E402
+    Suppressions,
+    apply_baseline,
+    load_baseline,
+)
+
+FIX = REPO / "tests" / "lint_fixtures"
+ENSEMBLE = REPO / "nonlocalheatequation_tpu" / "serve" / "ensemble.py"
+PICKER = REPO / "nonlocalheatequation_tpu" / "serve" / "picker.py"
+
+
+def run_rule(rule: str, path: Path):
+    """One rule over one fixture, suppressions honored — the same
+    composition __main__.scan_file applies."""
+    src = path.read_text()
+    tree = ast.parse(src)
+    found = rules.ALL_RULES[rule](str(path), src, tree, src.splitlines())
+    sup = Suppressions(src)
+    return [f for f in found if not sup.active(f.rule, f.line)]
+
+
+# -- W/P rules against their fixtures ---------------------------------------
+
+
+@pytest.mark.parametrize("rule,bad_hits", [
+    ("W1", 3),  # devices() x2 forms + device_count()
+    ("W2", 3),  # subscript write, setdefault, update
+    ("W3", 2),  # f64-local scan + inline-f64 fori_loop
+    ("W4", 1),
+    ("P1", 1),
+])
+def test_rule_flags_bad_fixture(rule, bad_hits):
+    found = run_rule(rule, FIX / f"{rule.lower()}_bad.py")
+    assert len(found) == bad_hits, [f.render() for f in found]
+    assert all(f.rule == rule for f in found)
+    # every finding anchors to a real line of code for baseline matching
+    assert all(f.code for f in found)
+
+
+@pytest.mark.parametrize("rule", ["W1", "W2", "W3", "W4", "P1"])
+def test_rule_passes_good_fixture(rule):
+    found = run_rule(rule, FIX / f"{rule.lower()}_good.py")
+    assert found == [], [f.render() for f in found]
+
+
+def test_w4_suppression_requires_reason():
+    src = (FIX / "w4_good.py").read_text().replace(
+        "# lint-ok: W4 backpressure on the dispatch queue, not a "
+        "timing fence",
+        "# lint-ok: W4")
+    sup = Suppressions(src)
+    assert sup.unreasoned and sup.unreasoned[0][1] == "W4"
+    # and the bare annotation no longer suppresses
+    tree = ast.parse(src)
+    found = rules.rule_w4("x.py", src, tree, src.splitlines())
+    assert len(found) == 1
+    assert not sup.active("W4", found[0].line)
+
+
+# -- L1 lock discipline ------------------------------------------------------
+
+
+def test_l1_flags_offlock_mutations():
+    src = (FIX / "l1_bad.py").read_text()
+    found = locks.check_locks("l1_bad.py", src, ast.parse(src))
+    assert len(found) == 2, [f.render() for f in found]
+    assert {"pop" in f.code or "+=" in f.code for f in found} == {True}
+    assert all("on_reader_thread" in f.msg for f in found)
+
+
+def test_l1_passes_good_fixture():
+    src = (FIX / "l1_good.py").read_text()
+    found = locks.check_locks("l1_good.py", src, ast.parse(src))
+    assert found == [], [f.render() for f in found]
+
+
+def test_l1_serve_tier_annotations_live():
+    """The real serve tier declares guards (the annotations are not
+    vestigial) and holds them — the dogfood state this PR establishes."""
+    for relpath in ("nonlocalheatequation_tpu/serve/router.py",
+                    "nonlocalheatequation_tpu/serve/transport.py"):
+        src = (REPO / relpath).read_text()
+        assert "guarded_by: self._lock" in src, relpath
+        found = locks.check_locks(relpath, src, ast.parse(src))
+        assert found == [], [f.render() for f in found]
+
+
+# -- K1 engine-key completeness ---------------------------------------------
+
+
+def test_k1_clean_on_repo():
+    found = enginekey.check_engine_key(str(ENSEMBLE), str(PICKER))
+    assert found == [], [f.render() for f in found]
+
+
+@pytest.mark.parametrize("drop,expect", [
+    # delete the stepper dimension from prog_key: two engines differing
+    # only in integrator would share stored programs
+    ("self.comm, self.stepper, self.stages)", "self.comm, self.stages)"),
+    # delete the ksteps dimension from the store key: a superstep A/B
+    # would serve the other arm's executable across processes
+    ("self.method, self.precision,\n                                  "
+     "self.ksteps))", "self.method, self.precision))"),
+])
+def test_k1_flags_deleted_key_dimension(tmp_path, drop, expect):
+    src = ENSEMBLE.read_text()
+    assert src.count(drop) == 1, (
+        "key-builder text drifted — update this regression test AND "
+        "check the K1 checker still resolves the new spelling")
+    missing = "stepper" if "stepper" in drop else "ksteps"
+    mutated = tmp_path / "ensemble_mutated.py"
+    mutated.write_text(src.replace(drop, expect))
+    found = enginekey.check_engine_key(str(mutated))
+    assert any(f.rule == "K1" and f"'{missing}'" in f.msg
+               for f in found), [f.render() for f in found]
+
+
+def test_k1_flags_stale_allowlist_and_unknown_picker_axis(tmp_path):
+    eng = tmp_path / "ensemble.py"
+    eng.write_text(
+        "class EnsembleEngine:\n"
+        "    def __init__(self, method='auto'):\n"
+        "        self.method = method\n"
+        "    def build_program(self, key, chunk):\n"
+        "        prog_key = (key, self.method)\n"
+        "        return prog_key\n")
+    found = enginekey.check_engine_key(str(eng))
+    # every NONPROGRAM knob is stale against this minimal ctor
+    stale = [f for f in found if "stale" in f.msg]
+    assert len(stale) == len(enginekey.NONPROGRAM_KNOBS)
+    pick = tmp_path / "picker.py"
+    pick.write_text(
+        "class EngineChoice:\n"
+        "    def engine_kwargs(self):\n"
+        "        return {'method': self.method, 'tile_w': self.tile_w}\n")
+    found = enginekey.check_engine_key(str(ENSEMBLE), str(pick))
+    assert any("tile_w" in f.msg for f in found), \
+        [f.render() for f in found]
+
+
+# -- baseline machinery ------------------------------------------------------
+
+
+def test_baseline_split_and_staleness():
+    from tools.lint.core import Finding
+
+    f1 = Finding("W1", "a.py", 3, "m", code="jax.devices()")
+    f2 = Finding("W1", "a.py", 9, "m", code="jax.devices()")
+    f3 = Finding("W4", "b.py", 1, "m", code="x.block_until_ready()")
+    entries = [
+        {"rule": "W1", "path": "a.py", "code": "jax.devices()",
+         "reason": "r"},
+        {"rule": "W2", "path": "gone.py", "code": "os.environ[...]",
+         "reason": "r"},
+    ]
+    split = apply_baseline([f1, f2, f3], entries)
+    # one entry covers ONE of the two identical findings, by count
+    assert [f.line for f in split.grandfathered] == [3]
+    assert {(f.rule, f.line) for f in split.new} == {("W1", 9),
+                                                    ("W4", 1)}
+    assert [e["path"] for e in split.stale] == ["gone.py"]
+
+
+def test_baseline_schema_refusals(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"rule": "W1"}))
+    with pytest.raises(ValueError, match="JSON list"):
+        load_baseline(p)
+    p.write_text(json.dumps([{"rule": "W1", "path": "a.py"}]))
+    with pytest.raises(ValueError, match="missing keys"):
+        load_baseline(p)
+    p.write_text(json.dumps([{"rule": "W1", "path": "a.py",
+                              "code": "c", "reason": "  "}]))
+    with pytest.raises(ValueError, match="empty reason"):
+        load_baseline(p)
+
+
+def test_shipped_baseline_is_reasoned():
+    entries = load_baseline(REPO / "tools" / "lint" / "baseline.json")
+    for e in entries:
+        assert e["rule"] != "K1", "K1 findings may never be baselined"
+        assert len(e["reason"]) > 20, e
+
+
+# -- CLI end to end ----------------------------------------------------------
+
+
+def _cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.lint", *argv],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120)
+
+
+def test_cli_repo_is_clean():
+    """ISSUE 14 acceptance: the dogfooded repo lints clean modulo the
+    explicit baseline, at rc 0."""
+    proc = _cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_cli_flags_fixture_at_rc1():
+    proc = _cli("tests/lint_fixtures/w1_bad.py")
+    assert proc.returncode == 1
+    assert "W1" in proc.stdout
+
+
+def test_cli_fix_rewrites_w1(tmp_path):
+    target = tmp_path / "snippet.py"
+    target.write_text((FIX / "w1_bad.py").read_text())
+    proc = _cli("--fix", str(target))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = target.read_text()
+    assert "jax.devices(" not in out and "jax.device_count(" not in out
+    assert "from nonlocalheatequation_tpu.utils.devices import" in out
+    # the rewrite is valid python and now lints clean
+    ast.parse(out)
+    assert _cli(str(target)).returncode == 0
+
+
+def test_k1_ignores_helper_dicts_in_engine_kwargs(tmp_path):
+    pick = tmp_path / "picker.py"
+    pick.write_text(
+        "class EngineChoice:\n"
+        "    def engine_kwargs(self):\n"
+        "        labels = {'deadline': self.deadline}  # log helper\n"
+        "        return {'method': self.method}\n")
+    found = enginekey.check_engine_key(str(ENSEMBLE), str(pick))
+    assert found == [], [f.render() for f in found]
+
+
+def test_cli_runs_k1_on_restricted_ensemble_scan():
+    """A path-scoped scan naming ensemble.py must still run the
+    never-baselined K1 check (pre-commit-hook shape)."""
+    proc = _cli("nonlocalheatequation_tpu/serve/ensemble.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_bad_path_is_usage_error():
+    proc = _cli("tools/lint/does_not_exist.py")
+    assert proc.returncode == 2
+    assert "cannot read" in proc.stderr
+
+
+def test_cli_fix_keeps_docstring_first(tmp_path):
+    """A module with a docstring and no top-level imports: the fixer
+    must insert the import BELOW the docstring, not demote it."""
+    target = tmp_path / "snippet.py"
+    target.write_text(
+        '"""Docstring that must stay first."""\n\n\n'
+        "def pick():\n"
+        "    import jax\n\n"
+        "    return jax.devices()[0]\n")
+    proc = _cli("--fix", str(target))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = target.read_text()
+    assert ast.get_docstring(ast.parse(out)) == \
+        "Docstring that must stay first."
+    assert "device_list()[0]" in out
+
+
+def test_k1_unauditable_engine_kwargs_fails_closed(tmp_path):
+    pick = tmp_path / "picker.py"
+    pick.write_text(
+        "class EngineChoice:\n"
+        "    def engine_kwargs(self):\n"
+        "        kw = {'method': self.method}\n"
+        "        return kw\n")
+    found = enginekey.check_engine_key(str(ENSEMBLE), str(pick))
+    assert any("cannot audit" in f.msg for f in found), \
+        [f.render() for f in found]
+
+
+def test_w3_module_scan_ignores_function_locals():
+    """A dtype-inherited module-level scan must not be tainted by an
+    unrelated function's f64 local of the same name."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "out = lax.scan(lambda c, x: (c + x, c), init, xs)\n"
+        "def unrelated():\n"
+        "    init = jnp.zeros((4,), dtype=jnp.float64)\n"
+        "    return init\n")
+    found = rules.rule_w3("x.py", src, ast.parse(src), src.splitlines())
+    assert found == [], [f.render() for f in found]
+
+
+def test_cli_fix_skips_grandfathered_findings(tmp_path):
+    """--fix must never rewrite a finding the baseline grandfathers as
+    deliberately raw (tpu_sanity's probe children are the live case)."""
+    target = tmp_path / "probe.py"
+    target.write_text("import jax\n\nd = jax.devices()\n")
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps([{
+        "rule": "W1", "path": str(target.resolve()),
+        "code": "d = jax.devices()",
+        "reason": "deliberate raw probe in a sacrificial child"}]))
+    before = target.read_text()
+    proc = _cli("--fix", "--baseline", str(bl), str(target))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "rewrote 0 line(s)" in proc.stdout
+    assert target.read_text() == before
+
+
+def test_cli_fix_merges_partial_devices_import(tmp_path):
+    target = tmp_path / "snippet.py"
+    target.write_text(
+        "import jax\n"
+        "from nonlocalheatequation_tpu.utils.devices import device_count\n"
+        "\n"
+        "n = device_count()\n"
+        "d = jax.devices()[0]\n")
+    proc = _cli("--fix", str(target))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = target.read_text()
+    assert "jax.devices(" not in out
+    assert ("from nonlocalheatequation_tpu.utils.devices import "
+            "device_count, device_list") in out
+    ast.parse(out)
+
+
+def test_k1_dict_unpacking_is_unauditable(tmp_path):
+    pick = tmp_path / "picker.py"
+    pick.write_text(
+        "class EngineChoice:\n"
+        "    def engine_kwargs(self):\n"
+        "        return {**self._axes}\n")
+    found = enginekey.check_engine_key(str(ENSEMBLE), str(pick))
+    assert any("cannot audit" in f.msg for f in found), \
+        [f.render() for f in found]
+
+
+def test_k1_picker_findings_use_report_path(tmp_path):
+    """Picker findings must surface under the caller-supplied (repo-
+    relative in the CLI) path, not the absolute file read."""
+    bad = tmp_path / "picker.py"
+    bad.write_text(
+        "class EngineChoice:\n"
+        "    def engine_kwargs(self):\n"
+        "        return 1\n")
+    found = enginekey.check_engine_key(
+        str(ENSEMBLE), str(bad), picker_rel_path="serve/picker.py")
+    assert found and all(f.path == "serve/picker.py" for f in found), \
+        [f.render() for f in found]
+
+
+def test_cli_fix_refuses_aliased_or_multiline_devices_import(tmp_path):
+    for body in (
+        "import jax\n"
+        "from nonlocalheatequation_tpu.utils.devices import (\n"
+        "    device_count,\n"
+        ")\n\n"
+        "d = jax.devices()[0]\n",
+        "import jax\n"
+        "from nonlocalheatequation_tpu.utils.devices import "
+        "device_count as dc\n\n"
+        "n = dc()\n"
+        "d = jax.devices()[0]\n",
+    ):
+        target = tmp_path / "snippet.py"
+        target.write_text(body)
+        proc = _cli("--fix", str(target))
+        assert proc.returncode != 0
+        assert "by hand" in proc.stdout + proc.stderr
+        # the file was not corrupted: still parses, import intact
+        ast.parse(target.read_text())
+
+
+def test_cli_fix_with_no_baseline_still_skips_grandfathered(tmp_path):
+    target = tmp_path / "probe.py"
+    target.write_text("import jax\n\nd = jax.devices()\n")
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps([{
+        "rule": "W1", "path": str(target.resolve()),
+        "code": "d = jax.devices()",
+        "reason": "deliberate raw probe in a sacrificial child"}]))
+    before = target.read_text()
+    proc = _cli("--fix", "--no-baseline", "--baseline", str(bl),
+                str(target))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "rewrote 0 line(s)" in proc.stdout
+    assert target.read_text() == before
+
+
+def test_cli_refuses_baselined_k1(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps([{"rule": "K1", "path": "x.py",
+                               "code": "c", "reason": "nope"}]))
+    proc = _cli("--baseline", str(bl))
+    assert proc.returncode == 2
+    assert "K1" in proc.stderr

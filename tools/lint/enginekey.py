@@ -125,10 +125,12 @@ def _covered_attrs(cls: ast.ClassDef, build: ast.FunctionDef) -> set[str]:
 
 
 def check_engine_key(ensemble_path: str, picker_path: str | None = None,
-                     rel_path: str | None = None) -> list[Finding]:
+                     rel_path: str | None = None,
+                     picker_rel_path: str | None = None) -> list[Finding]:
     """Run K1 against an ensemble.py (and optionally picker.py) source
-    file.  ``rel_path`` overrides the path findings are reported under
-    (the regression test runs this on a mutated copy)."""
+    file.  ``rel_path``/``picker_rel_path`` override the paths findings
+    are reported under (repo-relative in the CLI; the regression test
+    runs this on a mutated copy)."""
     rel = rel_path or ensemble_path
     with open(ensemble_path, encoding="utf-8") as fh:
         src = fh.read()
@@ -170,12 +172,14 @@ def check_engine_key(ensemble_path: str, picker_path: str | None = None,
             code=f"def __init__(...)  # stale allowlist: {knob}"))
 
     if picker_path is not None:
-        out.extend(_check_picker(picker_path, knobs))
+        out.extend(_check_picker(picker_path, knobs,
+                                 picker_rel_path or picker_path))
     return out
 
 
-def _check_picker(picker_path: str, knobs: list[str]) -> list[Finding]:
-    with open(picker_path, encoding="utf-8") as fh:
+def _check_picker(picker_file: str, knobs: list[str],
+                  picker_path: str) -> list[Finding]:
+    with open(picker_file, encoding="utf-8") as fh:
         tree = ast.parse(fh.read())
     cls = _find_class(tree, "EngineChoice")
     if cls is None:
@@ -188,16 +192,36 @@ def _check_picker(picker_path: str, knobs: list[str]) -> list[Finding]:
                         "EngineChoice.engine_kwargs not found — the K1 "
                         "picker check must be updated")]
     out = []
+    # only the RETURNED dict is the engine-kwargs contract; helper
+    # dicts (log labels etc.) inside the method are not axes
+    audited = False
     for node in ast.walk(kwargs):
-        if isinstance(node, ast.Dict):
-            for k in node.keys:
-                if isinstance(k, ast.Constant) and isinstance(k.value, str) \
-                        and k.value not in knobs:
-                    out.append(Finding(
-                        "K1", picker_path, node.lineno,
-                        f"EngineChoice.engine_kwargs() key {k.value!r} is "
-                        "not an EnsembleEngine constructor knob — a "
-                        "picked engine would vary in a dimension the "
-                        "program store cannot key on",
-                        code=f"engine_kwargs()  # unknown: {k.value}"))
+        if not isinstance(node, ast.Return) or not isinstance(node.value,
+                                                              ast.Dict):
+            continue
+        audited = True
+        for k in node.value.keys:
+            if k is None or not (isinstance(k, ast.Constant)
+                                 and isinstance(k.value, str)):
+                # `{**...}` unpacking / computed keys hide the axes —
+                # that defeats the audit, same as no literal return
+                audited = False
+                continue
+            if k.value not in knobs:
+                out.append(Finding(
+                    "K1", picker_path, node.lineno,
+                    f"EngineChoice.engine_kwargs() key {k.value!r} is "
+                    "not an EnsembleEngine constructor knob — a "
+                    "picked engine would vary in a dimension the "
+                    "program store cannot key on",
+                    code=f"engine_kwargs()  # unknown: {k.value}"))
+    if not audited:
+        # never fail open: like the missing-class/method paths, a shape
+        # the checker cannot audit is itself a finding
+        out.append(Finding(
+            "K1", picker_path, kwargs.lineno,
+            "EngineChoice.engine_kwargs() has no literal `return {...}` "
+            "— K1 cannot audit the picked axes; keep the dict-literal "
+            "return shape or update the checker alongside the refactor",
+            code="def engine_kwargs(...)  # unauditable"))
     return out

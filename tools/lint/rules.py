@@ -154,38 +154,93 @@ def _has_platform_guard(scope: ast.AST) -> bool:
     return False
 
 
+def _scan_scopes(tree: ast.AST):
+    """Yield (scope, scan_calls) for the module and every function —
+    each scope carrying its own local-assignment map so an f64 marker
+    assigned one line above the scan call is still seen."""
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    inner = set()
+    for f in funcs:
+        for g in ast.walk(f):
+            if g is not f and isinstance(g, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                inner.add(g)
+    # nested defs stay part of their outermost function's scope: their
+    # locals and calls are one stability story
+    yield from ((f, f) for f in funcs if f not in inner)
+    yield tree, tree
+
+
 def rule_w3(path: str, src: str, tree: ast.AST,
             lines: list[str]) -> list[Finding]:
     out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        name = None
-        if isinstance(fn, ast.Attribute) and fn.attr in ("scan",
-                                                         "fori_loop"):
-            base = fn.value
-            # lax.scan / jax.lax.scan (and fori_loop) spellings
-            if (isinstance(base, ast.Name) and base.id == "lax") or (
-                    isinstance(base, ast.Attribute) and base.attr == "lax"):
-                name = f"lax.{fn.attr}"
-        if name is None:
-            continue
-        # the call's OWN argument subtree must name float64 explicitly;
-        # dtype-inherited scans (the normal repo idiom) are out of scope
-        # by design — this rule catches the spelled-out foot-gun, the
-        # test suite's bit-identity contracts catch the rest
-        if not any(_has_f64_marker(a) for a in
-                   list(node.args) + [kw.value for kw in node.keywords]):
-            continue
-        # a guard anywhere in the module clears it: the author
-        # demonstrably split on platform somewhere, and a finer-grained
-        # reachability claim would overreach for an AST heuristic
-        if _has_platform_guard(tree):
-            continue
-        out.append(Finding("W3", path, node.lineno,
-                           _W3_MSG.format(fn=name),
-                           _code(lines, node.lineno)))
+    # a guard anywhere in the module clears it: the author demonstrably
+    # split on platform somewhere, and a finer-grained reachability
+    # claim would overreach for an AST heuristic
+    if _has_platform_guard(tree):
+        return out
+    seen: set[int] = set()
+    for scope, _ in _scan_scopes(tree):
+        local_values: dict[str, list] = {}
+        if isinstance(scope, ast.Module):
+            # the module scope owns only statements outside any def/class
+            # — a function's private f64 local must not taint an
+            # unrelated module-level scan through a shared name
+            assign_iter = (n for stmt in scope.body
+                           if not isinstance(stmt, (ast.FunctionDef,
+                                                    ast.AsyncFunctionDef,
+                                                    ast.ClassDef))
+                           for n in ast.walk(stmt))
+        else:
+            assign_iter = ast.walk(scope)
+        for n in assign_iter:
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        local_values.setdefault(t.id, []).append(n.value)
+
+        def expr_has_f64(expr, depth=0):
+            if _has_f64_marker(expr):
+                return True
+            if depth >= 2:  # one hop of local resolution is plenty
+                return False
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name):
+                    for v in local_values.get(n.id, []):
+                        if expr_has_f64(v, depth + 1):
+                            return True
+            return False
+
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            fn = node.func
+            name = None
+            if isinstance(fn, ast.Attribute) and fn.attr in ("scan",
+                                                             "fori_loop"):
+                base = fn.value
+                # lax.scan / jax.lax.scan (and fori_loop) spellings
+                if (isinstance(base, ast.Name) and base.id == "lax") or (
+                        isinstance(base, ast.Attribute)
+                        and base.attr == "lax"):
+                    name = f"lax.{fn.attr}"
+            if name is None:
+                continue
+            seen.add(id(node))
+            # the call's argument subtree (with same-scope locals
+            # resolved one hop) must name float64 explicitly;
+            # dtype-inherited scans (the normal repo idiom) are out of
+            # scope by design — this rule catches the spelled-out
+            # foot-gun, the bit-identity contracts catch the rest
+            if not any(expr_has_f64(a) for a in
+                       list(node.args) + [kw.value for kw in
+                                          node.keywords]):
+                continue
+            out.append(Finding("W3", path, node.lineno,
+                               _W3_MSG.format(fn=name),
+                               _code(lines, node.lineno)))
+    out.sort(key=lambda f: f.line)
     return out
 
 

@@ -171,6 +171,23 @@ carries "steps_ratio" (euler steps / picked steps) / "tta_speedup"
 mixed sweep's named/picked wall ratio) / "sharded" (comm, mesh,
 stepper) / "met_target" / "bit_identical"; requires BENCH_PLATFORM=cpu
 like BENCH_ROUTER — a fleet is a host measurement),
+BENCH_SESSION=N (N >= 1: the live-session tier — ISSUE 15,
+serve/sessions.py session_stream_bench + session_resume_ab: N
+concurrent streaming sessions (BENCH_SESSION_CHUNKS chunks of
+BENCH_SESSION_CHUNK steps each, default steps/4) driven over a
+2-replica fleet WHILE BENCH_SESSION_CASES batch cases run paced
+through the shared admission controller, the session gate set to half
+the fleet's measured step capacity.  The rung is labeled "variant":
+"sessionN" and carries "sessions" / "frames" / "frames_per_s" (stream
+throughput at the chunk cadence) / "deferrals" (the budget visibly
+engaging) / "batch" (offered/accepted/shed/p99_ms) / "bound_ms" /
+"budget_held" (batch shed nothing, its p99 stayed inside the
+admission bound, AND the sessions deferred — the cannot-starve-batch
+acceptance) plus "resume_bit_identical"/"resumed_from" from the
+kill-after-half-the-chunks + checkpoint-resume A/B (frames deduped by
+step must equal the uninterrupted stream bitwise, final f64 field
+included).  Requires BENCH_PLATFORM=cpu like BENCH_ROUTER — a fleet
+is a host measurement),
 BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
@@ -407,7 +424,11 @@ class Best:
                 # ttafleet rung: the fleet time-to-accuracy + engine-
                 # picker evidence (ISSUE 13)
                 "stages", "picker_engine", "picker_speedup",
-                "picker_small", "sweep_cases", "met_target")
+                "picker_small", "sweep_cases", "met_target",
+                # session rung: the live-session tier evidence (ISSUE 15)
+                "sessions", "frames", "frames_per_s", "deferrals",
+                "session_rate_steps_s", "batch", "bound_ms",
+                "budget_held", "resume_bit_identical", "resumed_from")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -981,6 +1002,18 @@ def child_measure():
         os.environ.pop("BENCH_TRACE_FLEET", None)
     tta = os.environ.get("BENCH_TTA") == "1"
     ttafleet = os.environ.get("BENCH_TTA_FLEET") == "1"
+    session_n = int(os.environ.get("BENCH_SESSION", 0) or 0)
+    if session_n and (warmboot or tta or ttafleet or srv or ens or mchip
+                      or router_n or fleet_n
+                      or any(os.environ.get(k) for k in
+                             ("BENCH_CARRIED", "BENCH_RESIDENT",
+                              "BENCH_SUPERSTEP"))):
+        log("BENCH_SESSION set: ignoring BENCH_WARMBOOT/TTA/TTA_FLEET/"
+            "SERVE/ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/CARRIED/RESIDENT/"
+            "SUPERSTEP — the session rung is its own labeled variant")
+        warmboot = False
+        tta = ttafleet = False
+        srv = ens = mchip = router_n = fleet_n = 0
     if warmboot and (tta or ttafleet or srv or ens or mchip or router_n
                      or fleet_n
                      or any(os.environ.get(k) for k in
@@ -1055,6 +1088,88 @@ def child_measure():
             dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
             op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / grid, method=method,
                               precision=PRECISION)
+            if session_n:
+                # live-session tier (ISSUE 15, serve/sessions.py): N
+                # concurrent streaming sessions over a 2-replica fleet
+                # while a paced batch load shares the admission
+                # controller — frames/s at the chunk cadence, the
+                # budget-held acceptance (batch p99 inside the bound,
+                # nothing shed, sessions visibly deferred), and the
+                # kill+checkpoint-resume bit-identity A/B.
+                if backend == "tpu":
+                    raise RuntimeError(
+                        "BENCH_SESSION needs BENCH_PLATFORM=cpu: replica "
+                        "fleets assume one accelerator per worker and "
+                        "the tunneled single chip cannot host N clients")
+                import shutil
+                import tempfile
+
+                from nonlocalheatequation_tpu.serve.sessions import (
+                    session_resume_ab,
+                    session_stream_bench,
+                )
+
+                chunk = int(os.environ.get("BENCH_SESSION_CHUNK", 0)
+                            or 0) or max(1, steps // 4)
+                chunks = int(os.environ.get("BENCH_SESSION_CHUNKS", 4))
+                Cb = int(os.environ.get("BENCH_SESSION_CASES", 8))
+                ek = {"method": method, "precision": PRECISION,
+                      "batch_sizes": (1,)}
+                sb = session_stream_bench(
+                    ek, sessions=session_n, grid=grid,
+                    chunk_steps=chunk, chunks=chunks, batch_cases=Cb,
+                    dt=dt, eps=EPS)
+                ckpt = tempfile.mkdtemp(prefix="nlheat-session-")
+                try:
+                    ra = session_resume_ab(
+                        ek, grid=grid, chunk_steps=chunk, chunks=chunks,
+                        ckpt_dir=ckpt, dt=dt, eps=EPS)
+                finally:
+                    shutil.rmtree(ckpt, ignore_errors=True)
+                if not ra["bit_identical"]:
+                    log("WARNING: resumed session stream is NOT "
+                        "bit-identical to the uninterrupted run — "
+                        "checkpoint resume must never change the "
+                        "trajectory")
+                if not sb["budget_held"]:
+                    log(f"WARNING: session budgets did NOT hold "
+                        f"(batch shed {sb['batch']['shed']}, p99 "
+                        f"{sb['batch']['p99_ms']:.1f} ms vs bound "
+                        f"{sb['bound_ms']:.1f} ms, deferrals "
+                        f"{sb['deferrals']})")
+                wall = sb["wall_s"]
+                log(f"rung {grid}^2 session: {session_n} session(s) x "
+                    f"{chunks}x{chunk} steps in {wall:.2f}s "
+                    f"({sb['frames_per_s']:.1f} frames/s, "
+                    f"{sb['deferrals']} deferral(s)); batch "
+                    f"{sb['batch']['accepted']}/{sb['batch']['offered']}"
+                    f" accepted p99 {sb['batch']['p99_ms']:.1f} ms "
+                    f"(bound {sb['bound_ms']:.1f}); resume "
+                    f"bit-identical {ra['bit_identical']}")
+                value = grid * grid * sb["steps_streamed"] / wall
+                event(
+                    event="rung",
+                    grid=grid,
+                    steps=chunks * chunk,
+                    best_s=wall,
+                    ms_per_step=wall / (chunks * chunk) * 1e3,
+                    value=value,
+                    variant=f"session{session_n}",
+                    sessions=session_n,
+                    cases=Cb,
+                    frames=sb["frames"],
+                    frames_per_s=sb["frames_per_s"],
+                    deferrals=sb["deferrals"],
+                    session_rate_steps_s=sb["session_rate_steps_s"],
+                    batch=sb["batch"],
+                    bound_ms=sb["bound_ms"],
+                    budget_held=sb["budget_held"],
+                    resume_bit_identical=ra["bit_identical"],
+                    resumed_from=ra["resumed_from"],
+                )
+                last_op = op
+                any_rung = True
+                continue
             if warmboot:
                 # cold-vs-warm boot A/B (ISSUE 9, serve/program_store.py):
                 # time-to-first-served-chunk, three arms over one shared
@@ -1358,7 +1473,7 @@ def child_measure():
                         shutil.rmtree(store_dir, ignore_errors=True)
                 arms_bit = all(np.array_equal(a, b) for a, b in
                                zip(ab["results"]["pipe"],
-                                   ab["results"]["tcp"]))
+                                   ab["results"]["tcp"], strict=True))
                 bit = arms_bit and ab.get("mixed_bit_identical") is True
                 sharded = ab["sharded"]  # None when BENCH_FLEET_SHARDED=0
                 if not bit:
@@ -1502,7 +1617,7 @@ def child_measure():
                             shutil.rmtree(store_dir, ignore_errors=True)
                     bit = all(np.array_equal(a, b) for a, b in
                               zip(ab["results"]["untraced"],
-                                  ab["results"]["traced"]))
+                                  ab["results"]["traced"], strict=True))
                     if not bit:
                         log("WARNING: routerobs arms are NOT "
                             "bit-identical — tracing must never change "
@@ -1546,7 +1661,7 @@ def child_measure():
                     if own_dir:
                         shutil.rmtree(store_dir, ignore_errors=True)
                 bit = all(np.array_equal(a, b) for a, b in
-                          zip(ab["results"][1], ab["results"][router_n]))
+                          zip(ab["results"][1], ab["results"][router_n], strict=True))
                 if not bit:
                     log("WARNING: router arms are NOT bit-identical — "
                         "routing must never change served results")

@@ -824,6 +824,62 @@ def add_listen_flags(p: argparse.ArgumentParser):
         help="--shard-threshold: devices in the gang replica's mesh "
              "(default: every device the gang worker sees)",
     )
+    # the live-session tier (ISSUE 15, serve/sessions.py): POST
+    # /v1/sessions opens a stateful streaming simulation on the same
+    # fleet; these knobs configure its budgets and crash-safety
+    p.add_argument(
+        "--session-chunk",
+        type=int,
+        default=None,
+        metavar="STEPS",
+        help="--listen: default steps per session chunk (one chunk = "
+             "one dispatched program = one preview frame; per-session "
+             "override via the POST body's chunk_steps)",
+    )
+    p.add_argument(
+        "--session-budget",
+        type=int,
+        default=None,
+        metavar="STEPS",
+        help="--listen: per-session step budget per second (0 = "
+             "unlimited; env NLHEAT_SESSION_BUDGET) — a greedy stream "
+             "DEFERS at chunk granularity instead of starving batch",
+    )
+    p.add_argument(
+        "--session-rate",
+        type=float,
+        default=None,
+        metavar="STEPS_PER_S",
+        help="--listen: FLEET-wide session step-rate cap through the "
+             "admission controller's token bucket (unset = no cap; "
+             "session chunks always defer while batch admission sheds)",
+    )
+    p.add_argument(
+        "--session-checkpoint-dir",
+        default=None,
+        metavar="DIR",
+        help="--listen: crash-safe session checkpoints land here "
+             "(utils/checkpoint.py, atomic+CRC, keyed session id + "
+             "step) — enables resume after a front-door death and "
+             "fork-from-checkpoint; unset = live-state forks only",
+    )
+    p.add_argument(
+        "--session-checkpoint-every",
+        type=int,
+        default=None,
+        metavar="CHUNKS",
+        help="--listen: checkpoint cadence in chunks (0 = off; env "
+             "NLHEAT_SESSION_CKPT_EVERY)",
+    )
+    p.add_argument(
+        "--session-preview",
+        type=int,
+        default=None,
+        metavar="STRIDE",
+        help="--listen: preview-frame downsample stride (f32 "
+             "u[::STRIDE] per chunk boundary; env "
+             "NLHEAT_SESSION_PREVIEW, default 4)",
+    )
 
 
 def validate_listen_args(args, dim: int | None = None) -> str | None:
@@ -841,7 +897,20 @@ def validate_listen_args(args, dim: int | None = None) -> str | None:
                            (getattr(args, "shard_threshold", None),
                             "--shard-threshold"),
                            (getattr(args, "gang_devices", None),
-                            "--gang-devices")):
+                            "--gang-devices"),
+                           (getattr(args, "session_chunk", None),
+                            "--session-chunk"),
+                           (getattr(args, "session_budget", None),
+                            "--session-budget"),
+                           (getattr(args, "session_rate", None),
+                            "--session-rate"),
+                           (getattr(args, "session_checkpoint_dir", None),
+                            "--session-checkpoint-dir"),
+                           (getattr(args, "session_checkpoint_every",
+                                    None),
+                            "--session-checkpoint-every"),
+                           (getattr(args, "session_preview", None),
+                            "--session-preview")):
             if flag is not None:
                 return f"{name} configures the --listen fleet; add --listen"
         return None
@@ -862,6 +931,24 @@ def validate_listen_args(args, dim: int | None = None) -> str | None:
                 "or use solve2d")
     if getattr(args, "gang_devices", None) is not None and not shard:
         return "--gang-devices sizes the gang mesh; add --shard-threshold"
+    for val, name in ((getattr(args, "session_chunk", None),
+                       "--session-chunk"),
+                      (getattr(args, "session_preview", None),
+                       "--session-preview")):
+        if val is not None and val < 1:
+            return f"{name} needs a value >= 1 (got {val})"
+    for val, name in ((getattr(args, "session_budget", None),
+                       "--session-budget"),
+                      (getattr(args, "session_rate", None),
+                       "--session-rate"),
+                      (getattr(args, "session_checkpoint_every", None),
+                       "--session-checkpoint-every")):
+        if val is not None and val < 0:
+            return f"{name} needs a value >= 0 (0 = off; got {val})"
+    if getattr(args, "session_checkpoint_every", None) \
+            and not getattr(args, "session_checkpoint_dir", None):
+        return ("--session-checkpoint-every needs a place to write; "
+                "add --session-checkpoint-dir")
     for flag, name in ((getattr(args, "test", False), "--test"),
                        (getattr(args, "test_batch", False), "--test_batch"),
                        (getattr(args, "ensemble", False), "--ensemble"),
@@ -888,8 +975,28 @@ def run_listen(args, engine_kwargs) -> int:
     and the final metrics dump becomes the --metrics-out payload."""
     import json as _json
 
-    from nonlocalheatequation_tpu.serve.http import IngressServer
+    from nonlocalheatequation_tpu.serve.http import (
+        AdmissionController,
+        IngressServer,
+    )
     from nonlocalheatequation_tpu.serve.router import ReplicaRouter
+    from nonlocalheatequation_tpu.serve.sessions import (
+        SESSION_BUDGET_ENV,
+        SESSION_CKPT_ENV,
+        SESSION_PREVIEW_ENV,
+        SessionManager,
+    )
+
+    # the session knobs are env-backed per-session defaults
+    # (SessionSpec.validate); the CLI flags pin the env for this server
+    for flag, env_name in ((getattr(args, "session_budget", None),
+                            SESSION_BUDGET_ENV),
+                           (getattr(args, "session_checkpoint_every",
+                                    None), SESSION_CKPT_ENV),
+                           (getattr(args, "session_preview", None),
+                            SESSION_PREVIEW_ENV)):
+        if flag is not None:
+            os.environ[env_name] = str(flag)
 
     serve_kwargs = {
         "retries": args.serve_retries,
@@ -946,11 +1053,24 @@ def run_listen(args, engine_kwargs) -> int:
         scaler = threading.Thread(target=_scale_loop, daemon=True,
                                   name="nlheat-router-scaler")
         scaler.start()
+        # the session tier (ISSUE 15): one SessionManager over the same
+        # fleet, sharing ONE admission controller with the ingress so
+        # the batch gate and the session gate read the same budgets
+        admission = AdmissionController(
+            router,
+            session_steps_per_s=getattr(args, "session_rate", None))
+        sessions = SessionManager(
+            router, admission=admission,
+            checkpoint_dir=getattr(args, "session_checkpoint_dir", None),
+            chunk_steps=getattr(args, "session_chunk", None) or 16)
+        sessions.start_driver()
         try:
-            with IngressServer(args.listen, router) as ingress:
+            with IngressServer(args.listen, router, admission=admission,
+                               sessions=sessions) as ingress:
                 print(f"ingress: http://127.0.0.1:{ingress.port}/v1/cases "
                       f"({args.replicas} replica(s); POST to submit, "
-                      "/healthz, /metrics; EOF on stdin stops the server)",
+                      "/v1/sessions opens a live stream, /healthz, "
+                      "/metrics; EOF on stdin stops the server)",
                       file=sys.stderr)
                 for _line in sys.stdin:  # lifetime = stdin
                     pass
@@ -959,6 +1079,7 @@ def run_listen(args, engine_kwargs) -> int:
             # chase a never-emptying pending set into its timeout
         finally:
             stop_scaling.set()
+            sessions.close()
         router.drain()
         if trace_dir:
             merged = router.dump_fleet_trace(

@@ -254,7 +254,7 @@ def _run(args, multi: bool) -> int:
                       file=sys.stderr)
                 set_metrics_payload(engine.report.metrics_json())
                 out = []
-                for s, u in zip(solvers, states):
+                for s, u in zip(solvers, states, strict=True):
                     s.u = u
                     out.append((s.compute_l2(s.nt), s.nx * s.ny * s.nz))
                 return out

@@ -291,7 +291,7 @@ def _make_expo_step(op, g, lg, dtype, stages: int = 0):
             tables[key] = _expo_tables(op, u.shape, u.dtype,
                                        sub_dt=dt / max(1, S),
                                        correction=bool(S))
-        pad = [(0, b - s_) for s_, b in zip(u.shape, box)]
+        pad = [(0, b - s_) for s_, b in zip(u.shape, box, strict=True)]
         dom = tuple(slice(0, s_) for s_ in u.shape)
         bh = None
         if test:

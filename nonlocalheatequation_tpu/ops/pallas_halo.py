@@ -157,7 +157,7 @@ def plan_exchange(
             "disagree in rank")
     per_axis = [
         _axis_ranges(int(b), int(n), int(eps))
-        for b, n in zip(block_shape, mesh_shape)
+        for b, n in zip(block_shape, mesh_shape, strict=True)
     ]
     msgs = []
     offsets = [sorted(r.keys()) for r in per_axis]
@@ -191,7 +191,7 @@ def collective_bytes(
     2*eps per completed axis."""
     total = 0
     extents = [int(b) for b in block_shape]
-    for ax, (bs, nshards) in enumerate(zip(block_shape, mesh_shape)):
+    for ax, (bs, nshards) in enumerate(zip(block_shape, mesh_shape, strict=True)):
         if int(nshards) <= 1:
             extents[ax] += 2 * eps
             continue
@@ -705,7 +705,7 @@ def halo_stats(mesh_shape: tuple[int, ...], block_shape: tuple[int, ...],
         return {"messages": len(plan),
                 "bytes": plan_bytes(plan, itemsize)}
     nmsg = sum(2 * min(len(hop_widths(eps, int(b))), max(int(n) - 1, 0))
-               for b, n in zip(block_shape, mesh_shape))
+               for b, n in zip(block_shape, mesh_shape, strict=True))
     return {"messages": nmsg,
             "bytes": collective_bytes(mesh_shape, block_shape, eps,
                                       itemsize)}

@@ -154,7 +154,7 @@ def operator_symbol(op, shape) -> np.ndarray:
 
 
 def _embed(u: jnp.ndarray, box: tuple) -> jnp.ndarray:
-    return jnp.pad(u, [(0, b - s) for s, b in zip(u.shape, box)])
+    return jnp.pad(u, [(0, b - s) for s, b in zip(u.shape, box, strict=True)])
 
 
 def neighbor_sum_fft(op, u: jnp.ndarray) -> jnp.ndarray:

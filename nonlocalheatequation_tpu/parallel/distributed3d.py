@@ -229,7 +229,7 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
                     if j < K:
                         ok = None
                         for ax, (start, N) in enumerate(
-                                zip(o0, (NX, NY, NZ))):
+                                zip(o0, (NX, NY, NZ), strict=True)):
                             c = (start - m) + lax.broadcasted_iota(
                                 jnp.int32, nxt.shape, ax)
                             in_ax = (c >= 0) & (c < N)

@@ -107,6 +107,6 @@ def halo_pad_nd(block, eps: int, mesh_shape: tuple[int, ...],
     N-dim generalization of the 2D two-phase exchange.
     """
     out = block
-    for axis, (name, nshards) in enumerate(zip(axis_names, mesh_shape)):
+    for axis, (name, nshards) in enumerate(zip(axis_names, mesh_shape, strict=True)):
         out = _axis_halo(out, axis, name, nshards, eps)
     return out

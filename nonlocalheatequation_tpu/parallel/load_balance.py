@@ -143,7 +143,7 @@ _NBRS = ((1, 0), (-1, 0), (0, 1), (0, -1))
 def _region_components(assignment: np.ndarray, device: int) -> int:
     """Number of 4-connected components of a device's tile region."""
     npx, npy = assignment.shape
-    todo = {(int(x), int(y)) for x, y in zip(*np.nonzero(assignment == device))}
+    todo = {(int(x), int(y)) for x, y in zip(*np.nonzero(assignment == device), strict=True)}
     comps = 0
     while todo:
         comps += 1
@@ -180,7 +180,7 @@ def _boundary_grabs(assignment: np.ndarray, receiver: int, donor: int):
     npx, npy = assignment.shape
     recv_mask = assignment == receiver
     out = []
-    for x, y in zip(*np.nonzero(assignment == donor)):
+    for x, y in zip(*np.nonzero(assignment == donor), strict=True):
         for dx, dy in _NBRS:
             jx, jy = x + dx, y + dy
             if 0 <= jx < npx and 0 <= jy < npy and recv_mask[jx, jy]:
@@ -333,7 +333,7 @@ def rebalance_assignment(assignment: np.ndarray, busy: np.ndarray,
             moves = []  # (x, y, previous_owner) for rollback
             split_moves = 0
             ok = True
-            for recv_side, donor_side in reversed(list(zip(path, path[1:]))):
+            for recv_side, donor_side in reversed(list(zip(path, path[1:], strict=False))):
                 grabs = _boundary_grabs(assignment, recv_side, donor_side)
                 if not grabs:  # unreachable per the argument above; defend
                     ok = False

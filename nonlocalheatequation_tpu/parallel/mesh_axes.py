@@ -102,7 +102,7 @@ def create_hybrid_mesh(
     n = int(np.prod(shape)) if shape else 1
     if n > len(devices):
         raise ValueError(
-            f"mesh {dict(zip(axis_names, shape))} needs {n} devices, "
+            f"mesh {dict(zip(axis_names, shape, strict=True))} needs {n} devices, "
             f"have {len(devices)}")
     devices = devices[:n]
     if granule_count(devices) <= 1:
@@ -112,10 +112,10 @@ def create_hybrid_mesh(
 
     ici_shape = tuple(
         s if axis_rule(name, rules) == "ici" else 1
-        for name, s in zip(axis_names, shape))
+        for name, s in zip(axis_names, shape, strict=True))
     dcn_shape = tuple(
         s if axis_rule(name, rules) == "dcn" else 1
-        for name, s in zip(axis_names, shape))
+        for name, s in zip(axis_names, shape, strict=True))
     dev_grid = create_hybrid_device_mesh(ici_shape, dcn_shape,
                                          devices=devices)
     return Mesh(dev_grid, axis_names)

@@ -145,7 +145,7 @@ def make_rkc_stagebatch_step(op, stages: int, ksteps: int, pad,
             (t,) = rest
         bshape = u_blk.shape
         origin = tuple(lax.axis_index(nm) * b
-                       for nm, b in zip(axis_names, bshape))
+                       for nm, b in zip(axis_names, bshape, strict=True))
 
         def crop(arr, m_from: int, m_to: int):
             d = m_from - m_to
@@ -159,7 +159,7 @@ def make_rkc_stagebatch_step(op, stages: int, ksteps: int, pad,
             # global domain stay zero at every stage, and the barrier
             # pins the stage boundary (the Euler superstep's ulp rule)
             ok = None
-            for ax, (start, Ngl) in enumerate(zip(origin, grid_N)):
+            for ax, (start, Ngl) in enumerate(zip(origin, grid_N, strict=True)):
                 c = (start - m) + lax.broadcasted_iota(
                     jnp.int32, arr.shape, ax)
                 in_ax = (c >= 0) & (c < Ngl)

@@ -47,4 +47,14 @@ space-parallel distributed run over an N-device mesh
 (parallel/gang.py ``solve_case_sharded``, ``comm='fused'`` where the
 kernel family serves it), streamed back over the same frames
 bit-identical to the offline ``Solver2DDistributed`` path.
+
+``serve/sessions.py`` is the INTERACTIVE tier over all of it: a
+``SessionManager`` owning long-running stateful cases as first-class
+fleet citizens — chunked stepping through the pipeline/router, coarse
+preview + final-f64 frame streams (SSE over serve/http.py),
+chunk-boundary retargeting of the source term (legal physics: b(t,x)
+is time-dependent), what-if forks from crash-safe checkpoints, resume
+bit-identical to an uninterrupted run, and per-session step budgets
+through the admission controller so streams can never starve the
+batch tier.
 """

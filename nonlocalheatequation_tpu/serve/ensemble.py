@@ -679,7 +679,7 @@ def run_test_cases(cases, **engine_kwargs):
     cases = list(cases)
     states = engine.run(cases)
     out = []
-    for case, u in zip(cases, states):
+    for case, u in zip(cases, states, strict=True):
         op = engine._make_op(case)
         want = (np.cos(2.0 * np.pi * (case.nt * case.dt))
                 * op.spatial_profile(*case.shape))

@@ -25,6 +25,22 @@ anything with ``submit``/``outstanding_total``/``retry_after_s``):
 * ``GET /v1/cases/<id>/result`` — the solved state: JSON
   ``{"shape": ..., "values": [...]}`` by default (f64 round-trip-exact),
   or raw ``.npy`` bytes with ``?bin=1``.
+* **Sessions** (ISSUE 15, serve/sessions.py — present when the server
+  is built with a :class:`~nonlocalheatequation_tpu.serve.sessions.SessionManager`):
+  ``POST /v1/sessions`` opens a live simulation (case fields + ``nt``
+  total steps + ``chunk_steps``/``preview_stride``/``budget_steps``/
+  ``checkpoint_every``), 429-shedding exactly like cases;
+  ``GET /v1/sessions/<id>`` is the status+audit document;
+  ``GET /v1/sessions/<id>/stream[?from_step=N]`` streams frames as
+  Server-Sent Events (``data: {...}\\n\\n`` per chunk boundary — coarse
+  f32 previews, then the final full-f64 frame; the ``from_step``
+  cursor makes a reconnect lossless and duplicate-free);
+  ``POST /v1/sessions/<id>/retarget`` queues a mid-flight source/k
+  change (applied at the next chunk boundary, step recorded);
+  ``POST /v1/sessions/<id>/fork`` branches a what-if session from a
+  checkpoint; ``POST /v1/sessions/<id>/close`` ends the stream;
+  ``GET /v1/sessions/<id>/result`` fetches the final f64 field
+  (``?bin=1`` for raw .npy bytes).
 * ``GET /healthz`` — liveness + fleet summary.
 * ``GET /metrics`` / ``/metrics.json`` — the backend registry's
   Prometheus/JSON exposition (the router's registry already aggregates
@@ -85,12 +101,25 @@ class AdmissionController:
     form of the same promise: a request we cannot serve inside the
     bound is refused NOW with a retry hint, not parked.
 
+    The SESSION tier's fleet-wide gate lives here too (ISSUE 15,
+    serve/sessions.py): ``session_steps_per_s`` rate-limits the
+    aggregate step rate streaming sessions may draw (a token bucket on
+    the injected ``clock``; burst = one second's tokens), and every
+    session chunk additionally clears :meth:`check` — so a saturated
+    batch tier DEFERS session chunks and a greedy session can never
+    starve the batch tier.  A refused chunk is a deferral the session
+    manager retries at its next pump, never an error.
+
     Counters land in the backend registry: ``/ingress/accepted``,
-    ``/ingress/shed``, and the ``/ingress/retry-after-s`` gauge
-    (the most recent hint)."""
+    ``/ingress/shed``, the ``/ingress/retry-after-s`` gauge (the most
+    recent hint), and the session gate's ``/ingress/session-steps`` /
+    ``/ingress/session-deferred``."""
 
     def __init__(self, backend, *, max_pending: int | None = None,
-                 max_queue_wait_ms: float | None = None):
+                 max_queue_wait_ms: float | None = None,
+                 session_steps_per_s: float | None = None,
+                 session_burst_steps: float | None = None,
+                 clock=time.monotonic):
         self.backend = backend
         self.max_pending = max_pending
         self.max_queue_wait_ms = max_queue_wait_ms
@@ -98,6 +127,34 @@ class AdmissionController:
         self._m_accepted = r.counter("/ingress/accepted")
         self._m_shed = r.counter("/ingress/shed")
         self._m_retry_after = r.gauge("/ingress/retry-after-s")
+        # the session gate's token bucket (0/None = no rate cap; the
+        # batch-depth check still applies to session chunks).  The
+        # burst defaults to one second's tokens; session_burst_steps
+        # pins it explicitly (the bench pins one CHUNK so the gate
+        # engages at any scale, not only past the first second)
+        if session_steps_per_s is not None and session_steps_per_s < 0:
+            raise ValueError(
+                f"session_steps_per_s must be >= 0, got "
+                f"{session_steps_per_s}")
+        if session_burst_steps is not None and session_burst_steps <= 0:
+            raise ValueError(
+                f"session_burst_steps must be > 0, got "
+                f"{session_burst_steps}")
+        self._clock = clock
+        self.session_steps_per_s = (float(session_steps_per_s)
+                                    if session_steps_per_s else None)
+        self._session_cap = (float(session_burst_steps)
+                             if session_burst_steps is not None
+                             else self.session_steps_per_s or 0.0)
+        # the bucket is mutated from every pumping thread (the session
+        # manager's driver, drive() callers, stream() reader threads) —
+        # an unlocked read-modify-write would lose chunk debt and admit
+        # above the configured rate
+        self._session_lock = threading.Lock()
+        self._session_tokens = self._session_cap  # guarded_by: self._session_lock
+        self._session_t = clock()  # guarded_by: self._session_lock
+        self._m_session_steps = r.counter("/ingress/session-steps")
+        self._m_session_deferred = r.counter("/ingress/session-deferred")
 
     def _cap(self) -> int:
         if self.max_pending is not None:
@@ -117,6 +174,33 @@ class AdmissionController:
                    if pct is not None else 0.0)
             if p50 > self.max_queue_wait_ms:
                 return self._hint(pending)
+        return None
+
+    def admit_session(self, steps: int) -> float | None:
+        """None to admit one session chunk of ``steps``, else the
+        defer hint in seconds.  Order matters: the batch-depth check
+        first (a saturated fleet defers sessions regardless of
+        tokens), then the rate bucket.  Tokens may go negative on an
+        oversized chunk — the debt throttles later chunks, so the
+        AVERAGE rate holds even when chunk_steps exceeds one window."""
+        retry = self.check()
+        if retry is not None:
+            self._m_session_deferred.inc()
+            return retry
+        if self.session_steps_per_s:
+            now = self._clock()
+            cap = self._session_cap
+            with self._session_lock:
+                self._session_tokens = min(
+                    cap, self._session_tokens
+                    + (now - self._session_t) * self.session_steps_per_s)
+                self._session_t = now
+                if self._session_tokens < min(float(steps), cap):
+                    short = min(float(steps), cap) - self._session_tokens
+                    self._m_session_deferred.inc()
+                    return max(0.05, short / self.session_steps_per_s)
+                self._session_tokens -= float(steps)
+        self._m_session_steps.inc(int(steps))
         return None
 
     def _hint(self, pending: int) -> float:
@@ -202,13 +286,17 @@ class IngressServer:
     def __init__(self, port: int, backend, *,
                  admission: AdmissionController | None = None,
                  max_pending: int | None = None,
-                 max_queue_wait_ms: float | None = None):
+                 max_queue_wait_ms: float | None = None,
+                 sessions=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.backend = backend
         self.admission = admission if admission is not None else \
             AdmissionController(backend, max_pending=max_pending,
                                 max_queue_wait_ms=max_queue_wait_ms)
+        #: the session tier (serve/sessions.py SessionManager), owned by
+        #: the caller like the backend; None = session endpoints 404
+        self.sessions = sessions
         self._requests: dict[int, object] = {}
         self._done: dict[int, None] = {}  # insertion-ordered: FIFO aging
         self._lock = threading.Lock()
@@ -272,7 +360,11 @@ class IngressServer:
 
     # -- request handling (called from handler threads) ----------------------
     def _post(self, h) -> None:
-        if h.path.rstrip("/") != "/v1/cases":
+        path = h.path.rstrip("/")
+        if path == "/v1/sessions" or path.startswith("/v1/sessions/"):
+            self._post_session(h, path)
+            return
+        if path != "/v1/cases":
             h._json(404, {"error": f"no such endpoint {h.path!r}"})
             return
         # trace identity (ISSUE 11): adopt the client's X-NLHEAT-Trace
@@ -397,6 +489,159 @@ class IngressServer:
         case = parse_case(base | {"nt": picked.steps, "dt": picked.dt})
         return case, picked
 
+    # -- the session tier (serve/sessions.py) --------------------------------
+    def _read_body(self, h) -> dict:
+        n = int(h.headers.get("Content-Length") or 0)
+        body = json.loads(h.rfile.read(n).decode() or "{}")
+        if not isinstance(body, dict):
+            raise ValueError(
+                f"body must be a JSON object, got {type(body).__name__}")
+        return body
+
+    def _post_session(self, h, path: str) -> None:
+        if self.sessions is None:
+            h._json(404, {"error": "no session tier on this server "
+                                   "(serve/sessions.py SessionManager "
+                                   "not configured)"})
+            return
+        if path == "/v1/sessions":
+            self._open_session(h)
+            return
+        rest = path[len("/v1/sessions/"):]
+        sid, _, verb = rest.partition("/")
+        try:
+            body = self._read_body(h)
+            if verb == "retarget":
+                out = self.sessions.retarget(
+                    sid, k=body.get("k"), source=body.get("source"),
+                    clear_source=bool(body.get("clear_source")))
+                h._json(202, dict(out, session=sid))
+            elif verb == "fork":
+                child = self.sessions.fork(sid, step=body.get("step"))
+                h._json(201, {"session": child.sid,
+                              "parent": sid,
+                              "from_step": child.step})
+            elif verb == "close":
+                h._json(200, self.sessions.close_session(sid))
+            else:
+                h._json(404, {"error": f"no session verb {verb!r}"})
+        except KeyError as e:
+            h._json(404, {"error": str(e.args[0]) if e.args else str(e)})
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            h._json(400, {"error": str(e)})
+
+    def _open_session(self, h) -> None:
+        from nonlocalheatequation_tpu.serve.sessions import SessionSpec
+
+        try:
+            body = self._read_body(h)
+            # ONE validator with the case form: every shared field
+            # (shape/eps/k/dh rules, u0 size, production-needs-u0)
+            # refuses exactly as POST /v1/cases would; nt is the
+            # session's TOTAL steps
+            case = parse_case({k2: v for k2, v in body.items()
+                               if k2 in ("shape", "nt", "eps", "k", "dt",
+                                         "dh", "u0", "test")})
+            if case.test:
+                raise ValueError(
+                    "sessions are production solves (test=false with "
+                    "u0); the manufactured-source test path cannot be "
+                    "chunked")
+            spec = SessionSpec(
+                shape=case.shape, eps=case.eps, k=case.k, dt=case.dt,
+                dh=case.dh, u0=case.u0, nt=case.nt,
+                chunk_steps=int(body.get("chunk_steps",
+                                         self.sessions.default_chunk_steps)),
+                preview_stride=body.get("preview_stride"),
+                budget_steps=body.get("budget_steps"),
+                checkpoint_every=body.get("checkpoint_every"))
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            h._json(400, {"error": str(e)})
+            return
+        # open-admission mirrors case admission: a saturated fleet
+        # sheds the OPEN (429 + Retry-After); a live session's chunks
+        # then defer through the session gate instead of shedding
+        retry = self.admission.check()
+        if retry is not None:
+            h._json(429, {"error": "overloaded",
+                          "retry_after_s": round(retry, 3)},
+                    headers=[("Retry-After",
+                              str(max(1, int(np.ceil(retry)))))])
+            return
+        try:
+            s = self.sessions.open(spec)
+        except (ValueError, TypeError, RuntimeError) as e:
+            h._json(400, {"error": str(e)})
+            return
+        h._json(201, {"session": s.sid, "status": "running",
+                      "step": s.step, "nt": spec.nt,
+                      "chunk_steps": spec.chunk_steps,
+                      "stream": f"/v1/sessions/{s.sid}/stream"})
+
+    def _get_session(self, h, path: str, params: dict) -> None:
+        if self.sessions is None:
+            h._json(404, {"error": "no session tier on this server"})
+            return
+        rest = path[len("/v1/sessions/"):]
+        sid, _, verb = rest.partition("/")
+        try:
+            s = self.sessions.get(sid)
+        except KeyError:
+            h._json(404, {"error": f"no live session {sid!r}"})
+            return
+        if verb == "":
+            h._json(200, s.status())
+            return
+        if verb == "result":
+            out = s.result()
+            if out is None:
+                h._json(409, {"error": f"session {sid!r} is "
+                                       f"{s.status()['state']}; the "
+                                       "final field exists once done/"
+                                       "closed"})
+                return
+            if params.get("bin") in ("1", "true"):
+                bio = io.BytesIO()
+                np.save(bio, out)
+                h._reply(200, bio.getvalue(),
+                         ctype="application/octet-stream")
+            else:
+                h._json(200, {"session": sid,
+                              "step": s.status()["step"],
+                              "shape": list(out.shape),
+                              "values": out.ravel().tolist()})
+            return
+        if verb != "stream":
+            h._json(404, {"error": f"no session endpoint {verb!r}"})
+            return
+        try:
+            from_step = int(params.get("from_step", -1))
+            timeout = float(params.get("timeout_s") or WAIT_TIMEOUT_S)
+        except ValueError:
+            h._json(400, {"error": "bad from_step/timeout_s"})
+            return
+        # Server-Sent Events over a close-delimited HTTP/1.1 response:
+        # no Content-Length, so the connection closes when the stream
+        # ends — every frame is one `data:` line, flushed immediately
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-store")
+        h.send_header("Connection", "close")
+        h.end_headers()
+        try:
+            for fr in self.sessions.stream(sid, from_step=from_step,
+                                           timeout_s=timeout):
+                h.wfile.write(b"data: " + json.dumps(fr.wire()).encode()
+                              + b"\n\n")
+                h.wfile.flush()
+            h.wfile.write(b"event: end\ndata: " +
+                          json.dumps(s.status()).encode() + b"\n\n")
+            h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # the client hung up: its cursor makes reconnect lossless
+        finally:
+            h.close_connection = True
+
     def _get(self, h) -> None:
         path, _, query = h.path.partition("?")
         params = {}
@@ -404,6 +649,9 @@ class IngressServer:
             if "=" in kv:
                 k, _, v = kv.partition("=")
                 params[k] = v
+        if path.startswith("/v1/sessions/"):
+            self._get_session(h, path.rstrip("/"), params)
+            return
         if path == "/healthz":
             m = self.backend.metrics()
             body = {"ok": m["replicas"] > 0,
@@ -418,6 +666,9 @@ class IngressServer:
             if m.get("shard_threshold") is not None:
                 body["gang"] = len(m.get("gang") or [])
                 body["sharded_cases"] = m.get("sharded_cases", 0)
+            if self.sessions is not None:
+                # session-tier liveness rides the same health document
+                body["sessions"] = self.sessions._active_count()
             h._json(200, body)
             return
         if path.startswith("/metrics"):

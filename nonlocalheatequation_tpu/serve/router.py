@@ -163,6 +163,11 @@ class RouterRequest:
         #: picked engine (serve/picker.py EngineChoice) riding the case
         #: frame to the worker; None = the fleet's default engine
         self.engine = None
+        #: routing identity override (serve/sessions.py): a session's
+        #: chunks all carry ("session", sid) so the final partial chunk
+        #: (different nt -> different bucket key) still lands on the
+        #: session's replica; None = the case's own bucket key
+        self.sticky_key = None
         self.trace: TraceContext | None = None  # fleet trace identity
         self.trace_minted = False  # router-minted (no ingress root)
         self._flow_started = False  # first flow hop already emitted
@@ -743,7 +748,7 @@ class ReplicaRouter:
 
     def submit(self, case: EnsembleCase, *, deadline_ms: float | None = None,
                priority: int = 0, trace=None,
-               engine=None) -> RouterRequest:
+               engine=None, sticky_key=None) -> RouterRequest:
         """Route one case; returns its handle.  Raises
         :class:`RouterOverloaded` when the fleet's bounded in-flight
         budget is exhausted (the ingress tier turns that into 429).
@@ -754,13 +759,18 @@ class ReplicaRouter:
         ``EngineChoice``): it rides the case frame — a pipeline worker
         serves the case from its engine pool, the gang worker threads
         the picked stepper/method through ``solve_case_sharded`` — so
-        BOTH case classes honor the pick; None is the fleet default."""
+        BOTH case classes honor the pick; None is the fleet default.
+        ``sticky_key`` overrides the ROUTING identity (the session
+        tier's long-lived placement key, serve/sessions.py); it changes
+        which replica owns the case, never what the worker computes."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("router is closed")
             req = RouterRequest(case, self._next_seq, self._clock())
             req.deadline_ms = deadline_ms
             req.priority = int(priority)
+            if sticky_key is not None:
+                req.sticky_key = tuple(sticky_key)
             if engine is not None:
                 req.engine = engine
                 self._m_picked.inc()
@@ -805,7 +815,8 @@ class ReplicaRouter:
                 if req.requeues == 0:
                     self._m_sharded.inc()
             else:
-                key = req.case.bucket_key()
+                key = (req.sticky_key if req.sticky_key is not None
+                       else req.case.bucket_key())
                 rid = self._owner.get(key)
                 rep = self._replicas.get(rid) if rid is not None else None
                 if rep is None or not rep.alive or rep.draining:
@@ -1467,15 +1478,15 @@ def fleet_tcp_ab(engine_kwargs: dict, cases, replicas: int,
                        cpus_per_replica=cpus_per_replica,
                        **engine_kwargs) as router:
         got = router.serve_cases(mixed)  # warm pass + identity capture
-        by_case = {id(c): v for c, v in zip(mixed, got)}
+        by_case = {id(c): v for c, v in zip(mixed, got, strict=True)}
         small_ok = all(
             by_case[id(c)] is not None
             and np.array_equal(by_case[id(c)], w)
-            for c, w in zip(cases, results["tcp"]))
+            for c, w in zip(cases, results["tcp"], strict=True))
         shard_ok = all(
             by_case[id(c)] is not None
             and np.array_equal(by_case[id(c)], w)
-            for c, (w, _info) in zip(shard_cases, oracle))
+            for c, (w, _info) in zip(shard_cases, oracle, strict=True))
         if not (small_ok and shard_ok):
             # name the failing HALF: a bare false bit-identity flag is
             # undiagnosable from the one-line JSON
@@ -1484,13 +1495,13 @@ def fleet_tcp_ab(engine_kwargs: dict, cases, replicas: int,
                     return "no result"
                 return f"max diff {float(np.abs(v - w).max())!r}"
 
-            for i, (c, w) in enumerate(zip(cases, results["tcp"])):
+            for i, (c, w) in enumerate(zip(cases, results["tcp"], strict=True)):
                 v = by_case[id(c)]
                 if v is None or not np.array_equal(v, w):
                     print(f"fleet_tcp_ab: mixed small case {i} deviates "
                           f"from the tcp arm ({_why(v, w)})",
                           file=sys.stderr)
-            for i, (c, (w, _)) in enumerate(zip(shard_cases, oracle)):
+            for i, (c, (w, _)) in enumerate(zip(shard_cases, oracle, strict=True)):
                 v = by_case[id(c)]
                 if v is None or not np.array_equal(v, w):
                     print(f"fleet_tcp_ab: sharded case {i} deviates "

@@ -625,7 +625,7 @@ class ServePipeline:
     # -- intake -------------------------------------------------------------
     def submit(self, case: EnsembleCase, *, deadline_ms: float | None = None,
                priority: int = 0, trace=None,
-               engine=None) -> ServeRequest:
+               engine=None, sticky_key=None) -> ServeRequest:
         """Queue one case; returns its handle.  ``deadline_ms`` (relative
         to now) pulls the case's chunk close forward; ``priority`` orders
         ready chunks competing for a dispatch slot.  ``trace`` is the
@@ -637,7 +637,13 @@ class ServePipeline:
         ``.key()`` tuple): the case is served by the matching sibling
         from the pipeline's engine pool — same supervision, same
         schedule, its own compiled programs; None (the default) is the
-        pipeline's engine, today's behavior bit for bit."""
+        pipeline's engine, today's behavior bit for bit.  ``sticky_key``
+        is the ROUTING identity override the fleet router honors
+        (serve/router.py; the session tier's long-lived placement key)
+        — accepted here so both backends expose one submit surface, and
+        deliberately inert: an in-process pipeline owns every bucket,
+        so placement identity has nothing to change."""
+        del sticky_key  # interface uniformity with ReplicaRouter.submit
         if self._closed:
             raise RuntimeError("pipeline is closed")
         now = self._clock()

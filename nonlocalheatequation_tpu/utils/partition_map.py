@@ -35,7 +35,7 @@ class PartitionMap:
 
     def tiles_of(self, owner: int) -> list[tuple[int, int]]:
         xs, ys = np.nonzero(self.assignment == owner)
-        return list(zip(xs.tolist(), ys.tolist()))
+        return list(zip(xs.tolist(), ys.tolist(), strict=True))
 
 
 def default_assignment(npx: int, npy: int, nl: int) -> np.ndarray:

@@ -30,7 +30,13 @@ for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
               # must not silently reroute the engine-picker tests
               # (serve/picker.py) or arm the ttafleet bench rung
               "NLHEAT_PICK_STAGES", "NLHEAT_PICK_EXPO",
-              "BENCH_TTA_FLEET"):
+              "BENCH_TTA_FLEET",
+              # leaked session-tier knobs (serve/sessions.py) must not
+              # silently change the suite's budgets, checkpoint cadence,
+              # or preview stride — the same hygiene as every prior
+              # serve-tier knob family
+              "NLHEAT_SESSION_BUDGET", "NLHEAT_SESSION_CKPT_EVERY",
+              "NLHEAT_SESSION_PREVIEW", "BENCH_SESSION"):
     os.environ.pop(_knob, None)
 # "" DISABLES autotune-cache persistence (unset means the per-user default
 # file since tuning became the on-TPU default): the suite must neither read

@@ -50,7 +50,7 @@ def test_partition_balanced_and_contiguous():
     assert counts.max() - counts.min() <= 1
     # contiguity: each part's tiles form one 4-connected component
     for p in range(4):
-        tiles = {(int(x), int(y)) for x, y in zip(*np.nonzero(a == p))}
+        tiles = {(int(x), int(y)) for x, y in zip(*np.nonzero(a == p), strict=True)}
         seen = set()
         stack = [next(iter(tiles))]
         while stack:

@@ -272,7 +272,7 @@ def test_expo_stages_zero_is_the_legacy_step():
 
     E, _P = _expo_tables(op, (n, n), jnp.float64)
     box = fft_box((n, n), eps)
-    pad = [(0, b - s) for s, b in zip((n, n), box)]
+    pad = [(0, b - s) for s, b in zip((n, n), box, strict=True)]
     want = np.asarray(irfftn(E * rfftn(jnp.pad(jnp.asarray(u0), pad)),
                              s=box))[:n, :n]
     assert np.array_equal(got, want)
@@ -412,7 +412,7 @@ def test_picker_served_bit_identical_to_offline_sibling():
         # picked and default cases never share a chunk/program
         assert pipe.report.buckets == 2
     offline = EnsembleEngine(**ch.engine_kwargs()).run(cases)
-    assert all(np.array_equal(a, b) for a, b in zip(served, offline))
+    assert all(np.array_equal(a, b) for a, b in zip(served, offline, strict=True))
     # served accuracy actually meets the target the picker promised
     op = NonlocalOp2D(eps, k, ch.dt, dh)
     want = (np.cos(2.0 * np.pi * (ch.steps * ch.dt))

@@ -94,7 +94,7 @@ def test_uniform_8case_bucket_one_trace_one_dispatch(monkeypatch):
     assert engine.report.programs_built == 1
     assert engine.report.dispatches == 1
     assert engine.report.strategies.popitem()[1] == "per-step[grid]"
-    for got, want in zip(res, solos):
+    for got, want in zip(res, solos, strict=True):
         assert np.array_equal(got, want)
 
 
@@ -107,7 +107,7 @@ def test_mixed_8case_bucket_bit_identical_per_step():
     assert engine.report.programs_built == 1
     assert engine.report.dispatches == 1
     assert engine.report.strategies.popitem()[1] == "per-step[stacked]"
-    for case, got in zip(cases, res):
+    for case, got in zip(cases, res, strict=True):
         assert np.array_equal(got, _solo(case))
 
 
@@ -119,7 +119,7 @@ def test_carried_and_superstep_bit_identical(params):
     resc = EnsembleEngine(method="pallas", variant="carried").run(cases)
     ress = EnsembleEngine(method="pallas", variant="superstep",
                           ksteps=2).run(cases)
-    for case, gc, gs in zip(cases, resc, ress):
+    for case, gc, gs in zip(cases, resc, ress, strict=True):
         assert np.array_equal(
             gc, _solo(case, pk.make_carried_multi_step_fn))
         assert np.array_equal(gs, _solo(case, _superstep2_maker))
@@ -132,12 +132,12 @@ def test_bf16_tier_bit_identical(params):
     cases = _cases(2, params, rng)
     engine = EnsembleEngine(method="pallas", precision="bf16")
     res = engine.run(cases)
-    for case, got in zip(cases, res):
+    for case, got in zip(cases, res, strict=True):
         assert np.array_equal(got, _solo(case, precision="bf16"))
     # the carried bf16 pair-frame path too
     resc = EnsembleEngine(method="pallas", precision="bf16",
                           variant="carried").run(cases)
-    for case, got in zip(cases, resc):
+    for case, got in zip(cases, resc, strict=True):
         assert np.array_equal(
             got, _solo(case, pk.make_carried_multi_step_fn,
                        precision="bf16"))
@@ -156,7 +156,7 @@ def test_bucket_boundary_mixed_grids_and_padding():
     assert engine.report.padded_cases == 1  # 3 -> 4
     assert len(res) == 5
     assert res[0].shape == (NX, NY) and res[3].shape == (48, 48)
-    for case, got in zip(cases, res):
+    for case, got in zip(cases, res, strict=True):
         assert np.array_equal(got, _solo(case))
 
 
@@ -172,7 +172,7 @@ def test_manufactured_source_bucket_matches_solo():
             c.u0 = op.spatial_profile(*c.shape)
         engine = EnsembleEngine(method="pallas")
         res = engine.run(cases)
-        for case, got in zip(cases, res):
+        for case, got in zip(cases, res, strict=True):
             op = NonlocalOp2D(case.eps, case.k, case.dt, case.dh,
                               method="pallas")
             g, lg = op.source_parts(*case.shape)
@@ -205,7 +205,7 @@ def test_1d_and_3d_buckets():
                        test=False, u0=rng.normal(size=50))
           for k, dt in [(1.0, 1e-3), (0.5, 2e-3), (1.0, 1e-3)]]
     res1 = EnsembleEngine().run(c1)
-    for case, got in zip(c1, res1):
+    for case, got in zip(c1, res1, strict=True):
         op = NonlocalOp1D(case.eps, case.k, case.dt, case.dh)
         solo = np.asarray(
             make_multi_step_fn_base(op, case.nt)(jnp.asarray(case.u0), 0))
@@ -215,7 +215,7 @@ def test_1d_and_3d_buckets():
           for k, dt in [(1.0, 1e-5), (0.5, 2e-5)]]
     eng3 = EnsembleEngine(method="sat")
     res3 = eng3.run(c3)
-    for case, got in zip(c3, res3):
+    for case, got in zip(c3, res3, strict=True):
         op = NonlocalOp3D(case.eps, case.k, case.dt, case.dh, method="sat")
         solo = np.asarray(
             make_multi_step_fn_base(op, case.nt)(jnp.asarray(case.u0), 0))
@@ -236,7 +236,7 @@ def test_tune_batch_dimension(monkeypatch):
     res = engine.run(cases)
     label = engine.report.strategies.popitem()[1]
     assert label.startswith("tuned:"), label
-    for case, got in zip(cases, res):
+    for case, got in zip(cases, res, strict=True):
         assert float(np.max(np.abs(got - _solo(case)))) < 1e-12
 
 

@@ -101,14 +101,14 @@ def test_tcp_fleet_bit_identity_warm_add_and_garbage_conn(tmp_path):
         # bit-identical to the offline engine over sockets (the pipe
         # router is pinned against the same oracle in test_router.py,
         # so this also pins tcp == pipe)
-        assert all(np.array_equal(a, b) for a, b in zip(want, got))
+        assert all(np.array_equal(a, b) for a, b in zip(want, got, strict=True))
         # a garbage connection to the transport listener (port scanner,
         # confused client) must not perturb the serving fleet
         port = router._transport.port
         with socket.create_connection(("127.0.0.1", port)) as s:
             s.sendall(b"\xff" * 64)
         got2 = router.serve_cases(cases)
-        assert all(np.array_equal(a, b) for a, b in zip(want, got2))
+        assert all(np.array_equal(a, b) for a, b in zip(want, got2, strict=True))
         assert router.metrics()["deaths"] == 0
         # warm-add over TCP: the newcomer dials in, inherits a fair
         # share of the buckets (1 of 2), and serves it from the shared
@@ -119,7 +119,7 @@ def test_tcp_fleet_bit_identity_warm_add_and_garbage_conn(tmp_path):
         moved = next(iter(router._replicas[rid].buckets))
         assert router._owner[moved] == rid
         got3 = router.serve_cases(cases)
-        assert all(np.array_equal(a, b) for a, b in zip(want, got3))
+        assert all(np.array_equal(a, b) for a, b in zip(want, got3, strict=True))
         stats = router.refresh_stats()
         new = stats[rid]["metrics"]
         assert new["cases"] >= 1
@@ -161,7 +161,7 @@ def test_gang_sharded_bit_identity_and_socket_chaos():
         # no lost results, no duplicates, every result bit-identical —
         # small to the engine oracle, sharded to the offline
         # distributed solve
-        for h, w in zip(handles, want_small + want_big):
+        for h, w in zip(handles, want_small + want_big, strict=True):
             assert h.error is None
             assert np.array_equal(h.result, w)
         # the gang replica answers the stats pull flagged gang=True and

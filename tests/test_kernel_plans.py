@@ -34,7 +34,7 @@ def test_naf_reconstructs_and_is_sparse(w):
     assert sum(sign * (1 << p) for p, sign in digits) == w
     # non-adjacency: no two consecutive powers used
     pows = sorted(p for p, _ in digits)
-    assert all(b - a >= 2 for a, b in zip(pows, pows[1:]))
+    assert all(b - a >= 2 for a, b in zip(pows, pows[1:], strict=False))
     # minimal weight: NAF uses at most ceil((bitlen+1)/2) digits
     assert len(digits) <= (w.bit_length() + 2) // 2
 

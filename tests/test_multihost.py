@@ -145,7 +145,7 @@ def _harvest(procs, timeout=240):
 def _run_loopback(dev_counts, extra_env=None, timeout=240):
     procs = _spawn_controllers(_free_port(), dev_counts, extra_env)
     outs = _harvest(procs, timeout)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
+    for pid, (p, out) in enumerate(zip(procs, outs, strict=True)):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-2000:]}"
     return outs
 
@@ -239,7 +239,7 @@ def test_cli_runs_multicontroller_like_srun(cli_args, banner, footer):
             env=env, cwd=REPO_DIR,
         ))
     outs = _harvest(procs, timeout=180)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
+    for pid, (p, out) in enumerate(zip(procs, outs, strict=True)):
         assert p.returncode == 0, (
             f"rank {pid} failed:\n{out[-1500:]}\n[stderr]\n"
             f"{p.stderr_text[-1500:]}")
@@ -301,7 +301,7 @@ def test_cli_batch_multicontroller_verifies_token_stream():
                 assert p.returncode != 0, f"rank {pid} missed divergence"
             assert "batch input" in "".join(outs)
         else:
-            for pid, (p, out) in enumerate(zip(procs, outs)):
+            for pid, (p, out) in enumerate(zip(procs, outs, strict=True)):
                 assert p.returncode == 0, f"rank {pid}:\n{out[-1500:]}"
             assert "Tests Passed" in outs[0]
 

@@ -90,7 +90,7 @@ def test_warm_boot_zero_retrace_bit_identical(tmp_path, monkeypatch):
     assert warm_eng.program_store.stats() == {
         "hits": 1, "misses": 0, "saves": 0, "gc_evictions": 0,
         "refusals": {}}
-    for a, b, c in zip(base, cold, warm):
+    for a, b, c in zip(base, cold, warm, strict=True):
         assert np.array_equal(a, b) and np.array_equal(a, c)
     # honesty: a loaded program's strategy label says where it came from,
     # and the counters split built (traced+compiled HERE) from loaded —
@@ -111,7 +111,7 @@ def test_store_off_is_todays_behavior_bit_identical(tmp_path, monkeypatch):
     off = EnsembleEngine(method="conv")
     got = off.run(cases)
     assert off.program_store is None and off._store_resolved
-    for a, b in zip(base, got):
+    for a, b in zip(base, got, strict=True):
         assert np.array_equal(a, b)
     # the solo maker returns the EXACT pre-store object when off: the
     # donated-jit wrapper, not a store wrapper (today's path, verbatim)
@@ -181,7 +181,7 @@ def test_fingerprint_mismatch_refuses_and_recompiles(
     got, stats = _rerun(tmp_path, cases)
     assert stats["hits"] == 0
     assert stats["refusals"] == {ps.REFUSE_FINGERPRINT: 1}
-    for a, b in zip(out, got):
+    for a, b in zip(out, got, strict=True):
         assert np.array_equal(a, b)  # fresh compile, same results
     err = capsys.readouterr().err
     assert "fingerprint-mismatch" in err and "falling back" in err
@@ -201,7 +201,7 @@ def test_topology_mismatch_refuses_and_recompiles(
     got, stats = _rerun(tmp_path, cases)
     assert stats["hits"] == 0
     assert stats["refusals"] == {ps.REFUSE_TOPOLOGY: 1}
-    for a, b in zip(out, got):
+    for a, b in zip(out, got, strict=True):
         assert np.array_equal(a, b)
     assert "topology-mismatch" in capsys.readouterr().err
 
@@ -222,14 +222,14 @@ def test_corrupt_entry_refuses_and_recompiles(
     got, stats = _rerun(tmp_path, cases)
     assert stats["hits"] == 0
     assert stats["refusals"] == {ps.REFUSE_CORRUPT: 1}
-    for a, b in zip(out, got):
+    for a, b in zip(out, got, strict=True):
         assert np.array_equal(a, b)
     assert "corrupt" in capsys.readouterr().err
     # the refused entry was re-persisted by the fresh compile and loads
     # cleanly on the next boot
     got2, stats2 = _rerun(tmp_path, cases)
     assert stats2["hits"] == 1 and stats2["refusals"] == {}
-    for a, b in zip(out, got2):
+    for a, b in zip(out, got2, strict=True):
         assert np.array_equal(a, b)
 
 
@@ -243,7 +243,7 @@ def test_unsupported_serialization_degrades_loudly(
     base = EnsembleEngine(method="conv").run(cases)
     eng = EnsembleEngine(method="conv", program_store=str(tmp_path))
     got = eng.run(cases)
-    for a, b in zip(base, got):
+    for a, b in zip(base, got, strict=True):
         assert np.array_equal(a, b)
     assert eng.program_store.stats()["refusals"] == {
         ps.REFUSE_UNSUPPORTED: 1}
@@ -320,13 +320,13 @@ def test_lru_eviction_never_changes_results():
     base = EnsembleEngine(method="conv").run(cases)
     capped = EnsembleEngine(method="conv", program_cache_cap=1)
     got = capped.run(cases)
-    for a, b in zip(base, got):
+    for a, b in zip(base, got, strict=True):
         assert np.array_equal(a, b)
     assert capped.report.programs_resident == 1
     assert capped.report.programs_evicted >= 1
     # rerunning re-builds evicted programs transparently, same results
     got2 = capped.run(cases)
-    for a, b in zip(base, got2):
+    for a, b in zip(base, got2, strict=True):
         assert np.array_equal(a, b)
 
 
@@ -368,7 +368,7 @@ def test_pipeline_serves_from_store_and_reports_metrics(tmp_path):
         got = pipe.serve_cases(cases)
         m = pipe.metrics()
         pipe.close()
-        for a, b in zip(offline, got):
+        for a, b in zip(offline, got, strict=True):
             assert np.array_equal(a, b)
         assert set(m["store"]) == {
             "hits", "misses", "saves", "refusals", "load_ms",
@@ -401,7 +401,7 @@ def test_cpu_fallback_sibling_keys_by_backend(tmp_path):
     assert sib.store_backend == "cpu"
     assert sib.program_store is eng.program_store  # one shared namespace
     assert eng.program_store.stats()["refusals"] == {}
-    for a, b in zip(out, fb_out):
+    for a, b in zip(out, fb_out, strict=True):
         assert np.array_equal(a, np.asarray(b))
     # the backend is load-bearing in the key: same program key, avals,
     # and donation, different backend -> different digest
@@ -430,7 +430,7 @@ def test_engine_settings_outside_prog_key_separate_store_entries(tmp_path):
     b = EnsembleEngine(method="conv", program_store=d)
     out_b = b.run(cases)
     assert b.program_store.stats()["hits"] == 1
-    for x, y in zip(out_a, out_b):
+    for x, y in zip(out_a, out_b, strict=True):
         assert np.array_equal(x, y)
 
 
@@ -501,7 +501,7 @@ def test_donation_flip_rematerializes_store_backed_program(
     got2 = eng.run(cases)
     # the flip re-materialized under a new (prog_key, donate) entry
     assert len(eng._programs) == 2
-    for a, b, c in zip(base, got1, got2):
+    for a, b, c in zip(base, got1, got2, strict=True):
         assert np.array_equal(a, b) and np.array_equal(a, c)
 
 
@@ -521,7 +521,7 @@ def test_pipeline_adopting_prewarmed_engine_keeps_store_metrics(tmp_path):
     got = pipe.serve_cases(serve_cases)
     m = pipe.metrics()
     pipe.close()
-    for a, b in zip(offline, got):
+    for a, b in zip(offline, got, strict=True):
         assert np.array_equal(a, b)
     # the serve-time store activity (fresh bucket -> miss + save) is
     # visible through the PIPELINE's registry, not lost on the old one
@@ -589,7 +589,7 @@ def test_store_gc_end_to_end_saves_trigger_eviction(tmp_path, monkeypatch):
     cases = [_cases(1, nt=3 + i, seed=i)[0] for i in range(4)]
     want = EnsembleEngine(method="conv", batch_sizes=(1,)).run(cases)
     got = engine.run(cases)
-    assert all(np.array_equal(a, b) for a, b in zip(want, got))
+    assert all(np.array_equal(a, b) for a, b in zip(want, got, strict=True))
     stats = engine.program_store.stats()
     assert stats["saves"] == 4
     sizes = sum(os.path.getsize(os.path.join(d, p)) for p in _entries(d))
@@ -600,7 +600,7 @@ def test_store_gc_end_to_end_saves_trigger_eviction(tmp_path, monkeypatch):
         engine2 = EnsembleEngine(method="conv", batch_sizes=(1,),
                                  program_store=ps.ProgramStore(str(d)))
         got2 = engine2.run(cases)
-        assert all(np.array_equal(a, b) for a, b in zip(want, got2))
+        assert all(np.array_equal(a, b) for a, b in zip(want, got2, strict=True))
 
 
 def test_store_cap_env_refusals(monkeypatch):

@@ -86,7 +86,7 @@ def test_sticky_routing_bit_identity_and_fleet_scrape():
                        batch_sizes=(1,)) as router:
         got = router.serve_cases(cases)
         # bit-identical to the offline engine, in submission order
-        assert all(np.array_equal(a, b) for a, b in zip(want, got))
+        assert all(np.array_equal(a, b) for a, b in zip(want, got, strict=True))
         m = router.metrics()
         assert m["cases"] == 8 and m["outstanding"] == 0
         assert m["deaths"] == 0 and m["buckets"] == 2
@@ -125,7 +125,7 @@ def test_warm_added_replica_boots_from_shared_store(tmp_path):
     with ReplicaRouter(replicas=1, method="sat", batch_sizes=(1,),
                        program_store=store, max_replicas=2) as router:
         got = router.serve_cases(cases)
-        assert all(np.array_equal(a, b) for a, b in zip(want, got))
+        assert all(np.array_equal(a, b) for a, b in zip(want, got, strict=True))
         # replica 0 populated the shared store (one save per bucket)
         stats0 = router.refresh_stats()[0]
         assert stats0["metrics"]["store"]["saves"] >= 2
@@ -139,7 +139,7 @@ def test_warm_added_replica_boots_from_shared_store(tmp_path):
         # ... and serves its first chunks from the store: store_hits
         # >= 1 with ZERO programs built — the zero-retrace spy
         got2 = router.serve_cases(cases)
-        assert all(np.array_equal(a, b) for a, b in zip(want, got2))
+        assert all(np.array_equal(a, b) for a, b in zip(want, got2, strict=True))
         stats = router.refresh_stats()
         new = stats[rid]["metrics"]
         assert new["cases"] >= 1  # the moved bucket's cases landed here
@@ -149,7 +149,7 @@ def test_warm_added_replica_boots_from_shared_store(tmp_path):
         # drain the newcomer back out: ownership reassigns, results flow
         router.drain_replica(rid)
         got3 = router.serve_cases(cases)
-        assert all(np.array_equal(a, b) for a, b in zip(want, got3))
+        assert all(np.array_equal(a, b) for a, b in zip(want, got3, strict=True))
         assert router.live_count() == 1
 
 
@@ -167,7 +167,7 @@ def test_replica_kill_chaos_reroutes_bit_identically():
         assert m["requeued"] >= 1
         # no lost results: every handle delivered exactly once, and the
         # re-served output is bit-identical to the offline oracle
-        for h, w in zip(handles, want):
+        for h, w in zip(handles, want, strict=True):
             assert h.error is None
             assert np.array_equal(h.result, w)
         assert m["outstanding"] == 0
@@ -176,7 +176,7 @@ def test_replica_kill_chaos_reroutes_bit_identically():
     with ReplicaRouter(replicas=1, method="sat", batch_sizes=(1,),
                        faults="die@1", respawn=True) as router:
         got = router.serve_cases(cases)
-        assert all(np.array_equal(a, b) for a, b in zip(want, got))
+        assert all(np.array_equal(a, b) for a, b in zip(want, got, strict=True))
         m = router.metrics()
         assert m["deaths"] == 1 and m["spawns"] == 2
         assert m["replicas"] == 1
@@ -197,7 +197,7 @@ def test_poison_frame_classifies_without_killing_the_worker():
             h_bad.wait(timeout=60)
         # the worker survived and keeps serving
         got = router.serve_cases(good)
-        assert all(np.array_equal(a, b) for a, b in zip(want, got))
+        assert all(np.array_equal(a, b) for a, b in zip(want, got, strict=True))
         assert router.metrics()["deaths"] == 0
         # parent-side poison (an unhashable bucket key) refuses in
         # submit() itself without leaking a ledger entry

@@ -193,7 +193,7 @@ def test_no_fence_between_dispatches_and_bit_identity(monkeypatch):
     assert events.count("dispatch") == 5
     assert events.count("fence") == 5  # one per retire, none elsewhere
     assert max(n for _t, n in pipe.report.occupancy_samples) >= 2
-    for got, want in zip(served, offline):
+    for got, want in zip(served, offline, strict=True):
         assert np.array_equal(got, want)
 
 
@@ -206,7 +206,7 @@ def test_bit_identity_mixed_buckets_vs_offline():
     offline = offline_engine.run(cases)
     with ServePipeline(depth=3, window_ms=10_000.0) as pipe:
         served = pipe.serve_cases(cases)
-    for got, want in zip(served, offline):
+    for got, want in zip(served, offline, strict=True):
         assert np.array_equal(got, want)
     assert pipe.report.padded_cases == offline_engine.report.padded_cases
     assert pipe.report.buckets == offline_engine.report.buckets

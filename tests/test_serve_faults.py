@@ -451,7 +451,7 @@ def test_breaker_opens_routes_fallback_probes_and_recloses():
     moves = [(t["from"], t["to"]) for t in m["breaker"]["transitions"]]
     assert moves == [("closed", "open"), ("open", "half-open"),
                      ("half-open", "closed")]
-    for h, want in zip(handles, offline):
+    for h, want in zip(handles, offline, strict=True):
         assert np.array_equal(h.result, want)
 
 
@@ -523,5 +523,5 @@ def test_happy_path_supervision_reports_all_zero_telemetry():
     assert res["quarantined"] == [] and res["backoff_ms_total"] == 0.0
     assert res["breaker"]["state"] == "closed"
     assert res["breaker"]["transitions"] == []
-    for got, want in zip(served, offline):
+    for got, want in zip(served, offline, strict=True):
         assert np.array_equal(got, want)

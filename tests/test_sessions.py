@@ -1,0 +1,708 @@
+"""Live simulation sessions (ISSUE 15): the stateful streaming tier.
+
+Pins the tentpole contracts on the CPU suite:
+
+* chunked stepping bit-identity — a session's final f64 field equals
+  the offline chunk-by-chunk EnsembleEngine composition, and the frame
+  stream (initial + per-boundary previews + final) is a deterministic
+  function of (spec, retarget log),
+* retarget-at-chunk-boundary determinism — queued k/source verbs apply
+  exactly at the next boundary, audited by step, bit-identical to the
+  manually composed two-phase run,
+* fork + checkpoint resume — a branch from a retained boundary equals
+  a fresh run from that state; a manager killed mid-session resumes
+  from the newest uncorrupted checkpoint and the combined stream
+  (pre-death + post-resume frames, deduped by step) is bit-identical
+  to an uninterrupted run with no lost or duplicated frames,
+* `die@` chaos — a replica SIGKILLed mid-session and mid-fork is
+  invisible to the stream (the router re-routes; results bit-identical),
+* budget starvation — with per-session budgets through the admission
+  controller's session gate, a greedy streaming session defers and the
+  batch tier keeps admitting within its latency bound (deterministic
+  injected-clock test; the gateless contrast arm shows batch shed).
+
+The in-process ServePipeline backs every test that doesn't need real
+worker processes — the fleet tests (chaos, HTTP/SSE) spawn one router
+each and batch their assertions to hold the tier-1 budget.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from nonlocalheatequation_tpu.obs.metrics import MetricsRegistry
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase,
+    EnsembleEngine,
+)
+from nonlocalheatequation_tpu.serve.http import (
+    AdmissionController,
+    IngressServer,
+)
+from nonlocalheatequation_tpu.serve.router import (
+    ReplicaRouter,
+    RouterOverloaded,
+)
+from nonlocalheatequation_tpu.serve.server import ServePipeline
+from nonlocalheatequation_tpu.serve.sessions import (
+    Session,
+    SessionManager,
+    SessionSpec,
+)
+from nonlocalheatequation_tpu.utils.checkpoint import (
+    list_session_checkpoints,
+    session_checkpoint_path,
+)
+
+assert jax.config.jax_enable_x64  # the oracle contract (conftest forces it)
+
+G = 12
+PHYS = dict(eps=2, k=1.0, dt=1e-5, dh=1.0 / G)
+
+
+def u0_of(seed=0):
+    return np.random.default_rng(seed).normal(size=(G, G))
+
+
+def chunked_oracle(u0, plan):
+    """The session trajectory, composed by hand: ``plan`` is a list of
+    ``(n_steps, k, source)`` chunks — each one offline engine run plus
+    the session tier's first-order source splitting (u += n*dt*b at the
+    chunk's end).  Returns every boundary state (incl. the initial)."""
+    eng = EnsembleEngine(method="sat", batch_sizes=(1,))
+    states = [np.asarray(u0, np.float64)]
+    u = states[0]
+    for n, k, source in plan:
+        u = eng.run([EnsembleCase(shape=u.shape, nt=n, eps=PHYS["eps"],
+                                  k=k, dt=PHYS["dt"], dh=PHYS["dh"],
+                                  test=False, u0=u)])[0]
+        u = np.asarray(u, np.float64)
+        if source is not None:
+            u = u + n * PHYS["dt"] * np.asarray(source, np.float64)
+        states.append(u)
+    return states
+
+
+def make_pipe():
+    return ServePipeline(method="sat", batch_sizes=(1,), depth=1,
+                         window_ms=0.0)
+
+
+def frames_by_step(frames):
+    return {(f.step, f.kind): np.array(f.values) for f in frames}
+
+
+# ---------------------------------------------------------------------------
+# chunked stepping + stream (in-process pipeline)
+# ---------------------------------------------------------------------------
+
+
+def test_session_chunked_stream_bit_identity(tmp_path):
+    u0 = u0_of(1)
+    with make_pipe() as pipe:
+        with SessionManager(pipe, checkpoint_dir=str(tmp_path),
+                            chunk_steps=4) as mgr:
+            s = mgr.open(shape=(G, G), u0=u0, nt=10, checkpoint_every=1,
+                         preview_stride=3, **PHYS)
+            mgr.drive(timeout_s=120)
+            assert s.state == "done" and s.step == 10
+            # boundary oracle: 4 + 4 + 2 steps (the final partial chunk)
+            states = chunked_oracle(u0, [(4, 1.0, None), (4, 1.0, None),
+                                         (2, 1.0, None)])
+            assert np.array_equal(s.result(), states[-1])
+            assert s.result().dtype == np.float64
+            # the stream: initial preview, one per boundary, final f64 —
+            # previews are the f32 ::stride downsample of the boundary
+            frames = list(mgr.stream(s.sid, from_step=-1, timeout_s=5))
+            kinds = [(f.step, f.kind) for f in frames]
+            assert kinds == [(0, "preview"), (4, "preview"),
+                             (8, "preview"), (10, "preview"),
+                             (10, "final")]
+            for f, u in zip(frames[:-1], states, strict=True):
+                assert f.values.dtype == np.float32
+                assert np.array_equal(f.values, u[::3, ::3]
+                                      .astype(np.float32))
+            # cursor semantics: a reconnecting reader loses nothing and
+            # duplicates nothing
+            tail = list(mgr.stream(s.sid, from_step=4, timeout_s=5))
+            assert [(f.step, f.kind) for f in tail] == [
+                (8, "preview"), (10, "preview"), (10, "final")]
+            # checkpoints retained at every boundary (cadence 1)
+            assert mgr.checkpoints(s.sid) == [4, 8, 10]
+            m = mgr.metrics()
+            assert m["chunks"] == 3 and m["steps"] == 10
+            assert m["completed"] == 1 and m["frames"] == 5
+            # the registry is the backend's: one scrape shows the tier
+            assert pipe.registry.get("/session/chunks").value == 3
+
+
+def test_retarget_at_chunk_boundary_determinism(tmp_path, monkeypatch):
+    events = tmp_path / "events.jsonl"
+    monkeypatch.setenv("NLHEAT_EVENT_LOG", str(events))
+    u0 = u0_of(2)
+    b = np.full((G, G), 0.25)
+    with make_pipe() as pipe:
+        with SessionManager(pipe, chunk_steps=3) as mgr:
+            s = mgr.open(shape=(G, G), u0=u0, nt=9, **PHYS)
+            # queued BEFORE any chunk retires: applies at step 3, so
+            # chunk 1 runs the opening physics, chunks 2..3 the new k
+            # with the source active
+            ticket = mgr.retarget(s.sid, k=1.5, source=b)
+            assert ticket["requested_at_step"] == 0
+            mgr.drive(timeout_s=120)
+            assert s.state == "done"
+            states = chunked_oracle(u0, [(3, 1.0, None), (3, 1.5, b),
+                                         (3, 1.5, b)])
+            assert np.array_equal(s.result(), states[-1])
+            # the audit trail: the boundary step is recorded evidence
+            audit = s.status()["audit"]
+            assert audit == [{"verb": "retarget", "applied_at_step": 3,
+                              "requested_at_step": 0, "k": 1.5,
+                              "source": "set"}]
+            # clearing the source is a verb too (fresh session)
+            s2 = mgr.open(shape=(G, G), u0=u0, nt=6, **PHYS)
+            mgr.retarget(s2.sid, source=b)
+            while s2.step < 3:  # chunk 1 retires; source now active
+                mgr.pump(block=True)
+            mgr.retarget(s2.sid, clear_source=True)
+            mgr.drive(timeout_s=120)
+            states2 = chunked_oracle(u0, [(3, 1.0, None), (3, 1.0, b)])
+            # chunk 2 ran WITH the source (cleared only at step 6)
+            assert np.array_equal(s2.result(), states2[-1])
+    lines = [json.loads(ln) for ln in events.read_text().splitlines()]
+    kinds = [ln["event"] for ln in lines]
+    assert "session-open" in kinds and "session-chunk" in kinds
+    assert "session-retarget" in kinds
+    assert "session-retarget-applied" in kinds and "session-done" in kinds
+    applied = next(ln for ln in lines
+                   if ln["event"] == "session-retarget-applied")
+    assert applied["applied_at_step"] == 3
+
+
+def test_fork_and_manager_death_resume_bit_identical(tmp_path, capsys):
+    ckpt = str(tmp_path / "ckpt")
+    u0 = u0_of(3)
+    # arm A: the uninterrupted run — every boundary frame + final field
+    with make_pipe() as pipe:
+        with SessionManager(pipe, checkpoint_dir=ckpt,
+                            chunk_steps=4) as mgr:
+            a = mgr.open(shape=(G, G), u0=u0, nt=16, checkpoint_every=1,
+                         **PHYS)
+            mgr.drive(timeout_s=180)
+            want_frames = frames_by_step(
+                mgr.stream(a.sid, from_step=-1, timeout_s=5))
+            want_final = a.result()
+            sid_a = a.sid
+    # arm B: same spec, the manager DIES after 2 chunks (close() is the
+    # stand-in for the front-door crash — checkpoints are already on
+    # disk); a fresh manager resumes from the newest boundary and the
+    # combined stream re-emits from there, bit-identical, no dup/loss
+    ckpt_b = str(tmp_path / "ckpt_b")
+    with make_pipe() as pipe:
+        mgr = SessionManager(pipe, checkpoint_dir=ckpt_b, chunk_steps=4)
+        b = mgr.open(shape=(G, G), u0=u0, nt=16, checkpoint_every=1,
+                     **PHYS)
+        sid = b.sid
+        while b.step < 8:
+            mgr.pump(block=True)
+        pre_frames = b.frames_after(-1)  # passive read: stream() would
+        # pump a driverless manager and finish the run we mean to kill
+        assert b.step == 8 and list_session_checkpoints(ckpt_b, sid) \
+            == [4, 8]
+        mgr.close()  # the "death" (sessions end closed, ckpts remain)
+    with make_pipe() as pipe:
+        with SessionManager(pipe, checkpoint_dir=ckpt_b) as mgr2:
+            br = mgr2.resume(sid)
+            assert br.resumed_from == 8 and br.step == 8
+            mgr2.drive(timeout_s=180)
+            post_frames = list(mgr2.stream(sid, from_step=-1,
+                                           timeout_s=5))
+            got = frames_by_step(pre_frames)
+            dupes = 0
+            for f in post_frames:
+                key = (f.step, f.kind)
+                if key in got:
+                    dupes += 1
+                    # a re-emitted boundary must be bit-identical
+                    assert np.array_equal(got[key], f.values)
+                got[key] = np.array(f.values)
+            # the resume re-emitted its boundary (step 8): dup by
+            # design, deduped by the cursor/step key
+            assert dupes >= 1
+            # no lost, no extra: the union equals the uninterrupted set
+            want = {(k[0], k[1]) for k in want_frames}
+            assert set(got) == want
+            for key in want:
+                assert np.array_equal(got[key], want_frames[key]), key
+            assert np.array_equal(br.result(), want_final)
+            assert mgr2.metrics()["resumes"] == 1
+    # corrupt-newest fallback: torn final checkpoint -> resume falls
+    # back to the previous boundary, loudly
+    newest = session_checkpoint_path(ckpt, sid_a,
+                                     list_session_checkpoints(
+                                         ckpt, sid_a)[-1])
+    with open(newest, "wb") as f:
+        f.write(b"torn")
+    with make_pipe() as pipe:
+        with SessionManager(pipe, checkpoint_dir=ckpt) as mgr3:
+            c = mgr3.resume(sid_a)
+            assert c.step == 12  # newest UNCORRUPTED boundary
+            mgr3.drive(timeout_s=180)
+            assert np.array_equal(c.result(), want_final)
+    assert "unreadable" in capsys.readouterr().err
+
+
+def test_fork_branches_and_parent_unaffected(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    u0 = u0_of(4)
+    b = np.full((G, G), -0.5)
+    with make_pipe() as pipe:
+        with SessionManager(pipe, checkpoint_dir=ckpt,
+                            chunk_steps=4) as mgr:
+            parent = mgr.open(shape=(G, G), u0=u0, nt=12,
+                              checkpoint_every=1, **PHYS)
+            # run to the first boundary, then branch a what-if with a
+            # retargeted source while the parent continues unchanged
+            while parent.step < 4:
+                mgr.pump(block=True)
+            assert parent.step == 4
+            child = mgr.fork(parent.sid, step=4)
+            assert child.parent == (parent.sid, 4) and child.step == 4
+            mgr.retarget(child.sid, source=b)
+            mgr.drive(timeout_s=180)
+            p_states = chunked_oracle(u0, [(4, 1.0, None)] * 3)
+            assert np.array_equal(parent.result(), p_states[-1])
+            c_states = chunked_oracle(p_states[1], [(4, 1.0, None),
+                                                    (4, 1.0, b)])
+            assert np.array_equal(child.result(), c_states[-1])
+            assert child.status()["audit"][0] == {
+                "verb": "fork", "parent": parent.sid, "from_step": 4}
+            assert mgr.metrics()["forks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# die@ chaos over a real fleet
+# ---------------------------------------------------------------------------
+
+
+def test_die_chaos_mid_session_and_mid_fork_bit_identical(tmp_path):
+    u0 = u0_of(5)
+    # the oracle: boundary states of the uninterrupted trajectory
+    states = chunked_oracle(u0, [(4, 1.0, None)] * 3)
+    # die@1 kills the replica serving the SECOND session chunk mid-
+    # flight; die@4 kills again while the fork's first chunk is in
+    # flight — both re-route and re-serve bit-identically (the session
+    # never notices; checkpoint resume is for manager death, above)
+    with ReplicaRouter(replicas=2, method="sat", batch_sizes=(1,),
+                       faults="die@1,die@4", respawn=True) as router:
+        with SessionManager(router, checkpoint_dir=str(tmp_path),
+                            chunk_steps=4) as mgr:
+            s = mgr.open(shape=(G, G), u0=u0, nt=12, checkpoint_every=1,
+                         **PHYS)
+            # drive the parent through its chunks; fork at step 8
+            while True:
+                mgr.pump(block=True)
+                if s.step >= 8:
+                    break
+            child = mgr.fork(s.sid, step=8)
+            mgr.drive(timeout_s=300)
+            assert s.state == "done" and child.state == "done"
+            assert np.array_equal(s.result(), states[-1])
+            # the fork continued the same trajectory from step 8
+            assert np.array_equal(child.result(), states[-1])
+            frames = list(mgr.stream(s.sid, from_step=-1, timeout_s=5))
+            assert [(f.step, f.kind) for f in frames] == [
+                (0, "preview"), (4, "preview"), (8, "preview"),
+                (12, "preview"), (12, "final")]
+        m = router.metrics()
+        assert m["deaths"] >= 1 and m["requeued"] >= 1
+        assert m["outstanding"] == 0
+        # session placement was sticky-by-session-id, not bucket key
+        assert any(key[0] == "session" for key in router._owner)
+
+
+# ---------------------------------------------------------------------------
+# budgets: a greedy stream cannot starve the batch tier (injected clock)
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _StubRequest:
+    def __init__(self, case, seq, submit_t):
+        self.case = case
+        self.seq = seq
+        self.submit_t = submit_t
+        self.result = None
+        self.error = None
+        self.latency_s = None
+        self.replica = 0
+        self.requeues = 0
+        self.done = threading.Event()
+
+
+class _StubBackend:
+    """Router-shaped backend with test-controlled completion and an
+    injected clock — the 'fleet' whose capacity the starvation test
+    reasons about deterministically."""
+
+    def __init__(self, clock, max_outstanding=4):
+        self.registry = MetricsRegistry()
+        self.max_outstanding = max_outstanding
+        self.clock = clock
+        self._pending = []
+        self._seq = 0
+        self._lat = self.registry.histogram("/router/request-latency-ms")
+        self.registry.gauge("/router/outstanding")
+
+    def live_count(self):
+        return 1
+
+    def outstanding_total(self):
+        return len(self._pending)
+
+    def retry_after_s(self):
+        return 0.25
+
+    def submit(self, case, deadline_ms=None, priority=0, sticky_key=None,
+               trace=None, engine=None):
+        if len(self._pending) >= self.max_outstanding:
+            raise RouterOverloaded(len(self._pending),
+                                   self.max_outstanding, 0.25)
+        req = _StubRequest(case, self._seq, self.clock())
+        self._seq += 1
+        self._pending.append(req)
+        return req
+
+    def finish(self, n=1):
+        for _ in range(n):
+            req = self._pending.pop(0)
+            req.result = np.asarray(req.case.u0, np.float64)
+            req.latency_s = self.clock() - req.submit_t
+            self._lat.observe(req.latency_s * 1e3)
+            req.done.set()
+
+
+def _greedy_sessions(mgr, n, budget=0):
+    return [mgr.open(shape=(G, G), u0=u0_of(10 + i), nt=None,
+                     chunk_steps=4, budget_steps=budget,
+                     preview_stride=4, checkpoint_every=0, **PHYS)
+            for i in range(n)]
+
+
+def test_session_budget_cannot_starve_batch():
+    clock = _FakeClock()
+    backend = _StubBackend(clock, max_outstanding=4)
+    # the session gate: 8 steps/s fleet-wide (2 chunks of 4), batch
+    # bound 250 ms — the admission controller's promise under load
+    adm = AdmissionController(backend, max_pending=4,
+                              max_queue_wait_ms=250.0,
+                              session_steps_per_s=8.0, clock=clock)
+    with SessionManager(backend, admission=adm, clock=clock) as mgr:
+        _greedy_sessions(mgr, 8)
+        # 8 greedy open-ended sessions race: the token bucket admits
+        # exactly 2 chunks (burst = one second = 8 steps), the rest
+        # DEFER — the fleet keeps 2 of 4 slots free for batch
+        assert mgr.pump() == 2
+        assert backend.outstanding_total() == 2
+        assert mgr.metrics()["deferrals"] == 6
+        assert adm.backend.registry.get(
+            "/ingress/session-deferred").value == 6
+        # batch keeps flowing: both offered cases admitted, no shed
+        batch = [EnsembleCase(shape=(G, G), nt=2, test=False,
+                              u0=u0_of(30 + i), **PHYS)
+                 for i in range(2)]
+        for c in batch:
+            req, retry = adm.try_submit(c)
+            assert req is not None and retry is None
+        backend.finish(4)  # everything in flight completes this tick
+        clock.advance(0.1)
+        # batch latency stayed inside the admission bound (the
+        # deterministic p99-within-bound half of the acceptance)
+        lat = adm.backend.registry.get("/router/request-latency-ms")
+        assert lat.percentiles()["p99"] <= 250.0
+        assert adm.backend.registry.get("/ingress/shed").value == 0
+        # the rolling average holds: 0.6 s later only ONE more chunk's
+        # worth of tokens has accrued — the pump retires the two
+        # finished chunks and admits exactly one new one
+        clock.advance(0.5)
+        assert mgr.pump() == 3
+        assert backend.outstanding_total() == 1
+        assert adm.backend.registry.get(
+            "/ingress/session-steps").value == 12
+    # CONTRAST arm — no session gate: the same greedy sessions fill
+    # every slot and the batch tier sheds.  This is the starvation the
+    # gate exists to prevent.
+    clock2 = _FakeClock()
+    backend2 = _StubBackend(clock2, max_outstanding=4)
+    adm2 = AdmissionController(backend2, max_pending=4, clock=clock2)
+    with SessionManager(backend2, admission=adm2, clock=clock2) as mgr2:
+        _greedy_sessions(mgr2, 8)
+        mgr2.pump()
+        assert backend2.outstanding_total() == 4  # saturated
+        req, retry = adm2.try_submit(
+            EnsembleCase(shape=(G, G), nt=2, test=False, u0=u0_of(40),
+                         **PHYS))
+        assert req is None and retry > 0
+        assert backend2.registry.get("/ingress/shed").value == 1
+
+
+def test_per_session_budget_window(monkeypatch):
+    # the PER-session budget (no fleet gate): 4 steps per window means
+    # one chunk per window — the second submit defers until the window
+    # rolls on the injected clock
+    clock = _FakeClock()
+    backend = _StubBackend(clock, max_outstanding=8)
+    with SessionManager(backend, clock=clock) as mgr:
+        s = mgr.open(shape=(G, G), u0=u0_of(11), nt=12, chunk_steps=4,
+                     budget_steps=4, budget_window_s=1.0,
+                     checkpoint_every=0, **PHYS)
+        assert mgr.pump() == 1
+        backend.finish(1)
+        assert mgr.pump() == 1  # retire chunk 1
+        assert s.step == 4
+        assert mgr.pump() == 0  # budget spent: deferred
+        assert s.status()["deferrals"] == 1
+        clock.advance(1.1)  # the window rolls
+        assert mgr.pump() == 1
+        backend.finish(1)
+        # env default wiring: NLHEAT_SESSION_BUDGET backs specs that
+        # don't name a budget
+        monkeypatch.setenv("NLHEAT_SESSION_BUDGET", "16")
+        s2 = mgr.open(shape=(G, G), u0=u0_of(12), nt=4, chunk_steps=4,
+                      checkpoint_every=0, **PHYS)
+        assert s2.spec.budget_steps == 16
+
+
+def test_close_mid_stream_delivers_final_frame():
+    # regression: close_session emits the final f64 frame at the SAME
+    # step as the last preview — a reader that already consumed that
+    # preview (cursor == step) must still receive the final (the
+    # (step, kind-rank) cursor; a bare step cursor skipped it)
+    clock = _FakeClock()
+    backend = _StubBackend(clock)
+    with SessionManager(backend, clock=clock) as mgr:
+        s = mgr.open(shape=(G, G), u0=u0_of(13), nt=None, chunk_steps=4,
+                     checkpoint_every=0, **PHYS)
+        mgr.pump()
+        backend.finish(1)
+        mgr.pump()  # boundary at step 4: preview emitted
+        seen = s.frames_after(-1)
+        assert [(f.step, f.kind) for f in seen] == [(0, "preview"),
+                                                    (4, "preview")]
+        mgr.close_session(s.sid)
+        # the final at step 4 is strictly PAST the consumed-preview
+        # position (4, rank 0) ...
+        due = s.frames_after(4, 0)
+        assert [(f.step, f.kind) for f in due] == [(4, "final")]
+        assert due[0].values.dtype == np.float64
+        # ... and the stream generator delivers it from the same cursor
+        frames = list(mgr.stream(s.sid, from_step=4, timeout_s=1))
+        assert [(f.step, f.kind) for f in frames] == [(4, "final")]
+        # the pump claim: a session already being worked by one thread
+        # is skipped by every other pump (no double-submit)
+        s2 = mgr.open(shape=(G, G), u0=u0_of(14), nt=8, chunk_steps=4,
+                      checkpoint_every=0, **PHYS)
+        with s2._lock:
+            s2._pump_busy = True
+        assert mgr.pump() == 0
+        with s2._lock:
+            s2._pump_busy = False
+        assert mgr.pump() == 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: open / stream (SSE) / retarget / fork / close / result
+# ---------------------------------------------------------------------------
+
+
+def _post(base, path, payload):
+    try:
+        r = urllib.request.urlopen(urllib.request.Request(
+            base + path, json.dumps(payload).encode()))
+        return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def test_http_session_endpoints_end_to_end(tmp_path):
+    u0 = u0_of(6)
+    states = chunked_oracle(u0, [(4, 1.0, None), (4, 1.0, None)])
+    with ReplicaRouter(replicas=1, method="sat",
+                       batch_sizes=(1,)) as router:
+        adm = AdmissionController(router)
+        with SessionManager(router, admission=adm,
+                            checkpoint_dir=str(tmp_path),
+                            chunk_steps=4) as mgr:
+            mgr.start_driver()
+            with IngressServer(0, router, admission=adm,
+                               sessions=mgr) as ing:
+                base = f"http://127.0.0.1:{ing.port}"
+                body = dict(shape=[G, G], nt=8, eps=PHYS["eps"],
+                            k=PHYS["k"], dt=PHYS["dt"], dh=PHYS["dh"],
+                            u0=u0.tolist(), chunk_steps=4,
+                            checkpoint_every=1)
+                st, r = _post(base, "/v1/sessions", body)
+                assert st == 201 and r["status"] == "running"
+                sid = r["session"]
+                assert r["stream"] == f"/v1/sessions/{sid}/stream"
+                # the SSE stream: read to EOF (the server closes when
+                # the session completes), parse `data:` lines
+                raw = urllib.request.urlopen(
+                    base + f"/v1/sessions/{sid}/stream?timeout_s=60",
+                    timeout=120).read().decode()
+                frames = [json.loads(ln[len("data: "):])
+                          for ln in raw.splitlines()
+                          if ln.startswith("data: ")]
+                assert [(f["step"], f["kind"]) for f in frames[:-1]] == [
+                    (0, "preview"), (4, "preview"), (8, "preview"),
+                    (8, "final")]
+                assert "event: end" in raw
+                final = np.asarray(
+                    frames[-2]["values"]).reshape(frames[-2]["shape"])
+                # JSON f64 round-trips exactly: the streamed final field
+                # IS the oracle composition, bitwise
+                assert np.array_equal(final, states[-1])
+                # status document + result endpoint
+                r = json.load(urllib.request.urlopen(
+                    base + f"/v1/sessions/{sid}"))
+                assert r["state"] == "done" and r["step"] == 8
+                r = json.load(urllib.request.urlopen(
+                    base + f"/v1/sessions/{sid}/result"))
+                got = np.asarray(r["values"]).reshape(r["shape"])
+                assert np.array_equal(got, states[-1])
+                # fork over HTTP from a retained checkpoint boundary:
+                # the child re-runs 4 -> 8 on the same physics, so its
+                # final field must equal the parent's, bitwise
+                st, r = _post(base, f"/v1/sessions/{sid}/fork",
+                              {"step": 4})
+                assert st == 201 and r["from_step"] == 4
+                child = r["session"]
+                raw2 = urllib.request.urlopen(
+                    base + f"/v1/sessions/{child}/stream?timeout_s=60",
+                    timeout=120).read().decode()
+                finals = [json.loads(ln[len("data: "):])
+                          for ln in raw2.splitlines()
+                          if ln.startswith("data: ")
+                          and '"final"' in ln]
+                got = np.asarray(finals[-1]["values"]).reshape(
+                    finals[-1]["shape"])
+                assert np.array_equal(got, states[-1])
+                # retarget + close ride HTTP too (a long-running
+                # session this time, so the verbs race nothing)
+                st, r = _post(base, "/v1/sessions",
+                              dict(body, nt=4000))
+                assert st == 201
+                slow = r["session"]
+                st, r = _post(base, f"/v1/sessions/{slow}/retarget",
+                              {"k": 2.0})
+                assert st == 202 and r["session"] == slow
+                st, r = _post(base, f"/v1/sessions/{slow}/close", {})
+                assert st == 200 and r["state"] == "closed"
+                # client errors: bad body, unknown session, bad verb
+                st, r = _post(base, "/v1/sessions", {"shape": [G, G]})
+                assert st == 400 and "missing case field" in r["error"]
+                st, r = _post(base, "/v1/sessions/nope/retarget",
+                              {"k": 2.0})
+                assert st == 404
+                st, _ = _post(base, f"/v1/sessions/{sid}/explode", {})
+                assert st == 404
+                # a test=true session is refused: chunked manufactured
+                # sources would restart time every chunk
+                st, r = _post(base, "/v1/sessions",
+                              dict(body, test=True))
+                assert st == 400 and "test" in r["error"]
+                # the health document carries the session tier
+                r = json.load(urllib.request.urlopen(base + "/healthz"))
+                assert "sessions" in r
+                # /session/* metrics ride the same fleet scrape
+                text = urllib.request.urlopen(
+                    base + "/metrics").read().decode()
+                assert "nlheat_session_opened" in text
+                assert "nlheat_session_chunks" in text
+
+
+# ---------------------------------------------------------------------------
+# refusals
+# ---------------------------------------------------------------------------
+
+
+def test_session_spec_and_manager_refusals(tmp_path):
+    clock = _FakeClock()
+    backend = _StubBackend(clock)
+    ok = dict(shape=(G, G), u0=u0_of(7), nt=8, **PHYS)
+    for bad, msg in [
+        (dict(ok, u0=None), "needs an initial state"),
+        (dict(ok, nt=0), "nt must be"),
+        (dict(ok, shape=(0,)), "bad session shape"),
+        (dict(ok, chunk_steps=0), "chunk_steps"),
+        (dict(ok, u0=np.zeros(3)), "u0 has 3 values"),
+        (dict(ok, budget_steps=-1), "budget_steps"),
+        (dict(ok, preview_stride=0), "preview_stride"),
+        (dict(ok, checkpoint_every=-1), "checkpoint_every"),
+    ]:
+        with pytest.raises(ValueError, match=msg):
+            SessionSpec(**bad).validate()
+    with SessionManager(backend, clock=clock) as mgr:
+        s = mgr.open(**ok)
+        # JSON-shaped values COERCE at validate (a 2.5 stride or "10"
+        # budget must never detonate later inside the pump)
+        sp = SessionSpec(**dict(ok, preview_stride=2.5,
+                                budget_steps="10",
+                                chunk_steps=4.0)).validate()
+        assert sp.preview_stride == 2 and sp.budget_steps == 10
+        assert sp.chunk_steps == 4 and isinstance(sp.chunk_steps, int)
+        with pytest.raises(ValueError, match="retarget needs"):
+            mgr.retarget(s.sid)
+        with pytest.raises(ValueError, match="source has"):
+            mgr.retarget(s.sid, source=[1.0, 2.0])
+        with pytest.raises(KeyError):
+            mgr.get("nope")
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            mgr.resume("nope")
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            mgr.fork(s.sid, step=4)
+        mgr.close_session(s.sid)
+        with pytest.raises(ValueError, match="running"):
+            mgr.retarget(s.sid, k=2.0)
+        # double close is idempotent: /session/closed counts ONE end
+        mgr.close_session(s.sid)
+        assert backend.registry.get("/session/closed").value == 1
+    # bounded retention of ended sessions (the RESULTS_CAP twin): the
+    # oldest ended sessions age out; checkpoints on disk would remain
+    with SessionManager(backend, clock=clock, retain_ended=2) as mgr:
+        sids = []
+        for i in range(4):
+            si = mgr.open(**dict(ok, u0=u0_of(20 + i)))
+            sids.append(si.sid)
+            mgr.close_session(si.sid)
+        live = set(mgr._sessions)
+        assert sids[0] not in live and sids[1] not in live
+        assert sids[2] in live and sids[3] in live
+    with SessionManager(backend, clock=clock,
+                        checkpoint_dir=str(tmp_path)) as mgr:
+        s = mgr.open(**ok)
+        with pytest.raises(ValueError, match="already live"):
+            mgr.resume(s.sid)
+        with pytest.raises(FileNotFoundError):
+            mgr.resume("never-existed")
+        with pytest.raises(FileNotFoundError, match="no checkpoints"):
+            mgr.fork(s.sid, step=99)  # nothing retained yet at all
+    # a session is pinned by sticky key, and Session exposes it
+    assert Session("s9", SessionSpec(**ok).validate()).sticky_key() \
+        == ("session", "s9")

@@ -313,7 +313,7 @@ def test_ensemble_stepper_matches_sequential_bitwise():
 
     cases = _cases()
     states = EnsembleEngine(method="fft", stepper="rkc", stages=4).run(cases)
-    for case, got in zip(cases, states):
+    for case, got in zip(cases, states, strict=True):
         op = NonlocalOp2D(case.eps, case.k, case.dt, case.dh, method="fft")
         g, lg = op.source_parts(*case.shape)
         solo = steppers.make_multi_step_fn(
@@ -350,7 +350,7 @@ def test_serve_fft_cases_bit_identical_to_offline():
     with ServePipeline(engine=engine, depth=2, window_ms=0.0) as pipe:
         handles = [pipe.submit(c) for c in cases]
         pipe.drain()
-    for h, want in zip(handles, offline):
+    for h, want in zip(handles, offline, strict=True):
         assert h.error is None
         assert np.array_equal(np.asarray(h.result), np.asarray(want))
 

@@ -453,7 +453,7 @@ def test_golden_end_to_end_fleet_trace_with_retry_and_die(tmp_path):
     doc, pm, traces, results, stale_names, pruned_names = \
         _run_chaos_fleet(tmp_path, 2, cases, "die@2")
     # served results bit-identical to offline, tracing + chaos on
-    for got, want in zip(results, offline):
+    for got, want in zip(results, offline, strict=True):
         assert np.array_equal(got, want)
 
     # -- the merged artifact is schema-valid and multi-process ----------
@@ -529,7 +529,7 @@ def test_acceptance_four_replica_chaos_run(tmp_path):
     offline = EnsembleEngine(method="sat", batch_sizes=(1,)).run(cases)
     doc, pm, traces, results, _stale, _pruned = \
         _run_chaos_fleet(tmp_path, 4, cases, "die@3")
-    for got, want in zip(results, offline):
+    for got, want in zip(results, offline, strict=True):
         assert np.array_equal(got, want)
     events = doc["traceEvents"]
     _check_schema(events)

@@ -987,7 +987,7 @@ def bench_router(steps: int):
     finally:
         shutil.rmtree(store_dir, ignore_errors=True)
     bit = all(np.array_equal(a, b)
-              for a, b in zip(ab["results"][1], ab["results"][replicas]))
+              for a, b in zip(ab["results"][1], ab["results"][replicas], strict=True))
     total_steps = sum(c.nt for c in cases)
     emit("router/1replica", n * n * C, total_steps // C, ab["walls"][1],
          grid=n, eps=8, replicas=1, cases=C)
@@ -1041,7 +1041,7 @@ def bench_router_obs(steps: int):
                               cases, replicas, store_dir, trace_dir)
         bit = all(np.array_equal(a, b)
                   for a, b in zip(ab["results"]["untraced"],
-                                  ab["results"]["traced"]))
+                                  ab["results"]["traced"], strict=True))
         total_steps = sum(c.nt for c in cases)
         merged = ab["merged"] or {}
         emit(f"routerobs/untraced{replicas}", n * n * C,
@@ -1112,7 +1112,7 @@ def bench_fleet_tcp(steps: int):
         shutil.rmtree(store_dir, ignore_errors=True)
     bit = all(np.array_equal(a, b)
               for a, b in zip(ab["results"]["pipe"],
-                              ab["results"]["tcp"]))
+                              ab["results"]["tcp"], strict=True))
     total_steps = sum(c.nt for c in cases)
     emit(f"fleettcp/pipe{replicas}", n * n * C, total_steps // C,
          ab["walls"]["pipe"], grid=n, eps=8, replicas=replicas, cases=C,
@@ -1195,6 +1195,52 @@ def bench_fleet_tta(steps: int):
          sharded_comm=info["comm"], sharded_mesh=info["mesh"])
 
 
+def bench_sessions(steps: int):
+    """Live-session tier (ISSUE 15, serve/sessions.py): N concurrent
+    streaming sessions over a 2-replica fleet while a paced batch load
+    shares the admission controller — the session gate at half the
+    measured step capacity with a one-chunk burst.  Rows carry the
+    stream throughput (frames/s at the chunk cadence), the budget-held
+    verdict (batch shed nothing, p99 inside the bound, sessions
+    visibly deferred), and the kill+checkpoint-resume bit-identity.
+    Off-TPU only, like the router/fleettcp groups."""
+    import shutil
+    import tempfile
+
+    from nonlocalheatequation_tpu.serve.sessions import (
+        session_resume_ab,
+        session_stream_bench,
+    )
+
+    if on_tpu():
+        log("  sessions: skipped on TPU (replica fleets assume one "
+            "accelerator per worker; run with BENCH_PLATFORM=cpu)")
+        return
+    n = cfg("BT_SESSION_GRID", 256, 32)
+    nsess = int(os.environ.get("BT_SESSIONS", 4))
+    chunk = max(1, steps // 4)
+    chunks = int(os.environ.get("BT_SESSION_CHUNKS", 4))
+    ek = {"method": "sat", "batch_sizes": (1,)}
+    sb = session_stream_bench(ek, sessions=nsess, grid=n,
+                              chunk_steps=chunk, chunks=chunks,
+                              batch_cases=8)
+    ckpt = tempfile.mkdtemp(prefix="nlheat-bt-session-")
+    try:
+        ra = session_resume_ab(ek, grid=n, chunk_steps=chunk,
+                               chunks=chunks, ckpt_dir=ckpt)
+    finally:
+        shutil.rmtree(ckpt, ignore_errors=True)
+    emit(f"sessions/stream{nsess}", n * n * nsess,
+         chunks * chunk, sb["wall_s"], grid=n, sessions=nsess,
+         frames=sb["frames"], frames_per_s=sb["frames_per_s"],
+         deferrals=sb["deferrals"],
+         session_rate_steps_s=sb["session_rate_steps_s"],
+         batch_p99_ms=sb["batch"]["p99_ms"], bound_ms=sb["bound_ms"],
+         batch_shed=sb["batch"]["shed"], budget_held=sb["budget_held"],
+         resume_bit_identical=ra["bit_identical"],
+         resumed_from=ra["resumed_from"])
+
+
 def bench_multichip(steps: int):
     """Fused-vs-collective halo A/B (round 9, ops/pallas_halo.py): the
     distributed 2D solver over ONE shared device mesh, collective halos
@@ -1257,6 +1303,7 @@ BENCHES = {
     "routerobs": bench_router_obs,
     "fleettcp": bench_fleet_tcp,
     "ttafleet": bench_fleet_tta,
+    "sessions": bench_sessions,
 }
 
 

@@ -100,7 +100,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 STEPS="bench4096 resident512 carried4096 superstep2 \
 bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 servefault8x1024 \
 obs8x1024 multichip1024 fft4096 tta4096 warmboot1024 router8x1024 \
-routerobs8x1024 fleettcp8x1024 ttafleet8x512 \
+routerobs8x1024 fleettcp8x1024 ttafleet8x512 session8x256 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -324,6 +324,22 @@ run_step_cmd() {  # the queue's one name->command map
         BENCH_PLATFORM=cpu \
         BENCH_GRID="${OPP_GRID_TTAFLEET:-512}" \
         BENCH_LADDER="${OPP_GRID_TTAFLEET:-512}" BENCH_ACCURACY=0 ;;
+    session8x256)
+      # live-session tier (ISSUE 15, serve/sessions.py
+      # session_stream_bench + session_resume_ab): 8 concurrent
+      # streaming sessions over a 2-replica fleet while a paced batch
+      # load shares the admission controller — the session gate at
+      # half the measured step capacity with a one-chunk burst — plus
+      # the kill+checkpoint-resume bit-identity A/B.  A HOST
+      # measurement like router8x1024 (same BENCH_PLATFORM=cpu
+      # rationale; step() exempts the backend grep).  Gate
+      # (step_variant_ok): variant sessionN, budget_held (batch shed
+      # nothing, p99 inside the admission bound, sessions visibly
+      # deferred), resume_bit_identical, frames_per_s > 0.
+      bench_nofb BENCH_SESSION="${OPP_SESSIONS:-8}" \
+        BENCH_PLATFORM=cpu \
+        BENCH_GRID="${OPP_GRID_SESSION:-256}" \
+        BENCH_LADDER="${OPP_GRID_SESSION:-256}" BENCH_ACCURACY=0 ;;
     superstep2-tm128)
       bench_nofb BENCH_SUPERSTEP=2 NLHEAT_TM=128 BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
@@ -637,6 +653,26 @@ PYEOF
       grep -q '"variant": "superstep2"' "$2" && grep -q '"tm": 128' "$2" ;;
     superstep3-tm96)
       grep -q '"variant": "superstep3"' "$2" && grep -q '"tm": 96' "$2" ;;
+    session8x256) python - "$2" <<'PYEOF'
+import json, sys
+ok = False
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    if not str(r.get("variant") or "").startswith("session"):
+        continue
+    if r.get("budget_held") is True \
+            and r.get("resume_bit_identical") is True \
+            and (r.get("frames_per_s") or 0) > 0:
+        ok = True
+sys.exit(0 if ok else 1)
+PYEOF
+      ;;
     tm160 | tm192 | tm224 | tm256) grep -q "\"tm\": ${1#tm}" "$2" ;;
     *) return 0 ;;
   esac
@@ -656,7 +692,7 @@ step() {  # <name>: run one queue step unless already done.
   log "step $name: start"
   local run rc backend_check=step_backend_ok
   case $name in
-    router8x1024 | routerobs8x1024 | fleettcp8x1024 | ttafleet8x512)
+    router8x1024 | routerobs8x1024 | fleettcp8x1024 | ttafleet8x512 | session8x256)
       # deliberately host measurements (see run_step_cmd): the fleet
       # proxies pin BENCH_PLATFORM=cpu because N replica processes
       # cannot share the single tunneled chip — their rows are cpu-

@@ -68,7 +68,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "inside the Pallas step kernel, overlapped with "
                         "the interior sweep; needs --method pallas)")
     p.add_argument("--method", default="auto",
-                   choices=("auto", "conv", "shift", "sat", "pallas"))
+                   choices=("auto", "conv", "shift", "sat", "pallas",
+                            "fft"))
     add_stepper_flags(p)
     p.add_argument("--log", action="store_true")
     p.add_argument("--checkpoint", default=None,
@@ -150,13 +151,25 @@ def main(argv=None) -> int:
         return 1
     # the distributed stepper tier (ISSUE 13): rkc's stage loop runs
     # above the halo exchange (parallel/stepper_halo.py) on the SPMD
-    # path; expo and the elastic executor are refused loudly — this CLI
-    # used to silently ignore the stepper axis entirely
-    if args.stepper == "expo":
-        print("--stepper expo integrates the whole-domain spectral "
-              "symbol and cannot serve sharded blocks; run it on the "
-              "serial solve2d CLI (--stepper rkc super-steps the "
-              "distributed path)", file=sys.stderr)
+    # path; the sharded spectral tier (ISSUE 16, --method fft on the
+    # all-to-all pencil transposes) serves euler/rkc/expo there too.
+    # expo without --method fft is refused by validate_stepper_args;
+    # the elastic executor takes neither (stencil Euler only).
+    if args.method == "fft" and use_elastic:
+        print("--method fft runs the SPMD pencil-transpose path; the "
+              "elastic executor (partition maps / --nbalance / "
+              "--test_load_balance) is stencil-only — drop one of "
+              "them", file=sys.stderr)
+        return 1
+    if args.method == "fft" and args.comm == "fused":
+        print("--method fft runs on the collective all-to-all pencil "
+              "transposes; --comm fused is a stencil-halo transport — "
+              "drop one of them", file=sys.stderr)
+        return 1
+    if args.method == "fft" and args.superstep > 1:
+        print("--method fft has no superstep form (the transform is "
+              "global every step); --stepper rkc/expo carry the big-dt "
+              "claim on the spectral tier", file=sys.stderr)
         return 1
     if args.stepper != "euler" and use_elastic:
         print("--stepper rkc runs on the SPMD distributed path; the "

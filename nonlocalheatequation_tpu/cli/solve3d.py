@@ -101,20 +101,20 @@ def main(argv=None) -> int:
         print("--checkpoint/--resume cannot be combined with --test_batch",
               file=sys.stderr)
         return 1
-    if args.method == "fft" and args.distributed:
-        # honesty rule: the spectral embedding is exact only for the
-        # whole-domain zero collar; a sharded block's halo carries
-        # neighbor data (ops/spectral.py docstring)
-        print("--method fft serves whole-domain solves only; "
-              "--distributed needs pallas/sat/shift", file=sys.stderr)
+    # --method fft with --distributed runs the sharded spectral tier
+    # (ISSUE 16, ops/spectral_sharded.py: the global zero-collar box
+    # computed by pencil transposes) — including --stepper expo, whose
+    # whole-domain embedding argument that tier preserves; the non-fft
+    # expo combination is refused by validate_stepper_args below.
+    if args.method == "fft" and args.distributed and args.comm == "fused":
+        print("--method fft runs on the collective all-to-all pencil "
+              "transposes; --comm fused is a stencil-halo transport — "
+              "drop one of them", file=sys.stderr)
         return 1
-    if args.stepper == "expo" and args.distributed:
-        # rkc now super-steps the distributed scan (ISSUE 13,
-        # parallel/stepper_halo.py); expo stays whole-domain-only
-        print("--stepper expo integrates the whole-domain spectral "
-              "symbol and cannot serve sharded blocks; drop "
-              "--distributed (--stepper rkc super-steps the "
-              "distributed path)", file=sys.stderr)
+    if args.method == "fft" and args.distributed and args.superstep > 1:
+        print("--method fft has no superstep form (the transform is "
+              "global every step); --stepper rkc/expo carry the big-dt "
+              "claim on the spectral tier", file=sys.stderr)
         return 1
     err0 = validate_stepper_args(args)
     if err0:

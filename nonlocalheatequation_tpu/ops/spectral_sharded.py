@@ -1,0 +1,337 @@
+"""Sharded spectral transforms — the distributed twin of ops/spectral.py.
+
+The whole-domain spectral tier (PR 7) is exact by the zero-collar
+embedding argument (ops/spectral.py module docstring); its honesty
+boundary refused every *halo-padded* entry point because a block's halo
+carries neighbor data.  This module serves the sharded case class
+WITHOUT crossing that boundary: the global 5-smooth zero-padded box is
+still the transform domain — it is merely *computed distributed*, by a
+per-axis pencil decomposition with ``lax.all_to_all`` transposes over
+the gang's existing mesh axes (parallel/mesh_axes.py logical axes "x",
+"y"[, "z"]).  Every wrapped read of the circulant multiply therefore
+still lands in domain or zero collar, exactly as in the serial path; no
+halo is ever wrapped.
+
+Layout (2D, mesh (mx, my), block (bx, by), box (BX, BY),
+BYr = BY//2 + 1, BYrp = BYr rounded up to a multiple of mx*my):
+
+forward   (bx, by)                 real block, owner (i, j)
+  a2a y   (bx/my, NY)              row pencils (split ax0, concat ax1)
+  rfft    (bx/my, BYr)             last-axis real FFT, n=BY (implicit
+                                   zero pad NY->BY == the y collar)
+  pad     (bx/my, BYrp)            zero frequency columns to divisibility
+  a2a y   (bx, BYrp/my)            freq chunk j of the x-block rows
+  a2a x   (NX, BYrp/(mx*my))       column pencils, freq chunk j*mx + i
+  fft     (BX, BYrp/(mx*my))       axis-0 complex FFT, n=BX (x collar)
+
+so the global frequency array is laid out ``P(None, ("y", "x"))`` —
+axis 0 replicated-size BX per shard's pencil, axis 1 sharded y-major
+(chunk index j*mx + i).  The inverse runs the exact mirror (ifft,
+slice [:NX], two inverse transposes, slice [:BYr], irfft n=BY, slice
+[:NY], final transpose back to (bx, by)).  3D adds one more transpose
+pair around the middle axis; the middle-axis FFT output (length BY) is
+zero-padded to the next multiple of my *after* transforming — carrying
+zero spectrum columns through the later stages costs nothing and
+removes every box-size divisibility constraint (fft of zeros is zeros,
+and the inverse slices them off before the middle-axis ifft).
+
+Divisibility: beyond the solver's own block uniformity (mx | NX,
+my | NY[, mz | NZ]) the pencil split needs only ``NX % (mx*my) == 0``
+(2D) / ``NX % (mx*mz) == 0`` (3D) — the first transpose splits the
+x-block rows across the last mesh axis.  ``supports_sharded_fft`` is
+the capability gate the router publishes to the picker (serve/router
+``sharded_fft_capability``); ``require_sharded_fft`` is the loud
+construction-time refusal.  ``NLHEAT_FFT_SHARDED=0`` is the
+kill-switch: the gate reports unsupported everywhere and every sharded
+spectral pick falls back to the stencil tier.
+
+Numerics: per-axis FFTs + transposes reassociate sums differently from
+the one-shot ``rfftn``, so results hold the <= 1e-12 oracle contract
+against ops/spectral.py (not bitwise) — the same relation fft already
+has to shift/conv.  Runs are bitwise DETERMINISTIC run-to-run: the
+schedule is static and all_to_all concatenation order is the fixed
+mesh order (tests/test_spectral_sharded.py pins both).
+
+Reference parity: the transform serves the operator of
+src/2d_nonlocal_serial.cpp:198-221 (volumetric u = 0 collar) on the
+distributed solver's grid (src/2d_nonlocal_distributed.cpp:360-1325);
+the symbol baking discipline is ops/spectral.py's (host float64, physics
+scalars outside the symbol).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from nonlocalheatequation_tpu.ops.spectral import fft_box, neighbor_symbol
+from nonlocalheatequation_tpu.utils.compat import irfft_last, rfft_last
+
+
+def _round_up(n: int, mult: int) -> int:
+    """Smallest multiple of ``mult`` >= ``n``."""
+    return -(-int(n) // int(mult)) * int(mult)
+
+
+def sharded_fft_enabled() -> bool:
+    """The kill-switch: ``NLHEAT_FFT_SHARDED=0`` disables the sharded
+    spectral tier everywhere (capability gate reports unsupported, the
+    solvers refuse construction) — one knob to fall back to the stencil
+    gang fleet-wide."""
+    return os.environ.get("NLHEAT_FFT_SHARDED", "1") != "0"
+
+
+def supports_sharded_fft(shape, eps: int, mesh_shape) -> bool:
+    """Whether the pencil decomposition serves ``shape`` on a mesh of
+    ``mesh_shape`` (pure host arithmetic — no backend touch, safe for
+    the router's capability probe under wedge discipline)."""
+    if not sharded_fft_enabled():
+        return False
+    shape = tuple(int(n) for n in shape)
+    mesh_shape = tuple(int(m) for m in mesh_shape)
+    if len(shape) != len(mesh_shape) or len(shape) not in (2, 3):
+        return False
+    if any(n % m for n, m in zip(shape, mesh_shape)):
+        return False  # the solver's own uniform-block requirement
+    # the first transpose splits the x-block rows across the LAST axis
+    return shape[0] % (mesh_shape[0] * mesh_shape[-1]) == 0
+
+
+def require_sharded_fft(shape, eps: int, mesh_shape) -> None:
+    """Loud construction-time refusal (never a silent downgrade) when
+    the pencil decomposition cannot serve this (grid, mesh) pair."""
+    if supports_sharded_fft(shape, eps, mesh_shape):
+        return
+    if not sharded_fft_enabled():
+        raise ValueError(
+            "method='fft' on the distributed path is disabled by "
+            "NLHEAT_FFT_SHARDED=0 (kill-switch); unset it or run the "
+            "stencil methods")
+    raise ValueError(
+        f"sharded fft cannot serve grid {tuple(shape)} on mesh "
+        f"{tuple(mesh_shape)}: the pencil transposes need every axis "
+        "to divide its mesh extent and the leading extent to divide "
+        "mesh[0]*mesh[-1] (ops/spectral_sharded.py layout); pick a "
+        "compatible mesh or run the stencil methods")
+
+
+class ShardedSpectralPlan:
+    """Baked transpose/transform schedule for one (shape, eps, mesh).
+
+    ``fwd``/``inv`` are per-shard functions to call INSIDE shard_map
+    over the plan's mesh axes; ``freq_spec`` is the PartitionSpec of
+    global frequency-domain arrays (symbols, expo tables), and
+    ``pad_freq`` pads a host rfftn-layout array to that global shape.
+    """
+
+    def __init__(self, shape, eps: int, mesh_shape, axis_names=None):
+        shape = tuple(int(n) for n in shape)
+        mesh_shape = tuple(int(m) for m in mesh_shape)
+        require_sharded_fft(shape, eps, mesh_shape)
+        from jax.sharding import PartitionSpec as P
+
+        self.shape = shape
+        self.eps = int(eps)
+        self.mesh_shape = mesh_shape
+        self.box = fft_box(shape, eps)
+        nd = len(shape)
+        self.axis_names = tuple(
+            axis_names if axis_names is not None
+            else ("x", "y", "z")[:nd])
+        ndev = 1
+        for m in mesh_shape:
+            ndev *= m
+        last_r = self.box[-1] // 2 + 1  # rfft bins of the last box axis
+        if nd == 2:
+            # frequency axis 1 padded so mx*my chunks tile it exactly
+            self.freq_global_shape = (
+                self.box[0], _round_up(last_r, ndev))
+            self.freq_spec = P(None, (self.axis_names[1],
+                                      self.axis_names[0]))
+        else:
+            # middle axis padded to a multiple of my (the transformed-
+            # axis zero-pad trick), last to a multiple of mx*my*mz
+            self.freq_global_shape = (
+                self.box[0],
+                _round_up(self.box[1], mesh_shape[1]),
+                _round_up(last_r, ndev))
+            self.freq_spec = P(None, self.axis_names[1],
+                               (self.axis_names[2], self.axis_names[0]))
+        self._last_r = last_r
+
+    # -- host-side helpers --------------------------------------------------
+
+    def pad_freq(self, arr: np.ndarray) -> np.ndarray:
+        """Zero-pad a host array in rfftn frequency layout (box[:-1] +
+        (box[-1]//2+1,)) to ``freq_global_shape`` — the padded columns
+        multiply the zero spectrum the forward path carries there."""
+        arr = np.asarray(arr)
+        want = tuple(self.box[:-1]) + (self._last_r,)
+        if arr.shape != want:
+            raise ValueError(
+                f"frequency array shape {arr.shape} != rfftn layout "
+                f"{want} of box {self.box}")
+        pad = [(0, g - s) for s, g in
+               zip(arr.shape, self.freq_global_shape, strict=True)]
+        return np.pad(arr, pad)
+
+    def neighbor_symbol_padded(self, weights) -> np.ndarray:
+        """The baked neighbor symbol (ops/spectral.neighbor_symbol —
+        host float64, cached) in the plan's padded frequency layout."""
+        return self.pad_freq(neighbor_symbol(weights, self.box))
+
+    def a2a_schedule(self):
+        """The forward transposes as (axis_extent, elems, complex)
+        triples — static host arithmetic for the observability layer
+        (the inverse path is the exact mirror: same traffic)."""
+        if len(self.shape) == 2:
+            (mx, my), (bx, by) = self.mesh_shape, self._block()
+            BYrp = self.freq_global_shape[1]
+            return [
+                (my, bx * by, False),
+                (my, (bx // my) * BYrp, True),
+                (mx, bx * (BYrp // my), True),
+            ]
+        (mx, my, mz), (bx, by, bz) = self.mesh_shape, self._block()
+        BX, BYp, BZp = self.freq_global_shape
+        return [
+            (mz, bx * by * bz, False),
+            (mz, (bx // mz) * by * BZp, True),
+            (my, bx * by * (BZp // mz), True),
+            (my, bx * BYp * (BZp // (mz * my)), True),
+            (mx, self.shape[0] * (BYp // my) * (BZp // (mz * mx)), True),
+        ]
+
+    def _block(self):
+        return tuple(n // m for n, m in
+                     zip(self.shape, self.mesh_shape, strict=True))
+
+    # -- the per-shard transforms (call inside shard_map) -------------------
+
+    def fwd(self, u_blk: jnp.ndarray) -> jnp.ndarray:
+        """Real block -> this shard's pencil of the global box rfft
+        (module-docstring layout).  2D and 3D share the outer stages;
+        3D inserts the middle-axis pair."""
+        if len(self.shape) == 2:
+            return self._fwd2(u_blk)
+        return self._fwd3(u_blk)
+
+    def inv(self, h_blk: jnp.ndarray) -> jnp.ndarray:
+        """Frequency pencil -> the shard's (block-shaped) slice of the
+        inverse transform's DOMAIN interior (collar discarded — the
+        inverse of fwd up to per-axis FFT roundoff)."""
+        if len(self.shape) == 2:
+            return self._inv2(h_blk)
+        return self._inv3(h_blk)
+
+    def _fwd2(self, u):
+        ax, ay = self.axis_names
+        mx, my = self.mesh_shape
+        BX = self.box[0]
+        BYrp = self.freq_global_shape[1]
+        if my > 1:  # (bx, by) -> (bx/my, NY) row pencils
+            u = lax.all_to_all(u, ay, split_axis=0, concat_axis=1,
+                               tiled=True)
+        h = rfft_last(u, self.box[1])  # n=BY: the y zero collar
+        h = jnp.pad(h, ((0, 0), (0, BYrp - h.shape[1])))
+        if my > 1:  # back to x-block rows, freq chunk j
+            h = lax.all_to_all(h, ay, split_axis=1, concat_axis=0,
+                               tiled=True)
+        if mx > 1:  # column pencils: all x-block rows, freq chunk j*mx+i
+            h = lax.all_to_all(h, ax, split_axis=1, concat_axis=0,
+                               tiled=True)
+        # n=BX pads NX -> BX with zeros: the x collar
+        return jnp.fft.fft(h, n=BX, axis=0)
+
+    def _inv2(self, h):
+        ax, ay = self.axis_names
+        mx, my = self.mesh_shape
+        NX, NY = self.shape
+        u = jnp.fft.ifft(h, axis=0)[:NX]
+        if mx > 1:
+            u = lax.all_to_all(u, ax, split_axis=0, concat_axis=1,
+                               tiled=True)
+        if my > 1:
+            u = lax.all_to_all(u, ay, split_axis=0, concat_axis=1,
+                               tiled=True)
+        u = irfft_last(u[..., : self._last_r], self.box[1])[..., :NY]
+        if my > 1:
+            u = lax.all_to_all(u, ay, split_axis=1, concat_axis=0,
+                               tiled=True)
+        return u
+
+    def _fwd3(self, u):
+        ax, ay, az = self.axis_names
+        mx, my, mz = self.mesh_shape
+        BX, BYp, BZp = self.freq_global_shape
+        BY = self.box[1]
+        if mz > 1:  # (bx, by, bz) -> (bx/mz, by, NZ) z pencils
+            u = lax.all_to_all(u, az, split_axis=0, concat_axis=2,
+                               tiled=True)
+        h = rfft_last(u, self.box[2])  # n=BZ: the z zero collar
+        h = jnp.pad(h, ((0, 0), (0, 0), (0, BZp - h.shape[2])))
+        if mz > 1:  # back to x-block rows, z-freq chunk l
+            h = lax.all_to_all(h, az, split_axis=2, concat_axis=0,
+                               tiled=True)
+        if my > 1:  # y pencils, z-freq chunk l*my + j
+            h = lax.all_to_all(h, ay, split_axis=2, concat_axis=1,
+                               tiled=True)
+        h = jnp.fft.fft(h, n=BY, axis=1)  # n=BY: the y collar
+        # transformed-axis pad BY -> BYp: zero spectrum columns ride
+        # through the remaining stages (fft of zeros is zeros) so the
+        # box never needs my-divisibility; inverse slices them off
+        h = jnp.pad(h, ((0, 0), (0, BYp - BY), (0, 0)))
+        if my > 1:  # y chunk j back, z-freq chunk l
+            h = lax.all_to_all(h, ay, split_axis=1, concat_axis=2,
+                               tiled=True)
+        if mx > 1:  # x pencils: all rows, z-freq chunk l*mx + i
+            h = lax.all_to_all(h, ax, split_axis=2, concat_axis=0,
+                               tiled=True)
+        return jnp.fft.fft(h, n=BX, axis=0)  # n=BX: the x collar
+
+    def _inv3(self, h):
+        ax, ay, az = self.axis_names
+        mx, my, mz = self.mesh_shape
+        NX, NY, NZ = self.shape
+        BY = self.box[1]
+        u = jnp.fft.ifft(h, axis=0)[:NX]
+        if mx > 1:
+            u = lax.all_to_all(u, ax, split_axis=0, concat_axis=2,
+                               tiled=True)
+        if my > 1:
+            u = lax.all_to_all(u, ay, split_axis=2, concat_axis=1,
+                               tiled=True)
+        u = jnp.fft.ifft(u[:, :BY, :], axis=1)[:, :NY, :]
+        if my > 1:
+            u = lax.all_to_all(u, ay, split_axis=1, concat_axis=2,
+                               tiled=True)
+        if mz > 1:
+            u = lax.all_to_all(u, az, split_axis=0, concat_axis=2,
+                               tiled=True)
+        u = irfft_last(u[..., : self._last_r], self.box[2])[..., :NZ]
+        if mz > 1:
+            u = lax.all_to_all(u, az, split_axis=2, concat_axis=0,
+                               tiled=True)
+        return u
+
+
+#: Plan cache keyed by (shape, eps, mesh_shape, axis_names) — plans are
+#: pure schedules (no device state), shared freely across solvers.
+_plan_cache: dict = {}
+
+
+def get_plan(shape, eps: int, mesh_shape, axis_names=None
+             ) -> ShardedSpectralPlan:
+    """Cached :class:`ShardedSpectralPlan` constructor."""
+    key = (tuple(int(n) for n in shape), int(eps),
+           tuple(int(m) for m in mesh_shape),
+           tuple(axis_names) if axis_names is not None else None)
+    plan = _plan_cache.get(key)
+    if plan is None:
+        plan = ShardedSpectralPlan(shape, eps, mesh_shape, axis_names)
+        _plan_cache[key] = plan
+    return plan

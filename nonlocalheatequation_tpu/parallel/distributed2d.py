@@ -47,10 +47,13 @@ from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
 from nonlocalheatequation_tpu.utils.devices import device_list
 
 
-def choose_mesh_for_grid(NX: int, NY: int, devices=None) -> Mesh:
-    """Largest mesh (mx, my) with mx | NX, my | NY and mx*my <= #devices."""
-    devices = list(devices if devices is not None else device_list())
-    n = len(devices)
+def choose_mesh_shape(NX: int, NY: int, ndevices: int) -> tuple[int, int]:
+    """Largest (mx, my) with mx | NX, my | NY and mx*my <= ndevices —
+    the pure-arithmetic half of :func:`choose_mesh_for_grid`.  Touches
+    no backend (wedge discipline), so the router's sharded-fft
+    capability probe (serve/router.py) can predict the gang's mesh
+    without waking a device client."""
+    n = int(ndevices)
     best = (1, 1)
     for mx in range(1, min(NX, n) + 1):
         if NX % mx:
@@ -58,7 +61,14 @@ def choose_mesh_for_grid(NX: int, NY: int, devices=None) -> Mesh:
         for my in range(1, min(NY, n // mx) + 1):
             if NY % my == 0 and mx * my > best[0] * best[1]:
                 best = (mx, my)
-    return make_mesh(best[0], best[1], devices)
+    return best
+
+
+def choose_mesh_for_grid(NX: int, NY: int, devices=None) -> Mesh:
+    """Largest mesh (mx, my) with mx | NX, my | NY and mx*my <= #devices."""
+    devices = list(devices if devices is not None else device_list())
+    mx, my = choose_mesh_shape(NX, NY, len(devices))
+    return make_mesh(mx, my, devices)
 
 
 class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
@@ -139,11 +149,13 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         # the halo exchange (parallel/stepper_halo.py) — every stage is
         # one eps-halo apply, so the fused/collective transports serve
         # it unchanged; with superstep K > 1 the stages batch into
-        # communication-avoiding groups of K.  expo is refused: its
-        # spectral embedding is whole-domain (a sharded block's halo
-        # carries neighbor data, not the zero collar — ops/spectral.py
-        # honesty boundary); the NumPy oracle has no distributed twin,
-        # so there is no oracle-backend rule to repeat here.
+        # communication-avoiding groups of K.  expo serves sharded
+        # blocks only through method='fft' (ISSUE 16): the pencil-
+        # decomposed global transform (ops/spectral_sharded.py) keeps
+        # the whole-domain zero-collar argument intact, where a stencil
+        # block's halo carries neighbor data (ops/spectral.py honesty
+        # boundary); the NumPy oracle has no distributed twin, so there
+        # is no oracle-backend rule to repeat here.
         self.stepper, self.stages = _validate_dist_stepper(
             self.op, stepper, stages)
         self.mesh = mesh if mesh is not None else choose_mesh_for_grid(self.NX, self.NY)
@@ -153,6 +165,29 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
             raise ValueError(
                 f"comm must be 'collective' or 'fused', got {comm!r}")
         self.comm = comm
+        if self.op.method == "fft":
+            # the sharded spectral tier (ops/spectral_sharded.py):
+            # honesty gates up front, never a silent downgrade
+            if comm == "fused":
+                raise ValueError(
+                    "method='fft' runs on the collective all-to-all "
+                    "pencil transposes (ops/spectral_sharded.py); "
+                    "comm='fused' is a stencil-halo transport — run "
+                    "comm='collective'")
+            if self.ksteps > 1:
+                raise ValueError(
+                    "method='fft' has no superstep form (the transform "
+                    "is global every step, there is no halo to "
+                    "amortize); run superstep=1 — rkc stages or "
+                    "stepper='expo' carry the big-dt claim on the "
+                    "spectral tier")
+            from nonlocalheatequation_tpu.ops.spectral_sharded import (
+                require_sharded_fft,
+            )
+
+            require_sharded_fft(
+                (self.NX, self.NY), self.eps,
+                tuple(self.mesh.shape[n] for n in ("x", "y")))
         if comm == "fused":
             # honesty gate up front: every fused-incapable config is
             # refused at construction, never silently downgraded
@@ -173,6 +208,7 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         # ARGUMENTS (see make_runner).
         self._step_cache: dict = {}
         self._runner_cache: dict = {}
+        self._spectral_tabs = None  # device tables, baked once per run
         self.t0 = 0
         self.test = False
         self.u0 = np.zeros((self.NX, self.NY), dtype=np.float64)
@@ -230,6 +266,12 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         # them inside the scan would waste collective rounds), including
         # the shallower remainder program and K == 1 segments
         src_halo = (self.ksteps - 1) * eps
+
+        if op.method == "fft":
+            # the sharded spectral tier: no halo — the global box
+            # transform computed by pencil transposes, tables entering
+            # as sharded ARGUMENTS (parallel/spectral_halo.py)
+            return self._build_spectral_step(spec)
 
         apply_blk = None
         if self.ksteps == 1:
@@ -345,6 +387,53 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         return shard_map(local_step, mesh=mesh, in_specs=in_specs,
                          out_specs=spec, check_vma=vma_ok)
 
+    # -- the sharded spectral tier (ISSUE 16) -------------------------------
+    def _spectral_plan(self):
+        """The cached pencil-FFT schedule for this (grid, mesh) pair."""
+        from nonlocalheatequation_tpu.ops.spectral_sharded import get_plan
+
+        return get_plan(
+            (self.NX, self.NY), self.eps,
+            tuple(self.mesh.shape[n] for n in ("x", "y")), ("x", "y"))
+
+    def _build_spectral_step(self, spec):
+        """shard_map wrapper of the spectral step body
+        (parallel/spectral_halo.py): frequency tables lead the source/
+        time args, sharded by the plan's frequency spec."""
+        from nonlocalheatequation_tpu.parallel.spectral_halo import (
+            build_spectral_local_step,
+            ntables,
+        )
+
+        plan = self._spectral_plan()
+        local_step = build_spectral_local_step(
+            self.op, plan, self.stepper, self.stages, self.test)
+        tab_specs = (plan.freq_spec,) * ntables(self.stepper, self.stages)
+        in_specs = ((spec, *tab_specs, spec, spec, P()) if self.test
+                    else (spec, *tab_specs, P()))
+        return shard_map(local_step, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=spec)
+
+    def _spectral_args(self) -> tuple:
+        """The baked frequency tables as SHARDED device arrays (jit
+        arguments — the multihost discipline of _device_state: a
+        closure constant would materialize the global array in the
+        trace).  Baked once per solver instance."""
+        if self._spectral_tabs is None:
+            from jax.sharding import NamedSharding
+
+            from nonlocalheatequation_tpu.parallel.spectral_halo import (
+                spectral_tables,
+            )
+
+            plan = self._spectral_plan()
+            tabs = spectral_tables(self.op, plan, self._dtype(),
+                                   self.stepper, self.stages)
+            sharding = NamedSharding(self.mesh, plan.freq_spec)
+            self._spectral_tabs = tuple(
+                put_global(t, sharding) for t in tabs)
+        return self._spectral_tabs
+
     def _prep_sources(self, g, lg):
         """Pad the (sharded) source blocks with the (ksteps-1)*eps ring ONCE
         per run.  The shard_map output concatenates each shard's padded
@@ -393,6 +482,16 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
             halo_stats,
         )
 
+        if self.op.method == "fft":
+            # spectral tier: the traffic is the plan's all-to-all
+            # transpose schedule, not eps bands
+            from nonlocalheatequation_tpu.parallel.spectral_halo import (
+                spectral_halo_obs,
+            )
+
+            return spectral_halo_obs(
+                self._spectral_plan(), self.stepper, self.stages, steps,
+                jnp.dtype(self._dtype()).itemsize, self.comm)
         mesh_shape = tuple(self.mesh.shape[n] for n in ("x", "y"))
         block = self._block_shape()
         itemsize = jnp.dtype(self._dtype()).itemsize
@@ -435,6 +534,10 @@ class Solver2DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         u, source_args = self._device_state()
         if source_args and self.ksteps > 1:
             source_args = self._prep_sources(*source_args)
+        if self.op.method == "fft":
+            # frequency tables lead the runner's srcs tuple — the step
+            # body's (u, *tables, [g, lg,] t) signature
+            source_args = self._spectral_args() + source_args
 
         checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
 

@@ -33,10 +33,13 @@ from nonlocalheatequation_tpu.utils.checkpoint import CheckpointMixin
 from nonlocalheatequation_tpu.utils.devices import device_list
 
 
-def choose_mesh_for_grid_3d(NX: int, NY: int, NZ: int, devices=None) -> Mesh:
-    """Largest mesh (mx, my, mz) whose shape divides the grid, product <= #devices."""
-    devices = list(devices if devices is not None else device_list())
-    n = len(devices)
+def choose_mesh_shape_3d(NX: int, NY: int, NZ: int,
+                         ndevices: int) -> tuple[int, int, int]:
+    """Largest (mx, my, mz) whose shape divides the grid, product <=
+    ndevices — the pure-arithmetic half of
+    :func:`choose_mesh_for_grid_3d` (no backend touch: wedge
+    discipline, same as the 2D twin)."""
+    n = int(ndevices)
     best = (1, 1, 1)
 
     def better(c, b):
@@ -54,6 +57,13 @@ def choose_mesh_for_grid_3d(NX: int, NY: int, NZ: int, devices=None) -> Mesh:
             for mz in range(1, min(NZ, n // (mx * my)) + 1):
                 if NZ % mz == 0 and better((mx, my, mz), best):
                     best = (mx, my, mz)
+    return best
+
+
+def choose_mesh_for_grid_3d(NX: int, NY: int, NZ: int, devices=None) -> Mesh:
+    """Largest mesh (mx, my, mz) whose shape divides the grid, product <= #devices."""
+    devices = list(devices if devices is not None else device_list())
+    best = choose_mesh_shape_3d(NX, NY, NZ, len(devices))
     return make_mesh_3d(*best, devices=devices)
 
 
@@ -95,7 +105,8 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
                                precision=precision)
         # stepper tier (ISSUE 13): see Solver2DDistributed — rkc's stage
         # loop above the exchange, ksteps > 1 = stage batches; expo
-        # refused (whole-domain spectral embedding)
+        # serves sharded blocks only through method='fft' (ISSUE 16,
+        # the pencil-decomposed sharded transform)
         self.stepper, self.stages = _validate_dist_stepper(
             self.op, stepper, stages)
         self.mesh = (
@@ -108,6 +119,28 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
             raise ValueError(
                 f"comm must be 'collective' or 'fused', got {comm!r}")
         self.comm = comm
+        if self.op.method == "fft":
+            # sharded spectral tier gates — see Solver2DDistributed
+            if comm == "fused":
+                raise ValueError(
+                    "method='fft' runs on the collective all-to-all "
+                    "pencil transposes (ops/spectral_sharded.py); "
+                    "comm='fused' is a stencil-halo transport — run "
+                    "comm='collective'")
+            if self.ksteps > 1:
+                raise ValueError(
+                    "method='fft' has no superstep form (the transform "
+                    "is global every step, there is no halo to "
+                    "amortize); run superstep=1 — rkc stages or "
+                    "stepper='expo' carry the big-dt claim on the "
+                    "spectral tier")
+            from nonlocalheatequation_tpu.ops.spectral_sharded import (
+                require_sharded_fft,
+            )
+
+            require_sharded_fft(
+                (self.NX, self.NY, self.NZ), self.eps,
+                tuple(self.mesh.shape[n] for n in ("x", "y", "z")))
         if comm == "fused":
             from nonlocalheatequation_tpu.ops.pallas_halo import (
                 require_fused,
@@ -117,6 +150,7 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
                           ksteps=self.ksteps)
         self.checkpoint_path = checkpoint_path
         self.ncheckpoint = int(ncheckpoint)
+        self._spectral_tabs = None  # device tables, baked once per run
         self.t0 = 0
         self.test = False
         self.u0 = np.zeros((self.NX, self.NY, self.NZ), dtype=np.float64)
@@ -156,6 +190,10 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         K = max(1, int(ksteps))
         NX, NY, NZ = self.NX, self.NY, self.NZ
         src_halo = (self.ksteps - 1) * eps  # see the 2D solver
+
+        if op.method == "fft":
+            # sharded spectral tier — see Solver2DDistributed
+            return self._build_spectral_step(spec)
 
         apply_blk = None
         if self.ksteps == 1:
@@ -271,6 +309,51 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         lg = put_global(np.asarray(lg, npdt), sharding)
         return u, (g, lg)
 
+    # -- the sharded spectral tier (ISSUE 16) -------------------------------
+    def _spectral_plan(self):
+        """The cached pencil-FFT schedule for this (grid, mesh) pair."""
+        from nonlocalheatequation_tpu.ops.spectral_sharded import get_plan
+
+        return get_plan(
+            (self.NX, self.NY, self.NZ), self.eps,
+            tuple(self.mesh.shape[n] for n in ("x", "y", "z")),
+            ("x", "y", "z"))
+
+    def _build_spectral_step(self, spec):
+        """shard_map wrapper of the spectral step body — see
+        Solver2DDistributed._build_spectral_step."""
+        from nonlocalheatequation_tpu.parallel.spectral_halo import (
+            build_spectral_local_step,
+            ntables,
+        )
+
+        plan = self._spectral_plan()
+        local_step = build_spectral_local_step(
+            self.op, plan, self.stepper, self.stages, self.test)
+        tab_specs = (plan.freq_spec,) * ntables(self.stepper, self.stages)
+        in_specs = ((spec, *tab_specs, spec, spec, P()) if self.test
+                    else (spec, *tab_specs, P()))
+        return shard_map(local_step, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=spec)
+
+    def _spectral_args(self) -> tuple:
+        """Baked frequency tables as sharded device arrays — see
+        Solver2DDistributed._spectral_args."""
+        if self._spectral_tabs is None:
+            from jax.sharding import NamedSharding
+
+            from nonlocalheatequation_tpu.parallel.spectral_halo import (
+                spectral_tables,
+            )
+
+            plan = self._spectral_plan()
+            tabs = spectral_tables(self.op, plan, self._dtype(),
+                                   self.stepper, self.stages)
+            sharding = NamedSharding(self.mesh, plan.freq_spec)
+            self._spectral_tabs = tuple(
+                put_global(t, sharding) for t in tabs)
+        return self._spectral_tabs
+
     def _prep_sources(self, g, lg):
         """Pad the source blocks with the (ksteps-1)*eps ring once per run
         (see Solver2DDistributed._prep_sources)."""
@@ -296,6 +379,15 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
             halo_stats,
         )
 
+        if self.op.method == "fft":
+            # spectral tier: all-to-all transpose traffic, not eps bands
+            from nonlocalheatequation_tpu.parallel.spectral_halo import (
+                spectral_halo_obs,
+            )
+
+            return spectral_halo_obs(
+                self._spectral_plan(), self.stepper, self.stages, steps,
+                jnp.dtype(self._dtype()).itemsize, self.comm)
         mesh_shape = tuple(self.mesh.shape[n] for n in ("x", "y", "z"))
         block = self._block_shape()
         itemsize = jnp.dtype(self._dtype()).itemsize
@@ -332,6 +424,10 @@ class Solver3DDistributed(CheckpointMixin, ManufacturedMetrics2D):
         u, source_args = self._device_state()
         if source_args and self.ksteps > 1:
             source_args = self._prep_sources(*source_args)
+        if self.op.method == "fft":
+            # frequency tables lead the runner's srcs tuple (the step
+            # body's (u, *tables, [g, lg,] t) signature)
+            source_args = self._spectral_args() + source_args
 
         checkpointing = bool(self.checkpoint_path and self.ncheckpoint)
 

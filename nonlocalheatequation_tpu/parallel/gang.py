@@ -384,8 +384,13 @@ def solve_case_sharded(case, *, ndevices: int | None = None,
     communication-avoiding groups.  The tier keeps the adapter
     contract: the gang worker and the offline oracle call THIS function
     with the same arguments, so sharded rkc results stream back
-    bit-identical to the offline distributed-rkc solve.  ``expo`` is
-    refused by the solver (whole-domain spectral embedding).
+    bit-identical to the offline distributed-rkc solve.
+    ``method='fft'`` (and with it ``stepper='expo'``) runs the sharded
+    spectral tier (ops/spectral_sharded.py, ISSUE 16): the ctor's
+    fft+fused refusal lands in the ValueError fallback below, so a
+    fused-comm gang serves fft picks on the collective all-to-all
+    transposes — recorded honestly in the info dict like every other
+    fallback.
 
     ``solver_cache`` (a plain dict the caller owns) memoizes the
     constructed solver — and through Solver2DDistributed's own

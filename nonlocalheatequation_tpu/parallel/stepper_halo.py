@@ -66,20 +66,24 @@ def validate_dist_stepper(op, stepper: str, stages: int) -> tuple:
     """Stepper validation for the DISTRIBUTED solvers: the single-device
     model checks (models/steppers.validate_stepper — unknown names, rkc
     stage count, the rkc dt-vs-beta(s) stability bound) plus the
-    distributed-tier rule: ``expo`` is refused because its spectral
-    embedding is exact only for the whole-domain zero collar — a sharded
-    block's halo carries neighbor data (ops/spectral.py honesty
-    boundary), and rkc owns the distributed super-stepping claim.
-    Returns the canonical ``(stepper, stages)`` pair."""
+    distributed-tier rule: ``expo`` serves sharded blocks ONLY through
+    the pencil-decomposed spectral tier (``method='fft'``,
+    ops/spectral_sharded.py — the global zero-collar box computed
+    distributed, so the whole-domain embedding argument still holds);
+    on every stencil method a sharded block's halo carries neighbor
+    data, not the zero collar (ops/spectral.py honesty boundary), and
+    rkc owns the super-stepping claim there.  Returns the canonical
+    ``(stepper, stages)`` pair."""
     if stepper not in STEPPERS:
         raise ValueError(
             f"unknown stepper {stepper!r}; one of {STEPPERS}")
-    if stepper == "expo":
+    if stepper == "expo" and getattr(op, "method", None) != "fft":
         raise ValueError(
-            "stepper='expo' integrates the whole-domain spectral symbol "
-            "and cannot serve sharded blocks (their halos carry neighbor "
-            "data, not the zero collar); run expo on the serial solver — "
-            "rkc super-steps the distributed path")
+            "stepper='expo' integrates the whole-domain spectral symbol; "
+            "on the distributed path it requires method='fft' (the "
+            "pencil-decomposed sharded transform, ops/spectral_sharded"
+            ".py) — a stencil block's halo carries neighbor data, not "
+            "the zero collar; rkc super-steps the stencil methods")
     validate_stepper(op, stepper, stages)
     return stepper, int(stages)
 

@@ -441,8 +441,10 @@ class IngressServer:
         picked)`` — picked None for the explicit nt/dt form.  The
         picked form routes accuracy/T_final through the engine picker
         with the fleet's engine base and, for a case bound for the
-        sharded tier, the stencil-only candidate axis (the spectral
-        embedding cannot serve halo-padded blocks)."""
+        sharded tier, the router's sharded-fft capability verdict as
+        the fft candidate axis (ops/spectral_sharded.py — the pencil
+        transform serves compatible (grid, mesh) pairs; incompatible
+        ones pick on the stencil axis)."""
         if "accuracy" not in body and "T_final" not in body:
             return parse_case(body), None
         for bad in ("nt", "dt"):
@@ -476,16 +478,21 @@ class IngressServer:
         accuracy = float(body["accuracy"])
         # T_final/accuracy/deadline_ms positivity: pick_engine's own
         # refusals (ValueError -> the client's 400)
-        # the ROUTER's own predicate (one rule, no drift): a case the
-        # router would route to the gang must pick on the stencil-only
-        # axis; router-shaped stubs without the method are never sharded
+        # the ROUTER's own predicates (one rule, no drift): a case the
+        # router would route to the gang picks on the fft axis only
+        # when the router's sharded-fft capability says the pencil
+        # transform can serve it (ISSUE 16 — no more hardcoded stencil-
+        # only axis); router-shaped stubs without the predicates are
+        # never sharded / never fft-capable
         is_sharded = getattr(self.backend, "is_sharded", None)
         sharded = bool(is_sharded(shape)) if is_sharded else False
+        cap = getattr(self.backend, "sharded_fft_capability", None)
+        allow_fft = (not sharded) or bool(cap and cap(shape, eps))
         ek = getattr(self.backend, "engine_kwargs", None) or {}
         picked = pick_engine(
             shape, eps, k, dh, T_final, accuracy,
             deadline_ms=body.get("deadline_ms"),
-            method=ek.get("method", "auto"), allow_fft=not sharded)
+            method=ek.get("method", "auto"), allow_fft=allow_fft)
         case = parse_case(base | {"nt": picked.steps, "dt": picked.dt})
         return case, picked
 

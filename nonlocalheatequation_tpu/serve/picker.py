@@ -26,10 +26,16 @@ over the request's real contract — an accuracy target and a deadline:
   ``accuracy`` at its stability-capped dt is INFEASIBLE — the picker
   never gambles accuracy for the deadline.  bf16 candidates carry the
   tier's measured error floor (``constants.BF16_L2_BUDGET``) on top.
-  expo is time-exact in the interior but its collar defect has no
-  closed per-request model, so expo candidates are opt-in
-  (``allow_expo`` / ``NLHEAT_PICK_EXPO=1`` — the caller asserts the
-  interior envelope; the stages arg arms the boundary correction).
+  expo is time-exact in the interior; its collar defect now carries a
+  measured per-request model (:func:`modeled_expo_defect`, ISSUE 16 —
+  calibrated amplitude ``min(1, C r^2)`` with ``r`` the substep/Euler-
+  bound ratio, squared over the ``2 d eps / min(shape)`` boundary
+  band; conservative 5-30x at every probe point, docs/round18.md), so
+  corrected expo candidates compete WITHOUT opt-in whenever
+  ``ERR_SAFETY * defect <= accuracy`` at the minimal feasible substep
+  count.  ``allow_expo=True`` / ``NLHEAT_PICK_EXPO=1`` still forces a
+  caller-asserted candidate at ``expo_stages`` (the pre-model opt-in
+  envelope); ``allow_expo=False`` excludes the stepper entirely.
 * **Cost model** — steps x operator applies per step (s for rkc, 1 for
   euler, ~3.5 fft-equivalents per corrected expo substage) x
   per-apply milliseconds.  Rates come from ``rate_fn`` when the caller
@@ -51,7 +57,8 @@ never silently serves an engine that misses the accuracy target.
 
 Env knobs (scrubbed in tests/conftest.py): ``NLHEAT_PICK_STAGES`` — the
 rkc stage ladder (comma list, default ``4,8,16,32``);
-``NLHEAT_PICK_EXPO=1`` — include the expo candidates.
+``NLHEAT_PICK_EXPO=1`` — FORCE the caller-asserted expo candidate at
+``expo_stages`` (the defect-model-gated candidate competes by default).
 """
 
 from __future__ import annotations
@@ -81,6 +88,16 @@ NS_PER_FFT_POINT = 4.0
 #: Operator applies per corrected expo substage (the midpoint Duhamel
 #: correction costs ~3.5 fft round trips per substep; the plain step 1).
 EXPO_CORR_APPLIES = 3.5
+
+#: Collar-defect amplitude model for corrected expo (ISSUE 16):
+#: ``e ~ min(EXPO_DEFECT_CAP, EXPO_DEFECT_COEF * r^2)`` with ``r`` the
+#: substep-to-Euler-bound ratio ``(T_final / S) / stable_dt(euler)``.
+#: Measured across S in {1,2,4,8} and r in [0.25, 45] on 24^2/eps 3 and
+#: 50^2/eps 5 (one-shot solves, the picker's usage): the fitted
+#: coefficient never exceeds 1.05e-3, so 2e-3 is conservative 2x at the
+#: worst probe point and 5-30x in squared err/#points units everywhere.
+EXPO_DEFECT_COEF = 2e-3
+EXPO_DEFECT_CAP = 1.0
 
 #: bf16 operand windows halve the bandwidth of the memory-bound stencil
 #: reads; the analytic model credits the tier conservatively.
@@ -241,6 +258,44 @@ def modeled_error(dim: int, T_final: float, dt: float) -> float:
     return amp * amp * 0.5 ** dim
 
 
+def _boundary_frac(shape, eps: int) -> float:
+    """Fraction of grid points inside the eps-wide collar-coupled band
+    (two faces per axis; the defect lives there, the interior is
+    time-exact)."""
+    return min(1.0, 2.0 * len(shape) * eps / min(int(s) for s in shape))
+
+
+def modeled_expo_defect(shape, eps: int, euler_bound: float,
+                        T_final: float, stages: int) -> float:
+    """The corrected expo collar defect for ONE step to ``T_final``
+    with ``stages = S >= 1`` substeps, in error_l2/#points units:
+    amplitude ``min(cap, C r^2)`` (:data:`EXPO_DEFECT_COEF` calibration
+    note) squared over the boundary band fraction.  Conservative by
+    construction — the qualification gate multiplies ERR_SAFETY on
+    top, so a defect the model clears really does sit under the
+    measured one with >= 10x total margin at every probe point."""
+    S = max(1, int(stages))
+    r = (T_final / S) / euler_bound
+    e = min(EXPO_DEFECT_CAP, EXPO_DEFECT_COEF * r * r)
+    return e * e * _boundary_frac(shape, eps)
+
+
+def _expo_min_stages(shape, eps: int, euler_bound: float,
+                     T_final: float, accuracy: float) -> int | None:
+    """Smallest S with ``ERR_SAFETY * modeled_expo_defect <= accuracy``
+    (defect is monotone decreasing and cost monotone increasing in S,
+    so the minimal feasible S is also the cheapest).  None when even
+    the unsaturated quadratic regime cannot reach the budget."""
+    e_budget = math.sqrt(accuracy / (ERR_SAFETY * _boundary_frac(shape,
+                                                                 eps)))
+    if e_budget >= EXPO_DEFECT_CAP:
+        return 1  # any substep count models inside the budget
+    r_max = math.sqrt(e_budget / EXPO_DEFECT_COEF)
+    if r_max <= 0 or not math.isfinite(r_max):
+        return None
+    return max(1, math.ceil(T_final / (r_max * euler_bound)))
+
+
 def pick_engine(shape, eps: int, k: float, dh: float, T_final: float,
                 accuracy: float, deadline_ms: float | None = None, *,
                 method: str = "auto", rate_fn=None,
@@ -253,10 +308,16 @@ def pick_engine(shape, eps: int, k: float, dh: float, T_final: float,
     physical time on ``shape`` — or :class:`PickerRefusal`.
 
     ``method`` is the fleet's stencil base ('auto' models as the conv/
-    sat stencil); the fft twin competes unless ``allow_fft=False`` (the
-    ingress disables it — and with it expo — for cases bound for the
-    SHARDED tier, whose halo-padded blocks the spectral embedding
-    cannot serve).  ``rate_fn(method, shape, eps, precision) -> ms`` is
+    sat stencil); the fft twin competes unless ``allow_fft=False``.
+    ``allow_fft`` is the ROUTER's sharded-fft capability verdict for
+    cases bound for the gang tier (serve/router.py
+    ``sharded_fft_capability``): True when the pencil-decomposed
+    sharded transform (ops/spectral_sharded.py) can serve the (grid,
+    mesh) pair — sharded picks then compete over the FULL stepper x
+    stages x method x precision space — and False when it cannot
+    (indivisible pencil split, unknown gang mesh, or the
+    NLHEAT_FFT_SHARDED=0 kill-switch), which excludes fft and expo.
+    ``rate_fn(method, shape, eps, precision) -> ms`` is
     the caller's measured cost model; default analytic (backend-free).
     """
     from nonlocalheatequation_tpu.ops.constants import (
@@ -280,17 +341,21 @@ def pick_engine(shape, eps: int, k: float, dh: float, T_final: float,
         rates_label = "analytic"
     else:
         rates_label = getattr(rate_fn, "provenance", "measured")
-    if allow_expo is None:
-        allow_expo = os.environ.get("NLHEAT_PICK_EXPO") == "1"
+    if allow_expo is None and os.environ.get("NLHEAT_PICK_EXPO") == "1":
+        allow_expo = True  # forced opt-in; None stays the model gate
     ladder = tuple(stages_ladder) if stages_ladder else _stage_ladder()
     wsum = _wsum(dim, eps)
     c = _c_const(dim, k, eps, dh)
     stencil = method if method not in ("auto", "fft") else "auto"
     if not allow_fft:
         if method == "fft":
-            raise ValueError(
-                "allow_fft=False (a sharded-tier case) with a fleet "
-                "whose base method IS fft — no servable candidate axis")
+            raise PickerRefusal(
+                "the router's sharded-fft capability gate excludes "
+                "method='fft' for this case (the pencil transposes "
+                "cannot serve the (grid, mesh) pair, or "
+                "NLHEAT_FFT_SHARDED=0 — serve/router.py "
+                "sharded_fft_capability) and the fleet's base method "
+                "IS fft: no servable candidate axis")
         methods = [stencil]
         allow_expo = False  # expo is fft-only
     else:
@@ -342,16 +407,36 @@ def pick_engine(shape, eps: int, k: float, dh: float, T_final: float,
                     stepper=stepper, stages=stages, method=m,
                     precision=prec, dt=dt, steps=steps, est_ms=est_ms,
                     est_err=err, rates=rates_label))
-    if allow_expo:
+    eul = stable_dt(c, dh, dim, wsum)
+    if allow_expo is True:
+        # forced opt-in (the pre-model envelope): the caller asserts
+        # the interior contract at its chosen substep count; est_err
+        # still reports the model's verdict for the audit trail
         S = max(0, int(expo_stages))
-        # time-exact inside the interior envelope (caller-asserted);
-        # one step to any horizon, unconditionally stable
         applies = max(1.0, EXPO_CORR_APPLIES * S)
         candidates.append(EngineChoice(
             stepper="expo", stages=S, method="fft", precision="f32",
             dt=T_final, steps=1,
             est_ms=applies * rate_fn("fft", shape, eps, "f32"),
-            est_err=0.0, rates=rates_label))
+            est_err=modeled_expo_defect(shape, eps, eul, T_final,
+                                        max(1, S)),
+            rates=rates_label))
+    elif allow_expo is None and "fft" in methods:
+        # the ISSUE 16 qualification: corrected expo competes without
+        # opt-in when the measured collar-defect model clears the
+        # accuracy target at the minimal (= cheapest) substep count —
+        # one step to the horizon, unconditionally stable, never a
+        # gamble (ERR_SAFETY rides the gate like every other candidate)
+        S = _expo_min_stages(shape, eps, eul, T_final, accuracy)
+        if S is not None:
+            defect = modeled_expo_defect(shape, eps, eul, T_final, S)
+            if ERR_SAFETY * defect <= accuracy:
+                candidates.append(EngineChoice(
+                    stepper="expo", stages=S, method="fft",
+                    precision="f32", dt=T_final, steps=1,
+                    est_ms=(EXPO_CORR_APPLIES * S
+                            * rate_fn("fft", shape, eps, "f32")),
+                    est_err=defect, rates=rates_label))
 
     if not candidates:
         # the accuracy cap comes from the closed-form manufactured

@@ -717,6 +717,38 @@ class ReplicaRouter:
     def _is_sharded(self, case) -> bool:
         return self.is_sharded(getattr(case, "shape", None))
 
+    def sharded_fft_capability(self, shape, eps: int) -> bool:
+        """Can the gang serve a SHARDED case of ``shape`` with
+        method='fft' (the pencil-decomposed transform,
+        ops/spectral_sharded.py)?  The ingress picker reads this to
+        decide the candidate axis for gang-bound cases (ISSUE 16 —
+        allow_fft stopped being a hardcoded False).  Pure host
+        arithmetic: the gang's mesh is predicted with
+        ``choose_mesh_shape`` from ``gang_devices``, so the router
+        never touches a backend (wedge discipline).  ``gang_devices``
+        None means the worker sizes its own mesh from devices the
+        router cannot see — the capability is then unknown and the
+        answer is the conservative False (the stencil axis always
+        serves)."""
+        if self.gang_devices is None:
+            return False
+        try:
+            shape = tuple(int(s) for s in shape)
+        except (TypeError, ValueError):
+            return False
+        if len(shape) != 2:
+            return False
+        from nonlocalheatequation_tpu.ops.spectral_sharded import (
+            supports_sharded_fft,
+        )
+        from nonlocalheatequation_tpu.parallel.distributed2d import (
+            choose_mesh_shape,
+        )
+
+        mesh_shape = choose_mesh_shape(shape[0], shape[1],
+                                       self.gang_devices)
+        return supports_sharded_fft(shape, int(eps), mesh_shape)
+
     def _gang_rep(self) -> _Replica:
         for r in self._replicas.values():
             if r.gang and r.alive:
@@ -1586,10 +1618,11 @@ def _gang_loop(cfg: dict, out, poll, eof, tracer, trace_dir,
                                     case=msg.get("id")):
                     # the picked engine (serve/picker.py) overrides the
                     # fleet defaults per case — the sharded class honors
-                    # the pick too (ISSUE 13); expo/fft never reach here
-                    # (the ingress restricts sharded picks to stencil
-                    # methods, and solve_case_sharded refuses loudly if
-                    # one does)
+                    # the pick too (ISSUE 13), including fft/expo picks
+                    # since ISSUE 16: solve_case_sharded serves them on
+                    # the pencil-decomposed spectral tier (a fused-comm
+                    # gang falls back to the collective transposes via
+                    # its ValueError fallback, recorded in info)
                     pe = msg.get("engine") or {}
                     values, info = solve_case_sharded(
                         msg["case"],

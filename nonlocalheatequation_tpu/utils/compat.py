@@ -154,13 +154,56 @@ def aot_fingerprint() -> dict:
     }
 
 
-# -- real-input FFT (ops/spectral.py) ---------------------------------------
+# -- real-input FFT (ops/spectral.py, ops/spectral_sharded.py) --------------
 # The pinned jaxlib (0.4.x) ships jnp.fft.rfftn/irfftn, but older builds of
 # the axon plugin stack have shipped jnp.fft trees without the real-input
-# entry points.  The spectral path imports from here so the capability
+# entry points.  The spectral paths import from here so the capability
 # split lives in one place: where rfftn exists it is used directly; where
 # it does not, the full complex transform + hermitian slice/embed is the
 # mathematically identical fallback (real input => hermitian spectrum).
+# The fallbacks are defined UNCONDITIONALLY (not only inside the except
+# branch) so the suite can pin them against np.fft on every build — in
+# particular the n//2+1 inverse rounding on ODD last-axis lengths, which
+# the sharded pencil transposes (ops/spectral_sharded.py) rely on for
+# non-even pencil widths.
+
+import jax.numpy as _jnp
+
+
+def _rfftn_fallback(x):
+    """rfftn via the full complex transform + hermitian slice."""
+    full = _jnp.fft.fftn(x)
+    half = x.shape[-1] // 2 + 1
+    return full[..., :half]
+
+
+def _irfftn_fallback(xh, s):
+    """irfftn via hermitian reconstruction + full complex inverse."""
+    n_last = s[-1]
+    # rebuild the redundant half from hermitian symmetry: the
+    # negative frequencies are the reversed conjugates of 1..ceil-1
+    # (for odd n_last the Nyquist bin is absent and the tail starts at
+    # bin 1; (n_last + 1) // 2 covers both parities)
+    tail = _jnp.conj(xh[..., 1:(n_last + 1) // 2])
+    for ax in range(xh.ndim - 1):
+        tail = _jnp.flip(_jnp.roll(tail, -1, axis=ax), axis=ax)
+    tail = _jnp.flip(tail, axis=-1)
+    full = _jnp.concatenate([xh, tail], axis=-1)
+    return _jnp.real(_jnp.fft.ifftn(full))
+
+
+def _rfft_last_fallback(x, n: int):
+    """Last-axis rfft of zero-padded-to-n input via the complex fft."""
+    full = _jnp.fft.fft(x, n=n, axis=-1)
+    return full[..., : n // 2 + 1]
+
+
+def _irfft_last_fallback(xh, n: int):
+    """Last-axis irfft back to n real points via hermitian rebuild."""
+    tail = _jnp.flip(_jnp.conj(xh[..., 1:(n + 1) // 2]), axis=-1)
+    full = _jnp.concatenate([xh, tail], axis=-1)
+    return _jnp.real(_jnp.fft.ifft(full, axis=-1))
+
 
 try:  # the normal case on the pinned jaxlib
     from jax.numpy.fft import irfftn as _jnp_irfftn
@@ -176,21 +219,17 @@ try:  # the normal case on the pinned jaxlib
         # without axes=
         return _jnp_irfftn(xh, s=s, axes=tuple(range(-len(s), 0)))
 
+    def rfft_last(x, n: int):
+        """Last-axis real FFT with zero-padding to ``n`` (the sharded
+        pencil form: one real axis per transpose stage)."""
+        return _jnp.fft.rfft(x, n=n, axis=-1)
+
+    def irfft_last(xh, n: int):
+        """Inverse of :func:`rfft_last` back to ``n`` real points."""
+        return _jnp.fft.irfft(xh, n=n, axis=-1)
+
 except ImportError:  # pragma: no cover — plugin builds without rfftn
-    import jax.numpy as _jnp
-
-    def rfftn(x):
-        full = _jnp.fft.fftn(x)
-        half = x.shape[-1] // 2 + 1
-        return full[..., :half]
-
-    def irfftn(xh, s):
-        n_last = s[-1]
-        # rebuild the redundant half from hermitian symmetry: the
-        # negative frequencies are the reversed conjugates of 1..ceil-1
-        tail = _jnp.conj(xh[..., 1:(n_last + 1) // 2])
-        for ax in range(xh.ndim - 1):
-            tail = _jnp.flip(_jnp.roll(tail, -1, axis=ax), axis=ax)
-        tail = _jnp.flip(tail, axis=-1)
-        full = _jnp.concatenate([xh, tail], axis=-1)
-        return _jnp.real(_jnp.fft.ifftn(full))
+    rfftn = _rfftn_fallback
+    irfftn = _irfftn_fallback
+    rfft_last = _rfft_last_fallback
+    irfft_last = _irfft_last_fallback

@@ -36,7 +36,11 @@ for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
               # or preview stride — the same hygiene as every prior
               # serve-tier knob family
               "NLHEAT_SESSION_BUDGET", "NLHEAT_SESSION_CKPT_EVERY",
-              "NLHEAT_SESSION_PREVIEW", "BENCH_SESSION"):
+              "NLHEAT_SESSION_PREVIEW", "BENCH_SESSION",
+              # a leaked sharded-fft kill-switch / fft-gang bench knob
+              # must not silently disable the spectral tier under test
+              # (ops/spectral_sharded.py) or arm the fftgang bench rung
+              "NLHEAT_FFT_SHARDED", "BENCH_FFT_GANG"):
     os.environ.pop(_knob, None)
 # "" DISABLES autotune-cache persistence (unset means the per-user default
 # file since tuning became the on-TPU default): the suite must neither read

@@ -60,11 +60,13 @@ def test_2d_fft_and_stepper_surface():
                             "--superstep-stages", "2", "--dt", "0.1"])
     assert r.returncode == 2
     assert "exceeds the rkc[s=2] stability bound" in r.stderr
-    # honesty refusals: expo needs fft; fft excludes --distributed (3d)
+    # honesty refusals: expo needs fft; fft excludes the fused stencil
+    # transport (the sharded spectral tier is collective-only, ISSUE 16)
     r = run_cli("solve2d", ["--test", "--stepper", "expo"])
     assert r.returncode == 1 and "requires --method fft" in r.stderr
-    r = run_cli("solve3d", ["--test", "--method", "fft", "--distributed"])
-    assert r.returncode == 1 and "whole-domain" in r.stderr
+    r = run_cli("solve3d", ["--test", "--method", "fft", "--distributed",
+                            "--comm", "fused"])
+    assert r.returncode == 1 and "pencil" in r.stderr
     # euler past its bound stays accepted (reference parity) with a loud
     # warning naming the bound
     r = run_cli("solve2d", ["--test", "--nt", "2", "--cmp", "0"])

@@ -468,7 +468,10 @@ def test_gang_sharded_rkc_socket_and_http_picked_form():
                           "dh": dh, "T_final": T, "accuracy": 1e-6,
                           "test": True})
             assert resp2["engine"]["stepper"] == "rkc"
-            assert resp2["engine"]["method"] != "fft"  # sharded: no fft
+            # the fft axis is OPEN for this (grid, mesh) pair since
+            # ISSUE 16 (capability gate, not a hardcoded exclusion);
+            # the analytic rates price the 24^2 stencil under it here
+            assert resp2["engine"]["method"] != "fft"
             for rid in (resp["id"], resp2["id"]):
                 r = urllib.request.urlopen(
                     f"http://127.0.0.1:{ing.port}/v1/cases/{rid}"
@@ -516,6 +519,79 @@ def test_gang_sharded_rkc_socket_and_http_picked_form():
         assert router.registry.get("/router/picked-cases").value == 2
 
 
+def test_gang_sharded_fft_picks_over_tcp(monkeypatch):
+    # ISSUE 16: sharded picks compete over the FULL method/stepper
+    # space — an fft (and a forced-expo) pick crosses HTTP -> router ->
+    # gang over TCP and lands bit-identical to the offline
+    # solve_case_sharded sibling on the pencil-decomposed spectral tier
+    from nonlocalheatequation_tpu.serve.http import IngressServer
+    from nonlocalheatequation_tpu.serve.router import ReplicaRouter
+
+    eps, k, dh = 5, 1.0, 0.02
+    T = 30 * euler_bound(eps, k, dh)
+    # fft-base fleet, tight target at eps=5: the analytic model prices
+    # rkc-4-on-fft under every stencil candidate — a NATURAL fft pick
+    ch = pick_engine((32, 32), eps, k, dh, T, 1e-6, method="fft")
+    assert (ch.stepper, ch.stages, ch.method) == ("rkc", 4, "fft")
+    # and the forced-expo envelope (NLHEAT_PICK_EXPO=1) picks expo on
+    # the same axis — the opt-in caller owns the interior contract
+    monkeypatch.setenv("NLHEAT_PICK_EXPO", "1")
+    che = pick_engine((32, 32), eps, k, dh, T, 1e-6, method="fft")
+    monkeypatch.delenv("NLHEAT_PICK_EXPO")
+    assert (che.stepper, che.method, che.steps) == ("expo", "fft", 1)
+    # offline oracles through the SAME adapter + comm config the gang
+    # worker runs: the fused gang honestly serves fft picks on the
+    # collective all-to-all transposes (ValueError fallback, recorded)
+    want, info = solve_case_sharded(
+        EnsembleCase(shape=(32, 32), nt=ch.steps, eps=eps, k=k,
+                     dt=ch.dt, dh=dh, test=True),
+        ndevices=8, comm="fused", method="fft",
+        stepper=ch.stepper, stages=ch.stages)
+    assert info["comm"] == "collective"
+    assert info["error_l2"] / (32 * 32) <= 1e-6
+    wante, infoe = solve_case_sharded(
+        EnsembleCase(shape=(32, 32), nt=1, eps=eps, k=k, dt=che.dt,
+                     dh=dh, test=True),
+        ndevices=8, comm="fused", method="fft",
+        stepper="expo", stages=che.stages)
+    assert infoe["comm"] == "collective"
+    assert infoe["stepper"] == "expo"
+    with ReplicaRouter(replicas=1, method="fft", batch_sizes=(1,),
+                       transport="tcp", shard_threshold=16 * 16,
+                       gang_devices=8) as router:
+        with IngressServer(0, router) as ing:
+            def post(body):
+                r = urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{ing.port}/v1/cases",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"}))
+                return json.loads(r.read())
+
+            body = {"shape": [32, 32], "eps": eps, "k": k, "dh": dh,
+                    "T_final": T, "accuracy": 1e-6, "test": True}
+            resp = post(body)
+            assert resp["engine"]["stepper"] == "rkc"
+            assert resp["engine"]["method"] == "fft"
+            monkeypatch.setenv("NLHEAT_PICK_EXPO", "1")
+            respe = post(body)
+            monkeypatch.delenv("NLHEAT_PICK_EXPO")
+            assert respe["engine"]["stepper"] == "expo"
+            assert respe["engine"]["method"] == "fft"
+            for rid, want_arr in ((resp["id"], want),
+                                  (respe["id"], wante)):
+                r = urllib.request.urlopen(
+                    f"http://127.0.0.1:{ing.port}/v1/cases/{rid}"
+                    "?wait=1&timeout_s=300")
+                assert json.loads(r.read())["status"] == "done"
+                r = urllib.request.urlopen(
+                    f"http://127.0.0.1:{ing.port}/v1/cases/{rid}"
+                    "/result")
+                got = np.asarray(
+                    json.loads(r.read())["values"]).reshape(32, 32)
+                assert np.array_equal(got, want_arr)
+        assert router.metrics()["sharded_cases"] == 2
+
+
 # ---------------------------------------------------------------------------
 # the distributed CLIs' stepper surface
 # ---------------------------------------------------------------------------
@@ -544,11 +620,13 @@ def test_cli_distributed_stepper_surface():
     assert r2.returncode == 2
     assert "rkc[s=4] stability bound" in r2.stderr
     assert "bound in force" in r2.stderr
-    # expo is refused on the distributed CLI (rc 1, named reason)
+    # expo without --method fft is refused on the distributed CLI too
+    # (rc 1, named reason; expo + --method fft runs the sharded
+    # spectral tier — tests/test_spectral_sharded.py)
     r3 = run_cli("solve2d_distributed", ["--test", "true",
                                          "--stepper", "expo"])
     assert r3.returncode == 1
-    assert "whole-domain" in r3.stderr
+    assert "requires --method fft" in r3.stderr
     # elastic + rkc is refused (the elastic executor steps with Euler)
     r4 = run_cli("solve2d_distributed",
                  ["--test", "true", "--nbalance", "5",
@@ -566,7 +644,15 @@ def test_cli_solve3d_distributed_rkc():
                  "--superstep-stages", "4"])
     assert r.returncode == 0, r.stderr
     assert "rkc[s=4]" in r.stderr  # the bound in force, announced
-    # expo + --distributed stays refused
+    # expo + --distributed + --method fft now runs the sharded spectral
+    # tier (ISSUE 16) and holds the manufactured contract
     r2 = run_cli("solve3d", ["--test", "--distributed", "--method",
-                             "fft", "--stepper", "expo"])
-    assert r2.returncode == 1
+                             "fft", "--stepper", "expo", "--nx", "8",
+                             "--ny", "8", "--nz", "8", "--nt", "3",
+                             "--eps", "2", "--cmp", "0"])
+    assert r2.returncode == 0, r2.stderr
+    # ... but fft + the fused stencil transport stays refused
+    r3 = run_cli("solve3d", ["--test", "--distributed", "--method",
+                             "fft", "--comm", "fused"])
+    assert r3.returncode == 1
+    assert "pencil" in r3.stderr

@@ -1,0 +1,533 @@
+"""Sharded spectral tier (ISSUE 16): pencil-FFT transposes + the
+distributed method='fft' steppers.
+
+Pins the tentpole contracts on the f64 8-virtual-device CPU suite:
+
+* the sharded forward transform assembles the SAME global frequency
+  array np.fft.rfftn produces on the zero-collar box (<= 1e-12; the
+  2D path has measured bitwise equality, pinned as <= 1e-12 per the
+  reassociation caveat in ops/spectral_sharded.py), meshes (8,1) /
+  (4,2) / (2,4) and 3D (2,2,2), non-square grids, odd 5-smooth boxes,
+* roundtrip inv(fwd(u)) == u and the sharded neighbor sum vs the
+  NumPy whole-domain oracle (ops/spectral.neighbor_sum_fft_np),
+* distributed euler-on-fft / rkc-on-fft / expo (S=0 and S>=1) match
+  the serial spectral solvers <= 1e-12 and hold the manufactured
+  ``error_l2 / #points <= 1e-6`` contract,
+* bitwise run-to-run determinism of a sharded spectral solve,
+* the honesty gates: fused/superstep/divisibility/kill-switch
+  refusals are loud ValueErrors, never silent downgrades,
+* the compat real-FFT fallbacks (utils/compat.py) against np.fft —
+  including ODD last-axis lengths, where the n//2+1 inverse rounding
+  is the regression the pencil transposes rely on (satellite 1).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from nonlocalheatequation_tpu.models.solver2d import Solver2D
+from nonlocalheatequation_tpu.models.solver3d import Solver3D
+from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+from nonlocalheatequation_tpu.ops.spectral import (
+    fft_box,
+    neighbor_sum_fft_np,
+)
+from nonlocalheatequation_tpu.ops.spectral_sharded import (
+    get_plan,
+    require_sharded_fft,
+    supports_sharded_fft,
+)
+from nonlocalheatequation_tpu.parallel.distributed2d import Solver2DDistributed
+from nonlocalheatequation_tpu.parallel.distributed3d import Solver3DDistributed
+from nonlocalheatequation_tpu.parallel.mesh import make_mesh, make_mesh_3d
+from nonlocalheatequation_tpu.parallel.spectral_halo import spectral_halo_obs
+from nonlocalheatequation_tpu.utils import compat
+from nonlocalheatequation_tpu.utils.compat import shard_map
+
+assert jax.config.jax_enable_x64  # the oracle contract (conftest forces it)
+
+
+def _embed_np(u, box):
+    up = np.zeros(box, np.float64)
+    up[tuple(slice(0, s) for s in u.shape)] = u
+    return up
+
+
+def _global_freq_oracle(u, plan):
+    """np.fft.rfftn on the zero-collar box, zero-padded to the plan's
+    global frequency layout (the padded columns carry zero spectrum on
+    the sharded path too — ops/spectral_sharded.py docstring)."""
+    F = np.fft.rfftn(_embed_np(u, plan.box))
+    pad = [(0, g - s) for s, g in
+           zip(F.shape, plan.freq_global_shape, strict=True)]
+    return np.pad(F, pad)
+
+
+def _run_fwd_inv(u, mesh, plan):
+    spec = P(*plan.axis_names)
+    sharding = NamedSharding(mesh, spec)
+    fwd = jax.jit(shard_map(plan.fwd, mesh=mesh, in_specs=spec,
+                            out_specs=plan.freq_spec))
+    inv = jax.jit(shard_map(plan.inv, mesh=mesh, in_specs=plan.freq_spec,
+                            out_specs=spec))
+    ud = jax.device_put(jnp.asarray(u), sharding)
+    h = fwd(ud)
+    return np.asarray(h), np.asarray(inv(h))
+
+
+# ---------------------------------------------------------------------------
+# the raw transform vs the whole-domain rfftn oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4)])
+def test_fwd_matches_rfftn_oracle_2d(mesh_shape):
+    # non-square grid; eps 3 makes the y box 5-smooth 27 (odd-adjacent
+    # sizes are covered by the odd-box test below)
+    NX, NY = 16, 24
+    rng = np.random.default_rng(7)
+    u = rng.standard_normal((NX, NY))
+    plan = get_plan((NX, NY), 3, mesh_shape)
+    h, rt = _run_fwd_inv(u, make_mesh(*mesh_shape), plan)
+    F = _global_freq_oracle(u, plan)
+    scale = np.max(np.abs(F))
+    assert np.max(np.abs(h - F)) / scale <= 1e-12
+    # roundtrip: inv discards the collar and returns the domain interior
+    assert np.max(np.abs(rt - u)) <= 1e-12
+
+
+def test_fwd_matches_rfftn_oracle_2d_odd_box():
+    # eps 3 on NY=22 -> y box 25 (odd): the rfft bin count (n+1)//2
+    # rounding and the frequency padding to a multiple of 8 both bite
+    NX, NY, eps = 16, 22, 3
+    plan = get_plan((NX, NY), eps, (4, 2))
+    assert plan.box[1] % 2 == 1  # the config actually exercises odd n
+    rng = np.random.default_rng(11)
+    u = rng.standard_normal((NX, NY))
+    h, rt = _run_fwd_inv(u, make_mesh(4, 2), plan)
+    F = _global_freq_oracle(u, plan)
+    assert np.max(np.abs(h - F)) / np.max(np.abs(F)) <= 1e-12
+    assert np.max(np.abs(rt - u)) <= 1e-12
+
+
+def test_fwd_matches_rfftn_oracle_3d():
+    # (8, 12, 10) on the full 2x2x2 mesh: odd middle box (15), padded
+    # frequency axes on both the middle (transformed-axis pad) and last
+    NX, NY, NZ, eps = 8, 12, 10, 2
+    plan = get_plan((NX, NY, NZ), eps, (2, 2, 2))
+    assert plan.box[1] % 2 == 1
+    rng = np.random.default_rng(13)
+    u = rng.standard_normal((NX, NY, NZ))
+    h, rt = _run_fwd_inv(u, make_mesh_3d(2, 2, 2), plan)
+    F = _global_freq_oracle(u, plan)
+    assert np.max(np.abs(h - F)) / np.max(np.abs(F)) <= 1e-12
+    assert np.max(np.abs(rt - u)) <= 1e-12
+
+
+def test_sharded_neighbor_sum_matches_np_oracle():
+    # the full apply chain the steppers use: fwd * sigma -> inv equals
+    # the NumPy whole-domain spectral oracle
+    NX, NY, eps = 16, 24, 3
+    op = NonlocalOp2D(eps, 1.0, 5e-4, 0.02, method="fft")
+    plan = get_plan((NX, NY), eps, (4, 2))
+    mesh = make_mesh(4, 2)
+    sig = jax.device_put(
+        jnp.asarray(plan.neighbor_symbol_padded(op.weights)),
+        NamedSharding(mesh, plan.freq_spec))
+    spec = P("x", "y")
+
+    def ns_blk(u_blk, sig_blk):
+        return plan.inv(plan.fwd(u_blk) * sig_blk)
+
+    ns = jax.jit(shard_map(ns_blk, mesh=mesh,
+                           in_specs=(spec, plan.freq_spec),
+                           out_specs=spec))
+    rng = np.random.default_rng(17)
+    u = rng.standard_normal((NX, NY))
+    got = np.asarray(
+        ns(jax.device_put(jnp.asarray(u), NamedSharding(mesh, spec)), sig))
+    want = neighbor_sum_fft_np(op, u)
+    assert np.max(np.abs(got - want)) / np.max(np.abs(want)) <= 1e-12
+
+
+# ---------------------------------------------------------------------------
+# the distributed spectral steppers vs the serial spectral solvers
+# ---------------------------------------------------------------------------
+
+
+def _serial2d(stepper, stages, dt, nt):
+    s = Solver2D(24, 24, nt, 3, backend="jit", method="fft",
+                 stepper=stepper, stages=stages, dt=dt)
+    s.test_init()
+    s.do_work()
+    return s
+
+
+def _dist2d(stepper, stages, dt, nt, mx, my):
+    d = Solver2DDistributed(24 // mx, 24 // my, mx, my, nt, 3,
+                            method="fft", stepper=stepper, stages=stages,
+                            dt=dt, mesh=make_mesh(mx, my))
+    d.test_init()
+    d.do_work()
+    return d
+
+
+@pytest.mark.parametrize("stepper,stages,dt",
+                         [("euler", 0, 5e-4), ("rkc", 4, 2e-3),
+                          ("expo", 0, 1e-3), ("expo", 2, 1e-3)])
+def test_distributed_fft_steppers_match_serial_2d(stepper, stages, dt):
+    s = _serial2d(stepper, stages, dt, nt=5)
+    for mx, my in ((4, 2), (2, 4), (8, 1)):
+        d = _dist2d(stepper, stages, dt, 5, mx, my)
+        rel = np.max(np.abs(d.u - s.u)) / np.max(np.abs(s.u))
+        assert rel <= 1e-12, (mx, my, rel)
+
+
+def test_distributed_fft_steppers_match_serial_3d():
+    N = (8, 12, 10)
+    for stepper, stages in (("euler", 0), ("rkc", 4), ("expo", 2)):
+        s = Solver3D(*N, 4, 2, backend="jit", method="fft",
+                     stepper=stepper, stages=stages, dt=5e-4, dh=0.05)
+        s.test_init()
+        s.do_work()
+        d = Solver3DDistributed(*N, 4, 2, method="fft", stepper=stepper,
+                                stages=stages, dt=5e-4, dh=0.05,
+                                mesh=make_mesh_3d(2, 2, 2))
+        d.test_init()
+        d.do_work()
+        rel = np.max(np.abs(d.u - s.u)) / np.max(np.abs(s.u))
+        assert rel <= 1e-12, (stepper, rel)
+
+
+def test_distributed_fft_manufactured_contract():
+    # the reference pass criterion holds THROUGH the sharded tier —
+    # euler under its stability bound (1.4e-4 at eps=3, dh=0.02) and
+    # expo with the boundary correction at a dt where the measured
+    # collar defect sits under the target (2x the Euler-stable dt)
+    d = _dist2d("euler", 0, 1e-4, 20, 4, 2)
+    assert d.error_l2 / (24 * 24) <= 1e-6
+    d = _dist2d("expo", 2, 2e-4, 10, 4, 2)
+    assert d.error_l2 / (24 * 24) <= 1e-6
+
+
+def test_distributed_fft_bitwise_deterministic():
+    # static schedule + fixed mesh concatenation order: two fresh
+    # solves are BITWISE equal (the determinism claim the module
+    # docstring makes; a tolerance here would hide nondeterminism)
+    a = _dist2d("expo", 2, 1e-3, 5, 4, 2)
+    b = _dist2d("expo", 2, 1e-3, 5, 4, 2)
+    assert np.array_equal(np.asarray(a.u), np.asarray(b.u))
+
+
+# ---------------------------------------------------------------------------
+# capability gate + honesty refusals
+# ---------------------------------------------------------------------------
+
+
+def test_supports_sharded_fft_table():
+    # pure host arithmetic: (shape, mesh) -> served or not
+    assert supports_sharded_fft((16, 24), 3, (4, 2))
+    assert supports_sharded_fft((16, 24), 3, (1, 1))
+    assert supports_sharded_fft((8, 12, 10), 2, (2, 2, 2))
+    # leading extent must divide mesh[0]*mesh[-1]
+    assert not supports_sharded_fft((10, 10), 3, (2, 2))
+    # blocks must be uniform
+    assert not supports_sharded_fft((16, 25), 3, (4, 5))
+    # rank mismatch / unsupported rank
+    assert not supports_sharded_fft((16, 24), 3, (2, 2, 2))
+    assert not supports_sharded_fft((64,), 3, (8,))
+
+
+def test_require_sharded_fft_refusals(monkeypatch):
+    with pytest.raises(ValueError, match="pencil"):
+        require_sharded_fft((10, 10), 3, (2, 2))
+    monkeypatch.setenv("NLHEAT_FFT_SHARDED", "0")
+    assert not supports_sharded_fft((16, 24), 3, (4, 2))
+    with pytest.raises(ValueError, match="kill-switch"):
+        require_sharded_fft((16, 24), 3, (4, 2))
+
+
+def test_solver_ctor_refusals(monkeypatch):
+    # fft + the fused stencil transport: loud, never a downgrade
+    with pytest.raises(ValueError, match="pencil"):
+        Solver2DDistributed(6, 12, 4, 2, 5, 3, method="fft",
+                            comm="fused", mesh=make_mesh(4, 2))
+    # fft + communication-avoiding superstep: the transform is global
+    with pytest.raises(ValueError, match="superstep"):
+        Solver2DDistributed(6, 12, 4, 2, 5, 3, method="fft",
+                            superstep=2, mesh=make_mesh(4, 2))
+    # indivisible pencil split: named (grid, mesh) pair in the message
+    with pytest.raises(ValueError, match="pencil"):
+        Solver2DDistributed(5, 5, 2, 2, 5, 3, method="fft",
+                            mesh=make_mesh(2, 2))
+    # the kill-switch reaches the ctor too
+    monkeypatch.setenv("NLHEAT_FFT_SHARDED", "0")
+    with pytest.raises(ValueError, match="kill-switch"):
+        Solver2DDistributed(6, 12, 4, 2, 5, 3, method="fft",
+                            mesh=make_mesh(4, 2))
+
+
+def test_pad_freq_shape_check():
+    plan = get_plan((16, 24), 3, (4, 2))
+    with pytest.raises(ValueError, match="rfftn layout"):
+        plan.pad_freq(np.zeros((3, 3)))
+
+
+def test_spectral_halo_obs_traffic_model():
+    plan = get_plan((16, 24), 3, (4, 2))
+    obs = spectral_halo_obs(plan, "rkc", 4, steps=10, itemsize=8,
+                            comm="collective")
+    assert obs["transport"] == "alltoall"
+    assert obs["devices"] == 8
+    assert obs["rounds"] == 10 * 4  # one transform pair per rkc stage
+    assert obs["bytes_per_device_round"] > 0
+    # expo with S=2 substeps: the step transform + 3 projections per
+    # substep (the documented approximation)
+    obs2 = spectral_halo_obs(plan, "expo", 2, steps=10, itemsize=8,
+                             comm="collective")
+    assert obs2["rounds"] == 10 * (1 + 3 * 2)
+
+
+# ---------------------------------------------------------------------------
+# compat real-FFT fallbacks vs np.fft (satellite 1: odd last axes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [8, 9, 25])
+def test_compat_rfft_last_fallback_odd_even(n):
+    rng = np.random.default_rng(n)
+    x = rng.standard_normal((3, 5))  # zero-padded 5 -> n by the fft
+    got = np.asarray(compat._rfft_last_fallback(jnp.asarray(x), n))
+    want = np.fft.rfft(x, n=n, axis=-1)
+    assert got.shape[-1] == n // 2 + 1
+    assert np.max(np.abs(got - want)) <= 1e-12
+
+
+@pytest.mark.parametrize("n", [8, 9, 25])
+def test_compat_irfft_last_fallback_odd_even(n):
+    # the n//2+1 inverse rounding: for odd n the Nyquist bin is absent
+    # and the hermitian tail starts at bin 1 — the regression the
+    # sharded pencils rely on for odd 5-smooth boxes
+    rng = np.random.default_rng(n + 1)
+    x = rng.standard_normal((3, n))
+    xh = np.fft.rfft(x, axis=-1)
+    got = np.asarray(compat._irfft_last_fallback(jnp.asarray(xh), n))
+    assert got.shape[-1] == n
+    assert np.max(np.abs(got - x)) <= 1e-12
+    # and the public entry points agree with np.fft on this build too
+    got_pub = np.asarray(compat.irfft_last(jnp.asarray(xh), n))
+    assert np.max(np.abs(got_pub - x)) <= 1e-12
+
+
+@pytest.mark.parametrize("shape", [(4, 6), (4, 5), (3, 4, 5)])
+def test_compat_rfftn_irfftn_fallback_roundtrip(shape):
+    rng = np.random.default_rng(sum(shape))
+    x = rng.standard_normal(shape)
+    got = np.asarray(compat._rfftn_fallback(jnp.asarray(x)))
+    want = np.fft.rfftn(x)
+    assert np.max(np.abs(got - want)) <= 1e-12
+    back = np.asarray(
+        compat._irfftn_fallback(jnp.asarray(want), shape))
+    assert back.shape == tuple(shape)
+    assert np.max(np.abs(back - x)) <= 1e-12
+
+
+# ---------------------------------------------------------------------------
+# the picker lift: the collar-defect model qualifies expo; allow_fft is
+# the router's capability verdict (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def _euler_bound(eps, k, dh):
+    from nonlocalheatequation_tpu.ops.constants import c_2d, stable_dt
+    from nonlocalheatequation_tpu.ops.stencil import horizon_mask_2d
+
+    wsum = float(np.asarray(horizon_mask_2d(eps), np.float64).sum())
+    return stable_dt(c_2d(k, eps, dh), dh, 2, wsum)
+
+
+def test_expo_defect_model_is_conservative():
+    # the model must OVERestimate the measured one-shot defect at every
+    # calibration-class point (feasibility gates multiply ERR_SAFETY on
+    # top; an underestimate here would gamble the accuracy target)
+    from nonlocalheatequation_tpu.serve.picker import modeled_expo_defect
+
+    eps, dh = 3, 0.02
+    eul = _euler_bound(eps, 1.0, dh)
+    for S, mult in ((1, 2), (2, 5), (4, 10), (8, 2)):
+        T = mult * eul
+        s = Solver2D(24, 24, 1, eps, backend="jit", method="fft",
+                     stepper="expo", stages=S, dt=T, dh=dh)
+        s.test_init()
+        s.do_work()
+        measured = s.error_l2 / (24 * 24)
+        model = modeled_expo_defect((24, 24), eps, eul, T, S)
+        assert model >= measured, (S, mult, model, measured)
+
+
+def test_picker_expo_qualifies_without_opt_in():
+    from nonlocalheatequation_tpu.serve.picker import (
+        ERR_SAFETY,
+        PickerRefusal,
+        modeled_expo_defect,
+        pick_engine,
+    )
+
+    from nonlocalheatequation_tpu.serve.picker import _expo_min_stages
+
+    eps, k, dh = 2, 1.0, 0.01
+    eul = _euler_bound(eps, k, dh)
+    # short horizon, loose target: one corrected substep covers T at
+    # fewer modeled applies (3.5*1) than euler (4 steps at 0.8*bound)
+    # or rkc-4 (one 4-stage step), so the model's verdict decides
+    T = 3 * eul
+
+    def rate(method, shape, e, precision):
+        # stencil applies priced out: only the spectral axis can win
+        return 1e-6 if method == "fft" else 1e3
+
+    # the defect model clears the target and expo leaves the opt-in
+    # envelope — no NLHEAT_PICK_EXPO, no allow_expo=True
+    ch = pick_engine((32, 32), eps, k, dh, T, 1e-3, rate_fn=rate)
+    assert (ch.stepper, ch.method, ch.steps) == ("expo", "fft", 1)
+    assert ch.stages >= 1  # the boundary correction is always armed
+    # est_err is the MODEL's defect, and the target is never gambled
+    assert ch.est_err == modeled_expo_defect((32, 32), eps, eul, T,
+                                             ch.stages)
+    assert ERR_SAFETY * ch.est_err <= 1e-3
+    # tighter accuracy needs more substeps — monotone qualification
+    # (the pick itself then falls back to rkc, which outprices the
+    # extra corrector applies: qualification is never a free pass)
+    s_loose = _expo_min_stages((32, 32), eps, eul, T, 1e-3)
+    s_tight = _expo_min_stages((32, 32), eps, eul, T, 1e-5)
+    assert s_loose == ch.stages and s_tight > s_loose
+    ch2 = pick_engine((32, 32), eps, k, dh, T, 1e-5, rate_fn=rate)
+    assert ch2.stepper == "rkc"
+    # allow_expo=False still excludes the stepper outright
+    ch3 = pick_engine((32, 32), eps, k, dh, T, 1e-3, rate_fn=rate,
+                      allow_expo=False)
+    assert ch3.stepper != "expo"
+    # and the capability-gated axis excludes fft AND expo together
+    ch4 = pick_engine((32, 32), eps, k, dh, T, 1e-3, rate_fn=rate,
+                      allow_fft=False)
+    assert ch4.method != "fft" and ch4.stepper != "expo"
+    # an fft-base fleet with no fft capability refuses as a 422-class
+    # PickerRefusal naming the capability gate (satellite 2)
+    with pytest.raises(PickerRefusal, match="capability gate"):
+        pick_engine((32, 32), eps, k, dh, T, 1e-3, method="fft",
+                    allow_fft=False)
+
+
+def test_router_sharded_fft_capability_predicate():
+    # unit form: the predicate is pure host arithmetic over
+    # (gang_devices, shape, eps) — no router spawn, no backend touch
+    from nonlocalheatequation_tpu.serve.router import ReplicaRouter
+
+    class Stub:
+        gang_devices = 8
+
+    cap = ReplicaRouter.sharded_fft_capability
+    assert cap(Stub(), (64, 64), 3)  # choose_mesh_shape(64,64,8)=(8,1)
+    assert not cap(Stub(), (64, 64, 64), 3)  # gang tier is 2D
+    assert not cap(Stub(), (65, 64), 3)  # indivisible pencil split
+    assert not cap(Stub(), "bad", 3)
+
+    class NoGang:
+        gang_devices = None  # worker-sized mesh: unknowable, so False
+
+    assert not cap(NoGang(), (64, 64), 3)
+
+
+def test_http_sharded_fft_pick_and_422_body():
+    import json
+    import urllib.error
+    import urllib.request
+
+    from nonlocalheatequation_tpu.obs.metrics import MetricsRegistry
+    from nonlocalheatequation_tpu.serve.http import IngressServer
+
+    class _Req:
+        def __init__(self, case, seq):
+            self.case, self.seq = case, seq
+            self.result = self.error = None
+            self.latency_s = 0.0
+            self.replica = 0
+            import threading
+
+            self.done = threading.Event()
+
+    class _Backend:
+        """Router-shaped stub: every case is sharded; the fft
+        capability is the TEST's knob."""
+
+        max_outstanding = 4
+
+        def __init__(self, fft_ok):
+            self.registry = MetricsRegistry()
+            self.fft_ok = fft_ok
+            self.engine_kwargs = {"method": "sat"}
+            self.submitted = []
+            self.registry.histogram(
+                "/router/request-latency-ms").observe(1.0)
+
+        def is_sharded(self, shape):
+            return True
+
+        def sharded_fft_capability(self, shape, eps):
+            return self.fft_ok
+
+        def live_count(self):
+            return 1
+
+        def outstanding_total(self):
+            return 0
+
+        def retry_after_s(self):
+            return 0.25
+
+        def metrics(self):
+            return {}
+
+        def submit(self, case, deadline_ms=None, priority=0, **kw):
+            req = _Req(case, len(self.submitted))
+            self.submitted.append((case, kw.get("engine")))
+            return req
+
+    eps, k, dh = 2, 1.0, 0.01
+    T = 30 * _euler_bound(eps, k, dh)
+    body = {"shape": [32, 32], "eps": eps, "k": k, "dh": dh,
+            "T_final": T, "accuracy": 1e-3, "test": True}
+
+    def post(ing, payload):
+        try:
+            r = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{ing.port}/v1/cases",
+                json.dumps(payload).encode()))
+            return r.status, json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, json.load(e)
+
+    # capability True: the sharded pick competes on the full axis (the
+    # analytic rate model prices the 32^2 fft under the priced stencil
+    # dt cap here — what matters is the axis is OPEN and the pick rides
+    # the case frame to the backend)
+    be = _Backend(fft_ok=True)
+    with IngressServer(0, be) as ing:
+        status, resp = post(ing, body)
+        assert status == 202 and "engine" in resp
+        _case, engine = be.submitted[0]
+        assert engine is not None
+    # capability False + an fft-base fleet: the picker's refusal is the
+    # client's 422 naming the capability gate (satellite 2 pin)
+    be2 = _Backend(fft_ok=False)
+    be2.engine_kwargs = {"method": "fft"}
+    with IngressServer(0, be2) as ing:
+        status, resp = post(ing, body)
+        assert status == 422
+        assert resp["refused"] == "picker"
+        assert "capability gate" in resp["error"]
+        assert "sharded_fft_capability" in resp["error"]
+        assert be2.submitted == []  # refused before any routing

@@ -33,6 +33,7 @@ What these tests pin, on the CPU/f64 suite:
 
 import json
 import os
+import time
 import urllib.request
 
 import numpy as np
@@ -425,9 +426,33 @@ def _run_chaos_fleet(tmp_path, replicas, cases, die_plan):
             router.stale_after_s = 0.0  # window elapsed
             router.refresh_stats()
             names_after = router.registry.names()
-            merged = router.dump_fleet_trace(
-                os.path.join(trace_dir, "fleet_trace.json"))
-            assert merged is not None and merged["processes"] >= 2
+            # the merged artifact must carry every live worker's pid,
+            # but a worker respawned after the die@ kill only traces
+            # once it SERVES — and the survivors can drain the batch
+            # before the fresh spawn (a jax import) wins a case.  Top
+            # the fleet up (bounded) until every worker has traced:
+            # _pick_replica prefers the zero-bucket fresh worker, so
+            # one routed case per round converges.  A chaos-timing
+            # guard, not a behavior pin — the top-up trace ids are
+            # ingress-minted like any other (recorded in ``traces``).
+            tpath = os.path.join(trace_dir, "fleet_trace.json")
+            for i in range(8):
+                merged = router.dump_fleet_trace(tpath)
+                assert merged is not None and merged["processes"] >= 2
+                wpids = {e["pid"]
+                         for e in json.load(open(tpath))["traceEvents"]
+                         if e["ph"] != "M"}
+                if len(wpids) > replicas:  # router pid + all workers
+                    break
+                time.sleep(0.25)  # let an in-flight respawn get ready
+                # a FRESH bucket per round: a warm bucket routes sticky
+                # to its owner, never to the zero-bucket fresh worker
+                d, _hdr = _post_case(
+                    base, make_cases(1, grid=8, nt=20 + i, buckets=1,
+                                     seed=99 + i)[0])
+                traces.append(d["trace"])
+                urllib.request.urlopen(
+                    base + f"/v1/cases/{d['id']}?wait=1&timeout_s=300")
         finally:
             ing.close()
     # surviving workers wrote per-replica artifacts at clean stop
@@ -467,7 +492,9 @@ def test_golden_end_to_end_fleet_trace_with_retry_and_die(tmp_path):
 
     # -- every stamped span chains to an ingress-minted request ---------
     minted = set(traces)
-    assert len(minted) == len(cases)  # one identity per request
+    # one identity per request (>= : the helper's trace-coverage top-up
+    # may mint a few beyond the offline-compared batch)
+    assert len(minted) == len(traces) >= len(cases)
     stamped = [e for e in events
                if e.get("args", {}).get("trace") is not None]
     assert stamped, "no span carries a trace id"

@@ -159,7 +159,8 @@ the SAME fixed sharded problem — grid^2 to the horizon T = steps *
 dt_euler at the BENCH_TTA_TARGET accuracy (default the repo contract
 1e-6) — twice: once at the user-named Euler schedule and once at the
 engine the PICKER chooses (rkc super-stepping where the accuracy model
-allows it; the sharded tier's candidate axis is stencil-only).  The
+allows it; this rung pins allow_fft=False — the stencil twin of
+BENCH_FFT_GANG below).  The
 picked arm's fleet result must come back bit-identical to the offline
 solve_case_sharded oracle with the picked stepper threaded through,
 and its measured manufactured error must actually meet the target (the
@@ -171,6 +172,24 @@ carries "steps_ratio" (euler steps / picked steps) / "tta_speedup"
 mixed sweep's named/picked wall ratio) / "sharded" (comm, mesh,
 stepper) / "met_target" / "bit_identical"; requires BENCH_PLATFORM=cpu
 like BENCH_ROUTER — a fleet is a host measurement),
+BENCH_FFT_GANG=N (N >= 2: the sharded-SPECTRAL A/B — ISSUE 16,
+ops/spectral_sharded.py + parallel/spectral_halo.py: ONE fleet (1
+pipeline replica + the gang tier on N virtual devices) serves the SAME
+fixed sharded problem — grid^2 to T = steps * dt_euler at the
+BENCH_TTA_TARGET accuracy — twice: once at the user-named Euler
+schedule on the stencil gang and once at the engine the picker chooses
+ON the fft axis (the stencil axis priced out of the rate model, so the
+pick is the cheapest engine over the pencil-decomposed distributed
+rfftn: euler/rkc/expo on method='fft').  The grid/mesh pair must pass
+the router's sharded-fft capability gate (a refusal is a loud rung
+error, never a silent stencil serve), the picked arm must stream back
+bit-identical to the offline solve_case_sharded oracle with the picked
+engine threaded through, and its measured error must meet the target.
+The rung is labeled "variant": "fftgangN" and carries "steps_ratio" /
+"tta_speedup" (euler-stencil wall / picked-spectral wall) /
+"picker_engine" / "sharded" (comm, mesh, stepper) / "met_target" /
+"bit_identical"; requires BENCH_PLATFORM=cpu like BENCH_ROUTER, and
+the NLHEAT_FFT_SHARDED=0 kill-switch makes it refuse loudly),
 BENCH_SESSION=N (N >= 1: the live-session tier — ISSUE 15,
 serve/sessions.py session_stream_bench + session_resume_ab: N
 concurrent streaming sessions (BENCH_SESSION_CHUNKS chunks of
@@ -675,8 +694,12 @@ def main():
     # worker, AND the in-process sharded oracle see the same device set
     ft_env = int(os.environ.get("BENCH_FLEET_TCP", 0) or 0)
     ttf_env = os.environ.get("BENCH_TTA_FLEET") == "1"
-    if (ft_env >= 2 or ttf_env) and mc_env < 2:
-        gang = int(os.environ.get("BENCH_FLEET_GANG", 4) or 4)
+    # BENCH_FFT_GANG: the knob VALUE is the gang device count (the
+    # pencil mesh), same flag discipline as the fleet rungs
+    fg_env = int(os.environ.get("BENCH_FFT_GANG", 0) or 0)
+    if (ft_env >= 2 or ttf_env or fg_env >= 2) and mc_env < 2:
+        gang = (fg_env if fg_env >= 2
+                else int(os.environ.get("BENCH_FLEET_GANG", 4) or 4))
         if gang >= 2:
             flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
                      if "host_platform_device_count" not in f]
@@ -1002,35 +1025,49 @@ def child_measure():
         os.environ.pop("BENCH_TRACE_FLEET", None)
     tta = os.environ.get("BENCH_TTA") == "1"
     ttafleet = os.environ.get("BENCH_TTA_FLEET") == "1"
+    fftgang_n = int(os.environ.get("BENCH_FFT_GANG", 0) or 0)
+    if fftgang_n == 1:
+        fftgang_n = 0  # the pencil mesh needs >= 2 devices; 0/1 = off
     session_n = int(os.environ.get("BENCH_SESSION", 0) or 0)
-    if session_n and (warmboot or tta or ttafleet or srv or ens or mchip
-                      or router_n or fleet_n
+    if session_n and (warmboot or tta or ttafleet or fftgang_n or srv
+                      or ens or mchip or router_n or fleet_n
                       or any(os.environ.get(k) for k in
                              ("BENCH_CARRIED", "BENCH_RESIDENT",
                               "BENCH_SUPERSTEP"))):
         log("BENCH_SESSION set: ignoring BENCH_WARMBOOT/TTA/TTA_FLEET/"
-            "SERVE/ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/CARRIED/RESIDENT/"
-            "SUPERSTEP — the session rung is its own labeled variant")
+            "FFT_GANG/SERVE/ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/CARRIED/"
+            "RESIDENT/SUPERSTEP — the session rung is its own labeled "
+            "variant")
         warmboot = False
         tta = ttafleet = False
-        srv = ens = mchip = router_n = fleet_n = 0
-    if warmboot and (tta or ttafleet or srv or ens or mchip or router_n
+        srv = ens = mchip = router_n = fleet_n = fftgang_n = 0
+    if warmboot and (tta or ttafleet or fftgang_n or srv or ens or mchip
+                     or router_n or fleet_n
+                     or any(os.environ.get(k) for k in
+                            ("BENCH_CARRIED", "BENCH_RESIDENT",
+                             "BENCH_SUPERSTEP"))):
+        log("BENCH_WARMBOOT set: ignoring BENCH_TTA/TTA_FLEET/FFT_GANG/"
+            "SERVE/ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/CARRIED/RESIDENT/"
+            "SUPERSTEP — the warmboot rung is its own labeled variant")
+        tta = ttafleet = False
+        srv = ens = mchip = router_n = fleet_n = fftgang_n = 0
+    if ttafleet and (tta or fftgang_n or srv or ens or mchip or router_n
                      or fleet_n
                      or any(os.environ.get(k) for k in
                             ("BENCH_CARRIED", "BENCH_RESIDENT",
                              "BENCH_SUPERSTEP"))):
-        log("BENCH_WARMBOOT set: ignoring BENCH_TTA/TTA_FLEET/SERVE/"
+        log("BENCH_TTA_FLEET set: ignoring BENCH_TTA/FFT_GANG/SERVE/"
             "ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/CARRIED/RESIDENT/"
-            "SUPERSTEP — the warmboot rung is its own labeled variant")
-        tta = ttafleet = False
-        srv = ens = mchip = router_n = fleet_n = 0
-    if ttafleet and (tta or srv or ens or mchip or router_n or fleet_n
-                     or any(os.environ.get(k) for k in
-                            ("BENCH_CARRIED", "BENCH_RESIDENT",
-                             "BENCH_SUPERSTEP"))):
-        log("BENCH_TTA_FLEET set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
+            "SUPERSTEP — the ttafleet rung is its own labeled variant")
+        tta = False
+        srv = ens = mchip = router_n = fleet_n = fftgang_n = 0
+    if fftgang_n and (tta or srv or ens or mchip or router_n or fleet_n
+                      or any(os.environ.get(k) for k in
+                             ("BENCH_CARRIED", "BENCH_RESIDENT",
+                              "BENCH_SUPERSTEP"))):
+        log("BENCH_FFT_GANG set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
             "MULTICHIP/ROUTER/FLEET_TCP/CARRIED/RESIDENT/SUPERSTEP — "
-            "the ttafleet rung is its own labeled variant")
+            "the fftgang rung is its own labeled variant")
         tta = False
         srv = ens = mchip = router_n = fleet_n = 0
     if fleet_n and (tta or srv or ens or mchip
@@ -1249,6 +1286,156 @@ def child_measure():
                 last_op = op
                 any_rung = True
                 continue
+            if fftgang_n:
+                # the sharded-spectral A/B (ISSUE 16,
+                # ops/spectral_sharded.py + parallel/spectral_halo.py):
+                # the SAME grid^2-to-T problem served by ONE fleet
+                # twice — the user-named Euler schedule on the stencil
+                # gang vs the engine the picker chooses ON the fft
+                # axis (stencil priced out of the rate model: the
+                # cheapest euler/rkc/expo engine over the
+                # pencil-decomposed distributed rfftn).  The picked
+                # arm must stream back bit-identical to the offline
+                # solve_case_sharded oracle with the picked engine.
+                if backend == "tpu":
+                    raise RuntimeError(
+                        "BENCH_FFT_GANG needs BENCH_PLATFORM=cpu: a "
+                        "replica fleet is a host measurement and the "
+                        "tunneled single chip cannot host its workers")
+                from nonlocalheatequation_tpu.ops.spectral_sharded import (
+                    supports_sharded_fft,
+                )
+                from nonlocalheatequation_tpu.parallel.distributed2d import (
+                    choose_mesh_shape,
+                )
+                from nonlocalheatequation_tpu.parallel.gang import (
+                    solve_case_sharded,
+                )
+                from nonlocalheatequation_tpu.serve.ensemble import (
+                    EnsembleCase,
+                )
+                from nonlocalheatequation_tpu.serve.picker import (
+                    analytic_rate_fn,
+                    pick_engine,
+                )
+                from nonlocalheatequation_tpu.serve.router import (
+                    ReplicaRouter,
+                )
+
+                target = float(os.environ.get("BENCH_TTA_TARGET", 1e-6))
+                gang = fftgang_n
+                T = steps * dt
+                shape = (grid, grid)
+                thr = grid * grid // 2  # grid^2 IS the sharded class
+                mesh_shape = choose_mesh_shape(grid, grid, gang)
+                if not supports_sharded_fft(shape, EPS, mesh_shape):
+                    # capability honesty: a pair the pencil transposes
+                    # cannot serve (or the kill-switch) is a loud rung
+                    # error, never a silently-stencil "fftgang" label
+                    raise RuntimeError(
+                        f"BENCH_FFT_GANG={gang}: the sharded-fft "
+                        f"capability gate refuses grid {grid}^2 on "
+                        f"mesh {mesh_shape} (pencil divisibility or "
+                        "NLHEAT_FFT_SHARDED=0)")
+
+                def fft_axis_rate(m, s, e, p, _a=analytic_rate_fn):
+                    # the spectral arm: price the stencil axis out so
+                    # the pick is the cheapest engine ON the fft axis
+                    return _a(m, s, e, p) * (1e9 if m != "fft" else 1.0)
+                fft_axis_rate.provenance = "analytic/fft-axis"
+                ch = pick_engine(shape, EPS, 1.0, 1.0 / grid, T,
+                                 target, method=method,
+                                 rate_fn=fft_axis_rate)
+                if ch.method != "fft":
+                    raise RuntimeError(
+                        f"BENCH_FFT_GANG: no fft engine meets the "
+                        f"{target:g} target for {grid}^2 to T={T:g} "
+                        f"(picker fell back to {ch.method}) — the "
+                        "fftgang label would lie; widen the target or "
+                        "the grid")
+                case_e = EnsembleCase(shape=shape, nt=steps, eps=EPS,
+                                      k=1.0, dt=dt, dh=1.0 / grid,
+                                      test=True)
+                case_f = EnsembleCase(shape=shape, nt=ch.steps,
+                                      eps=EPS, k=1.0, dt=ch.dt,
+                                      dh=1.0 / grid, test=True)
+                # the offline oracle of the picked spectral arm: the
+                # bit-identity evidence AND the measured-error check
+                # of the picker's accuracy promise (the fused-comm
+                # gang honestly serves fft on the collective
+                # transposes — recorded in info)
+                want_f, info_f = solve_case_sharded(
+                    case_f, ndevices=gang, comm="fused", method="fft",
+                    precision=ch.precision,
+                    stepper=ch.stepper, stages=ch.stages)
+                met = bool(info_f.get("error_l2", float("inf"))
+                           / (grid * grid) <= target)
+                if not met:
+                    log(f"WARNING: picked spectral engine missed the "
+                        f"accuracy target ({info_f.get('error_l2')} "
+                        f"l2 vs {target:g}) — the defect model needs "
+                        "recalibration")
+                with ReplicaRouter(replicas=1, depth=1, window_ms=1.0,
+                                   method=method, precision=PRECISION,
+                                   batch_sizes=(1,),
+                                   shard_threshold=thr,
+                                   gang_devices=gang) as router:
+                    if not router.sharded_fft_capability(shape, EPS):
+                        raise RuntimeError(
+                            "BENCH_FFT_GANG: the router's capability "
+                            "verdict disagrees with the offline gate "
+                            "— choose_mesh_shape drift?")
+
+                    def timed(case_, engine=None):
+                        # warm pass (compiles), then the timed pass
+                        router.submit(case_, engine=engine).wait(600)
+                        t0 = time.perf_counter()
+                        out = router.submit(case_,
+                                            engine=engine).wait(600)
+                        return time.perf_counter() - t0, out
+
+                    wall_e, _ = timed(case_e)
+                    wall_f, out_f = timed(case_f, engine=ch)
+                    bit = bool(np.array_equal(out_f, want_f))
+                    if not bit:
+                        log("WARNING: picked spectral arm is NOT "
+                            "bit-identical to the offline oracle")
+                picker_engine = (f"{ch.stepper}[s={ch.stages}]/"
+                                 f"{ch.method}/{ch.precision}")
+                log(f"rung {grid}^2 fftgang{gang}: euler-stencil "
+                    f"{steps} steps {wall_e:.2f}s vs picked "
+                    f"{picker_engine} {ch.steps} step(s) "
+                    f"{wall_f:.2f}s (steps_ratio "
+                    f"{steps / ch.steps:.1f}x, speedup "
+                    f"{wall_e / wall_f:.2f}x)")
+                value = grid * grid * steps / wall_e
+                event(
+                    event="rung",
+                    grid=grid,
+                    steps=steps,
+                    best_s=wall_e,
+                    ms_per_step=wall_e / steps * 1e3,
+                    value=value,
+                    variant=f"fftgang{gang}",
+                    stepper=ch.stepper,
+                    stages=ch.stages,
+                    picker_engine=picker_engine,
+                    steps_taken=ch.steps,
+                    steps_ratio=round(steps / ch.steps, 2),
+                    tta_speedup=round(wall_e / wall_f, 3),
+                    tta_target=target,
+                    sharded={"comm": info_f["comm"],
+                             "mesh": info_f["mesh"],
+                             "devices": info_f["devices"],
+                             "threshold": thr,
+                             "stepper": info_f.get("stepper", "euler")},
+                    met_target=met,
+                    bit_identical=bit,
+                )
+                last_op = op
+                any_rung = True
+                continue
+
             if ttafleet:
                 # fleet-level time-to-accuracy (ISSUE 13,
                 # parallel/stepper_halo.py + serve/picker.py): the SAME

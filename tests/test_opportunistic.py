@@ -24,7 +24,7 @@ ALL_STEPS = [
     "bf16-4096", "bf16-carried4096", "ensemble8x1024", "serve8x1024",
     "servefault8x1024", "obs8x1024", "multichip1024", "fft4096",
     "tta4096", "warmboot1024", "router8x1024", "routerobs8x1024",
-    "fleettcp8x1024", "ttafleet8x512", "session8x256",
+    "fleettcp8x1024", "ttafleet8x512", "fftgang8x4096", "session8x256",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
@@ -288,6 +288,32 @@ def test_ttafleet_step_banks_picker_evidence(tmp_path):
     assert '"picker_engine"' in table
     assert '"met_target": true' in table
     assert '"bit_identical": true' in table
+
+
+@pytest.mark.slow  # ~60 s (a gate bench + the spectral A/B fleet child;
+# a worker process hosts the 2-device gang mesh) — the sharded-fft
+# machinery itself is tier-1-covered by tests/test_spectral_sharded.py
+# and test_distributed_rkc.py; this proves the queue's gate parses
+# steps_ratio/met_target/bit_identical before banking, and the step's
+# cpu-labeled rows pass the backend-grep exemption like router8x1024
+def test_fftgang_step_banks_spectral_evidence(tmp_path):
+    proc, state, table, _out = _run(
+        tmp_path, "fftgang8x4096",
+        # tiny-grid smoke: eps 3 at 64^2 with 40 Euler steps — the
+        # accuracy-capped dt sits well past the Euler bound, so the
+        # picker's fft-axis engine lands at 1 step and the >= 10x
+        # steps_ratio floor holds even at smoke scale
+        {"OPP_GRID_FFTGANG": "64", "OPP_FFTGANG_DEVICES": "2",
+         "BENCH_EPS": "3", "BENCH_STEPS": "40"}, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    assert "fftgang8x4096\n" in state
+    assert "fail:" not in state
+    assert '"variant": "fftgang2"' in table
+    assert '"picker_engine"' in table
+    assert '"met_target": true' in table
+    assert '"bit_identical": true' in table
+    assert '"sharded"' in table  # the gang's comm/mesh recorded
 
 
 @pytest.mark.slow  # ~73 s: two strike rounds, each a full bench child plus

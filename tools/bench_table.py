@@ -27,7 +27,10 @@ Env:
     the routerobs group (ISSUE 11 traced-vs-untraced fleet A/B) shares
     the BT_ROUTER_* knobs, as does the fleettcp group (ISSUE 12
     pipe-vs-TCP transport A/B + sharded gang tier; BT_FLEET_SHARDED
-    (2) sharded cases at twice the small edge)
+    (2) sharded cases at twice the small edge),
+    BT_FFTGANG_GRID (4096 / 64) + BT_FFTGANG_DEVICES (4, the fftgang
+    group's gang mesh — ISSUE 16 stencil-vs-picked-spectral A/B;
+    needs that many local/virtual devices)
 """
 
 from __future__ import annotations
@@ -1195,6 +1198,112 @@ def bench_fleet_tta(steps: int):
          sharded_comm=info["comm"], sharded_mesh=info["mesh"])
 
 
+def bench_fftgang(steps: int):
+    """Sharded-spectral A/B (ISSUE 16, ops/spectral_sharded.py +
+    parallel/spectral_halo.py): the SAME grid^2-to-T problem served by
+    ONE 1-replica + gang fleet twice — the user-named Euler schedule on
+    the stencil gang vs the engine the picker chooses ON the fft axis
+    (the stencil axis priced out of the rate model, so the pick is the
+    cheapest euler/rkc/expo engine over the pencil-decomposed
+    distributed rfftn).  The picked row records ``steps_ratio`` /
+    ``tta_speedup``, bit-identity against the offline
+    ``solve_case_sharded`` oracle with the picked engine threaded, and
+    ``met_target`` — the picker's accuracy promise, measured.  Off-TPU
+    only, like the router/fleettcp groups, and the gang mesh needs the
+    virtual-device CPU suite (XLA_FLAGS
+    --xla_force_host_platform_device_count=N) or a real multi-device
+    host."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+    from nonlocalheatequation_tpu.ops.spectral_sharded import (
+        supports_sharded_fft,
+    )
+    from nonlocalheatequation_tpu.parallel.distributed2d import (
+        choose_mesh_shape,
+    )
+    from nonlocalheatequation_tpu.parallel.gang import solve_case_sharded
+    from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+    from nonlocalheatequation_tpu.serve.picker import (
+        analytic_rate_fn,
+        pick_engine,
+    )
+    from nonlocalheatequation_tpu.serve.router import ReplicaRouter
+
+    if on_tpu():
+        log("  fftgang: skipped on TPU (replica fleets assume one "
+            "accelerator per worker; run with BENCH_PLATFORM=cpu)")
+        return
+    gang = int(os.environ.get("BT_FFTGANG_DEVICES", 4))
+    if len(device_list()) < gang:
+        log(f"  fftgang: skipped — {len(device_list())} local devices "
+            f"< gang of {gang} (set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={gang})")
+        return
+    n = cfg("BT_FFTGANG_GRID", 4096, 64)
+    eps = 3
+    target = float(os.environ.get("BT_TTA_TARGET", 1e-6))
+    dt_e = stable_dt(NonlocalOp2D(eps, k=1.0, dt=1.0, dh=1.0 / n,
+                                  method="sat"))
+    T = steps * dt_e
+    mesh_shape = choose_mesh_shape(n, n, gang)
+    if not supports_sharded_fft((n, n), eps, mesh_shape):
+        # capability honesty: never a silently-stencil "fftgang" row
+        raise RuntimeError(
+            f"sharded-fft capability gate refuses grid {n}^2 on mesh "
+            f"{mesh_shape} (pencil divisibility or NLHEAT_FFT_SHARDED=0)")
+
+    def fft_axis_rate(m, s, e, p, _a=analytic_rate_fn):
+        # the spectral arm: price the stencil axis out so the pick is
+        # the cheapest engine ON the fft axis
+        return _a(m, s, e, p) * (1e9 if m != "fft" else 1.0)
+    fft_axis_rate.provenance = "analytic/fft-axis"
+    ch = pick_engine((n, n), eps, 1.0, 1.0 / n, T, target,
+                     method="fft", rate_fn=fft_axis_rate)
+    if ch.method != "fft":
+        raise RuntimeError(
+            f"no fft engine meets the {target:g} target for {n}^2 to "
+            f"T={T:g} (picker fell back to {ch.method}) — the fftgang "
+            "row would lie")
+    case_e = EnsembleCase(shape=(n, n), nt=steps, eps=eps, k=1.0,
+                          dt=dt_e, dh=1.0 / n, test=True)
+    case_f = EnsembleCase(shape=(n, n), nt=ch.steps, eps=eps, k=1.0,
+                          dt=ch.dt, dh=1.0 / n, test=True)
+    want_f, info = solve_case_sharded(case_f, ndevices=gang,
+                                      comm="fused", method="fft",
+                                      precision=ch.precision,
+                                      stepper=ch.stepper,
+                                      stages=ch.stages)
+    met = bool(info.get("error_l2", float("inf")) / (n * n) <= target)
+    with ReplicaRouter(replicas=1, depth=1, window_ms=1.0,
+                       method="fft", batch_sizes=(1,),
+                       shard_threshold=n * n // 2,
+                       gang_devices=gang) as router:
+        if not router.sharded_fft_capability((n, n), eps):
+            raise RuntimeError("router capability verdict disagrees "
+                               "with the offline gate — "
+                               "choose_mesh_shape drift?")
+
+        def timed(case, engine=None):
+            router.submit(case, engine=engine).wait(600)  # warm/compile
+            t0 = time.perf_counter()
+            out = router.submit(case, engine=engine).wait(600)
+            return time.perf_counter() - t0, out
+
+        wall_e, _ = timed(case_e)
+        wall_f, out_f = timed(case_f, engine=ch)
+    emit(f"fftgang/euler-stencil{gang}", n * n, steps, wall_e, grid=n,
+         eps=eps, stepper="euler", tta_target=target)
+    emit(f"fftgang/picked-fft{gang}", n * n, ch.steps, wall_f, grid=n,
+         eps=eps,
+         picker_engine=f"{ch.stepper}[s={ch.stages}]/{ch.method}/"
+                       f"{ch.precision}",
+         steps_ratio=round(steps / ch.steps, 2),
+         tta_speedup=round(wall_e / wall_f, 3), tta_target=target,
+         met_target=met,
+         bit_identical=bool(np.array_equal(out_f, want_f)),
+         sharded_comm=info["comm"], sharded_mesh=info["mesh"],
+         sharded_stepper=info.get("stepper", "euler"))
+
+
 def bench_sessions(steps: int):
     """Live-session tier (ISSUE 15, serve/sessions.py): N concurrent
     streaming sessions over a 2-replica fleet while a paced batch load
@@ -1303,6 +1412,7 @@ BENCHES = {
     "routerobs": bench_router_obs,
     "fleettcp": bench_fleet_tcp,
     "ttafleet": bench_fleet_tta,
+    "fftgang": bench_fftgang,
     "sessions": bench_sessions,
 }
 

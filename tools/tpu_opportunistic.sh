@@ -100,7 +100,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 STEPS="bench4096 resident512 carried4096 superstep2 \
 bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 servefault8x1024 \
 obs8x1024 multichip1024 fft4096 tta4096 warmboot1024 router8x1024 \
-routerobs8x1024 fleettcp8x1024 ttafleet8x512 session8x256 \
+routerobs8x1024 fleettcp8x1024 ttafleet8x512 fftgang8x4096 session8x256 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -324,6 +324,24 @@ run_step_cmd() {  # the queue's one name->command map
         BENCH_PLATFORM=cpu \
         BENCH_GRID="${OPP_GRID_TTAFLEET:-512}" \
         BENCH_LADDER="${OPP_GRID_TTAFLEET:-512}" BENCH_ACCURACY=0 ;;
+    fftgang8x4096)
+      # sharded-spectral A/B (ISSUE 16, ops/spectral_sharded.py +
+      # parallel/spectral_halo.py): the SAME 4096^2-to-T problem served
+      # by one 8-device gang fleet at the user-named Euler schedule on
+      # the stencil and at the picker's choice ON the fft axis (the
+      # stencil priced out of the rate model — the cheapest
+      # euler/rkc/expo engine over the pencil-decomposed distributed
+      # rfftn).  A HOST measurement like router8x1024 (same
+      # BENCH_PLATFORM=cpu rationale; step() exempts the backend grep).
+      # Gate (step_variant_ok): variant fftgangN, steps_ratio >=
+      # OPP_FFTGANG_MIN_RATIO (default 10), met_target (the picker's
+      # accuracy promise measured, never gambled), bit_identical
+      # (fleet-served spectral arm == offline solve_case_sharded
+      # oracle with the picked engine threaded).
+      bench_nofb BENCH_FFT_GANG="${OPP_FFTGANG_DEVICES:-8}" \
+        BENCH_PLATFORM=cpu \
+        BENCH_GRID="${OPP_GRID_FFTGANG:-4096}" \
+        BENCH_LADDER="${OPP_GRID_FFTGANG:-4096}" BENCH_ACCURACY=0 ;;
     session8x256)
       # live-session tier (ISSUE 15, serve/sessions.py
       # session_stream_bench + session_resume_ab): 8 concurrent
@@ -653,6 +671,35 @@ PYEOF
       grep -q '"variant": "superstep2"' "$2" && grep -q '"tm": 128' "$2" ;;
     superstep3-tm96)
       grep -q '"variant": "superstep3"' "$2" && grep -q '"tm": 96' "$2" ;;
+    fftgang8x4096) python - "$2" <<'PYEOF'
+import json, os, sys
+# the ISSUE 16 gate: the picked spectral engine must honestly beat the
+# stencil Euler schedule — steps_ratio >= OPP_FFTGANG_MIN_RATIO (default
+# 10, the acceptance floor; the smoke harness can relax it), the
+# picker's accuracy promise MEASURED (met_target — a pick that misses
+# the target voids the row), and the fleet-served spectral arm
+# bit-identical to the offline solve_case_sharded oracle with the
+# picked engine threaded through the gang.
+limit = float(os.environ.get("OPP_FFTGANG_MIN_RATIO", "10"))
+ok = False
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    if not str(r.get("variant") or "").startswith("fftgang"):
+        continue
+    ratio = r.get("steps_ratio")
+    if not isinstance(ratio, (int, float)) or ratio < limit:
+        continue
+    if r.get("met_target") is True and r.get("bit_identical") is True:
+        ok = True
+sys.exit(0 if ok else 1)
+PYEOF
+      ;;
     session8x256) python - "$2" <<'PYEOF'
 import json, sys
 ok = False
@@ -692,7 +739,8 @@ step() {  # <name>: run one queue step unless already done.
   log "step $name: start"
   local run rc backend_check=step_backend_ok
   case $name in
-    router8x1024 | routerobs8x1024 | fleettcp8x1024 | ttafleet8x512 | session8x256)
+    router8x1024 | routerobs8x1024 | fleettcp8x1024 | ttafleet8x512 \
+      | fftgang8x4096 | session8x256)
       # deliberately host measurements (see run_step_cmd): the fleet
       # proxies pin BENCH_PLATFORM=cpu because N replica processes
       # cannot share the single tunneled chip — their rows are cpu-

@@ -24,11 +24,17 @@ import time
 import numpy as np
 
 from nonlocalheatequation_tpu.cli.common import (
+    add_obs_flags,
     add_platform_flags,
+    add_program_store_flag,
+    apply_program_store,
     bool_flag,
     check_same_input_state,
     cli_startup,
     guard_multihost_stdin,
+    obs_session,
+    publish_solve_metrics,
+    validate_obs_args,
 )
 from nonlocalheatequation_tpu.utils.devices import device_list
 
@@ -63,16 +69,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "gather-free offsets/windowed paths on TPU)")
     p.add_argument("--vtu", default=None, metavar="FILE",
                    help="write the final field as a .vtu point cloud")
+    bool_flag(p, "gang-order", True,
+              "reorder nodes by the coarse-grid RCB parts "
+              "(serve/meshes.py gang_order) before a --devices N shard, "
+              "so each device's index-contiguous block is spatially "
+              "compact and the ring halo carries only true cut edges")
     p.add_argument("--no-header", action="store_true", dest="no_header")
     add_platform_flags(p)
+    add_obs_flags(p)
+    add_program_store_flag(p)
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    err = validate_obs_args(args)
+    if err:
+        print(err, file=sys.stderr)
+        return 1
     # the srun analog (cli_startup holds the load-bearing ordering)
     multi = cli_startup(args, "nlheat_unstructured")
+    apply_program_store(args)
+    with obs_session(args):
+        return _run(args, multi)
 
+
+def _run(args, multi: bool) -> int:
     import jax
 
     if args.devices is None:
@@ -108,6 +130,20 @@ def main(argv=None) -> int:
     dh = float(np.sqrt(best).mean())
     eps = args.eps if args.eps > 0 else args.eps_h * dh
     vol = dh ** pts.shape[1]
+
+    # gang placement (ISSUE 17): the sharded operator partitions by
+    # INDEX into equal contiguous blocks, so reorder the nodes by the
+    # refined RCB cuts of a coarse tile grid (serve/meshes.py
+    # gang_order — the reference's decomposition recipe,
+    # src/domain_decomposition.cpp:157-195) before the shard; outputs
+    # below are unpermuted back to mesh-file order.
+    inv = None
+    if args.devices > 1 and args.gang_order:
+        from nonlocalheatequation_tpu.serve.meshes import gang_order
+
+        perm = gang_order(pts, args.devices)
+        inv = np.argsort(perm)
+        pts = pts[perm]
 
     op = UnstructuredNonlocalOp(pts, eps, k=args.k, dt=args.dt or 1.0,
                                vol=vol)
@@ -145,14 +181,19 @@ def main(argv=None) -> int:
         s.test_init()
     else:
         guard_multihost_stdin(multi)
-        s.input_init(
-            np.array(sys.stdin.read().split(), dtype=np.float64)[:n])
+        vals = np.array(sys.stdin.read().split(), dtype=np.float64)[:n]
+        # stdin arrives in mesh-file order; the operator's nodes may be
+        # gang-ordered — permute the state to match
+        s.input_init(vals if inv is None else vals[np.argsort(inv)])
         check_same_input_state(multi, s.u0)
 
     t0 = time.perf_counter()
     s.do_work()
     elapsed = time.perf_counter() - t0
+    publish_solve_metrics("unstructured", elapsed, n, args.nt,
+                          error_l2=s.error_l2 if args.test else None)
 
+    u_out = np.asarray(s.u) if inv is None else np.asarray(s.u)[inv]
     if args.test:
         err = s.error_l2 / n
         if args.cmp:
@@ -160,14 +201,15 @@ def main(argv=None) -> int:
                   f"({'<=' if err <= 1e-6 else '>'} 1e-6)")
         print(f"l2: {s.error_l2:g} linfinity: {s.error_linf:g}")
     if args.results:
-        for v in s.u:
+        for v in u_out:
             print(f"{v:g}")
     if args.vtu and (not multi or jax.process_index() == 0):
         # file output is rank 0's alone (docs/multihost.md "log from one
         # process"); N racing writers to one path corrupt it
         from nonlocalheatequation_tpu.utils.vtu import write_point_cloud_vtu
 
-        write_point_cloud_vtu(args.vtu, pts, {"Temperature": s.u})
+        write_point_cloud_vtu(args.vtu, pts if inv is None else pts[inv],
+                              {"Temperature": u_out})
         print(f"wrote {args.vtu}")
 
     if not args.no_header:

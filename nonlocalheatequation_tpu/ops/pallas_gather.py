@@ -1,0 +1,272 @@
+"""Pallas CSR gather kernel tier for the unstructured operator.
+
+ISSUE 17 tentpole (a): the ε-ball operator on a point cloud is a FIXED
+sparsity pattern (ops/unstructured.py builds the edge list once on the
+host), so the production gather can be a Pallas kernel instead of the
+XLA ``segment_sum``/ELL reductions the soak path uses.  The math is the
+reference's nonlocal sum (problem_description.tex:131-158, evaluated on
+arbitrary nodes per the unstructured module's moment matching):
+
+    L(u)[i] = c_i * (sum_j w_ij * u_j  -  wsum_i * u_i)
+
+Kernel layout — CSR rows packed into VMEM-resident strips:
+
+* The host packs the CSR table (row offsets + column indices, the order
+  ``build_edges`` emits: rows ascending, columns ascending within a row)
+  into fixed-width row strips of ``TM`` rows x ``kpad`` lanes.  Per-row
+  constants are BAKED into the strip weights at pack time:
+  ``W[i, j] = c_i * w_ij`` for the neighbor columns plus one trailing
+  ``(-c_i * wsum_i, col=i)`` center entry, so the kernel body is a pure
+  gather + row reduction with no per-row scalar traffic.
+* Each grid step holds one (TM, kpad) column/weight strip plus the whole
+  padded state vector in VMEM (the strip height is chosen against the
+  pallas_kernel VMEM budget); rows gather their neighbor values from the
+  resident state and reduce along the lane axis.
+* ``precision="bf16"`` is the PR 1 pair-frame tier: the gathered operand
+  takes one bfloat16 round-trip before any accumulation while the baked
+  weights and the accumulate stay in the (>= f32) carry dtype — the
+  ``_bf16_round`` operand semantic of ops/nonlocal_op.py and
+  ``pallas_halo.build_split_nsum_2d``.
+
+Off-TPU every ``pallas_call`` here runs in interpreter mode (the
+``pallas_halo`` precedent), so the CPU tier-1 suite executes the real
+kernel body; the ``segment_sum``/ELL layouts in ops/unstructured.py stay
+the 1e-12 parity oracles (tests/test_pallas_gather.py), and on uniform
+grid-shaped clouds the result is pinned <= 1e-12 to the grid stencil
+(ops/stencil.py raster) with the grid constant.
+
+Per-step and ``lax.scan``-carried multi-step forms mirror the grid
+makers (ops/nonlocal_op.py ``make_step_fn``/``make_multi_step_fn``), so
+the ensemble engine can compile one scan program per mesh bucket and the
+AOT program store can warm-boot it by mesh hash (serve/ensemble.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from nonlocalheatequation_tpu.ops.pallas_kernel import (
+    _VMEM_BUDGET,
+    _VMEM_LIMIT,
+    _on_tpu,
+    _reject_f64_on_tpu,
+    _round_up,
+)
+
+#: Strip heights the packer may choose (sublane-aligned; the top one is
+#: plenty for every suite-sized cloud, the ladder keeps big-kmax meshes
+#: inside the VMEM budget).
+_TM_LADDER = (1024, 512, 256, 128, 64, 32, 16, 8)
+
+#: Lane quantum of the strip width (the f32 tile's lane count).
+_LANE = 128
+
+
+def _params():
+    """Pallas params: compiled with a VMEM ceiling on TPU, interpreter
+    mode everywhere else (the pallas_halo ``_kernel_params_fused``
+    discipline) so the CPU suite runs the real kernel body."""
+    if _on_tpu():
+        cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+        return dict(compiler_params=cls(vmem_limit_bytes=_VMEM_LIMIT))
+    return dict(interpret=True)
+
+
+def csr_arrays(op):
+    """The operator's neighbor table in CSR form: ``(offsets, cols, w)``
+    with ``offsets`` (n+1,) int64 row starts, ``cols`` (nnz,) int32 and
+    ``w`` (nnz,) f64 in build_edges order (rows ascending, columns
+    ascending within a row — the segment_sum oracle's order)."""
+    n, tgt = op.n, op.tgt
+    deg = np.bincount(tgt, minlength=n) if len(tgt) else np.zeros(n, np.int64)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=offsets[1:])
+    return offsets, op.src.astype(np.int32), op.edge_w.astype(np.float64)
+
+
+def _choose_tm(n: int, kpad: int, n_upad: int, itemsize: int) -> int:
+    """Largest ladder strip height whose working set — the (TM, kpad)
+    column + weight strips, the resident padded state, and the (TM, 1)
+    output block — fits the pallas_kernel VMEM budget."""
+    for tm in _TM_LADDER:
+        strips = tm * kpad * (4 + itemsize)  # int32 cols + weights
+        state = n_upad * itemsize
+        if strips + state + tm * itemsize <= _VMEM_BUDGET:
+            return tm
+    return _TM_LADDER[-1]
+
+
+def pack_strips(op, dtype_name: str = "float32"):
+    """Pack the operator's CSR table into kernel strips.
+
+    Returns ``(col, w, tm, n_pad, n_upad)``: ``col``/``w`` are
+    (n_pad, kpad) arrays — per-row neighbor columns and c_i-scaled
+    weights plus the trailing ``(-c_i * wsum_i, i)`` center entry —
+    zero-weight padded to the lane quantum and to a whole number of
+    TM-row strips; ``n_upad`` is the lane-aligned length of the padded
+    state vector the kernel keeps resident.  Cached on the op (the edge
+    set is immutable), keyed by dtype."""
+    cache = getattr(op, "_gather_strips", None)
+    if cache is None:
+        cache = op._gather_strips = {}
+    hit = cache.get(dtype_name)
+    if hit is not None:
+        return hit
+    dtype = np.dtype(dtype_name)
+    offsets, cols, w = csr_arrays(op)
+    n = op.n
+    kw = op.kmax + 1  # + the baked center column
+    kpad = max(_LANE, _round_up(kw, _LANE))
+    n_upad = max(_LANE, _round_up(n, _LANE))
+    tm = _choose_tm(n, kpad, n_upad, dtype.itemsize)
+    n_pad = _round_up(max(n, 1), tm)
+    col = np.zeros((n_pad, kpad), np.int32)
+    wst = np.zeros((n_pad, kpad), np.float64)
+    if len(cols):
+        tgt = op.tgt
+        pos = np.arange(len(cols)) - offsets[tgt]
+        col[tgt, pos] = cols
+        wst[tgt, pos] = op.c[tgt] * w
+    rows = np.arange(n)
+    deg = np.diff(offsets)
+    col[rows, deg] = rows
+    wst[rows, deg] = -op.c * op.wsum
+    out = (col, wst.astype(dtype), tm, n_pad, n_upad)
+    cache[dtype_name] = out
+    return out
+
+
+def build_gather_L(op, dtype_name: str, precision: str = "f32"):
+    """``L(u)`` as a Pallas strip-gather kernel: ``(n,) -> (n,)``.
+
+    Parity contract: <= 1e-12 of ``op.apply(u, layout="edges")`` (the
+    segment_sum oracle) — same edges, same per-row column order, one
+    extra baked center product per row (tests/test_pallas_gather.py).
+    """
+    if precision not in ("f32", "bf16"):
+        raise ValueError(f"unknown gather precision {precision!r}")
+    dtype = jnp.dtype(dtype_name)
+    _reject_f64_on_tpu(dtype)
+    col, wst, tm, n_pad, n_upad = pack_strips(op, dtype.name)
+    n = op.n
+    bf16 = precision == "bf16"
+    colj = jnp.asarray(col)
+    wj = jnp.asarray(wst)
+
+    def kernel(u_ref, col_ref, w_ref, out_ref):
+        uv = u_ref[0, :]
+        if bf16:
+            # the tier's operand semantic: one bf16 round-trip of the
+            # gathered state before any accumulation; the baked weights
+            # and the row reduction stay in the carry dtype
+            uv = uv.astype(jnp.bfloat16).astype(uv.dtype)
+        g = jnp.take(uv, col_ref[:], axis=0)
+        out_ref[:, :] = jnp.sum(w_ref[:] * g, axis=1, keepdims=True)
+
+    grid = n_pad // tm
+
+    @jax.jit
+    def L(u):
+        upad = jnp.zeros((1, n_upad), dtype).at[0, :n].set(
+            u.astype(dtype))
+        out = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[
+                # the whole padded state rides along every strip (index
+                # map pinned to block 0): rows gather from anywhere
+                pl.BlockSpec((1, n_upad), lambda i: (0, 0)),
+                pl.BlockSpec((tm, wj.shape[1]), lambda i: (i, 0)),
+                pl.BlockSpec((tm, wj.shape[1]), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((tm, 1), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n_pad, 1), dtype),
+            **_params(),
+        )(upad, colj, wj)
+        return out[:n, 0]
+
+    return L
+
+
+# ---------------------------------------------------------------------------
+# Step forms: per-step and lax.scan-carried multi-step (the grid makers'
+# shapes, ops/nonlocal_op.py make_step_fn / make_multi_step_fn)
+# ---------------------------------------------------------------------------
+
+
+def make_gather_step_fn(op, dtype=None, test: bool = False,
+                        precision: str = "f32"):
+    """``step(u, t) -> u + dt * (L(u) + b_t)`` over the strip-gather
+    kernel — the per-step form; ``test=True`` bakes the manufactured
+    source from the op's own profile (the batch_tester protocol,
+    reference src/1d_nonlocal_serial.cpp:239-266)."""
+    from nonlocalheatequation_tpu.ops.nonlocal_op import source_at
+
+    dtype = jnp.dtype(dtype) if dtype is not None else jnp.dtype(
+        jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    L = build_gather_L(op, dtype.name, precision)
+    dt = op.dt
+    if test:
+        g, lg = op.source_parts()
+        gd, lgd = jnp.asarray(g, dtype), jnp.asarray(lg, dtype)
+
+    def step(u, t):
+        du = L(u)
+        if test:
+            du = du + source_at(gd, lgd, t, dt)
+        return u + jnp.asarray(dt, dtype) * du
+
+    return step
+
+
+def make_gather_multi_step_fn(op, nt: int, dtype=None, test: bool = False,
+                              precision: str = "f32"):
+    """``multi(u0, t0) -> u_nt``: the scan-carried multi-step form — one
+    compiled program per (mesh, nt) whose ``lax.scan`` carries the state
+    across all nt kernel invocations (one dispatch per solve, the
+    tunnel-toll shape CLAUDE.md prescribes)."""
+    dtype = jnp.dtype(dtype) if dtype is not None else jnp.dtype(
+        jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    step = make_gather_step_fn(op, dtype=dtype, test=test,
+                               precision=precision)
+
+    @jax.jit
+    def multi(u0, t0):
+        ts = t0 + jnp.arange(nt)
+        return jax.lax.scan(lambda c, t: (step(c, t), None),
+                            u0.astype(dtype), ts)[0]
+
+    return multi
+
+
+def make_batched_gather_multi_step_fn(ops, nt: int, dtype=None,
+                                      test: bool = False,
+                                      precision: str = "f32"):
+    """``multi(U0, t0) -> (B, n)``: one program for a whole ensemble
+    chunk — each case's solo scan inlined and stacked (the engine's
+    'stacked' composition; cases in one mesh bucket share the edge table
+    but may differ in physics, so each lane bakes its own c_i-scaled
+    strips).  One compile, one dispatch per chunk; lane b is
+    bit-identical to ``make_gather_multi_step_fn(ops[b], nt)`` by
+    construction."""
+    dtype = jnp.dtype(dtype) if dtype is not None else jnp.dtype(
+        jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    steps = [make_gather_step_fn(op, dtype=dtype, test=test,
+                                 precision=precision) for op in ops]
+
+    @jax.jit
+    def multi(U0, t0):
+        ts = t0 + jnp.arange(nt)
+        outs = []
+        for b, step in enumerate(steps):
+            outs.append(jax.lax.scan(
+                lambda c, t, _s=step: (_s(c, t), None),
+                U0[b].astype(dtype), ts)[0])
+        return jnp.stack(outs)
+
+    return multi

@@ -81,6 +81,15 @@ class EnsembleCase:
     manufactured-solution source (the batch_tester protocol);
     ``u0=None`` with ``test=True`` defaults to the spatial profile G,
     matching Solver*.test_init.
+
+    ``mesh`` (ISSUE 17) keys an UNSTRUCTURED case: the content hash of a
+    registered point cloud (serve/meshes.py).  ``shape`` is then the
+    node count ``(n,)``, ``eps``/``dh`` are carried by the mesh itself
+    (set them 0), and the hash joins :meth:`bucket_key` — so mesh
+    buckets route sticky through the replica router and the hash
+    reaches the engine's ``prog_key``/``store_key`` through the bucket
+    key, which is what lets repeat-mesh traffic warm-boot compiled
+    gather programs from the shared AOT store with zero retrace.
     """
 
     shape: tuple
@@ -91,10 +100,11 @@ class EnsembleCase:
     dh: float
     test: bool = True
     u0: np.ndarray | None = None
+    mesh: str | None = None
 
     def bucket_key(self):
         return (tuple(int(s) for s in self.shape), int(self.nt),
-                int(self.eps), bool(self.test))
+                int(self.eps), bool(self.test), self.mesh)
 
     def physics(self):
         return (float(self.k), float(self.dt), float(self.dh))
@@ -344,6 +354,13 @@ class EnsembleEngine:
             NonlocalOp3D,
         )
 
+        if case.mesh is not None:
+            # mesh-keyed case: the operator is the registered point
+            # cloud under this case's physics (serve/meshes.py caches
+            # the rebuild; the stored edge table is hash-verified)
+            from nonlocalheatequation_tpu.serve.meshes import get_mesh_op
+
+            return get_mesh_op(case.mesh, case.k, case.dt)
         dim = len(case.shape)
         if dim == 1:
             # the 1D operator's method axis is shift|fft; the 2D/3D
@@ -466,7 +483,12 @@ class EnsembleEngine:
         dtype = self._dtype()
         # stepper/stages join the program key (ISSUE 8): two engines
         # differing only in integrator must never share compiled
-        # programs — a mixed-physics fleet buckets per integrator
+        # programs — a mixed-physics fleet buckets per integrator.
+        # The mesh-hash dimension (ISSUE 17) rides in ``key`` itself
+        # (EnsembleCase.bucket_key carries it), so two meshes with the
+        # same node count can never share a compiled gather program,
+        # while repeat traffic on ONE mesh hash warm-boots from the
+        # shared AOT store below with zero retrace.
         prog_key = (key, len(chunk), self.variant,
                     tuple(c.physics() for c in chunk), dtype.name,
                     self.comm, self.stepper, self.stages)
@@ -565,6 +587,9 @@ class EnsembleEngine:
             raise ValueError(
                 "a production (test=False) EnsembleCase needs an initial "
                 "state u0")
+        if case.mesh is not None:
+            # the unstructured profile is evaluated at the node coords
+            return self._make_op(case).spatial_profile()
         return self._make_op(case).spatial_profile(*case.shape)
 
     def _build_program(self, key, chunk, ops, test, dtype):
@@ -573,6 +598,33 @@ class EnsembleEngine:
             make_batched_multi_step_fn_vmap,
         )
 
+        if chunk[0].mesh is not None:
+            # mesh bucket (ISSUE 17): the Pallas strip-gather tier
+            # (ops/pallas_gather.py) — every case in the bucket shares
+            # the edge table (the hash is in the bucket key), physics
+            # may differ per lane.  Euler-only, stacked composition;
+            # anything the tier cannot honor refuses loudly (the
+            # carried/superstep honesty rule below).
+            if self.stepper != "euler":
+                raise ValueError(
+                    f"mesh buckets are Euler-only (the gather tier has "
+                    f"no {self.stepper!r} schedule)")
+            if self.method not in ("auto", "gather"):
+                raise ValueError(
+                    f"mesh buckets need method='gather' or 'auto' "
+                    f"(engine has method={self.method!r})")
+            if self.variant not in ("auto", "per-step", "stacked"):
+                raise ValueError(
+                    f"ensemble variant {self.variant!r} has no gather "
+                    "form; mesh buckets run 'auto'/'per-step'/'stacked'")
+            from nonlocalheatequation_tpu.ops.pallas_gather import (
+                make_batched_gather_multi_step_fn,
+            )
+
+            self.report.strategies[key] = "gather[stacked]"
+            return make_batched_gather_multi_step_fn(
+                ops, key[1], dtype=dtype, test=test,
+                precision=self.precision)
         shape, nt = key[0], key[1]
         dim = len(shape)
         op0 = ops[0]
@@ -681,8 +733,9 @@ def run_test_cases(cases, **engine_kwargs):
     out = []
     for case, u in zip(cases, states, strict=True):
         op = engine._make_op(case)
-        want = (np.cos(2.0 * np.pi * (case.nt * case.dt))
-                * op.spatial_profile(*case.shape))
+        prof = (op.spatial_profile() if case.mesh is not None
+                else op.spatial_profile(*case.shape))
+        want = np.cos(2.0 * np.pi * (case.nt * case.dt)) * prof
         d = np.asarray(u, np.float64) - want
         out.append((float(np.sum(d * d)), int(np.prod(case.shape))))
     return out

@@ -41,6 +41,17 @@ anything with ``submit``/``outstanding_total``/``retry_after_s``):
   checkpoint; ``POST /v1/sessions/<id>/close`` ends the stream;
   ``GET /v1/sessions/<id>/result`` fetches the final f64 field
   (``?bin=1`` for raw .npy bytes).
+* **Meshes** (ISSUE 17, serve/meshes.py — present when a mesh registry
+  is configured via ``mesh_dir`` or ``NLHEAT_MESH_DIR``):
+  ``POST /v1/meshes`` uploads a point cloud ONCE (JSON ``points`` +
+  ``eps`` field + optional ``vol``; validated + bounded — an oversized
+  or malformed body is a loud 400 — then content-hashed and persisted),
+  returning ``{"hash", "nodes", "dim", "edges"}``; cases and sessions
+  then reference it with ``"mesh": <hash>`` INSTEAD of
+  ``shape``/``eps``/``dh`` (the registered cloud carries the geometry),
+  which routes the mesh's bucket sticky and warm-boots its compiled
+  gather program from the shared AOT store (serve/ensemble.py).
+  ``GET /v1/meshes/<hash>`` returns the stored mesh's metadata.
 * ``GET /healthz`` — liveness + fleet summary.
 * ``GET /metrics`` / ``/metrics.json`` — the backend registry's
   Prometheus/JSON exposition (the router's registry already aggregates
@@ -77,6 +88,11 @@ from nonlocalheatequation_tpu.obs.export import (
 )
 from nonlocalheatequation_tpu.obs.trace import TraceContext
 from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+from nonlocalheatequation_tpu.serve.meshes import (
+    MAX_BODY_BYTES as MESH_MAX_BODY_BYTES,
+    UnknownMesh,
+    resolve_mesh_store,
+)
 from nonlocalheatequation_tpu.serve.picker import PickerRefusal, pick_engine
 from nonlocalheatequation_tpu.serve.router import RouterOverloaded
 
@@ -236,22 +252,55 @@ class AdmissionController:
         return req, None
 
 
-def parse_case(body: dict) -> EnsembleCase:
+def parse_case(body: dict, meshes=None) -> EnsembleCase:
     """Validate one JSON case body into an EnsembleCase — loudly: a
     malformed submission is the CLIENT's 400, never a worker's stack
-    trace mid-chunk."""
+    trace mid-chunk.
+
+    ``meshes`` (a serve/meshes.py MeshStore, or None when no registry
+    is configured) resolves mesh-keyed bodies (ISSUE 17): ``"mesh":
+    <hash>`` REPLACES ``shape``/``eps``/``dh`` — the registered cloud
+    carries the geometry, so shape becomes the node count ``(n,)`` and
+    eps/dh ride as 0 (the EnsembleCase mesh semantics).  An unknown
+    hash raises :class:`~nonlocalheatequation_tpu.serve.meshes.UnknownMesh`
+    (the HTTP layer's 404); a malformed one is the usual ValueError."""
     try:
-        shape = tuple(int(s) for s in body["shape"])
-        if not 1 <= len(shape) <= 3 or any(s < 1 for s in shape):
-            raise ValueError(f"bad shape {shape}")
-        nt = int(body["nt"])
-        eps = int(body["eps"])
-        if nt < 1 or eps < 1:
-            raise ValueError(f"need nt >= 1 and eps >= 1 (got {nt}, {eps})")
-        case = EnsembleCase(
-            shape=shape, nt=nt, eps=eps, k=float(body["k"]),
-            dt=float(body["dt"]), dh=float(body["dh"]),
-            test=bool(body.get("test", False)))
+        mhash = body.get("mesh")
+        if mhash is not None:
+            if not isinstance(mhash, str):
+                raise ValueError(f"mesh must be a hash string, got "
+                                 f"{type(mhash).__name__}")
+            if meshes is None:
+                raise ValueError(
+                    "mesh-keyed case but no mesh registry on this "
+                    "server (NLHEAT_MESH_DIR off)")
+            for clash in ("shape", "eps", "dh"):
+                if clash in body:
+                    raise ValueError(
+                        f"a mesh-keyed case carries its geometry in the "
+                        f"registered cloud: drop {clash!r}")
+            meta = meshes.meta(mhash)  # ValueError | UnknownMesh
+            shape = (int(meta["nodes"]),)
+            nt = int(body["nt"])
+            if nt < 1:
+                raise ValueError(f"need nt >= 1 (got {nt})")
+            case = EnsembleCase(
+                shape=shape, nt=nt, eps=0, k=float(body["k"]),
+                dt=float(body["dt"]), dh=0.0,
+                test=bool(body.get("test", False)), mesh=mhash)
+        else:
+            shape = tuple(int(s) for s in body["shape"])
+            if not 1 <= len(shape) <= 3 or any(s < 1 for s in shape):
+                raise ValueError(f"bad shape {shape}")
+            nt = int(body["nt"])
+            eps = int(body["eps"])
+            if nt < 1 or eps < 1:
+                raise ValueError(
+                    f"need nt >= 1 and eps >= 1 (got {nt}, {eps})")
+            case = EnsembleCase(
+                shape=shape, nt=nt, eps=eps, k=float(body["k"]),
+                dt=float(body["dt"]), dh=float(body["dh"]),
+                test=bool(body.get("test", False)))
         deadline = body.get("deadline_ms")
         if deadline is not None:
             if not isinstance(deadline, (int, float)) or deadline < 0:
@@ -271,6 +320,8 @@ def parse_case(body: dict) -> EnsembleCase:
         elif not case.test:
             raise ValueError("a production (test=false) case needs u0")
         return case
+    except UnknownMesh:
+        raise  # the 404, not a missing-field 400
     except KeyError as e:
         raise ValueError(f"missing case field {e.args[0]!r}") from None
 
@@ -287,10 +338,15 @@ class IngressServer:
                  admission: AdmissionController | None = None,
                  max_pending: int | None = None,
                  max_queue_wait_ms: float | None = None,
-                 sessions=None):
+                 sessions=None, mesh_dir: str | None = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.backend = backend
+        #: mesh registry root (serve/meshes.py): an explicit dir, or
+        #: None = the ambient NLHEAT_MESH_DIR knob (resolved per
+        #: request so tests and soak rigs can point it late); when both
+        #: are off the mesh endpoints 404
+        self.mesh_dir = mesh_dir
         self.admission = admission if admission is not None else \
             AdmissionController(backend, max_pending=max_pending,
                                 max_queue_wait_ms=max_queue_wait_ms)
@@ -358,11 +414,19 @@ class IngressServer:
         tr = getattr(self.backend, "_tracer", None)
         return tr if tr is not None else obs_trace.get_tracer()
 
+    def _meshes(self):
+        """The mesh registry (serve/meshes.py MeshStore), or None when
+        neither ``mesh_dir`` nor ``NLHEAT_MESH_DIR`` configures one."""
+        return resolve_mesh_store(self.mesh_dir)
+
     # -- request handling (called from handler threads) ----------------------
     def _post(self, h) -> None:
         path = h.path.rstrip("/")
         if path == "/v1/sessions" or path.startswith("/v1/sessions/"):
             self._post_session(h, path)
+            return
+        if path == "/v1/meshes":
+            self._post_mesh(h)
             return
         if path != "/v1/cases":
             h._json(404, {"error": f"no such endpoint {h.path!r}"})
@@ -389,6 +453,9 @@ class IngressServer:
             # is unservable — a client 422 naming the best infeasible
             # candidate, never a silently-slow or silently-wrong solve
             h._json(422, {"error": str(e), "refused": "picker"})
+            return
+        except UnknownMesh as e:
+            h._json(404, {"error": str(e)})
             return
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             h._json(400, {"error": str(e)})
@@ -445,8 +512,9 @@ class IngressServer:
         the fft candidate axis (ops/spectral_sharded.py — the pencil
         transform serves compatible (grid, mesh) pairs; incompatible
         ones pick on the stencil axis)."""
+        meshes = self._meshes()
         if "accuracy" not in body and "T_final" not in body:
-            return parse_case(body), None
+            return parse_case(body, meshes=meshes), None
         for bad in ("nt", "dt"):
             if bad in body:
                 raise ValueError(
@@ -465,7 +533,22 @@ class IngressServer:
         # eps < 1, u0/test rules are all the client's 400 here too
         base = {k2: v for k2, v in body.items()
                 if k2 not in ("accuracy", "T_final")}
-        parse_case(base | {"nt": 1, "dt": 1.0})
+        parse_case(base | {"nt": 1, "dt": 1.0}, meshes=meshes)
+        if body.get("mesh") is not None:
+            # the MESH axis (ISSUE 17): geometry and the stability
+            # bound come from the registered cloud (serve/picker.py
+            # _pick_mesh_engine); the grid shape/eps/dh knobs are
+            # absent by the parse_case mesh contract, so the
+            # placeholders below are ignored by the picker
+            T_final = float(body["T_final"])
+            accuracy = float(body["accuracy"])
+            picked = pick_engine(
+                (1,), 1, float(body["k"]), 1.0, T_final, accuracy,
+                deadline_ms=body.get("deadline_ms"),
+                mesh=str(body["mesh"]), mesh_dir=self.mesh_dir)
+            case = parse_case(base | {"nt": picked.steps,
+                                      "dt": picked.dt}, meshes=meshes)
+            return case, picked
         shape = tuple(int(s) for s in body["shape"])
         eps = int(body["eps"])
         k = float(body["k"])
@@ -493,8 +576,58 @@ class IngressServer:
             shape, eps, k, dh, T_final, accuracy,
             deadline_ms=body.get("deadline_ms"),
             method=ek.get("method", "auto"), allow_fft=allow_fft)
-        case = parse_case(base | {"nt": picked.steps, "dt": picked.dt})
+        case = parse_case(base | {"nt": picked.steps, "dt": picked.dt},
+                          meshes=meshes)
         return case, picked
+
+    # -- the mesh registry (serve/meshes.py) ---------------------------------
+    def _post_mesh(self, h) -> None:
+        """``POST /v1/meshes``: validate + hash + persist one point
+        cloud.  The read is BOUNDED (serve/meshes.py MAX_BODY_BYTES) —
+        an oversized declared body is refused before a byte of it is
+        read, and every validation failure is the client's 400."""
+        store = self._meshes()
+        if store is None:
+            h._json(404, {"error": "no mesh registry on this server "
+                                   "(serve/meshes.py — set mesh_dir or "
+                                   "NLHEAT_MESH_DIR)"})
+            return
+        n = int(h.headers.get("Content-Length") or 0)
+        if n > MESH_MAX_BODY_BYTES:
+            h._json(400, {"error": f"mesh upload declares {n} bytes, "
+                                   f"over the {MESH_MAX_BODY_BYTES}-"
+                                   "byte cap"})
+            return
+        try:
+            body = json.loads(h.rfile.read(n).decode() or "{}")
+            if not isinstance(body, dict):
+                raise ValueError(
+                    f"mesh body must be a JSON object, got "
+                    f"{type(body).__name__}")
+            for need in ("points", "eps"):
+                if need not in body:
+                    raise ValueError(
+                        f"a mesh upload needs {need!r} (points + eps "
+                        "field + optional vol)")
+            mhash = store.put(body["points"], body["eps"],
+                              body.get("vol"))
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            h._json(400, {"error": str(e)})
+            return
+        h._json(201, store.meta(mhash))
+
+    def _get_mesh(self, h, path: str) -> None:
+        store = self._meshes()
+        if store is None:
+            h._json(404, {"error": "no mesh registry on this server"})
+            return
+        mhash = path[len("/v1/meshes/"):]
+        try:
+            h._json(200, store.meta(mhash))
+        except UnknownMesh as e:
+            h._json(404, {"error": str(e)})
+        except ValueError as e:
+            h._json(400, {"error": str(e)})
 
     # -- the session tier (serve/sessions.py) --------------------------------
     def _read_body(self, h) -> dict:
@@ -548,7 +681,8 @@ class IngressServer:
             # session's TOTAL steps
             case = parse_case({k2: v for k2, v in body.items()
                                if k2 in ("shape", "nt", "eps", "k", "dt",
-                                         "dh", "u0", "test")})
+                                         "dh", "u0", "test", "mesh")},
+                              meshes=self._meshes())
             if case.test:
                 raise ValueError(
                     "sessions are production solves (test=false with "
@@ -556,12 +690,15 @@ class IngressServer:
                     "chunked")
             spec = SessionSpec(
                 shape=case.shape, eps=case.eps, k=case.k, dt=case.dt,
-                dh=case.dh, u0=case.u0, nt=case.nt,
+                dh=case.dh, u0=case.u0, nt=case.nt, mesh=case.mesh,
                 chunk_steps=int(body.get("chunk_steps",
                                          self.sessions.default_chunk_steps)),
                 preview_stride=body.get("preview_stride"),
                 budget_steps=body.get("budget_steps"),
                 checkpoint_every=body.get("checkpoint_every"))
+        except UnknownMesh as e:
+            h._json(404, {"error": str(e)})
+            return
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             h._json(400, {"error": str(e)})
             return
@@ -658,6 +795,9 @@ class IngressServer:
                 params[k] = v
         if path.startswith("/v1/sessions/"):
             self._get_session(h, path.rstrip("/"), params)
+            return
+        if path.startswith("/v1/meshes/"):
+            self._get_mesh(h, path.rstrip("/"))
             return
         if path == "/healthz":
             m = self.backend.metrics()

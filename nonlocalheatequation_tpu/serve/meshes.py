@@ -1,0 +1,248 @@
+"""Mesh registry: content-hashed point clouds as a serving dimension.
+
+ISSUE 17 tentpole (b)/(c): the realistic unstructured traffic shape is
+many users, FEW meshes — a mesh is uploaded once (``POST /v1/meshes``,
+serve/http.py), content-hashed, persisted under the mesh dir, and every
+case referencing the hash warm-boots the compiled gather program from
+the shared AOT store (the hash joins ``EnsembleCase.bucket_key`` and
+through it the engine's ``prog_key``/``store_key``, serve/ensemble.py).
+
+The hash covers exactly what the compiled program bakes: the node
+coordinates, the per-point horizon field, AND the derived edge table
+(build_edges is deterministic, but hashing its output means a builder
+change can never silently serve a stale stored executable against a
+different sparsity pattern — the same honesty rule as the program
+store's trace-env knobs).
+
+Trust boundary: like serve/program_store.py, the mesh dir is treated as
+private state (0700); payload validation happens at the front door
+(:func:`validate_mesh` — bounds, finiteness, dtype) so a malformed or
+oversized upload is a loud 400, never a worker crash.
+
+``partition_coarse_grid`` hook (utils/decompose.py): sharded meshes
+need spatially-compact contiguous index blocks (ShardedUnstructuredOp
+partitions by index), so :func:`gang_order` reorders nodes by the
+refined RCB cuts of a coarse tile grid — the reference's decomposition
+recipe (src/domain_decomposition.cpp:52-195) feeding gang placement.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from nonlocalheatequation_tpu.utils.checkpoint import atomic_file
+
+#: Env knob: the mesh directory.  ""/"0" = registry off, "1" = the
+#: per-user default, anything else = an explicit directory.
+MESH_DIR_ENV = "NLHEAT_MESH_DIR"
+
+DEFAULT_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "nlheat", "meshes")
+
+#: Upload bounds (validate_mesh / the HTTP front door): node count and
+#: request size.  Overridable by env for soak rigs, never per-request.
+MAX_NODES = 4_000_000
+MAX_BODY_BYTES = 256 << 20
+
+
+def mesh_dir_from_env() -> str | None:
+    """The configured mesh directory, or None when the registry is off
+    (unset/empty/``0``); ``1`` selects :data:`DEFAULT_DIR` — the
+    program store's env vocabulary."""
+    raw = os.environ.get(MESH_DIR_ENV, "")
+    if raw in ("", "0"):
+        return None
+    if raw == "1":
+        return DEFAULT_DIR
+    return raw
+
+
+def max_nodes() -> int:
+    return int(os.environ.get("NLHEAT_MESH_MAX_NODES") or MAX_NODES)
+
+
+class UnknownMesh(KeyError):
+    """A referenced mesh hash is not in the registry — the HTTP layer's
+    404 (a malformed hash is a ValueError/400 instead)."""
+
+    def __str__(self) -> str:  # KeyError repr-quotes its arg; keep the
+        return self.args[0] if self.args else ""  # message readable
+
+
+def validate_mesh(points, eps, vol=None):
+    """Normalize + validate an uploaded mesh; returns ``(points, eps,
+    vol)`` as f64 arrays.  Raises ``ValueError`` with a one-line reason
+    on anything malformed — the HTTP layer maps it to a 400."""
+    points = np.asarray(points, np.float64)
+    if points.ndim != 2:
+        raise ValueError(
+            f"mesh points must be 2-D (n, d), got shape {points.shape}")
+    n, d = points.shape
+    if not 1 <= d <= 3:
+        raise ValueError(f"mesh dimension must be 1..3, got {d}")
+    if n < 2:
+        raise ValueError(f"mesh needs at least 2 nodes, got {n}")
+    if n > max_nodes():
+        raise ValueError(
+            f"mesh has {n} nodes, over the {max_nodes()} cap "
+            "(NLHEAT_MESH_MAX_NODES)")
+    if not np.all(np.isfinite(points)):
+        raise ValueError("mesh points contain non-finite values")
+    eps = np.broadcast_to(np.asarray(eps, np.float64), (n,)).copy()
+    if not np.all(np.isfinite(eps)) or not np.all(eps > 0):
+        raise ValueError("eps field must be finite and > 0 everywhere")
+    if vol is None:
+        vol = np.ones(n)
+    vol = np.broadcast_to(np.asarray(vol, np.float64), (n,)).copy()
+    if not np.all(np.isfinite(vol)) or not np.all(vol > 0):
+        raise ValueError("vol field must be finite and > 0 everywhere")
+    return points, eps, vol
+
+
+def mesh_hash(points, eps, tgt, src) -> str:
+    """Content hash of (points, eps-field, edge table): the engine-key
+    dimension.  sha256 over shapes + raw f64/int32 bytes, truncated to
+    16 hex chars (the program store's digest discipline)."""
+    h = hashlib.sha256()
+    for a in (np.ascontiguousarray(points, np.float64),
+              np.ascontiguousarray(eps, np.float64)):
+        h.update(repr((a.shape, a.dtype.str)).encode())
+        h.update(a.tobytes())
+    for a in (np.ascontiguousarray(tgt, np.int32),
+              np.ascontiguousarray(src, np.int32)):
+        h.update(repr((a.shape, a.dtype.str)).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+class MeshStore:
+    """Dir-backed registry of validated meshes, keyed by content hash."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _path(self, mhash: str) -> str:
+        if not mhash or any(c not in "0123456789abcdef" for c in mhash):
+            # hashes come off the wire: a traversal-shaped "hash" must
+            # die here, not resolve to a path outside the dir
+            raise ValueError(f"malformed mesh hash {mhash!r}")
+        return os.path.join(self.root, f"{mhash}.npz")
+
+    def put(self, points, eps, vol=None) -> str:
+        """Validate, hash, persist; returns the content hash.  Repeat
+        uploads of the same content are idempotent (same hash, the
+        existing file wins)."""
+        points, eps, vol = validate_mesh(points, eps, vol)
+        from nonlocalheatequation_tpu.ops.unstructured import build_edges
+
+        tgt, src = build_edges(points, eps)
+        mhash = mesh_hash(points, eps, tgt, src)
+        path = self._path(mhash)
+        if not os.path.exists(path):
+            os.makedirs(self.root, mode=0o700, exist_ok=True)
+            with atomic_file(path, "wb") as f:
+                np.savez(f, points=points, eps=eps, vol=vol,
+                         tgt=tgt.astype(np.int32), src=src.astype(np.int32))
+        return mhash
+
+    def has(self, mhash: str) -> bool:
+        try:
+            return os.path.exists(self._path(mhash))
+        except ValueError:
+            return False
+
+    def get(self, mhash: str) -> dict:
+        """The stored arrays; :class:`UnknownMesh` (a KeyError) on an
+        unknown hash — the HTTP layer maps it to a 404."""
+        path = self._path(mhash)
+        if not os.path.exists(path):
+            raise UnknownMesh(f"unknown mesh hash {mhash!r}")
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+
+    def meta(self, mhash: str) -> dict:
+        d = self.get(mhash)
+        return {"hash": mhash, "nodes": int(len(d["points"])),
+                "dim": int(d["points"].shape[1]),
+                "edges": int(len(d["tgt"]))}
+
+
+def resolve_mesh_store(mesh_dir=None) -> MeshStore | None:
+    """A :class:`MeshStore` from an explicit dir or the env knob; None
+    when the registry is off."""
+    root = mesh_dir if mesh_dir is not None else mesh_dir_from_env()
+    return MeshStore(root) if root else None
+
+
+# -- mesh hash -> operator (the engine's _make_op hook) ---------------------
+
+#: (realpath(root), hash, k, dt) -> UnstructuredNonlocalOp.  Ops are
+#: immutable once built and a mesh bucket touches its op per chunk
+#: (u0 default + program build), so the registry keeps a small cache.
+_OP_CACHE: dict = {}
+_OP_CACHE_CAP = 8
+
+
+def get_mesh_op(mhash: str, k: float, dt: float, mesh_dir=None):
+    """The :class:`UnstructuredNonlocalOp` for a stored mesh under the
+    given physics.  The stored edge table is trusted (it is part of the
+    content hash) — the op rebuild verifies it matches."""
+    store = resolve_mesh_store(mesh_dir)
+    if store is None:
+        raise RuntimeError(
+            "mesh-keyed case but no mesh registry configured "
+            f"({MESH_DIR_ENV} is off)")
+    key = (os.path.realpath(store.root), mhash, float(k), float(dt))
+    op = _OP_CACHE.get(key)
+    if op is None:
+        from nonlocalheatequation_tpu.ops.unstructured import (
+            UnstructuredNonlocalOp,
+        )
+
+        d = store.get(mhash)
+        op = UnstructuredNonlocalOp(d["points"], d["eps"], k=float(k),
+                                    dt=float(dt), vol=d["vol"])
+        if (not np.array_equal(op.tgt, d["tgt"])
+                or not np.array_equal(op.src, d["src"])):
+            raise RuntimeError(
+                f"mesh {mhash}: rebuilt edge table disagrees with the "
+                "stored one — edge-builder drift; re-upload the mesh")
+        while len(_OP_CACHE) >= _OP_CACHE_CAP:
+            _OP_CACHE.pop(next(iter(_OP_CACHE)))
+        _OP_CACHE[key] = op
+    return op
+
+
+# -- gang placement (tentpole c: partition_coarse_grid feeds sharding) ------
+
+def gang_order(points: np.ndarray, ndevices: int,
+               coarse: int = 16) -> np.ndarray:
+    """A node permutation that makes index-contiguous equal blocks
+    spatially compact: bin the nodes onto a ``coarse x coarse`` tile
+    grid over their bounding box, partition the tiles with the refined
+    RCB cuts of :func:`utils.decompose.partition_coarse_grid` (the
+    reference's decomposition, src/domain_decomposition.cpp:157-195),
+    and order nodes by (owner part, tile, index).  Feeding the permuted
+    cloud to ``ShardedUnstructuredOp`` places each part's nodes on one
+    device, so the ring halo carries only true cut edges."""
+    from nonlocalheatequation_tpu.utils.decompose import (
+        partition_coarse_grid,
+    )
+
+    points = np.asarray(points, np.float64)
+    n, d = points.shape
+    if ndevices < 2 or n == 0:
+        return np.arange(n)
+    xy = points[:, :2] if d >= 2 else np.stack(
+        [points[:, 0], np.zeros(n)], axis=1)
+    lo, hi = xy.min(axis=0), xy.max(axis=0)
+    span = np.where(hi > lo, hi - lo, 1.0)
+    ij = np.minimum((coarse * (xy - lo) / span).astype(np.int64),
+                    coarse - 1)
+    owner = partition_coarse_grid(coarse, coarse, ndevices)
+    part = owner[ij[:, 0], ij[:, 1]]
+    tile = ij[:, 0] * coarse + ij[:, 1]
+    return np.lexsort((np.arange(n), tile, part))

@@ -186,7 +186,10 @@ def analytic_rate_fn(method: str, shape, eps: int,
                      precision: str) -> float:
     """Per-apply milliseconds from the backend-free analytic proxy
     (module docstring honesty note): stencil O(N (2 eps + 1)^d), fft
-    O(N_box log2 N_box)."""
+    O(N_box log2 N_box).  ``method='gather'`` (the mesh axis) rides the
+    stencil branch on purpose: with the rank-1 ``(n,)`` shape and the
+    mesh's effective eps (:func:`_mesh_eps_eff`) the same formula
+    prices O(nnz), the gather tier's true per-apply work."""
     n = 1
     for s in shape:
         n *= int(s)
@@ -296,12 +299,95 @@ def _expo_min_stages(shape, eps: int, euler_bound: float,
     return max(1, math.ceil(T_final / (r_max * euler_bound)))
 
 
+def _mesh_eps_eff(op) -> int:
+    """The mesh's effective integer eps for the RATE models: chosen so
+    the analytic stencil formula ``n * (2 eps + 1)^rank`` over the
+    rank-1 ``(n,)`` shape prices ``O(nnz)`` — the gather tier's true
+    per-apply work.  Probe records use the same key
+    (``gather/<n>/eps<e>``), so measured gather rates slot in next to
+    stencil/fft without a new rate_fn signature."""
+    mean_deg = (len(op.tgt) / op.n) if op.n else 1.0
+    return max(0, round((mean_deg - 1.0) / 2.0))
+
+
+def _pick_mesh_engine(mesh: str, k: float, T_final: float,
+                      accuracy: float, deadline_ms, rate_fn,
+                      rates_label: str, mesh_dir) -> EngineChoice:
+    """The mesh axis (ISSUE 17): candidates are the Pallas gather tier
+    (ops/pallas_gather.py) — method='gather', Euler-only (the tier has
+    no rkc/expo schedule), f32 + bf16 pair-frame precisions.  The
+    stability bound is the mesh's REAL per-point bound
+    ``1 / max(c_i * wsum_i)`` (the unstructured CLI's rule,
+    cli/solve_unstructured.py), computed from the registered cloud on
+    the host — no backend touched (wedge discipline: the ctor of
+    UnstructuredNonlocalOp is pure NumPy)."""
+    import numpy as np
+
+    from nonlocalheatequation_tpu.ops.constants import BF16_L2_BUDGET
+    from nonlocalheatequation_tpu.serve.meshes import get_mesh_op
+
+    op = get_mesh_op(mesh, k, dt=1.0, mesh_dir=mesh_dir)
+    dim = op.d
+    bound = float(np.max(op.c * op.wsum))
+    if not (bound > 0 and math.isfinite(bound)):
+        raise PickerRefusal(
+            f"mesh {mesh}: degenerate stability bound {bound!r} "
+            "(empty edge table?)")
+    eps_eff = _mesh_eps_eff(op)
+    shape = (int(op.n),)
+
+    def dt_cap(floor: float = 0.0) -> float:
+        budget = accuracy / ERR_SAFETY - floor
+        if budget <= 0:
+            return 0.0
+        return math.sqrt(budget / 0.5 ** dim) / (
+            0.5 * T_final * (2.0 * math.pi) ** 2)
+
+    candidates: list[EngineChoice] = []
+    for prec in ("f32", "bf16"):
+        cap = dt_cap(BF16_L2_BUDGET if prec == "bf16" else 0.0)
+        if cap <= 0:
+            continue
+        dt = min(0.8 / bound, cap)
+        if not math.isfinite(dt) or dt <= 0:
+            continue
+        steps = max(1, math.ceil(T_final / dt))
+        dt = T_final / steps
+        err = modeled_error(dim, T_final, dt)
+        if prec == "bf16":
+            err = err + BF16_L2_BUDGET
+        if ERR_SAFETY * err > accuracy:
+            continue
+        candidates.append(EngineChoice(
+            stepper="euler", stages=0, method="gather", precision=prec,
+            dt=dt, steps=steps,
+            est_ms=steps * rate_fn("gather", shape, eps_eff, prec),
+            est_err=err, rates=rates_label))
+    if not candidates:
+        raise PickerRefusal(
+            f"no gather engine meets accuracy {accuracy:g} for "
+            f"T_final={T_final:g} on mesh {mesh} ({op.n} nodes)")
+    candidates.sort(key=lambda ch: (ch.est_ms, ch.steps))
+    if deadline_ms is not None:
+        feasible = [ch for ch in candidates if ch.est_ms <= deadline_ms]
+        if not feasible:
+            best = candidates[0]
+            raise PickerRefusal(
+                f"no gather engine meets deadline {deadline_ms:g} ms "
+                f"at accuracy {accuracy:g} on mesh {mesh}: the "
+                f"cheapest accuracy-feasible engine models "
+                f"{best.est_ms:.1f} ms ({best.rates} rates)", best=best)
+        return feasible[0]
+    return candidates[0]
+
+
 def pick_engine(shape, eps: int, k: float, dh: float, T_final: float,
                 accuracy: float, deadline_ms: float | None = None, *,
                 method: str = "auto", rate_fn=None,
                 stages_ladder=None, allow_expo: bool | None = None,
                 allow_fft: bool = True,
-                expo_stages: int = 2) -> EngineChoice:
+                expo_stages: int = 2, mesh: str | None = None,
+                mesh_dir=None) -> EngineChoice:
     """The cheapest (stepper, stages, method, precision) engine meeting
     ``accuracy`` (error_l2/#points, the manufactured contract's units)
     and ``deadline_ms`` (None = no deadline) for a solve of ``T_final``
@@ -319,6 +405,12 @@ def pick_engine(shape, eps: int, k: float, dh: float, T_final: float,
     NLHEAT_FFT_SHARDED=0 kill-switch), which excludes fft and expo.
     ``rate_fn(method, shape, eps, precision) -> ms`` is
     the caller's measured cost model; default analytic (backend-free).
+
+    ``mesh`` (ISSUE 17) switches to the MESH axis: the hash of a
+    registered point cloud (serve/meshes.py).  Candidates are then the
+    Pallas gather tier only (:func:`_pick_mesh_engine`); ``shape``,
+    ``eps``, ``dh`` and the stepper/fft knobs are ignored — the mesh
+    carries its own geometry and stability bound.
     """
     from nonlocalheatequation_tpu.ops.constants import (
         BF16_L2_BUDGET,
@@ -333,6 +425,15 @@ def pick_engine(shape, eps: int, k: float, dh: float, T_final: float,
         raise ValueError(f"accuracy must be > 0, got {accuracy}")
     if deadline_ms is not None and deadline_ms <= 0:
         raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+    if mesh is not None:
+        if rate_fn is None:
+            mesh_rate, mesh_label = analytic_rate_fn, "analytic"
+        else:
+            mesh_rate = rate_fn
+            mesh_label = getattr(rate_fn, "provenance", "measured")
+        return _pick_mesh_engine(mesh, k, T_final, accuracy,
+                                 deadline_ms, mesh_rate, mesh_label,
+                                 mesh_dir)
     # cost-model provenance for the audit trail: an injected rate_fn is
     # the caller's measurement unless it declares otherwise (the
     # record_rate_fn closure tags itself "records")

@@ -266,6 +266,7 @@ class ReplicaRouter:
     def __init__(self, replicas: int = 1, *, depth: int = 1,
                  window_ms: float = 2.0, window_size: int | None = None,
                  program_store: str | None = None,
+                 mesh_dir: str | None = None,
                  max_outstanding: int = MAX_OUTSTANDING,
                  min_replicas: int | None = None,
                  max_replicas: int | None = None,
@@ -335,6 +336,11 @@ class ReplicaRouter:
         self.window_ms = float(window_ms)
         self.window_size = window_size
         self.program_store = program_store
+        # the mesh registry dir (ISSUE 17, serve/meshes.py): every
+        # worker resolves the SAME registry so a mesh-keyed bucket is
+        # servable wherever the sticky router pins it (None = inherit
+        # the ambient NLHEAT_MESH_DIR, the program_store convention)
+        self.mesh_dir = mesh_dir
         self.serve_kwargs = dict(serve_kwargs or {})
         self.engine_kwargs = dict(engine_kwargs)
         self.child_env = dict(child_env or {})
@@ -477,6 +483,7 @@ class ReplicaRouter:
             "window_ms": self.window_ms,
             "window_size": self.window_size,
             "program_store": self.program_store,
+            "mesh_dir": self.mesh_dir,
             "serve_kwargs": self.serve_kwargs,
             "engine_kwargs": self.engine_kwargs,
             "cpu_affinity": affinity,
@@ -1811,6 +1818,9 @@ def _worker_main(connect: str | None = None) -> None:
     store = cfg.get("program_store")
     if store is not None:
         os.environ["NLHEAT_PROGRAM_STORE"] = str(store)
+    mesh_dir = cfg.get("mesh_dir")
+    if mesh_dir is not None:
+        os.environ["NLHEAT_MESH_DIR"] = str(mesh_dir)
     rid = cfg.get("replica_id")
     # fleet tracing: a traced router hands every worker a trace_dir —
     # install the process-global tracer (so pipeline/ensemble/store

@@ -158,6 +158,11 @@ class SessionSpec:
     budget_steps: int | None = None
     budget_window_s: float = 1.0
     checkpoint_every: int | None = None
+    #: mesh-keyed session (ISSUE 17, serve/meshes.py): the content hash
+    #: of a registered point cloud.  ``shape`` is then the node count
+    #: ``(n,)`` and ``eps``/``dh`` ride as 0 — the mesh carries its own
+    #: geometry (the EnsembleCase mesh semantics, serve/ensemble.py).
+    mesh: str | None = None
 
     def validate(self) -> "SessionSpec":
         # every coercion is ASSIGNED, not just range-checked: a JSON
@@ -167,7 +172,11 @@ class SessionSpec:
         if not 1 <= len(self.shape) <= 3 or any(s < 1 for s in self.shape):
             raise ValueError(f"bad session shape {self.shape}")
         self.eps = int(self.eps)
-        if self.eps < 1:
+        if self.mesh is not None:
+            self.mesh = str(self.mesh)
+        elif self.eps < 1:
+            # a mesh-keyed session carries eps in the registered cloud
+            # (eps rides as 0); grid sessions need a real horizon
             raise ValueError(f"session eps must be >= 1, got {self.eps}")
         self.k = float(self.k)
         self.dt = float(self.dt)
@@ -227,6 +236,7 @@ class SessionSpec:
         return {
             "shape": list(self.shape), "eps": int(self.eps),
             "k": float(k), "dt": float(self.dt), "dh": float(self.dh),
+            "mesh": self.mesh,
             "nt": self.nt if self.nt is None else int(self.nt),
             "chunk_steps": int(self.chunk_steps),
             "preview_stride": int(self.preview_stride),
@@ -575,6 +585,7 @@ class SessionManager:
             u, t0, k, source = live_u, live_step, k_now, src_now
         child_spec = SessionSpec(
             shape=spec.shape, eps=spec.eps, k=k, dt=spec.dt, dh=spec.dh,
+            mesh=spec.mesh,
             u0=u, nt=spec.nt, chunk_steps=spec.chunk_steps,
             preview_stride=spec.preview_stride,
             budget_steps=spec.budget_steps,
@@ -610,6 +621,7 @@ class SessionManager:
         spec = SessionSpec(
             shape=tuple(params["shape"]), eps=params["eps"],
             k=params["k"], dt=params["dt"], dh=params["dh"], u0=u,
+            mesh=params.get("mesh"),
             nt=params.get("nt"), chunk_steps=params["chunk_steps"],
             preview_stride=params.get("preview_stride"),
             budget_steps=params.get("budget_steps"),
@@ -825,7 +837,7 @@ class SessionManager:
                 case = EnsembleCase(
                     shape=s.spec.shape, nt=n, eps=s.spec.eps, k=s.k,
                     dt=s.spec.dt, dh=s.spec.dh, test=False,
-                    u0=np.array(s.u))
+                    u0=np.array(s.u), mesh=s.spec.mesh)
                 sticky = s.sticky_key()
         if n <= 0:
             self._m_completed.inc()

@@ -207,6 +207,25 @@ kill-after-half-the-chunks + checkpoint-resume A/B (frames deduped by
 step must equal the uninterrupted stream bitwise, final f64 field
 included).  Requires BENCH_PLATFORM=cpu like BENCH_ROUTER — a fleet
 is a host measurement),
+BENCH_MESH=1 (the variable-resolution A/B — ISSUE 17,
+ops/pallas_gather.py + serve/meshes.py: the SAME manufactured problem
+to the horizon T = steps * dt_euler at the BENCH_TTA_TARGET accuracy
+(default the repo contract 1e-6) served two ways — the uniform grid^2
+stencil engine vs a graded tensor-product point cloud (fine near the
+domain center, ~4x coarser at the boundary, eps = 3x the local
+spacing) registered in a throwaway mesh store and solved through the
+Pallas strip-gather tier by mesh hash.  The mesh arm runs TWICE
+against one shared AOT program store — a cold engine (trace + compile
++ save) then a fresh warm engine (load, zero programs built) — so the
+rung measures the mesh-hash warm boot the serving tier relies on.
+The rung is labeled "variant": "mesh" and carries "points_ratio"
+(uniform points / mesh nodes, the raw variable-resolution win;
+acceptance >= 4) / "steps_ratio" (uniform steps / mesh steps — the
+coarse spacing also relaxes the Euler bound) / "warmboot_speedup"
+(cold mesh wall / warm mesh wall) / "warm_zero_built" /
+"bit_identical" (warm == cold bitwise) / "met_target" (BOTH arms'
+measured manufactured error inside the target) / "mesh_nodes" /
+"mesh_hash"),
 BENCH_ALLOW_CPU_FALLBACK (default 1:
 if the TPU never answers, measure on CPU and say so rather than emit
 0.0), BENCH_LATE_RETRY_S (default 90: after a CPU fallback, leftover
@@ -447,7 +466,11 @@ class Best:
                 # session rung: the live-session tier evidence (ISSUE 15)
                 "sessions", "frames", "frames_per_s", "deferrals",
                 "session_rate_steps_s", "batch", "bound_ms",
-                "budget_held", "resume_bit_identical", "resumed_from")
+                "budget_held", "resume_bit_identical", "resumed_from",
+                # mesh rung: the variable-resolution + mesh-hash
+                # warm-boot evidence (ISSUE 17)
+                "mesh_nodes", "mesh_hash", "mesh_steps", "points_ratio",
+                "warm_zero_built", "err_uniform", "err_mesh")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -1029,6 +1052,19 @@ def child_measure():
     if fftgang_n == 1:
         fftgang_n = 0  # the pencil mesh needs >= 2 devices; 0/1 = off
     session_n = int(os.environ.get("BENCH_SESSION", 0) or 0)
+    mesh_ab = os.environ.get("BENCH_MESH") == "1"
+    if mesh_ab and (session_n or warmboot or tta or ttafleet or fftgang_n
+                    or srv or ens or mchip or router_n or fleet_n
+                    or any(os.environ.get(k) for k in
+                           ("BENCH_CARRIED", "BENCH_RESIDENT",
+                            "BENCH_SUPERSTEP"))):
+        log("BENCH_MESH set: ignoring BENCH_SESSION/WARMBOOT/TTA/"
+            "TTA_FLEET/FFT_GANG/SERVE/ENSEMBLE/MULTICHIP/ROUTER/"
+            "FLEET_TCP/CARRIED/RESIDENT/SUPERSTEP — the mesh rung is "
+            "its own labeled variant")
+        warmboot = False
+        tta = ttafleet = False
+        srv = ens = mchip = router_n = fleet_n = fftgang_n = session_n = 0
     if session_n and (warmboot or tta or ttafleet or fftgang_n or srv
                       or ens or mchip or router_n or fleet_n
                       or any(os.environ.get(k) for k in
@@ -1125,6 +1161,163 @@ def child_measure():
             dt = 0.8 / (probe.c * probe.dh * probe.dh * probe.wsum)
             op = NonlocalOp2D(EPS, k=1.0, dt=dt, dh=1.0 / grid, method=method,
                               precision=PRECISION)
+            if mesh_ab:
+                # variable-resolution A/B (ISSUE 17): the SAME
+                # manufactured problem to T = steps * dt at the target
+                # accuracy, served by the uniform grid^2 stencil engine
+                # vs a graded point-cloud mesh (fine near the center,
+                # ~4x coarser at the boundary) through the Pallas
+                # strip-gather tier by mesh hash — plus the mesh-hash
+                # AOT warm-boot A/B (cold compile vs fresh-engine load)
+                import shutil
+                import tempfile
+
+                from nonlocalheatequation_tpu.serve.ensemble import (
+                    EnsembleCase,
+                    EnsembleEngine,
+                )
+                from nonlocalheatequation_tpu.serve.meshes import (
+                    MeshStore,
+                    get_mesh_op,
+                )
+
+                target = float(os.environ.get("BENCH_TTA_TARGET", 1e-6))
+                T = steps * dt
+                # graded tensor-product cloud on [0,1]^2: the monotone
+                # map g(xi) = xi + a*sin(2*pi*xi)/(2*pi) concentrates
+                # nodes near the center (spacing (1-a)/nm) and relaxes
+                # to (1+a)/nm at the boundary; eps tracks EPS x the
+                # local spacing and vol is the local cell volume, so
+                # the moment-matched operator stays the manufactured
+                # contract's (ops/unstructured.py)
+                nm, a = grid // 2, 0.6
+                xi = (np.arange(nm) + 0.5) / nm
+                gmap = xi + a * np.sin(2 * np.pi * xi) / (2 * np.pi)
+                gp = 1 + a * np.cos(2 * np.pi * xi)
+                X, Y = np.meshgrid(gmap, gmap, indexing="ij")
+                HX, HY = np.meshgrid(gp / nm, gp / nm, indexing="ij")
+                mpts = np.stack([X.ravel(), Y.ravel()], axis=1)
+                # the uniform arm's horizon is EPS grid spacings; the
+                # mesh keeps the SAME multiple of its local spacing so
+                # the two arms discretize the same operator family
+                meps = float(EPS) * (0.5 * (HX + HY)).ravel()
+                mvol = (HX * HY).ravel()
+                mdir = tempfile.mkdtemp(prefix="bench_mesh_")
+                sdir = tempfile.mkdtemp(prefix="bench_mesh_store_")
+                try:
+                    mhash = MeshStore(
+                        os.path.join(mdir, "meshes")).put(mpts, meps,
+                                                          mvol)
+                    os.environ["NLHEAT_MESH_DIR"] = os.path.join(
+                        mdir, "meshes")
+                    mop = get_mesh_op(mhash, 1.0, 1.0)
+                    bound = float(np.max(mop.c * mop.wsum))
+                    dt_m = 0.8 / bound
+                    nt_m = max(1, int(np.ceil(T / dt_m)))
+                    dt_m = T / nt_m
+                    case_u = EnsembleCase(shape=(grid, grid), nt=steps,
+                                          eps=EPS, k=1.0, dt=dt,
+                                          dh=1.0 / grid, test=True)
+                    case_m = EnsembleCase(shape=(nm * nm,), nt=nt_m,
+                                          eps=0, k=1.0, dt=dt_m,
+                                          dh=0.0, test=True, mesh=mhash)
+
+                    def timed(eng, case_):
+                        out = eng.run([case_])[0]  # warm the program
+                        t0 = time.perf_counter()
+                        out = eng.run([case_])[0]
+                        sync(jnp.asarray(out))
+                        return time.perf_counter() - t0, np.asarray(out)
+
+                    eng_u = EnsembleEngine(method=method,
+                                           precision=PRECISION,
+                                           batch_sizes=(1,))
+                    wall_u, out_u = timed(eng_u, case_u)
+                    # mesh arm: cold engine pays trace+compile+save
+                    # into the shared store; a FRESH engine then loads
+                    # the executable by mesh-keyed digest (the serving
+                    # tier's warm boot, spy-asserted below)
+                    cold_eng = EnsembleEngine(precision=PRECISION,
+                                              batch_sizes=(1,),
+                                              program_store=sdir)
+                    t0 = time.perf_counter()
+                    out_cold = np.asarray(cold_eng.run([case_m])[0])
+                    sync(jnp.asarray(out_cold))
+                    wall_cold = time.perf_counter() - t0
+                    warm_eng = EnsembleEngine(precision=PRECISION,
+                                              batch_sizes=(1,),
+                                              program_store=sdir)
+                    t0 = time.perf_counter()
+                    out_warm = np.asarray(warm_eng.run([case_m])[0])
+                    sync(jnp.asarray(out_warm))
+                    wall_warm = time.perf_counter() - t0
+                    zero_built = (warm_eng.report.programs_built == 0
+                                  and warm_eng.report.programs_loaded
+                                  >= 1)
+                    if not zero_built:
+                        log("WARNING: warm mesh engine built "
+                            f"{warm_eng.report.programs_built} "
+                            "program(s) — the mesh-hash store key "
+                            "failed to warm-boot")
+                    bit = bool(np.array_equal(out_cold, out_warm))
+                    if not bit:
+                        log("WARNING: warm mesh serve is NOT "
+                            "bit-identical to the cold compile")
+                    # both arms' measured manufactured error (f64
+                    # profile vs the served state — run_test_cases'
+                    # rule, serve/ensemble.py)
+                    prof_u = eng_u._make_op(case_u).spatial_profile(
+                        grid, grid)
+                    d_u = (out_u.astype(np.float64)
+                           - np.cos(2 * np.pi * T) * prof_u)
+                    err_u = float(np.sum(d_u * d_u)) / (grid * grid)
+                    prof_m = mop.spatial_profile()
+                    d_m = (out_warm.astype(np.float64)
+                           - np.cos(2 * np.pi * T) * prof_m)
+                    err_m = float(np.sum(d_m * d_m)) / (nm * nm)
+                    met = bool(err_u <= target and err_m <= target)
+                    if not met:
+                        log(f"WARNING: accuracy target {target:g} "
+                            f"missed (uniform {err_u:.3g}, mesh "
+                            f"{err_m:.3g}/point)")
+                finally:
+                    os.environ.pop("NLHEAT_MESH_DIR", None)
+                    shutil.rmtree(mdir, ignore_errors=True)
+                    shutil.rmtree(sdir, ignore_errors=True)
+                points_ratio = grid * grid / (nm * nm)
+                log(f"rung {grid}^2 mesh: uniform {steps} steps "
+                    f"{wall_u:.2f}s vs mesh {nm * nm} nodes {nt_m} "
+                    f"steps warm {wall_warm:.2f}s (points_ratio "
+                    f"{points_ratio:.1f}x, steps_ratio "
+                    f"{steps / nt_m:.1f}x, warmboot "
+                    f"{wall_cold / wall_warm:.2f}x, err "
+                    f"{err_u:.2e}/{err_m:.2e})")
+                value = grid * grid * steps / wall_u
+                event(
+                    event="rung",
+                    grid=grid,
+                    steps=steps,
+                    best_s=wall_u,
+                    ms_per_step=wall_u / steps * 1e3,
+                    value=value,
+                    variant="mesh",
+                    mesh_nodes=nm * nm,
+                    mesh_hash=mhash,
+                    mesh_steps=nt_m,
+                    points_ratio=round(points_ratio, 2),
+                    steps_ratio=round(steps / nt_m, 2),
+                    warmboot_speedup=round(wall_cold / wall_warm, 3),
+                    warm_zero_built=zero_built,
+                    bit_identical=bit,
+                    err_uniform=err_u,
+                    err_mesh=err_m,
+                    tta_target=target,
+                    met_target=met,
+                )
+                last_op = op
+                any_rung = True
+                continue
+
             if session_n:
                 # live-session tier (ISSUE 15, serve/sessions.py): N
                 # concurrent streaming sessions over a 2-replica fleet

@@ -199,6 +199,16 @@ def build_gather_L(op, dtype_name: str, precision: str = "f32"):
 # ---------------------------------------------------------------------------
 
 
+def _default_dtype() -> jnp.dtype:
+    """x64-mode state dtype OFF the TPU only: an f64 scan on the
+    tunneled chip wedges it (docs/bench/README.md), and x64 mode is a
+    CPU/oracle-suite property in this repo (tests/conftest.py)."""
+    if jax.default_backend() == "tpu":
+        return jnp.dtype(jnp.float32)
+    return jnp.dtype(jnp.float64 if jax.config.jax_enable_x64
+                     else jnp.float32)
+
+
 def make_gather_step_fn(op, dtype=None, test: bool = False,
                         precision: str = "f32"):
     """``step(u, t) -> u + dt * (L(u) + b_t)`` over the strip-gather
@@ -207,8 +217,7 @@ def make_gather_step_fn(op, dtype=None, test: bool = False,
     reference src/1d_nonlocal_serial.cpp:239-266)."""
     from nonlocalheatequation_tpu.ops.nonlocal_op import source_at
 
-    dtype = jnp.dtype(dtype) if dtype is not None else jnp.dtype(
-        jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    dtype = jnp.dtype(dtype) if dtype is not None else _default_dtype()
     L = build_gather_L(op, dtype.name, precision)
     dt = op.dt
     if test:
@@ -230,8 +239,7 @@ def make_gather_multi_step_fn(op, nt: int, dtype=None, test: bool = False,
     compiled program per (mesh, nt) whose ``lax.scan`` carries the state
     across all nt kernel invocations (one dispatch per solve, the
     tunnel-toll shape CLAUDE.md prescribes)."""
-    dtype = jnp.dtype(dtype) if dtype is not None else jnp.dtype(
-        jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    dtype = jnp.dtype(dtype) if dtype is not None else _default_dtype()
     step = make_gather_step_fn(op, dtype=dtype, test=test,
                                precision=precision)
 
@@ -254,8 +262,7 @@ def make_batched_gather_multi_step_fn(ops, nt: int, dtype=None,
     strips).  One compile, one dispatch per chunk; lane b is
     bit-identical to ``make_gather_multi_step_fn(ops[b], nt)`` by
     construction."""
-    dtype = jnp.dtype(dtype) if dtype is not None else jnp.dtype(
-        jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    dtype = jnp.dtype(dtype) if dtype is not None else _default_dtype()
     steps = [make_gather_step_fn(op, dtype=dtype, test=test,
                                  precision=precision) for op in ops]
 

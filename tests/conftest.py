@@ -40,7 +40,12 @@ for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
               # a leaked sharded-fft kill-switch / fft-gang bench knob
               # must not silently disable the spectral tier under test
               # (ops/spectral_sharded.py) or arm the fftgang bench rung
-              "NLHEAT_FFT_SHARDED", "BENCH_FFT_GANG"):
+              "NLHEAT_FFT_SHARDED", "BENCH_FFT_GANG",
+              # the mesh registry knobs (ISSUE 17, serve/meshes.py): an
+              # ambient mesh dir would make mesh-keyed cases resolve
+              # against a user registry instead of each test's tmp one,
+              # and BENCH_MESH must not arm its bench rung mid-suite
+              "NLHEAT_MESH_DIR", "NLHEAT_MESH_MAX_NODES", "BENCH_MESH"):
     os.environ.pop(_knob, None)
 # "" DISABLES autotune-cache persistence (unset means the per-user default
 # file since tuning became the on-TPU default): the suite must neither read

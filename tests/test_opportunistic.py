@@ -25,6 +25,7 @@ ALL_STEPS = [
     "servefault8x1024", "obs8x1024", "multichip1024", "fft4096",
     "tta4096", "warmboot1024", "router8x1024", "routerobs8x1024",
     "fleettcp8x1024", "ttafleet8x512", "fftgang8x4096", "session8x256",
+    "mesh4096",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",
@@ -314,6 +315,32 @@ def test_fftgang_step_banks_spectral_evidence(tmp_path):
     assert '"met_target": true' in table
     assert '"bit_identical": true' in table
     assert '"sharded"' in table  # the gang's comm/mesh recorded
+
+
+@pytest.mark.slow  # ~60 s (a gate bench + the mesh A/B child) — the
+# gather-engine machinery itself is tier-1-covered by
+# tests/test_pallas_gather.py and tests/test_unstructured.py; this
+# proves the queue's gate parses points_ratio/met_target/bit_identical/
+# warm_zero_built before banking, and the step's deliberately
+# cpu-labeled rows pass the backend-grep exemption like router8x1024
+def test_mesh_step_banks_gather_evidence(tmp_path):
+    proc, state, table, _out = _run(
+        tmp_path, "mesh4096",
+        # the 64^2 smoke grid is the same calibration the bench rung was
+        # designed at: the graded 32x32 cloud resolves the manufactured
+        # solution with exactly 4x fewer points, so the real >= 4
+        # points_ratio floor holds unrelaxed even at smoke scale
+        {"OPP_GRID_MESH": "64"}, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue complete" in proc.stdout
+    assert "mesh4096\n" in state
+    assert "fail:" not in state
+    assert '"variant": "mesh"' in table
+    assert '"points_ratio"' in table
+    assert '"met_target": true' in table
+    assert '"bit_identical": true' in table
+    assert '"warm_zero_built": true' in table
+    assert '"mesh_hash"' in table  # the registry key the evidence cites
 
 
 @pytest.mark.slow  # ~73 s: two strike rounds, each a full bench child plus

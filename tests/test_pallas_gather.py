@@ -1,0 +1,347 @@
+"""Pallas CSR strip-gather tier + mesh-hash serving (ISSUE 17).
+
+Contracts pinned here, all on the f64 8-virtual-device CPU suite
+(tests/conftest.py; off-TPU the kernels run in interpreter mode, so the
+real kernel BODY executes):
+
+* the strip-gather ``L(u)`` is <= 1e-12 of the ``segment_sum`` oracle
+  (ops/unstructured.py ``layout="edges"``) across dtypes, and the bf16
+  pair-frame tier equals the oracle applied to a bf16-rounded state —
+  the ``_bf16_round`` operand semantic of ops/nonlocal_op.py;
+* on a uniform grid-shaped cloud with the grid constant the kernel is
+  pinned <= 1e-12 to the 2-D grid stencil interior (ops/stencil.py via
+  NonlocalOp2D), and a registered grid mesh holds the manufactured
+  ``error_l2/#points <= 1e-6`` contract through the ensemble engine;
+* the scan-carried multi-step form equals the iterated per-step form,
+  and each batched lane is bit-identical to its solo scan;
+* repeat mesh-hash traffic WARM-BOOTS: second engine on the same mesh
+  + shared AOT store loads with zero programs built (store hits >= 1)
+  bit-identically — and the same spy holds through the replica-router
+  path (a fresh worker process booting from the shared store);
+* the picker's mesh axis picks the gather tier under the mesh's real
+  forward-Euler bound ``1 / max(c_i * wsum_i)``;
+* the ``POST /v1/meshes`` front door: upload -> meta -> mesh-keyed
+  solve bit-identical to the direct engine; malformed and oversized
+  uploads are refused loudly (400, Content-Length checked before any
+  body byte is read), unknown hashes 404.
+"""
+
+import http.client
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tests.cases import L2_THRESHOLD
+
+from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+from nonlocalheatequation_tpu.ops.pallas_gather import (
+    build_gather_L,
+    make_batched_gather_multi_step_fn,
+    make_gather_multi_step_fn,
+    make_gather_step_fn,
+    pack_strips,
+)
+from nonlocalheatequation_tpu.ops.unstructured import UnstructuredNonlocalOp
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase,
+    EnsembleEngine,
+    run_test_cases,
+)
+from nonlocalheatequation_tpu.serve.meshes import MeshStore, gang_order
+
+assert jax.config.jax_enable_x64  # the oracle contract (conftest forces it)
+
+
+def cloud(n=120, seed=7):
+    """Random planar cloud with a variable horizon (factor ~1.5)."""
+    rng = np.random.default_rng(seed)
+    pts = rng.uniform(size=(n, 2))
+    eps = 0.12 * (1.0 + 0.5 * rng.uniform(size=n))
+    return pts, eps
+
+
+def grid_cloud(n, dh):
+    ii, jj = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    return np.stack([ii.ravel() * dh, jj.ravel() * dh], axis=1)
+
+
+def bf16_round(u):
+    return np.asarray(jnp.asarray(u).astype(jnp.bfloat16), np.float64)
+
+
+# -- kernel parity vs the segment_sum oracle --------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["float64", "float32"])
+def test_gather_matches_segment_sum_oracle(dtype):
+    pts, eps = cloud()
+    op = UnstructuredNonlocalOp(pts, eps, k=1.0, dt=1e-4, vol=1.0 / 120)
+    rng = np.random.default_rng(1)
+    u = rng.normal(size=op.n)
+    want = np.asarray(op.apply(jnp.asarray(u), layout="edges"), np.float64)
+    got = np.asarray(build_gather_L(op, dtype)(jnp.asarray(u)), np.float64)
+    scale = np.abs(want).max()
+    tol = 1e-12 if dtype == "float64" else 1e-5
+    assert np.abs(got - want).max() <= tol * scale
+
+
+def test_bf16_pair_frame_matches_rounded_oracle():
+    pts, eps = cloud(seed=11)
+    op = UnstructuredNonlocalOp(pts, eps, k=1.0, dt=1e-4, vol=1.0 / 120)
+    rng = np.random.default_rng(2)
+    u = rng.normal(size=op.n)
+    # the tier rounds the gathered STATE once (center entry included,
+    # since the center rides as a baked column); weights and the row
+    # reduction stay in the f64 carry — so the oracle is the exact
+    # edges-layout apply of the rounded state
+    want = np.asarray(
+        op.apply(jnp.asarray(bf16_round(u)), layout="edges"), np.float64)
+    got = np.asarray(
+        build_gather_L(op, "float64", "bf16")(jnp.asarray(u)), np.float64)
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() <= 1e-12 * scale
+    # and the rounding is actually engaged (differs from the f32 tier)
+    full = np.asarray(build_gather_L(op, "float64")(jnp.asarray(u)))
+    assert np.abs(full - got).max() > 0
+
+
+def test_grid_cloud_matches_stencil_interior():
+    n, eps, dh = 16, 3, 1.0 / 16
+    gop = NonlocalOp2D(eps, k=1.0, dt=1e-4, dh=dh, method="shift")
+    uop = UnstructuredNonlocalOp(
+        grid_cloud(n, dh), eps * dh, k=1.0, dt=1e-4, vol=dh * dh, c=gop.c)
+    rng = np.random.default_rng(0)
+    u = rng.normal(size=(n, n))
+    a = gop.apply_np(u)
+    b = np.asarray(
+        build_gather_L(uop, "float64")(jnp.asarray(u.ravel()))).reshape(n, n)
+    interior = (slice(eps, n - eps),) * 2
+    scale = np.abs(a[interior]).max()
+    assert np.abs(a[interior] - b[interior]).max() <= 1e-12 * scale
+
+
+def test_strip_pack_is_cached_on_op():
+    pts, eps = cloud(n=40, seed=3)
+    op = UnstructuredNonlocalOp(pts, eps, k=1.0, dt=1e-4, vol=1.0 / 40)
+    a = pack_strips(op, "float64")
+    assert pack_strips(op, "float64") is a  # edge set immutable -> cached
+    col, w, tm, n_pad, n_upad = a
+    assert col.shape == w.shape and n_pad % tm == 0 and n_upad % 128 == 0
+
+
+def test_gather_rejects_unknown_precision():
+    pts, eps = cloud(n=24, seed=4)
+    op = UnstructuredNonlocalOp(pts, eps, k=1.0, dt=1e-4, vol=1.0 / 24)
+    with pytest.raises(ValueError, match="precision"):
+        build_gather_L(op, "float64", "f16")
+
+
+# -- step forms: scan == iterated, batched lane == solo ---------------------
+
+
+def test_multi_step_equals_iterated_steps():
+    pts, eps = cloud(n=80, seed=5)
+    op = UnstructuredNonlocalOp(pts, eps, k=1.0, dt=1e-5, vol=1.0 / 80)
+    step = make_gather_step_fn(op, test=True)
+    multi = make_gather_multi_step_fn(op, nt=5, test=True)
+    rng = np.random.default_rng(6)
+    u0 = rng.normal(size=op.n)
+    u = jnp.asarray(u0)
+    for t in range(5):
+        u = step(u, jnp.asarray(t))
+    got = np.asarray(multi(jnp.asarray(u0), 0))
+    want = np.asarray(u)
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() <= 1e-12 * scale
+
+
+def test_batched_lane_bit_identical_to_solo():
+    pts, eps = cloud(n=64, seed=8)
+    ops = [UnstructuredNonlocalOp(pts, eps, k=k, dt=1e-5, vol=1.0 / 64)
+           for k in (0.5, 1.0, 2.0)]
+    rng = np.random.default_rng(9)
+    U0 = rng.normal(size=(3, 64))
+    batched = make_batched_gather_multi_step_fn(ops, nt=4)
+    got = np.asarray(batched(jnp.asarray(U0), 0))
+    for b, op in enumerate(ops):
+        solo = np.asarray(
+            make_gather_multi_step_fn(op, nt=4)(jnp.asarray(U0[b]), 0))
+        assert np.array_equal(got[b], solo)  # stacked lane == solo scan
+
+
+# -- mesh-hash serving: engine, warm boot, picker ---------------------------
+
+
+def _register_grid_mesh(tmp_path, n=20):
+    dh = 1.0 / n
+    store = MeshStore(str(tmp_path / "meshes"))
+    mhash = store.put(grid_cloud(n, dh), 3 * dh, dh * dh)
+    return store, mhash, n * n
+
+
+def test_engine_mesh_case_manufactured_contract(tmp_path, monkeypatch):
+    store, mhash, nn = _register_grid_mesh(tmp_path)
+    monkeypatch.setenv("NLHEAT_MESH_DIR", store.root)
+    case = EnsembleCase(shape=(nn,), nt=20, eps=0, k=1.0, dt=1e-4,
+                        dh=0.0, test=True, mesh=mhash)
+    (err2, n), = run_test_cases([case])
+    assert n == nn and err2 / n <= L2_THRESHOLD
+
+
+def test_mesh_warm_boot_zero_retrace_bit_identical(tmp_path, monkeypatch):
+    store, mhash, nn = _register_grid_mesh(tmp_path)
+    monkeypatch.setenv("NLHEAT_MESH_DIR", store.root)
+    rng = np.random.default_rng(10)
+    cases = [EnsembleCase(shape=(nn,), nt=4, eps=0, k=1.0, dt=1e-5,
+                          dh=0.0, test=False, u0=rng.normal(size=nn),
+                          mesh=mhash)
+             for _ in range(2)]
+    d = str(tmp_path / "store")
+    cold_eng = EnsembleEngine(program_store=d)
+    cold = cold_eng.run(cases)
+    assert cold_eng.report.programs_built >= 1
+    assert cold_eng.program_store.stats()["saves"] >= 1
+    warm_eng = EnsembleEngine(program_store=d)
+    warm = warm_eng.run(cases)
+    # the zero-retrace spy: the stored executable IS the program
+    assert warm_eng.report.programs_built == 0
+    assert warm_eng.report.programs_loaded >= 1
+    assert warm_eng.program_store.stats()["hits"] >= 1
+    assert set(warm_eng.report.strategies.values()) == {"stored"}
+    for a, b in zip(cold, warm, strict=True):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_picker_mesh_axis_picks_gather(tmp_path):
+    from nonlocalheatequation_tpu.serve.meshes import get_mesh_op
+    from nonlocalheatequation_tpu.serve.picker import pick_engine
+
+    store, mhash, nn = _register_grid_mesh(tmp_path)
+    ch = pick_engine((1,), 0, 1.0, 1.0, T_final=5e-4, accuracy=1e-5,
+                     mesh=mhash, mesh_dir=store.root)
+    assert ch.method == "gather" and ch.stepper == "euler"
+    assert ch.precision in ("f32", "bf16")
+    # dt honors the mesh's REAL per-point forward-Euler bound
+    op = get_mesh_op(mhash, 1.0, 1.0, mesh_dir=store.root)
+    assert ch.dt <= 0.8 / float(np.max(op.c * op.wsum)) + 1e-15
+
+
+def test_gang_order_partitions_contiguously():
+    pts, _ = cloud(n=256, seed=12)
+    perm = gang_order(pts, 4)
+    assert sorted(perm) == list(range(256))  # a true permutation
+
+    # each device's contiguous index block must be MORE spatially
+    # compact than under mesh-file order (the RCB cut's whole point:
+    # the sharded operator partitions by index, so block bounding-box
+    # area is a proxy for the halo each device exchanges)
+    def area(order):
+        return sum(float(np.prod(np.ptp(pts[order[lo:lo + 64]], axis=0)))
+                   for lo in range(0, 256, 64))
+
+    assert area(perm) < 0.5 * area(np.arange(256))
+
+
+# -- front door + router warm boot (one fleet spawn, batched asserts) -------
+
+
+def _req(port, path, body=None, method=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(
+        url, data=data, method=method or ("POST" if data else "GET"))
+    try:
+        with urllib.request.urlopen(r) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_mesh_http_and_router_warm_boot(tmp_path, monkeypatch):
+    from nonlocalheatequation_tpu.serve.http import IngressServer
+    from nonlocalheatequation_tpu.serve.router import ReplicaRouter
+
+    mdir = str(tmp_path / "meshes")
+    sdir = str(tmp_path / "store")
+    pts, eps = cloud()
+    case_body = {"mesh": None, "nt": 5, "k": 1.0, "dt": 1e-4, "test": True}
+    with ReplicaRouter(replicas=1, mesh_dir=mdir,
+                       program_store=sdir) as router:
+        srv = IngressServer(0, router, mesh_dir=mdir)
+        try:
+            st, meta = _req(srv.port, "/v1/meshes",
+                            {"points": pts.tolist(), "eps": eps.tolist()})
+            assert st == 201 and meta["nodes"] == 120
+            mhash = meta["hash"]
+            st, m2 = _req(srv.port, f"/v1/meshes/{mhash}")
+            assert st == 200 and m2 == meta
+            st, e = _req(srv.port, "/v1/meshes/deadbeefdeadbeef")
+            assert st == 404
+            # malformed upload: eps wrong shape -> loud 400
+            st, e = _req(srv.port, "/v1/meshes",
+                         {"points": [[0.0, 0.0]], "eps": 0.1})
+            assert st == 400 and "error" in e
+            # oversized upload: refused on Content-Length alone, before
+            # any body byte is read (bounded ingestion)
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port)
+            try:
+                conn.putrequest("POST", "/v1/meshes")
+                conn.putheader("Content-Type", "application/json")
+                conn.putheader("Content-Length", str((256 << 20) + 1))
+                conn.endheaders()
+                resp = conn.getresponse()
+                assert resp.status == 400
+                assert b"error" in resp.read()
+            finally:
+                conn.close()
+            # mesh-keyed solve through the fleet
+            case_body["mesh"] = mhash
+            st, resp = _req(srv.port, "/v1/cases", case_body)
+            assert st == 202
+            st, done = _req(srv.port, f"/v1/cases/{resp['id']}?wait=1")
+            assert st == 200 and done["status"] == "done"
+            st, res = _req(srv.port, f"/v1/cases/{resp['id']}/result")
+            assert st == 200 and res["shape"] == [120]
+            got = np.array(res["values"])
+            # unknown mesh -> 404; mesh + grid-field clash -> 400
+            st, e = _req(srv.port, "/v1/cases",
+                         dict(case_body, mesh="deadbeefdeadbeef"))
+            assert st == 404
+            st, e = _req(srv.port, "/v1/cases",
+                         dict(case_body, shape=[120]))
+            assert st == 400 and "drop" in e["error"]
+            # the picked form routes through the mesh axis
+            st, resp = _req(srv.port, "/v1/cases",
+                            {"mesh": mhash, "k": 1.0, "T_final": 5e-4,
+                             "accuracy": 1e-5, "test": True})
+            assert st == 202 and resp["engine"]["method"] == "gather"
+            st, done = _req(srv.port, f"/v1/cases/{resp['id']}?wait=1")
+            assert done["status"] == "done"
+        finally:
+            srv.close()
+
+    # bit-identity: the direct engine on the same registered mesh
+    monkeypatch.setenv("NLHEAT_MESH_DIR", mdir)
+    want = EnsembleEngine().run(
+        [EnsembleCase(shape=(120,), nt=5, eps=0, k=1.0, dt=1e-4,
+                      dh=0.0, test=True, mesh=mhash)])[0]
+    assert np.array_equal(np.asarray(want), got)
+
+    # warm boot THROUGH the router: a fresh worker process on the same
+    # mesh dir + shared AOT store serves the bucket with zero programs
+    # built (the test_router zero-retrace spy, now keyed by mesh hash)
+    case = EnsembleCase(shape=(120,), nt=5, eps=0, k=1.0, dt=1e-4,
+                        dh=0.0, test=True, mesh=mhash)
+    with ReplicaRouter(replicas=1, mesh_dir=mdir,
+                       program_store=sdir) as router:
+        got2 = router.serve_cases([case])
+        assert np.array_equal(np.asarray(want), np.asarray(got2[0]))
+        metrics = router.refresh_stats()[0]["metrics"]
+        assert metrics["store"]["hits"] >= 1
+        assert metrics["programs_built"] == 0

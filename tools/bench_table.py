@@ -30,7 +30,10 @@ Env:
     (2) sharded cases at twice the small edge),
     BT_FFTGANG_GRID (4096 / 64) + BT_FFTGANG_DEVICES (4, the fftgang
     group's gang mesh — ISSUE 16 stencil-vs-picked-spectral A/B;
-    needs that many local/virtual devices)
+    needs that many local/virtual devices),
+    BT_MESH_GRID (512 / 64, the mesh group's uniform-grid arm — ISSUE
+    17 variable-resolution A/B vs a graded point cloud at 1/4 the
+    nodes through the Pallas strip-gather tier + mesh-hash warm boot)
 """
 
 from __future__ import annotations
@@ -1350,6 +1353,91 @@ def bench_sessions(steps: int):
          resumed_from=ra["resumed_from"])
 
 
+def bench_mesh(steps: int):
+    """Variable-resolution A/B + mesh-hash warm boot (ISSUE 17,
+    ops/pallas_gather.py + serve/meshes.py): the SAME manufactured
+    problem to T = steps * dt_euler served by the uniform grid^2
+    stencil engine vs a graded point-cloud mesh (fine near the center,
+    ~4x coarser at the boundary, eps the same multiple of the local
+    spacing) through the Pallas strip-gather tier.  The mesh arm runs
+    cold (trace + compile + save into a throwaway AOT store) then
+    through a FRESH engine (load by mesh-keyed digest, zero programs
+    built) — the graded-warm row carries the warm-boot evidence."""
+    import shutil
+    import tempfile
+
+    from nonlocalheatequation_tpu.ops.nonlocal_op import NonlocalOp2D
+    from nonlocalheatequation_tpu.serve.ensemble import (
+        EnsembleCase,
+        EnsembleEngine,
+    )
+    from nonlocalheatequation_tpu.serve.meshes import MeshStore, get_mesh_op
+
+    n = cfg("BT_MESH_GRID", 512, 64)
+    eps = 3
+    probe = NonlocalOp2D(eps, k=1.0, dt=1.0, dh=1.0 / n, method="sat")
+    dt = float(stable_dt(probe))
+    T = steps * dt
+    # the bench.py BENCH_MESH rung's graded tensor-product cloud: the
+    # monotone map concentrates nodes near the center (spacing
+    # (1-a)/nm .. (1+a)/nm), eps/vol track the local spacing
+    nm, a = n // 2, 0.6
+    xi = (np.arange(nm) + 0.5) / nm
+    g = xi + a * np.sin(2 * np.pi * xi) / (2 * np.pi)
+    gp = 1 + a * np.cos(2 * np.pi * xi)
+    X, Y = np.meshgrid(g, g, indexing="ij")
+    HX, HY = np.meshgrid(gp / nm, gp / nm, indexing="ij")
+    mdir = tempfile.mkdtemp(prefix="nlheat-bt-mesh-")
+    try:
+        mhash = MeshStore(os.path.join(mdir, "meshes")).put(
+            np.stack([X.ravel(), Y.ravel()], axis=1),
+            float(eps) * (0.5 * (HX + HY)).ravel(), (HX * HY).ravel())
+        os.environ["NLHEAT_MESH_DIR"] = os.path.join(mdir, "meshes")
+        mop = get_mesh_op(mhash, 1.0, 1.0)
+        dt_m = 0.8 / float(np.max(mop.c * mop.wsum))
+        nt_m = max(1, int(np.ceil(T / dt_m)))
+        dt_m = T / nt_m
+        case_u = EnsembleCase(shape=(n, n), nt=steps, eps=eps, k=1.0,
+                              dt=dt, dh=1.0 / n, test=True)
+        case_m = EnsembleCase(shape=(nm * nm,), nt=nt_m, eps=0, k=1.0,
+                              dt=dt_m, dh=0.0, test=True, mesh=mhash)
+        eng_u = EnsembleEngine(method="sat", batch_sizes=(1,))
+        eng_u.run([case_u])  # warm the program
+        t0 = time.perf_counter()
+        out_u = eng_u.run([case_u])[0]
+        fence(jnp.asarray(out_u))
+        wall_u = time.perf_counter() - t0
+        sdir = os.path.join(mdir, "store")
+        cold_eng = EnsembleEngine(batch_sizes=(1,), program_store=sdir)
+        t0 = time.perf_counter()
+        out_cold = cold_eng.run([case_m])[0]
+        fence(jnp.asarray(out_cold))
+        wall_cold = time.perf_counter() - t0
+        warm_eng = EnsembleEngine(batch_sizes=(1,), program_store=sdir)
+        t0 = time.perf_counter()
+        out_warm = warm_eng.run([case_m])[0]
+        fence(jnp.asarray(out_warm))
+        wall_warm = time.perf_counter() - t0
+    finally:
+        os.environ.pop("NLHEAT_MESH_DIR", None)
+        shutil.rmtree(mdir, ignore_errors=True)
+    prof_m = mop.spatial_profile()
+    d_m = np.asarray(out_warm, np.float64) - np.cos(2 * np.pi * T) * prof_m
+    emit("mesh/uniform-grid", n * n, steps, wall_u, grid=n, eps=eps)
+    emit("mesh/graded-cold", nm * nm, nt_m, wall_cold, grid=n,
+         mesh_hash=mhash, mesh_nodes=nm * nm)
+    emit("mesh/graded-warm", nm * nm, nt_m, wall_warm, grid=n,
+         mesh_hash=mhash, mesh_nodes=nm * nm,
+         points_ratio=round(n * n / (nm * nm), 2),
+         steps_ratio=round(steps / nt_m, 2),
+         warmboot_speedup=round(wall_cold / wall_warm, 3),
+         warm_zero_built=bool(warm_eng.report.programs_built == 0
+                              and warm_eng.report.programs_loaded >= 1),
+         bit_identical=bool(np.array_equal(np.asarray(out_cold),
+                                           np.asarray(out_warm))),
+         err_mesh=float(np.sum(d_m * d_m)) / (nm * nm))
+
+
 def bench_multichip(steps: int):
     """Fused-vs-collective halo A/B (round 9, ops/pallas_halo.py): the
     distributed 2D solver over ONE shared device mesh, collective halos
@@ -1414,6 +1502,7 @@ BENCHES = {
     "ttafleet": bench_fleet_tta,
     "fftgang": bench_fftgang,
     "sessions": bench_sessions,
+    "mesh": bench_mesh,
 }
 
 

@@ -101,6 +101,7 @@ STEPS="bench4096 resident512 carried4096 superstep2 \
 bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 servefault8x1024 \
 obs8x1024 multichip1024 fft4096 tta4096 warmboot1024 router8x1024 \
 routerobs8x1024 fleettcp8x1024 ttafleet8x512 fftgang8x4096 session8x256 \
+mesh4096 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
 table-unstructured3d table-eps-sweep sanity \
@@ -358,6 +359,24 @@ run_step_cmd() {  # the queue's one name->command map
         BENCH_PLATFORM=cpu \
         BENCH_GRID="${OPP_GRID_SESSION:-256}" \
         BENCH_LADDER="${OPP_GRID_SESSION:-256}" BENCH_ACCURACY=0 ;;
+    mesh4096)
+      # variable-resolution A/B + mesh-hash warm boot (ISSUE 17,
+      # ops/pallas_gather.py + serve/meshes.py): the SAME manufactured
+      # problem to T = steps * dt_euler served by the uniform 64^2
+      # (4096-point) stencil engine vs a graded point cloud at 1/4 the
+      # nodes through the Pallas strip-gather tier, the mesh arm run
+      # cold (compile + save) then through a fresh engine loading by
+      # mesh-keyed digest from the shared AOT store.  A HOST
+      # measurement like router8x1024 (the gather tier's CPU arm runs
+      # the interpreter-mode kernel body; step() exempts the backend
+      # grep).  Gate (step_variant_ok): variant mesh, points_ratio >=
+      # OPP_MESH_MIN_RATIO (default 4, the acceptance floor),
+      # met_target (BOTH arms' measured manufactured error inside the
+      # target), bit_identical + warm_zero_built (the warm-boot spy).
+      bench_nofb BENCH_MESH=1 \
+        BENCH_PLATFORM=cpu \
+        BENCH_GRID="${OPP_GRID_MESH:-64}" \
+        BENCH_LADDER="${OPP_GRID_MESH:-64}" BENCH_ACCURACY=0 ;;
     superstep2-tm128)
       bench_nofb BENCH_SUPERSTEP=2 NLHEAT_TM=128 BENCH_GRID="$GRID_LG" \
         BENCH_LADDER="$GRID_LG" BENCH_ACCURACY=0 ;;
@@ -720,6 +739,35 @@ for line in open(sys.argv[1]):
 sys.exit(0 if ok else 1)
 PYEOF
       ;;
+    mesh4096) python - "$2" <<'PYEOF'
+import json, os, sys
+# the ISSUE 17 gate: the graded mesh must honestly beat the uniform
+# grid at equal accuracy — points_ratio >= OPP_MESH_MIN_RATIO (default
+# 4, the acceptance floor), met_target MEASURED on both arms (a mesh
+# that misses the manufactured contract voids the row), and the
+# mesh-hash warm boot spy-pinned (fresh engine loads from the shared
+# AOT store bit-identically with zero programs built).
+limit = float(os.environ.get("OPP_MESH_MIN_RATIO", "4"))
+ok = False
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    if r.get("variant") != "mesh":
+        continue
+    ratio = r.get("points_ratio")
+    if not isinstance(ratio, (int, float)) or ratio < limit:
+        continue
+    if r.get("met_target") is True and r.get("bit_identical") is True \
+            and r.get("warm_zero_built") is True:
+        ok = True
+sys.exit(0 if ok else 1)
+PYEOF
+      ;;
     tm160 | tm192 | tm224 | tm256) grep -q "\"tm\": ${1#tm}" "$2" ;;
     *) return 0 ;;
   esac
@@ -740,7 +788,7 @@ step() {  # <name>: run one queue step unless already done.
   local run rc backend_check=step_backend_ok
   case $name in
     router8x1024 | routerobs8x1024 | fleettcp8x1024 | ttafleet8x512 \
-      | fftgang8x4096 | session8x256)
+      | fftgang8x4096 | session8x256 | mesh4096)
       # deliberately host measurements (see run_step_cmd): the fleet
       # proxies pin BENCH_PLATFORM=cpu because N replica processes
       # cannot share the single tunneled chip — their rows are cpu-

@@ -134,6 +134,21 @@ carries "trace_overhead" = traced/untraced wall ratio (the PR 5
 armed after the warm pass — a steady-state fleet must report 0), and
 "bit_identical"; set it to a DIRECTORY path (anything other than "1")
 to keep the merged artifact there),
+BENCH_SLO=N (N >= 2: the SLO promise-audit A/B — ISSUE 20, obs/slo.py
++ serve/router.py router_slo_ab: the SAME mixed-bucket case set served
+by two N-replica routers over ONE shared AOT store dir, once unaudited
+(slo=False, NLHEAT_SLO=0 in the workers) and once fully audited
+(router promise/outcome ledger + per-worker pipeline ledgers with live
+rate recalibration), then a deliberately corrupted pass (est_ms scaled
+1000x) that must fire the cost-model drift warning.  The rung is
+labeled "variant": "sloN" and carries "slo_overhead" = audited/
+unaudited wall ratio (the ISSUE 20 <= 1.05 gate), "deadline_hit_rate"
+(must be 1.0 unloaded), "drift_ratio_p50", "drift_fired_clean" (must
+stay False), "drift_fired_corrupt" (must be True), the ledger "slo"
+balance block, and "bit_identical"; reuses BENCH_ROUTER_CASES /
+BENCH_ROUTER_STEPS / BENCH_ROUTER_DIR for the workload so the walls
+stay comparable with the router rows, and requires BENCH_PLATFORM=cpu
+like BENCH_ROUTER),
 BENCH_FLEET_TCP=N (N >= 2: the worker-transport A/B + sharded big-case
 tier — ISSUE 12, serve/transport.py + serve/router.py fleet_tcp_ab:
 BENCH_FLEET_CASES mixed-bucket small cases served by an N-replica
@@ -470,7 +485,10 @@ class Best:
                 # mesh rung: the variable-resolution + mesh-hash
                 # warm-boot evidence (ISSUE 17)
                 "mesh_nodes", "mesh_hash", "mesh_steps", "points_ratio",
-                "warm_zero_built", "err_uniform", "err_mesh")
+                "warm_zero_built", "err_uniform", "err_mesh",
+                # slo rung: the promise-audit ledger evidence (ISSUE 20)
+                "slo_overhead", "deadline_hit_rate", "drift_ratio_p50",
+                "drift_fired_clean", "drift_fired_corrupt", "slo")
                if k in rung},
             **baseline_basis(base),
             **meta,
@@ -1046,6 +1064,15 @@ def child_measure():
             "the fleettcp rung is its own labeled variant")
         router_n = 0
         os.environ.pop("BENCH_TRACE_FLEET", None)
+    slo_n = int(os.environ.get("BENCH_SLO", 0) or 0)
+    if slo_n == 1:
+        slo_n = 0  # the A/B needs a fleet; 0/1 mean off
+    if slo_n and (router_n or fleet_n
+                  or os.environ.get("BENCH_TRACE_FLEET")):
+        log("BENCH_SLO set: ignoring BENCH_ROUTER/TRACE_FLEET/FLEET_TCP "
+            "— the slo rung is its own labeled variant")
+        router_n = fleet_n = 0
+        os.environ.pop("BENCH_TRACE_FLEET", None)
     tta = os.environ.get("BENCH_TTA") == "1"
     ttafleet = os.environ.get("BENCH_TTA_FLEET") == "1"
     fftgang_n = int(os.environ.get("BENCH_FFT_GANG", 0) or 0)
@@ -1055,57 +1082,61 @@ def child_measure():
     mesh_ab = os.environ.get("BENCH_MESH") == "1"
     if mesh_ab and (session_n or warmboot or tta or ttafleet or fftgang_n
                     or srv or ens or mchip or router_n or fleet_n
+                    or slo_n
                     or any(os.environ.get(k) for k in
                            ("BENCH_CARRIED", "BENCH_RESIDENT",
                             "BENCH_SUPERSTEP"))):
         log("BENCH_MESH set: ignoring BENCH_SESSION/WARMBOOT/TTA/"
             "TTA_FLEET/FFT_GANG/SERVE/ENSEMBLE/MULTICHIP/ROUTER/"
-            "FLEET_TCP/CARRIED/RESIDENT/SUPERSTEP — the mesh rung is "
-            "its own labeled variant")
+            "FLEET_TCP/SLO/CARRIED/RESIDENT/SUPERSTEP — the mesh rung "
+            "is its own labeled variant")
         warmboot = False
         tta = ttafleet = False
         srv = ens = mchip = router_n = fleet_n = fftgang_n = session_n = 0
+        slo_n = 0
     if session_n and (warmboot or tta or ttafleet or fftgang_n or srv
-                      or ens or mchip or router_n or fleet_n
+                      or ens or mchip or router_n or fleet_n or slo_n
                       or any(os.environ.get(k) for k in
                              ("BENCH_CARRIED", "BENCH_RESIDENT",
                               "BENCH_SUPERSTEP"))):
         log("BENCH_SESSION set: ignoring BENCH_WARMBOOT/TTA/TTA_FLEET/"
-            "FFT_GANG/SERVE/ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/CARRIED/"
-            "RESIDENT/SUPERSTEP — the session rung is its own labeled "
-            "variant")
+            "FFT_GANG/SERVE/ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/SLO/"
+            "CARRIED/RESIDENT/SUPERSTEP — the session rung is its own "
+            "labeled variant")
         warmboot = False
         tta = ttafleet = False
-        srv = ens = mchip = router_n = fleet_n = fftgang_n = 0
+        srv = ens = mchip = router_n = fleet_n = fftgang_n = slo_n = 0
     if warmboot and (tta or ttafleet or fftgang_n or srv or ens or mchip
-                     or router_n or fleet_n
+                     or router_n or fleet_n or slo_n
                      or any(os.environ.get(k) for k in
                             ("BENCH_CARRIED", "BENCH_RESIDENT",
                              "BENCH_SUPERSTEP"))):
         log("BENCH_WARMBOOT set: ignoring BENCH_TTA/TTA_FLEET/FFT_GANG/"
-            "SERVE/ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/CARRIED/RESIDENT/"
-            "SUPERSTEP — the warmboot rung is its own labeled variant")
+            "SERVE/ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/SLO/CARRIED/"
+            "RESIDENT/SUPERSTEP — the warmboot rung is its own labeled "
+            "variant")
         tta = ttafleet = False
-        srv = ens = mchip = router_n = fleet_n = fftgang_n = 0
+        srv = ens = mchip = router_n = fleet_n = fftgang_n = slo_n = 0
     if ttafleet and (tta or fftgang_n or srv or ens or mchip or router_n
-                     or fleet_n
+                     or fleet_n or slo_n
                      or any(os.environ.get(k) for k in
                             ("BENCH_CARRIED", "BENCH_RESIDENT",
                              "BENCH_SUPERSTEP"))):
         log("BENCH_TTA_FLEET set: ignoring BENCH_TTA/FFT_GANG/SERVE/"
-            "ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/CARRIED/RESIDENT/"
+            "ENSEMBLE/MULTICHIP/ROUTER/FLEET_TCP/SLO/CARRIED/RESIDENT/"
             "SUPERSTEP — the ttafleet rung is its own labeled variant")
         tta = False
-        srv = ens = mchip = router_n = fleet_n = fftgang_n = 0
+        srv = ens = mchip = router_n = fleet_n = fftgang_n = slo_n = 0
     if fftgang_n and (tta or srv or ens or mchip or router_n or fleet_n
+                      or slo_n
                       or any(os.environ.get(k) for k in
                              ("BENCH_CARRIED", "BENCH_RESIDENT",
                               "BENCH_SUPERSTEP"))):
         log("BENCH_FFT_GANG set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
-            "MULTICHIP/ROUTER/FLEET_TCP/CARRIED/RESIDENT/SUPERSTEP — "
-            "the fftgang rung is its own labeled variant")
+            "MULTICHIP/ROUTER/FLEET_TCP/SLO/CARRIED/RESIDENT/SUPERSTEP "
+            "— the fftgang rung is its own labeled variant")
         tta = False
-        srv = ens = mchip = router_n = fleet_n = 0
+        srv = ens = mchip = router_n = fleet_n = slo_n = 0
     if fleet_n and (tta or srv or ens or mchip
                     or any(os.environ.get(k) for k in
                            ("BENCH_CARRIED", "BENCH_RESIDENT",
@@ -1121,6 +1152,15 @@ def child_measure():
                              "BENCH_SUPERSTEP"))):
         log("BENCH_ROUTER set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
             "MULTICHIP/CARRIED/RESIDENT/SUPERSTEP — the router rung is "
+            "its own labeled variant")
+        tta = False
+        srv = ens = mchip = 0
+    if slo_n and (tta or srv or ens or mchip
+                  or any(os.environ.get(k) for k in
+                         ("BENCH_CARRIED", "BENCH_RESIDENT",
+                          "BENCH_SUPERSTEP"))):
+        log("BENCH_SLO set: ignoring BENCH_TTA/SERVE/ENSEMBLE/"
+            "MULTICHIP/CARRIED/RESIDENT/SUPERSTEP — the slo rung is "
             "its own labeled variant")
         tta = False
         srv = ens = mchip = 0
@@ -1916,6 +1956,102 @@ def child_measure():
                               "p99_ms": round(
                                   run["latency_s"]["p99"] * 1e3, 3)}
                         for lbl, run in ab["sweep"].items()},
+                    bit_identical=bit,
+                )
+                last_op = op
+                any_rung = True
+                continue
+            if slo_n:
+                # SLO promise-audit A/B (ISSUE 20, obs/slo.py +
+                # serve/router.py): the SAME mixed-bucket case set
+                # served by two N-replica fleets over ONE shared AOT
+                # store dir — once unaudited (ledger off everywhere),
+                # once with the full promise/outcome ledger on (router
+                # + per-worker pipelines + live rate recalibration) —
+                # then a corrupted pass (modeled cost scaled 1000x)
+                # that must fire the drift warning.  The overhead
+                # ratio is the ISSUE 20 <= 1.05 gate; the arms must
+                # stay bit-identical because auditing never touches
+                # the numerics.
+                if backend == "tpu":
+                    # same constraint as BENCH_ROUTER: N replica
+                    # processes cannot share the single tunneled chip
+                    raise RuntimeError(
+                        "BENCH_SLO needs BENCH_PLATFORM=cpu: replica "
+                        "fleets assume one accelerator per worker and "
+                        "the tunneled single chip cannot host N clients")
+                import shutil
+                import tempfile
+
+                from nonlocalheatequation_tpu.serve.ensemble import (
+                    EnsembleCase,
+                )
+                from nonlocalheatequation_tpu.serve.router import (
+                    router_slo_ab,
+                )
+
+                # the slo rung reuses the router rung's case knobs —
+                # the workload is deliberately identical so the two
+                # variants' walls are comparable across history rows
+                C = int(os.environ.get("BENCH_ROUTER_CASES", 16))
+                buckets = max(slo_n, min(8, C))
+                rsteps = int(os.environ.get("BENCH_ROUTER_STEPS", 0) or 0) \
+                    or max(steps, int(1e8 // (grid * grid)) or 1)
+                rcases = [
+                    EnsembleCase(shape=(grid, grid),
+                                 nt=rsteps + (i % buckets), eps=EPS,
+                                 k=1.0, dt=dt, dh=1.0 / grid, test=False,
+                                 u0=rng.normal(size=(grid, grid)))
+                    for i in range(C)]
+                store_dir = os.environ.get("BENCH_ROUTER_DIR")
+                own_dir = store_dir is None
+                if own_dir:
+                    store_dir = tempfile.mkdtemp(prefix="nlheat-slo-")
+                try:
+                    ab = router_slo_ab(
+                        {"method": method, "precision": PRECISION,
+                         "batch_sizes": (1,)},
+                        rcases, slo_n, store_dir)
+                finally:
+                    if own_dir:
+                        shutil.rmtree(store_dir, ignore_errors=True)
+                bit = all(np.array_equal(a, b) for a, b in
+                          zip(ab["results"]["unaudited"],
+                              ab["results"]["audited"], strict=True))
+                if not bit:
+                    log("WARNING: slo arms are NOT bit-identical — "
+                        "auditing must never change served results")
+                total_steps = sum(c.nt for c in rcases)
+                wall_a = ab["walls"]["audited"]
+                s = ab["slo"] or {}
+                log(f"rung {grid}^2 slo: unaudited "
+                    f"{ab['walls']['unaudited']:.2f}s vs audited "
+                    f"{wall_a:.2f}s ({ab['slo_overhead']:.3f}x); "
+                    f"deadline hit rate {ab['deadline_hit_rate']:.3f}, "
+                    f"drift p50 {s.get('drift_ratio_p50')}, corrupt "
+                    f"drift fired={ab['drift_fired_corrupt']}")
+                value = grid * grid * total_steps / wall_a
+                event(
+                    event="rung",
+                    grid=grid,
+                    steps=rsteps,
+                    best_s=wall_a,
+                    ms_per_step=wall_a / rsteps * 1e3,
+                    value=value,
+                    variant=f"slo{slo_n}",
+                    replicas=slo_n,
+                    cases=C,
+                    slo_overhead=round(ab["slo_overhead"], 4),
+                    deadline_hit_rate=ab["deadline_hit_rate"],
+                    drift_ratio_p50=s.get("drift_ratio_p50"),
+                    drift_fired_clean=ab["drift_fired_clean"],
+                    drift_fired_corrupt=ab["drift_fired_corrupt"],
+                    slo={"promised": s.get("promised"),
+                         "resolved": s.get("resolved"),
+                         "open": s.get("open"),
+                         "duplicate": s.get("duplicate"),
+                         "unmatched": s.get("unmatched"),
+                         "burn": s.get("burn")},
                     bit_identical=bit,
                 )
                 last_op = op

@@ -824,6 +824,20 @@ def add_listen_flags(p: argparse.ArgumentParser):
         help="--shard-threshold: devices in the gang replica's mesh "
              "(default: every device the gang worker sees)",
     )
+    p.add_argument(
+        "--slo",
+        type=int,
+        default=None,
+        choices=(0, 1),
+        metavar="0|1",
+        help="--listen: the SLO promise-audit ledger (obs/slo.py, "
+             "ISSUE 20) — 1 joins every accepted request's promise "
+             "(picked engine, modeled cost, deadline) to its observed "
+             "outcome: /slo/* metrics, the GET /v1/status burn/drift "
+             "block, and per-worker live rate recalibration back into "
+             "the autotune records; 0 forces off; unset defers to "
+             "NLHEAT_SLO=1",
+    )
     # the live-session tier (ISSUE 15, serve/sessions.py): POST
     # /v1/sessions opens a stateful streaming simulation on the same
     # fleet; these knobs configure its budgets and crash-safety
@@ -898,6 +912,7 @@ def validate_listen_args(args, dim: int | None = None) -> str | None:
                             "--shard-threshold"),
                            (getattr(args, "gang_devices", None),
                             "--gang-devices"),
+                           (getattr(args, "slo", None), "--slo"),
                            (getattr(args, "session_chunk", None),
                             "--session-chunk"),
                            (getattr(args, "session_budget", None),
@@ -1015,7 +1030,15 @@ def run_listen(args, engine_kwargs) -> int:
     # Perfetto timeline next to the per-process artifacts
     trace_dir = (getattr(args, "trace", None)
                  or os.environ.get("NLHEAT_TRACE") or None)
+    # --slo pins the env so the WORKERS inherit it (serve/router.py
+    # spawns copy os.environ): one flag audits the whole fleet — the
+    # router's promise ledger and every worker pipeline's, including
+    # the live rate write-back into the autotune records
+    slo = getattr(args, "slo", None)
+    if slo is not None:
+        os.environ["NLHEAT_SLO"] = str(int(slo))
     with ReplicaRouter(replicas=args.replicas,
+                       slo=(bool(slo) if slo is not None else None),
                        depth=1,
                        window_ms=args.serve_window_ms,
                        serve_kwargs=serve_kwargs,
@@ -1070,7 +1093,8 @@ def run_listen(args, engine_kwargs) -> int:
                 print(f"ingress: http://127.0.0.1:{ingress.port}/v1/cases "
                       f"({args.replicas} replica(s); POST to submit, "
                       "/v1/sessions opens a live stream, /healthz, "
-                      "/metrics; EOF on stdin stops the server)",
+                      "/v1/status, /metrics; EOF on stdin stops the "
+                      "server)",
                       file=sys.stderr)
                 for _line in sys.stdin:  # lifetime = stdin
                     pass

@@ -53,6 +53,9 @@ anything with ``submit``/``outstanding_total``/``retry_after_s``):
   gather program from the shared AOT store (serve/ensemble.py).
   ``GET /v1/meshes/<hash>`` returns the stored mesh's metadata.
 * ``GET /healthz`` — liveness + fleet summary.
+* ``GET /v1/status`` — the one-page fleet health document (ISSUE 20):
+  replica liveness/breakers/staleness, admission counters, sessions,
+  and the SLO ledger's burn/drift block when auditing is on.
 * ``GET /metrics`` / ``/metrics.json`` — the backend registry's
   Prometheus/JSON exposition (the router's registry already aggregates
   per-replica namespaces; obs/export.py renders it).
@@ -818,6 +821,9 @@ class IngressServer:
                 body["sessions"] = self.sessions._active_count()
             h._json(200, body)
             return
+        if path == "/v1/status":
+            self._get_status(h)
+            return
         if path.startswith("/metrics"):
             regs = [self.backend.registry]
             if path.startswith("/metrics.json"):
@@ -879,6 +885,74 @@ class IngressServer:
             h._json(200, {"id": seq,
                           "shape": list(req.result.shape),
                           "values": req.result.ravel().tolist()})
+
+    def _get_status(self, h) -> None:
+        """``GET /v1/status``: the one-page fleet health document
+        (ISSUE 20) — replica liveness/draining/breaker/scrape-staleness,
+        in-flight accounting, ingress admission counters, the session
+        tier, and the SLO block (burn, drift, per-axis hit rates) when
+        the ledger is on.  Assembled from state this process ALREADY
+        holds (backend metrics, the registry, each replica's last
+        absorbed stats frame) — a status poll never broadcasts to the
+        fleet, so dashboards can hammer it.  Router-shaped stubs and
+        plain pipelines stay valid: every field is read defensively."""
+        m = self.backend.metrics()
+        reg = getattr(self.backend, "registry", None)
+
+        def metric(name):
+            try:
+                g = reg.get(name) if reg is not None else None
+                return g.value if g is not None else None
+            except Exception:  # noqa: BLE001 — status must render
+                return None
+
+        body = {
+            "ok": (m.get("replicas") or 0) > 0 or "replicas" not in m,
+            "replicas": m.get("replicas"),
+            "gang": len(m.get("gang") or []),
+            "transport": m.get("transport"),
+            "cases": m.get("cases"),
+            "outstanding": m.get("outstanding"),
+            "deaths": m.get("deaths", 0),
+            "requeued": m.get("requeued", 0),
+            "spawns": m.get("spawns", 0),
+            "scale_ups": m.get("scale_ups", 0),
+            "scale_downs": m.get("scale_downs", 0),
+            "buckets": m.get("buckets"),
+            "request_latency_ms": m.get("request_latency_ms") or {},
+            "ingress": {
+                "accepted": metric("/ingress/accepted"),
+                "shed": metric("/ingress/shed"),
+                "retry_after_s": metric("/ingress/retry-after-s"),
+                "session_steps": metric("/ingress/session-steps"),
+                "session_deferred": metric("/ingress/session-deferred"),
+            },
+        }
+        # per-replica rows: the router's routing view, the scrape
+        # staleness label (ISSUE 11), and the breaker state from the
+        # replica's last absorbed stats frame (no new pull)
+        reps = getattr(self.backend, "_replicas", None) or {}
+        per = {}
+        for rid, info in (m.get("per_replica") or {}).items():
+            row = dict(info)
+            stale = metric(f"/replica{{{rid}}}/stale")
+            if stale is not None:
+                row["stale"] = bool(stale)
+            frame = getattr(reps.get(rid), "last_stats", None) or {}
+            br = (frame.get("metrics") or {}).get("breaker") or {}
+            if br:
+                row["breaker"] = {
+                    "state": br.get("state"),
+                    "transitions": br.get("transition_count"),
+                }
+            per[str(rid)] = row
+        if per:
+            body["per_replica"] = per
+        if self.sessions is not None:
+            body["sessions"] = self.sessions._active_count()
+        if m.get("slo") is not None:
+            body["slo"] = m["slo"]
+        h._json(200, body)
 
     def _note_done(self, seq: int) -> None:
         """Age out old completed requests (bounded retention)."""

@@ -130,7 +130,7 @@ class EngineChoice:
     steps: int
     est_ms: float
     est_err: float
-    rates: str  # "measured" | "records" | "analytic"
+    rates: str  # "measured" | "live" | "records" | "analytic"
 
     def engine_kwargs(self) -> dict:
         """The EnsembleEngine/sibling settings of this choice."""
@@ -210,16 +210,25 @@ def analytic_rate_fn(method: str, shape, eps: int,
 def record_rate_fn(device_kind: str, dtype_name: str = "float32",
                    version: str | None = None):
     """A rate_fn over the autotuner's persisted probe records
-    (utils/autotune file cache): per-apply ms from each method's
-    ``per-step`` entry where one exists, the analytic proxy otherwise.
+    (utils/autotune file cache): per-apply ms from each record's LIVE
+    recalibrated rate when serving traffic has banked one (obs/slo.py
+    ``LiveRateRecorder`` — the ISSUE 20 feedback loop), else the probed
+    ``per-step`` entry where one exists, else the analytic proxy.
     ``device_kind`` is the CALLER's knowledge (a worker that already
     touched its backend, a bench that measured) — the picker itself
-    stays backend-free."""
+    stays backend-free.  The closure's ``provenance`` reports ``"live"``
+    when any loaded record carries a live rate (the EngineChoice.rates
+    audit label then names the freshest source a lookup can hit),
+    ``"records"`` otherwise."""
     from nonlocalheatequation_tpu.utils.autotune import _load_file_cache
 
     if version is None:
         from nonlocalheatequation_tpu import __version__ as version
     cache = _load_file_cache()
+
+    def _num(v):
+        return (float(v) if isinstance(v, (int, float))
+                and not isinstance(v, bool) else None)
 
     def rate(method, shape, eps, precision):
         key = "/".join(
@@ -228,12 +237,16 @@ def record_rate_fn(device_kind: str, dtype_name: str = "float32",
              dtype_name]
             + ([f"prec-{precision}"] if precision != "f32" else []))
         entry = cache.get(key) or {}
-        ms = (entry.get("ms_per_step") or {}).get("per-step")
-        if isinstance(ms, (int, float)) and not isinstance(ms, bool):
-            return float(ms)
+        ms = _num(((entry.get("live") or {}).get("per-step")))
+        if ms is None:
+            ms = _num((entry.get("ms_per_step") or {}).get("per-step"))
+        if ms is not None:
+            return ms
         return analytic_rate_fn(method, shape, eps, precision)
 
-    rate.provenance = "records"  # the EngineChoice.rates audit label
+    rate.provenance = "live" if any(
+        _num(((e or {}).get("live") or {}).get("per-step")) is not None
+        for e in cache.values() if isinstance(e, dict)) else "records"
     return rate
 
 

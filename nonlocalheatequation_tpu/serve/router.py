@@ -95,6 +95,7 @@ import time
 import numpy as np
 
 from nonlocalheatequation_tpu.obs import flightrec
+from nonlocalheatequation_tpu.obs import slo as obs_slo
 from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.obs.export import REPLICA_ID_ENV
 from nonlocalheatequation_tpu.obs.metrics import (
@@ -286,6 +287,7 @@ class ReplicaRouter:
                  tracer=None, trace_dir: str | None = None,
                  flight_dir: str | None = None,
                  stale_after_s: float = 60.0,
+                 slo=None,
                  **engine_kwargs):
         replicas = int(replicas)
         if replicas < 1:
@@ -415,6 +417,13 @@ class ReplicaRouter:
         self._m_max_outstanding.set(self.max_outstanding)
         self._m_buckets = r.gauge("/router/buckets")
         self._h_latency = r.histogram("/router/request-latency-ms")
+        # the fleet SLO ledger (ISSUE 20, obs/slo.py): promises at
+        # submit, outcomes at the result frame.  ``live=False`` — the
+        # router never touches a backend (wedge discipline), so only
+        # the WORKERS recalibrate rates; their /slo/* metrics ride the
+        # stats-frame snapshots absorbed under /replica{r}/slo/*.
+        self._slo = obs_slo.SloLedger.from_arg(
+            slo, registry=self.registry, clock=clock, live=False)
         # the router's shared state is written from the caller's thread,
         # every per-replica reader thread, and the elastic scale loop;
         # the guarded_by annotations are ENFORCED by graftlint L1
@@ -576,6 +585,15 @@ class ReplicaRouter:
                 self._pending.pop(msg["id"], None)
                 self._m_outstanding.set(self.outstanding_total())
             self._h_latency.observe(req.latency_s * 1e3)
+            if self._slo is not None:
+                # the promise/outcome join: exactly once per case — the
+                # delivery ledger above already dropped late frames for
+                # re-routed cases, so a duplicate here would be a
+                # regression the ledger's /slo/duplicate counter names
+                self._slo.resolve(
+                    req.seq, latency_s=req.latency_s,
+                    error=(None if op == "result"
+                           else msg.get("classification", "error")))
             req.done.set()
         elif op == "stats":
             waiter = rep.stats_waiters.pop(msg.get("id"), None)
@@ -654,6 +672,10 @@ class ReplicaRouter:
                                        req.requeues,
                                        "re-routed past MAX_REQUEUES "
                                        "(replica-killing case?)")
+                if self._slo is not None:
+                    self._slo.resolve(
+                        req.seq, latency_s=self._clock() - req.submit_t,
+                        error="replica-death")
                 req.done.set()
                 decisions.append({"case": req.seq, "action": "quarantine",
                                   "requeues": req.requeues})
@@ -678,6 +700,10 @@ class ReplicaRouter:
                     self._pending.pop(req.seq, None)
                 req.error = ServeError("error", req.seq, -1, 0,
                                        f"re-route failed: {e}")
+                if self._slo is not None:
+                    self._slo.resolve(
+                        req.seq, latency_s=self._clock() - req.submit_t,
+                        error="re-route-failed")
                 req.done.set()
                 decisions.append({"case": req.seq, "action": "failed",
                                   "detail": str(e)})
@@ -836,6 +862,14 @@ class ReplicaRouter:
             with self._lock:
                 self._pending.pop(req.seq, None)
             raise
+        if self._slo is not None:
+            # promise AFTER the route sticks: a shed request (429 at
+            # the ingress tier) never becomes an SLO promise, so burn
+            # measures promises the fleet actually accepted
+            self._slo.promise(req.seq, engine=req.engine,
+                              deadline_ms=req.deadline_ms,
+                              mesh=getattr(req.case, "mesh", None),
+                              t=req.submit_t)
         return req
 
     def _route(self, req: RouterRequest, force: bool = False) -> None:
@@ -1166,7 +1200,7 @@ class ReplicaRouter:
                         "buckets": len(r.buckets), "alive": r.alive,
                         "draining": r.draining, "gang": r.gang}
                 for r in self._replicas.values()}
-        return {
+        out = {
             "replicas": len(live),
             "live": live,
             "gang": gang,
@@ -1186,6 +1220,9 @@ class ReplicaRouter:
             "request_latency_ms": self._h_latency.percentiles(),
             "per_replica": per_replica,
         }
+        if self._slo is not None:
+            out["slo"] = self._slo.summary()
+        return out
 
     def close(self) -> None:
         """Stop the fleet.  Outstanding handles complete exceptionally
@@ -1215,6 +1252,12 @@ class ReplicaRouter:
             if not req.done.is_set():
                 req.error = ServeError("error", req.seq, -1, 0,
                                        "router closed")
+                if self._slo is not None:
+                    # no open promises left behind: the chaos-consistency
+                    # test asserts promised == resolved after close
+                    self._slo.resolve(
+                        req.seq, latency_s=self._clock() - req.submit_t,
+                        error="router-closed")
                 req.done.set()
 
     def __enter__(self):
@@ -1399,6 +1442,127 @@ def router_traced_ab(engine_kwargs: dict, cases, replicas: int,
         "merged": merged,
         "spans_total": spans_total,
         "steady_state_builds": steady,
+    }
+
+
+def router_slo_ab(engine_kwargs: dict, cases, replicas: int,
+                  store_dir: str | None, *, window_ms: float = 2.0,
+                  deadline_ms: float = 60_000.0,
+                  corrupt_factor: float = 1e3,
+                  cpus_per_replica: int | None = None,
+                  child_env: dict | None = None) -> dict:
+    """The SLO-audit overhead + drift A/B shared by bench.py
+    (``BENCH_SLO``) and tools/bench_table.py (``slo`` group) — the
+    ISSUE 20 acceptance harness: serve the SAME case set through two
+    N-replica routers over ONE shared AOT store dir, once UNAUDITED
+    (``slo=False`` router-side, ``NLHEAT_SLO=0`` in every worker: the
+    one-attribute-read disabled path) and once AUDITED (fleet ledger on
+    the router, per-worker ledgers in every pipeline).  Each arm runs a
+    warm pass then a timed pass, so ``slo_overhead`` isolates the
+    ledger cost (the <= 1.05 gate, same bar as PR 5/11 tracing).
+
+    Both arms submit each case with an explicit :class:`EngineChoice`
+    matching the fleet's default engine (same compute, bit-identical
+    results) whose ``est_ms`` is SELF-CALIBRATED from the audited arm's
+    warm-pass latencies — the modeled-vs-observed ratio of the clean
+    timed pass is ~1 by construction, so the drift detector must stay
+    quiet (``drift_fired_clean``).  A third pass re-offers the cases
+    with ``est_ms`` divided by ``corrupt_factor`` — an injected
+    cost-model corruption the detector MUST flag
+    (``drift_fired_corrupt``), the acceptance pair.  ``deadline_ms`` is
+    generous: an unloaded fleet's ``deadline_hit_rate`` must read
+    1.0."""
+    cases = list(cases)
+    if cpus_per_replica is None:
+        # the same CPU proxy as router_load_ab: every worker in both
+        # arms gets one fixed core budget so the ratio measures ledger
+        # cost, not thread-placement luck
+        try:
+            cpus_per_replica = max(
+                1, len(os.sched_getaffinity(0)) // max(2, replicas))
+        except AttributeError:
+            cpus_per_replica = None
+
+    def default_choice(case, est_ms: float) -> EngineChoice:
+        # the fleet default engine's settings as an explicit pick: the
+        # worker serves it from the same pool entry it would use for an
+        # engine-less submission, so the audited/unaudited results stay
+        # bit-identical and only the promise metadata differs
+        return EngineChoice(
+            stepper=str(engine_kwargs.get("stepper", "euler")),
+            stages=int(engine_kwargs.get("stages", 0) or 0),
+            method=str(engine_kwargs.get("method", "auto")),
+            precision=str(engine_kwargs.get("precision", "f32")),
+            dt=float(case.dt), steps=int(case.nt),
+            est_ms=float(est_ms), est_err=0.0, rates="measured")
+
+    def run_pass(router, scale: float, est: dict) -> float:
+        # the submit loop is INSIDE the timed wall: promise() runs at
+        # submit, and hiding it outside t0 would flatter the overhead
+        t0 = time.perf_counter()
+        handles = [router.submit(
+            c, deadline_ms=deadline_ms,
+            engine=default_choice(c, est[i] * scale))
+            for i, c in enumerate(cases)]
+        router.drain()
+        wall = time.perf_counter() - t0
+        for h in handles:
+            if h.error is not None:
+                raise h.error
+        return wall
+
+    walls: dict[str, float] = {}
+    results: dict[str, list] = {}
+    slo_summary: dict = {}
+    drift_clean = drift_corrupt = 0
+    for arm in ("unaudited", "audited"):
+        audited = arm == "audited"
+        env = dict(child_env or {})
+        env["NLHEAT_SLO"] = "1" if audited else "0"
+        # the workers' own ledgers run for overhead realism, but their
+        # drift windows compare DEVICE ms against the e2e-calibrated
+        # est_ms this harness injects — not the modeled-vs-observed
+        # pair under test.  The router-level detector is the gated
+        # surface; park the worker band out of the way.
+        env.setdefault("NLHEAT_SLO_BAND", "1e-9,1e9")
+        with ReplicaRouter(replicas=replicas, program_store=store_dir,
+                           window_ms=window_ms, child_env=env,
+                           cpus_per_replica=cpus_per_replica,
+                           slo=audited,
+                           **engine_kwargs) as router:
+            # pass 1 warms (and, arm 1, populates the shared store);
+            # pass 2 calibrates the per-case modeled cost from STEADY
+            # latencies (warm-pass latencies carry store loads and
+            # would skew the clean drift window); pass 3 is the timed
+            # wall the overhead ratio reads
+            warm = [router.submit(c, deadline_ms=deadline_ms)
+                    for c in cases]
+            router.drain()
+            results[arm] = [h.result for h in warm]
+            cal = [router.submit(c, deadline_ms=deadline_ms)
+                   for c in cases]
+            router.drain()
+            est = {i: max(1e-3, (h.latency_s or 0.0) * 1e3)
+                   for i, h in enumerate(cal)}
+            walls[arm] = run_pass(router, 1.0, est)
+            if audited:
+                s = router.metrics()["slo"]
+                drift_clean = int(s["drift_warnings"])
+                slo_summary = s
+                # the injected corruption: the same cases promised at
+                # est_ms / corrupt_factor — observed/modeled leaves the
+                # band and the detector must warn exactly here
+                run_pass(router, 1.0 / corrupt_factor, est)
+                drift_corrupt = int(
+                    router.metrics()["slo"]["drift_warnings"])
+    return {
+        "walls": walls,
+        "slo_overhead": walls["audited"] / walls["unaudited"],
+        "results": results,
+        "slo": slo_summary,
+        "deadline_hit_rate": slo_summary.get("deadline_hit_rate"),
+        "drift_fired_clean": drift_clean > 0,
+        "drift_fired_corrupt": drift_corrupt > drift_clean,
     }
 
 

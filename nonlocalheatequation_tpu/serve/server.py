@@ -112,6 +112,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from nonlocalheatequation_tpu.obs import flightrec
+from nonlocalheatequation_tpu.obs import slo as obs_slo
 from nonlocalheatequation_tpu.obs import trace as obs_trace
 from nonlocalheatequation_tpu.obs.export import EventLog
 from nonlocalheatequation_tpu.obs.metrics import MetricsRegistry, backed
@@ -437,7 +438,7 @@ class ServePipeline:
                  nan_policy: str = "quarantine",
                  faults: FaultPlan | None = None, sleep=time.sleep,
                  registry: MetricsRegistry | None = None, tracer=None,
-                 **engine_kwargs):
+                 slo=None, **engine_kwargs):
         if engine is None:
             engine = EnsembleEngine(**engine_kwargs)
         elif engine_kwargs:
@@ -499,6 +500,14 @@ class ServePipeline:
                                  inflight=self._inflight_ledger)
             if self._events is not None:
                 self._flightrec.add_flush(self._events.flush)
+        # the SLO promise-audit ledger (obs/slo.py, ISSUE 20): joins
+        # every submit's promise to its retire/quarantine outcome under
+        # /slo/* on THIS report's registry, so it rides the fleet stats
+        # frames for free.  None when off — every tap below is one
+        # attribute read, the obs/ discipline.
+        self._slo = obs_slo.SloLedger.from_arg(slo,
+                                               registry=report.registry,
+                                               clock=clock)
         self.registry = report.registry
         if breaker is not None:
             # mirror the breaker's lifetime-exact transition count into
@@ -670,6 +679,14 @@ class ServePipeline:
             req.deadline_t = now + deadline_ms / 1e3
             oc.deadline_t = (req.deadline_t if oc.deadline_t is None
                              else min(oc.deadline_t, req.deadline_t))
+        if self._slo is not None:
+            # the promise half of the audit: the submit timestamp the
+            # scheduler already took, the pick's modeled cost when the
+            # front door picked (EngineChoice.est_ms), the axis either
+            # way — zero extra clock reads, zero fences
+            self._slo.promise(req.seq, engine=engine, engine_sel=sel,
+                              deadline_ms=deadline_ms, mesh=case.mesh,
+                              t=now)
         if len(oc.requests) >= self.window_size:
             self._close(okey, "size")
         self.pump()
@@ -1054,6 +1071,12 @@ class ServePipeline:
         self._event("quarantine", case=req.seq, chunk=chunk.chunk_id,
                     classification=classification,
                     attempts=chunk.attempts, detail=detail)
+        if self._slo is not None:
+            # the exceptional outcome resolves the promise too — a
+            # quarantined case must not linger as an open ledger entry
+            self._slo.resolve(req.seq, latency_s=req.latency_s,
+                              queue_wait_s=req.queue_wait_s,
+                              error=classification)
         fr = self._flightrec
         if fr is not None:
             # a typed ServeError quarantine is a black-box trigger: the
@@ -1149,6 +1172,57 @@ class ServePipeline:
         }
         self.report.chunk_log.append(entry)
         self._event("chunk", **entry)
+        if self._slo is not None:
+            self._slo_retire(chunk, entry, t2)
+
+    def _slo_retire(self, chunk: _Chunk, entry: dict, t2) -> None:
+        """The outcome half of the audit (obs/slo.py): resolve every
+        retired request's promise from the timestamps the retire already
+        took (zero-fence contract), then feed the live rate recorder the
+        chunk's observed per-apply milliseconds so the picker's cost
+        model recalibrates with traffic.  Called only when the ledger is
+        on; never raises (the ledger swallows its own failures)."""
+        sl = self._slo
+        B = len(chunk.requests)
+        dev_ms = entry["device_ms"]
+        for r in chunk.requests:
+            sl.resolve(r.seq, latency_s=r.latency_s,
+                       queue_wait_s=r.queue_wait_s,
+                       device_ms=dev_ms / B, t=t2)
+        if chunk.route != "device" or dev_ms <= 0:
+            return  # CPU-fallback walls must not recalibrate device picks
+        try:
+            case = chunk.requests[0].case
+            if case.mesh is not None:
+                # mesh-axis rate keys use the mesh's EFFECTIVE eps
+                # (serve/picker.py _mesh_eps_eff), which needs the
+                # registered cloud — not worth loading per retire
+                return
+            live = sl.ensure_live(self._device_kind())
+            if live is None:
+                return
+            engine = self._engine_for(chunk.engine_sel)
+            lanes = len(chunk.padded) if chunk.padded else B
+            applies = obs_slo.applies_per_step(engine.stepper,
+                                               engine.stages)
+            per_apply = dev_ms / (lanes * max(1, int(case.nt)) * applies)
+            live.record(engine.method, case.shape, case.eps,
+                        engine.precision, per_apply)
+        except Exception:  # noqa: BLE001 — observability never raises
+            pass
+
+    def _device_kind(self) -> str:
+        """The live-rate key's device kind, cached after first use.
+        Safe HERE by construction: a chunk has already retired through
+        this process's backend (the wedge discipline keeps the lookup
+        out of router/ingress processes — their ledgers run without a
+        live recorder)."""
+        dk = getattr(self, "_device_kind_cached", None)
+        if dk is None:
+            from nonlocalheatequation_tpu.utils.devices import device_list
+
+            dk = self._device_kind_cached = device_list()[0].device_kind
+        return dk
 
     # -- completion ---------------------------------------------------------
     def wait(self, req: ServeRequest) -> np.ndarray:
@@ -1207,6 +1281,8 @@ class ServePipeline:
             finally:
                 self._release_stalls()
                 donation.set_pipeline_depth(self._prev_depth)
+                if self._slo is not None:
+                    self._slo.close()  # flush buffered live rates
                 if self._events is not None:
                     self._events.close()
                 self._closed = True
@@ -1219,10 +1295,13 @@ class ServePipeline:
 
     # -- observability ------------------------------------------------------
     def metrics(self) -> dict:
-        return self.report.metrics()
+        m = self.report.metrics()
+        if self._slo is not None:
+            m["slo"] = self._slo.summary()
+        return m
 
     def metrics_json(self) -> str:
-        return self.report.metrics_json()
+        return json.dumps(self.metrics())
 
     # -- retrace watchdog (ISSUE 11 satellite) ------------------------------
     def arm_steady_state(self) -> int:

@@ -45,7 +45,14 @@ for _knob in ("NLHEAT_RESIDENT", "NLHEAT_SUPERSTEP", "NLHEAT_AUTOTUNE",
               # ambient mesh dir would make mesh-keyed cases resolve
               # against a user registry instead of each test's tmp one,
               # and BENCH_MESH must not arm its bench rung mid-suite
-              "NLHEAT_MESH_DIR", "NLHEAT_MESH_MAX_NODES", "BENCH_MESH"):
+              "NLHEAT_MESH_DIR", "NLHEAT_MESH_MAX_NODES", "BENCH_MESH",
+              # the SLO ledger knobs (ISSUE 20, obs/slo.py): an ambient
+              # NLHEAT_SLO would arm auditing (and the live rate
+              # write-back) inside every serve test, a leaked band/
+              # window would reshape the drift tests' thresholds, and
+              # BENCH_SLO must not arm its bench rung mid-suite
+              "NLHEAT_SLO", "NLHEAT_SLO_BAND", "NLHEAT_SLO_WINDOW",
+              "NLHEAT_SLO_MIN", "NLHEAT_SLO_LIVE", "BENCH_SLO"):
     os.environ.pop(_knob, None)
 # "" DISABLES autotune-cache persistence (unset means the per-user default
 # file since tuning became the on-TPU default): the suite must neither read

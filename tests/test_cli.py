@@ -216,6 +216,12 @@ def test_listen_flag_refusals():
     r = run_cli("solve2d", ["--listen", "0", "--transport", "bogus"],
                 stdin="")
     assert r.returncode == 2 and "--transport" in r.stderr
+    # ISSUE 20: the SLO audit flag needs the serving front door
+    r = run_cli("solve2d", ["--slo", "1"], stdin="")
+    assert r.returncode == 1 and "--slo" in r.stderr \
+        and "--listen" in r.stderr
+    r = run_cli("solve2d", ["--listen", "0", "--slo", "2"], stdin="")
+    assert r.returncode == 2 and "--slo" in r.stderr
 
 
 def test_listen_serves_http_and_stops_on_stdin_eof():

@@ -24,8 +24,8 @@ ALL_STEPS = [
     "bf16-4096", "bf16-carried4096", "ensemble8x1024", "serve8x1024",
     "servefault8x1024", "obs8x1024", "multichip1024", "fft4096",
     "tta4096", "warmboot1024", "router8x1024", "routerobs8x1024",
-    "fleettcp8x1024", "ttafleet8x512", "fftgang8x4096", "session8x256",
-    "mesh4096",
+    "sloaudit8x1024", "fleettcp8x1024", "ttafleet8x512",
+    "fftgang8x4096", "session8x256", "mesh4096",
     "autotune-2d512", "autotune-2d4096", "autotune-3d256",
     "table-unstructured", "table-elastic", "table-elastic-general",
     "table-unstructured3d", "table-eps-sweep", "sanity",

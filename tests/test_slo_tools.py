@@ -1,0 +1,175 @@
+"""ISSUE 20 tooling: bench_history regression sentinel + fleet_report.
+
+The sentinel's acceptance criterion is pinned DETERMINISTICALLY here
+(CI runs the live gate with a generous band because hosted-runner
+hardware varies): against a synthetic banked history, an injected 2x
+slowdown must fail (rc != 0, offending row named) and the clean row
+must pass.  fleet_report renders its one-page markdown from synthetic
+artifacts of the exact shapes the serving stack writes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_tool(name, *args, stdin=None):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", name), *args],
+        capture_output=True, text=True, input=stdin, timeout=120)
+
+
+def bench_row(value=1e8, variant=None, grid=256, backend="cpu", **extra):
+    row = {"metric": "points*steps/sec/chip", "value": value,
+           "grid": grid, "steps": 5, "ms_per_step": 1.0,
+           "backend": backend, "partial": False, **extra}
+    if variant is not None:
+        row["variant"] = variant
+    return row
+
+
+def write_rows(path, rows):
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return str(path)
+
+
+def test_bench_history_catches_2x_slowdown_and_passes_clean(tmp_path):
+    hist = tmp_path / "history.jsonl"
+    # three banked readings for the (base, 256, cpu) key, median 1e8
+    write_rows(hist, [bench_row(0.95e8), bench_row(1.0e8),
+                      bench_row(1.05e8)])
+    clean = write_rows(tmp_path / "clean.json", [bench_row(0.98e8)])
+    slow = write_rows(tmp_path / "slow.json", [bench_row(0.5e8)])
+    r = run_tool("bench_history.py", "--history", str(hist), "check",
+                 clean)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
+    # the injected 2x slowdown: rc != 0 and the offending row is NAMED
+    r = run_tool("bench_history.py", "--history", str(hist), "check",
+                 slow)
+    assert r.returncode == 1
+    assert "FAIL" in r.stdout and "offending row" in r.stdout
+    assert '"value": 50000000.0' in r.stdout
+    assert "variant=base grid=256 backend=cpu" in r.stdout
+
+
+def test_bench_history_keys_and_edges(tmp_path):
+    hist = tmp_path / "history.jsonl"
+    write_rows(hist, [bench_row(1e8),
+                      bench_row(2e6, variant="router4"),
+                      # a wedged-tunnel fallback row is its OWN class:
+                      # it must never drag the healthy baseline down
+                      bench_row(1e5, cpu_fallback=True)])
+    # per-variant keys: a router row checks against the router median,
+    # never the base one (2x the router baseline passes, and the much
+    # larger base baseline is not consulted)
+    ok = write_rows(tmp_path / "r.json",
+                    [bench_row(1.9e6, variant="router4")])
+    r = run_tool("bench_history.py", "--history", str(hist), "check", ok)
+    assert r.returncode == 0 and "variant=router4" in r.stdout
+    # a brand-new variant has no baseline: PASS with the seed note
+    new = write_rows(tmp_path / "n.json", [bench_row(1.0, variant="slo8")])
+    r = run_tool("bench_history.py", "--history", str(hist), "check", new)
+    assert r.returncode == 0 and "no baseline" in r.stdout
+    # an empty candidate set is a plumbing FAILURE, not a clean pass
+    empty = write_rows(tmp_path / "e.json", [])
+    r = run_tool("bench_history.py", "--history", str(hist), "check",
+                 empty)
+    assert r.returncode == 1 and "no candidate rows" in r.stdout
+    # a missing history gates nothing but still passes candidates
+    r = run_tool("bench_history.py", "--history",
+                 str(tmp_path / "absent.jsonl"), "check", ok)
+    assert r.returncode == 0 and "no baseline" in r.stdout
+
+
+def test_bench_history_bank_appends_and_dedups(tmp_path):
+    hist = tmp_path / "history.jsonl"
+    src = write_rows(tmp_path / "row.json",
+                     [bench_row(1e8, banked_tpu_evidence={"huge": 1})])
+    r = run_tool("bench_history.py", "--history", str(hist), "bank", src)
+    assert r.returncode == 0 and "banked 1 row(s)" in r.stdout
+    banked = json.loads(hist.read_text())
+    # the ledger strips the banked-evidence blob and stamps the source
+    assert "banked_tpu_evidence" not in banked
+    assert banked["source"] == src
+    # re-banking the same row is a no-op (idempotent evidence ledger)
+    r = run_tool("bench_history.py", "--history", str(hist), "bank", src)
+    assert "banked 0 row(s) (1 duplicate(s)" in r.stdout
+    assert len(hist.read_text().splitlines()) == 1
+    # stdin banking: the CI pipe shape
+    r = run_tool("bench_history.py", "--history", str(hist), "bank", "-",
+                 stdin="log chatter\n" + json.dumps(bench_row(2e8)) + "\n")
+    assert r.returncode == 0 and "banked 1 row(s)" in r.stdout
+
+
+def test_committed_history_gates_the_ci_smoke_row():
+    # the CI step checks the 256^2 CPU smoke row against the COMMITTED
+    # ledger — so that ledger must actually hold a (base, 256, cpu)
+    # baseline; an empty or mis-keyed seed would make the sentinel
+    # vacuously green forever
+    hist = os.path.join(REPO, "docs", "bench", "history.jsonl")
+    rows = [json.loads(line) for line in open(hist) if line.strip()]
+    assert any(r.get("grid") == 256 and r.get("backend") == "cpu"
+               and "variant" not in r and
+               isinstance(r.get("value"), (int, float))
+               for r in rows)
+
+
+def test_fleet_report_renders_all_sections(tmp_path):
+    metrics = tmp_path / "metrics.json"
+    metrics.write_text("router: serving\n" + json.dumps({
+        "replicas": 2, "transport": "pipe", "cases": 6, "outstanding": 0,
+        "deaths": 1, "requeued": 1, "spawns": 1,
+        "request_latency_ms": {"p50": 10.0, "p99": 25.0},
+        "per_replica": {"0": {"cases": 3, "deaths": 0},
+                        "1": {"cases": 3, "deaths": 1}},
+        "slo": {"promised": 6, "resolved": 6, "open": 0, "duplicate": 0,
+                "unmatched": 0, "deadline_hit_rate": 1.0, "burn": 0.0,
+                "drift_ratio_p50": 1.2, "drift_warnings": 1,
+                "e2e_ms": {"p50": 9.0, "p99": 24.0},
+                "axes": {"default": {"requests": 6,
+                                     "deadline_hit_rate": 1.0}}},
+    }) + "\n")
+    ev = tmp_path / "events.jsonl"
+    ev.write_text("".join(json.dumps(e) + "\n" for e in [
+        {"pid": 1, "seq": 0, "t": 10.0, "event": "submit"},
+        {"pid": 2, "seq": 0, "t": 10.5, "event": "submit"},
+        {"pid": 1, "seq": 1, "t": 11.0, "event": "slo-drift",
+         "p50": 5.0},
+    ]))
+    tr = tmp_path / "trace.json"
+    tr.write_text(json.dumps({"traceEvents": [
+        {"pid": 1, "tid": 1, "ph": "X", "ts": 0, "dur": 5,
+         "name": "chunk#0"},
+        {"pid": 2, "tid": 1, "ph": "X", "ts": 1, "dur": 5,
+         "name": "chunk#1"},
+        {"pid": 2, "tid": 1, "ph": "X", "ts": 2, "dur": 1,
+         "name": "router.submit"},
+    ]}))
+    r = run_tool("fleet_report.py", "--metrics", str(metrics),
+                 "--events", str(ev), "--trace", str(tr))
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    # every section rendered from its artifact
+    assert "# Fleet report" in out and "## Fleet" in out
+    assert "| replica deaths | 1 |" in out
+    assert "## SLO ledger" in out
+    assert "| deadline_hit_rate | 1.0 |" in out
+    assert "| drift_warnings | 1 |" in out
+    assert "| default | 6 | 1.0 |" in out
+    assert "## Events (3 from 1 stream(s))" in out
+    assert "slo-drift" in out and "warning-class" in out
+    assert "## Trace (3 events" in out
+    assert "| chunk | 2 |" in out
+    # partial artifacts still render: metrics-only, no ledger block
+    metrics2 = tmp_path / "m2.json"
+    metrics2.write_text(json.dumps({"replicas": 1, "cases": 2}) + "\n")
+    r = run_tool("fleet_report.py", "--metrics", str(metrics2))
+    assert r.returncode == 0
+    assert "_no ledger in the snapshot" in r.stdout
+    # no artifacts at all is a usage error
+    r = run_tool("fleet_report.py")
+    assert r.returncode == 2

@@ -1,0 +1,176 @@
+"""Golden span-inventory (ISSUE 20): doc table <-> emitters <-> trace.
+
+docs/architecture.md's "Span inventory (golden families)" table is a
+CONTRACT, checked mechanically here graftlint-style:
+
+* every documented family's emitter module(s) contain the literal span
+  name, and the named "exercised by" test file exists;
+* a source sweep over the package finds every literal duration-span
+  emission (``obs_trace.span("..."``, ``tracer.complete("..."``,
+  ``self._t_span("..."``) — the swept set and the documented set must
+  be EQUAL, so a brand-new span cannot ship undocumented and a
+  silently-dropped emitter cannot leave a stale doc row;
+* every family marked **golden** must appear in the trace artifact of
+  one traced chaos serve run: an injected first-attempt retry, a chunk
+  that exhausts its retries onto the synchronous fallback route, a
+  warm-boot reload from the AOT program store, the offline oracle
+  comparison (bit-identity preserved under tracing), and one direct
+  solver run.
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+
+from nonlocalheatequation_tpu.obs import trace as obs_trace
+from nonlocalheatequation_tpu.obs.trace import Tracer
+from nonlocalheatequation_tpu.serve.ensemble import (
+    EnsembleCase,
+    EnsembleEngine,
+)
+from nonlocalheatequation_tpu.serve.server import ServePipeline
+from nonlocalheatequation_tpu.utils.faults import FaultPlan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "nonlocalheatequation_tpu")
+DOC = os.path.join(REPO, "docs", "architecture.md")
+ANCHOR = "### Span inventory (golden families)"
+
+# literal duration-span emission points: the module-level/context
+# manager form, an explicit tracer.complete with a literal name, and
+# the serving pipeline's zero-extra-clock-read _t_span helper.  An
+# ``instant(`` is an instant event, not a span family, by design.
+EMIT_RE = re.compile(
+    r'(?:\bspan|\bcomplete|_t_span)\(\s*"([a-z_]+\.[a-z_]+)"')
+
+
+def parse_doc_table():
+    """Rows of the inventory table: (family, cat, emitters, test, golden)."""
+    text = open(DOC).read()
+    assert ANCHOR in text, "span-inventory anchor missing from the doc"
+    section = text.split(ANCHOR, 1)[1].split("\n## ", 1)[0]
+    rows = []
+    for line in section.splitlines():
+        if not line.startswith("| `"):
+            continue
+        cols = [c.strip() for c in line.strip("|").split("|")]
+        assert len(cols) == 5, f"malformed inventory row: {line!r}"
+        family = cols[0].strip("`")
+        emitters = re.findall(r"`([\w/]+\.py)`", cols[2])
+        test = re.findall(r"`([\w/]+\.py)`", cols[3])
+        assert emitters, f"no emitter modules in row: {line!r}"
+        assert len(test) == 1, f"need exactly one test in row: {line!r}"
+        rows.append((family, cols[1], emitters, test[0],
+                     cols[4] == "golden"))
+    assert rows, "span-inventory table has no rows"
+    return rows
+
+
+def sweep_source():
+    """Every literal duration-span family emitted anywhere in the
+    package, mapped to the repo-relative modules that emit it."""
+    found = {}
+    for dirpath, _dirs, files in os.walk(PKG):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, PKG)
+            for name in EMIT_RE.findall(open(path).read()):
+                found.setdefault(name, set()).add(rel)
+    return found
+
+
+def test_doc_table_and_emitters_cross_check():
+    rows = parse_doc_table()
+    swept = sweep_source()
+    documented = {}
+    for family, cat, emitters, test, _golden in rows:
+        assert family not in documented, f"duplicate row for {family}"
+        documented[family] = set(emitters)
+        # the named test file must exist (a renamed suite must update
+        # the table, or the "exercised by" claim rots)
+        assert os.path.exists(os.path.join(REPO, test)), \
+            f"{family}: exercising test {test} does not exist"
+        for mod in emitters:
+            src = open(os.path.join(PKG, mod)).read()
+            assert f'"{family}"' in src, \
+                f"{family}: documented emitter {mod} no longer emits it"
+        assert cat, f"{family}: empty cat column"
+    # set EQUALITY both ways: no undocumented span, no stale doc row
+    assert set(documented) == set(swept), (
+        f"doc table and source emitters disagree — undocumented: "
+        f"{sorted(set(swept) - set(documented))}, stale rows: "
+        f"{sorted(set(documented) - set(swept))}")
+    for family, mods in swept.items():
+        assert mods == documented[family], (
+            f"{family}: doc lists {sorted(documented[family])}, "
+            f"source emits from {sorted(mods)}")
+
+
+def _chaos_cases(n, rng, nt=6):
+    return [EnsembleCase(shape=(16, 16), nt=nt, eps=3.0 / 15, k=0.5,
+                         dt=1e-5, dh=1.0 / 15, test=False,
+                         u0=rng.normal(size=(16, 16)))
+            for _ in range(n)]
+
+
+def test_golden_families_appear_in_chaos_trace(tmp_path):
+    golden = {f for f, _c, _e, _t, g in parse_doc_table() if g}
+    rng = np.random.default_rng(7)
+    store = str(tmp_path / "store")
+    tr = Tracer(capacity=20_000, label="span-inventory")
+    prev = obs_trace.set_tracer(tr)
+    try:
+        # chaos pass: the first chunk's first two attempts raise; the
+        # two device-path failures open the breaker (threshold 2), so
+        # the retry routes through the synchronous CPU fallback — every
+        # case still serves, bit-identical to offline.  Programs land
+        # in the AOT store (store.save)
+        cases = _chaos_cases(3, rng)
+        with ServePipeline(depth=1, window_ms=0.0, batch_sizes=(1,),
+                           retries=2, backoff_ms=0.0, method="sat",
+                           breaker_threshold=2,
+                           faults=FaultPlan.parse("raise@0,raise@1"),
+                           program_store=store, tracer=tr) as pipe:
+            handles = [pipe.submit(c) for c in cases]
+            pipe.drain()
+            served = [np.asarray(h.result) for h in handles]
+            assert all(r is not None for r in served)
+            assert pipe.report.fallback_chunks >= 1, \
+                "chaos plan never exhausted a chunk onto the fallback"
+        # warm-boot pass: a fresh pipeline over the SAME store serves
+        # without building (store.load)
+        with ServePipeline(depth=1, window_ms=0.0, batch_sizes=(1,),
+                           method="sat", program_store=store,
+                           tracer=tr) as pipe:
+            h = pipe.submit(_chaos_cases(1, np.random.default_rng(7))[0])
+            pipe.drain()
+            assert h.result is not None
+        # offline oracle (ensemble.chunk): tracing must not perturb the
+        # served numerics — bit-identity is the contract everywhere
+        offline = EnsembleEngine(method="sat", batch_sizes=(1,),
+                                 program_store=store).run(cases)
+        for s, o in zip(served, offline):
+            np.testing.assert_array_equal(s, np.asarray(o))
+        # one direct solver run (solver.do_work)
+        from nonlocalheatequation_tpu.models.solver2d import Solver2D
+
+        s = Solver2D(16, 16, 4, eps=3, k=0.2, dt=0.001, dh=0.02,
+                     backend="jit", method="conv")
+        s.test_init()
+        s.do_work()
+    finally:
+        obs_trace.set_tracer(prev)
+    # the chaos-run trace ARTIFACT (not just the in-memory ring)
+    artifact = tmp_path / "chaos_trace.json"
+    tr.write(str(artifact))
+    doc = json.load(open(artifact))
+    families = {e["name"] for e in doc["traceEvents"]
+                if e.get("ph") == "X"}
+    missing = golden - families
+    assert not missing, (
+        f"golden span families missing from the chaos-run trace "
+        f"artifact: {sorted(missing)} (captured: {sorted(families)})")

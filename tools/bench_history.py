@@ -1,0 +1,203 @@
+"""Bench regression sentinel (ISSUE 20): bank + check bench.py rows.
+
+Every bench.py run prints ONE JSON line.  This tool turns those lines
+into a regression gate:
+
+* ``bank`` appends rows to ``docs/bench/history.jsonl`` (the banked
+  ledger of every measurement the repo has kept — CPU-proxy rungs,
+  opportunistic TPU heal-window rows, variant A/Bs), stamping each with
+  the source path so a row can always be traced back to its artifact.
+* ``check`` compares candidate rows against per-(variant, grid,
+  platform) baselines computed from the banked history — the MEDIAN of
+  prior ``value`` readings — and exits non-zero when a candidate falls
+  below ``(1 - tol)`` of its baseline, naming the offending row.  A 2x
+  slowdown (value halved) is caught at the default band.
+
+The check is deliberately one-sided: faster-than-baseline is never a
+failure (it becomes the new evidence to bank), and rows with no banked
+baseline PASS with a "no baseline" note — a brand-new variant must not
+brick CI before its first bank.  Rows that ran on the wedge-ladder CPU
+fallback (``cpu_fallback``) or carry ``partial`` grids still check, but
+only against rows of the SAME key, so a degraded run is never compared
+against a healthy chip's number.
+
+Usage::
+
+    BENCH_PLATFORM=cpu python bench.py | tee /tmp/row.json
+    python tools/bench_history.py bank /tmp/row.json
+    python tools/bench_history.py check /tmp/row.json           # gate
+    python tools/bench_history.py check --tol 0.85 /tmp/row.json  # CI
+    python tools/bench_history.py check -            # rows from stdin
+
+CI runs ``check`` with a generous band (hosted-runner hardware varies
+run to run); the strict 2x catch is pinned by the deterministic test in
+tests/test_slo_tools.py against synthetic history.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from pathlib import Path
+
+DEFAULT_HISTORY = Path(__file__).resolve().parent.parent / "docs" / \
+    "bench" / "history.jsonl"
+# candidate value must be >= (1 - TOL) * baseline median; 0.4 catches a
+# 2x slowdown (0.5x value) with margin while riding out CPU-proxy noise
+DEFAULT_TOL = 0.4
+
+
+def row_key(row: dict) -> tuple:
+    """Baselines group per (variant, grid, platform) — ISSUE 20.
+
+    ``variant`` defaults to "base" (the plain ladder rung);
+    ``backend`` is the platform axis (cpu proxy vs tpu), and a
+    cpu_fallback row is its own class so a wedged-tunnel measurement
+    never drags the healthy-chip baseline down (or vice versa).
+    """
+    return (
+        str(row.get("variant") or "base"),
+        row.get("grid"),
+        str(row.get("backend") or "?"),
+        bool(row.get("cpu_fallback")),
+    )
+
+
+def iter_rows(path: str):
+    """JSON rows from a file of JSON lines (or stdin when ``-``).
+
+    Non-JSON lines (log chatter around the ONE bench line) are
+    skipped; dict rows with a numeric ``value`` are yielded.
+    """
+    fh = sys.stdin if path == "-" else open(path)
+    try:
+        for line in fh:
+            line = line.strip()
+            if not line or not line.startswith("{"):
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict) and \
+                    isinstance(row.get("value"), (int, float)):
+                yield row
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+
+
+def load_history(path: Path) -> list[dict]:
+    if not path.exists():
+        return []
+    return list(iter_rows(str(path)))
+
+
+def describe(row: dict) -> str:
+    key = row_key(row)
+    return (f"variant={key[0]} grid={key[1]} backend={key[2]}"
+            + (" cpu_fallback" if key[3] else ""))
+
+
+def cmd_bank(args: argparse.Namespace) -> int:
+    hist_path = Path(args.history)
+    hist_path.parent.mkdir(parents=True, exist_ok=True)
+    # fingerprints exclude the source stamp: the SAME measurement banked
+    # from two paths (a tee'd file, then stdin) is still one row
+    seen = {json.dumps({k: v for k, v in r.items() if k != "source"},
+                       sort_keys=True) for r in load_history(hist_path)}
+    banked = skipped = 0
+    with open(hist_path, "a") as out:
+        for src in args.rows:
+            for row in iter_rows(src):
+                row = dict(row)
+                row.pop("banked_tpu_evidence", None)  # evidence rides
+                # its own source artifact; the ledger keeps THIS run
+                row.setdefault("source", src if src != "-" else "stdin")
+                fp = json.dumps(
+                    {k: v for k, v in row.items() if k != "source"},
+                    sort_keys=True)
+                if fp in seen:
+                    skipped += 1
+                    continue
+                seen.add(fp)
+                out.write(json.dumps(row, sort_keys=True) + "\n")
+                banked += 1
+    print(f"bench_history: banked {banked} row(s) "
+          f"({skipped} duplicate(s) skipped) -> {hist_path}")
+    return 0
+
+
+def cmd_check(args: argparse.Namespace) -> int:
+    history = load_history(Path(args.history))
+    by_key: dict[tuple, list[float]] = {}
+    for row in history:
+        by_key.setdefault(row_key(row), []).append(float(row["value"]))
+    rc = 0
+    checked = 0
+    for src in args.rows:
+        for row in iter_rows(src):
+            checked += 1
+            key = row_key(row)
+            prior = by_key.get(key, [])
+            if len(prior) < args.min_rows:
+                print(f"PASS  {describe(row)}: no baseline "
+                      f"({len(prior)} banked row(s), need "
+                      f">= {args.min_rows}) — bank this row to seed one")
+                continue
+            base = statistics.median(prior)
+            value = float(row["value"])
+            floor = (1.0 - args.tol) * base
+            ratio = value / base if base else float("inf")
+            if value < floor:
+                rc = 1
+                print(f"FAIL  {describe(row)}: value {value:.4g} is "
+                      f"{ratio:.2f}x the banked median {base:.4g} "
+                      f"(floor {floor:.4g}, tol {args.tol}) — "
+                      f"offending row: {json.dumps(row, sort_keys=True)}")
+            else:
+                print(f"PASS  {describe(row)}: value {value:.4g} vs "
+                      f"median {base:.4g} ({ratio:.2f}x, "
+                      f"{len(prior)} banked row(s))")
+    if checked == 0:
+        # an empty candidate set means the bench line never made it
+        # here — that is a plumbing failure, not a clean pass
+        print("FAIL  no candidate rows found (bench.py prints ONE "
+              "JSON line; pipe it in or name its file)")
+        return 1
+    return rc
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_history",
+        description="bank bench.py JSON rows / check them for "
+                    "regressions against the banked history")
+    ap.add_argument("--history", default=str(DEFAULT_HISTORY),
+                    help="history ledger path "
+                         "(default docs/bench/history.jsonl)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_bank = sub.add_parser("bank", help="append rows to the ledger")
+    p_bank.add_argument("rows", nargs="+",
+                        help="files of bench JSON lines ('-' = stdin)")
+    p_bank.set_defaults(fn=cmd_bank)
+    p_check = sub.add_parser(
+        "check", help="compare rows against per-(variant, grid, "
+                      "platform) banked medians; rc 1 on regression")
+    p_check.add_argument("rows", nargs="+",
+                         help="files of bench JSON lines ('-' = stdin)")
+    p_check.add_argument("--tol", type=float, default=DEFAULT_TOL,
+                         help="allowed fractional drop below the "
+                              "banked median (default %(default)s)")
+    p_check.add_argument("--min-rows", type=int, default=1,
+                         help="banked rows required before a key is "
+                              "gated (default %(default)s)")
+    p_check.set_defaults(fn=cmd_check)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

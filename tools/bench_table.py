@@ -27,7 +27,10 @@ Env:
     the routerobs group (ISSUE 11 traced-vs-untraced fleet A/B) shares
     the BT_ROUTER_* knobs, as does the fleettcp group (ISSUE 12
     pipe-vs-TCP transport A/B + sharded gang tier; BT_FLEET_SHARDED
-    (2) sharded cases at twice the small edge),
+    (2) sharded cases at twice the small edge) and the slo group
+    (ISSUE 20 audited-vs-unaudited promise-ledger A/B: the
+    ``slo_overhead`` <= 1.05 gate row, deadline hit rate, and the
+    corrupted-pass drift-warning verdict),
     BT_FFTGANG_GRID (4096 / 64) + BT_FFTGANG_DEVICES (4, the fftgang
     group's gang mesh — ISSUE 16 stencil-vs-picked-spectral A/B;
     needs that many local/virtual devices),
@@ -1065,6 +1068,62 @@ def bench_router_obs(steps: int):
         shutil.rmtree(trace_dir, ignore_errors=True)
 
 
+def bench_slo(steps: int):
+    """SLO promise-audit A/B (ISSUE 20, obs/slo.py + serve/router.py
+    router_slo_ab): the same mixed-bucket case set served by two
+    N-replica fleets over ONE shared AOT store dir — unaudited
+    (ledger off everywhere) vs fully audited (router promise/outcome
+    ledger + per-worker pipeline ledgers + live rate recalibration) —
+    then a corrupted pass (modeled cost scaled 1000x) that must fire
+    the drift warning.  The audited row records ``slo_overhead`` =
+    audited/unaudited wall (the ISSUE 20 <= 1.05 gate), the unloaded
+    ``deadline_hit_rate`` (must be 1.0), and the clean/corrupt drift
+    verdicts; results are pinned bit-identical across arms.  Off-TPU
+    only, like the router group."""
+    import shutil
+    import tempfile
+
+    from nonlocalheatequation_tpu.serve.ensemble import EnsembleCase
+    from nonlocalheatequation_tpu.serve.router import router_slo_ab
+
+    if on_tpu():
+        log("  slo: skipped on TPU (replica fleets assume one "
+            "accelerator per worker; run with BENCH_PLATFORM=cpu)")
+        return
+    replicas = int(os.environ.get("BT_ROUTER_REPLICAS", 4))
+    n = cfg("BT_ROUTER_GRID", 512, 128)
+    C = int(os.environ.get("BT_ROUTER_CASES", 16))
+    rsteps = cfg("BT_ROUTER_STEPS", 200, 800)
+    buckets = max(replicas, min(8, C))
+    rng = np.random.default_rng(0)
+    cases = [EnsembleCase(shape=(n, n), nt=rsteps + (i % buckets), eps=8,
+                          k=1.0, dt=1e-7, dh=1.0 / n, test=False,
+                          u0=rng.normal(size=(n, n)))
+             for i in range(C)]
+    store_dir = tempfile.mkdtemp(prefix="nlheat-bt-slo-")
+    try:
+        ab = router_slo_ab({"method": "sat", "batch_sizes": (1,)},
+                           cases, replicas, store_dir)
+        bit = all(np.array_equal(a, b)
+                  for a, b in zip(ab["results"]["unaudited"],
+                                  ab["results"]["audited"], strict=True))
+        total_steps = sum(c.nt for c in cases)
+        s = ab["slo"] or {}
+        emit(f"slo/unaudited{replicas}", n * n * C, total_steps // C,
+             ab["walls"]["unaudited"], grid=n, eps=8,
+             replicas=replicas, cases=C)
+        emit(f"slo/audited{replicas}", n * n * C, total_steps // C,
+             ab["walls"]["audited"], grid=n, eps=8, replicas=replicas,
+             cases=C, slo_overhead=round(ab["slo_overhead"], 4),
+             deadline_hit_rate=ab["deadline_hit_rate"],
+             drift_ratio_p50=s.get("drift_ratio_p50"),
+             drift_fired_clean=ab["drift_fired_clean"],
+             drift_fired_corrupt=ab["drift_fired_corrupt"],
+             bit_identical=bit)
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
 def bench_fleet_tcp(steps: int):
     """Worker-transport A/B + sharded big-case tier (ISSUE 12,
     serve/transport.py + serve/router.py fleet_tcp_ab): the same
@@ -1498,6 +1557,7 @@ BENCHES = {
     "warmboot": bench_warmboot,
     "router": bench_router,
     "routerobs": bench_router_obs,
+    "slo": bench_slo,
     "fleettcp": bench_fleet_tcp,
     "ttafleet": bench_fleet_tta,
     "fftgang": bench_fftgang,

@@ -1,0 +1,222 @@
+"""Offline fleet health report (ISSUE 20): one page from the artifacts.
+
+``GET /v1/status`` answers "how is the fleet NOW"; this tool answers
+the same question after the fact, from the artifacts a run leaves
+behind:
+
+* a **metrics snapshot** — the JSON line ``run_listen`` dumps at
+  shutdown (``ReplicaRouter.metrics()``), a ``ServePipeline.metrics()``
+  dict, or an ``obs.export.merged_snapshot_json`` registry dump;
+* **event JSONL** stream(s) — per-replica ``EventLog`` files
+  (``NLHEAT_EVENT_LOG``), heap-merged on the wall clock exactly like
+  ``tools/trace_merge.py --events``;
+* a **merged Chrome trace** — ``dump_fleet_trace()`` /
+  ``tools/trace_merge.py`` output, summarized per span family.
+
+Every section is optional: the report renders whatever artifacts it is
+given and says what is missing, so a crashed run with only a torn
+event log still yields a page.  Output is markdown to stdout.
+
+Usage::
+
+    python tools/fleet_report.py --metrics metrics.json \
+        --events ev.replica0.jsonl ev.replica1.jsonl \
+        --trace fleet_trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nonlocalheatequation_tpu.obs.export import (  # noqa: E402
+    merge_event_streams,
+    read_jsonl,
+)
+
+
+def load_metrics(path: str) -> dict:
+    """The snapshot dump is tolerant-JSON: run_listen prints one JSON
+    line among log chatter, so take the LAST parseable object line."""
+    picked = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict):
+                picked = obj
+    return picked
+
+
+def fmt_ms(v) -> str:
+    return f"{v:.2f}" if isinstance(v, (int, float)) else "—"
+
+
+def section_fleet(m: dict, out: list) -> None:
+    out.append("## Fleet")
+    rows = [("replicas", m.get("replicas")),
+            ("transport", m.get("transport")),
+            ("cases served", m.get("cases")),
+            ("outstanding at dump", m.get("outstanding")),
+            ("replica deaths", m.get("deaths")),
+            ("requeued cases", m.get("requeued")),
+            ("respawns", m.get("spawns")),
+            ("scale-ups / scale-downs",
+             f"{m.get('scale_ups')} / {m.get('scale_downs')}")]
+    out.append("")
+    out.append("| field | value |")
+    out.append("|---|---|")
+    for k, v in rows:
+        if v is not None and v != "None / None":
+            out.append(f"| {k} | {v} |")
+    lat = m.get("request_latency_ms") or {}
+    if lat:
+        out.append(f"| request latency p50/p99 ms "
+                   f"| {fmt_ms(lat.get('p50'))} / {fmt_ms(lat.get('p99'))} |")
+    out.append("")
+    per = m.get("per_replica") or {}
+    if per:
+        out.append("| replica | cases | deaths | state |")
+        out.append("|---|---|---|---|")
+        for rid, row in sorted(per.items(), key=lambda kv: str(kv[0])):
+            row = row or {}
+            out.append(f"| {rid} | {row.get('cases', '—')} "
+                       f"| {row.get('deaths', '—')} "
+                       f"| {row.get('state', row.get('alive', '—'))} |")
+        out.append("")
+
+
+def section_slo(m: dict, out: list) -> None:
+    s = m.get("slo")
+    out.append("## SLO ledger")
+    out.append("")
+    if not s:
+        out.append("_no ledger in the snapshot (run with NLHEAT_SLO=1 "
+                   "or --slo 1 to audit)_")
+        out.append("")
+        return
+    out.append("| field | value |")
+    out.append("|---|---|")
+    for k in ("promised", "resolved", "open", "errors", "duplicate",
+              "unmatched", "deadline_hit", "deadline_miss",
+              "deadline_hit_rate", "burn", "drift_ratio_p50", "drift",
+              "drift_warnings", "drift_band"):
+        if k in s:
+            out.append(f"| {k} | {s[k]} |")
+    for k in ("e2e_ms", "queue_wait_ms", "device_ms", "cost_ratio"):
+        q = s.get(k) or {}
+        if q:
+            out.append(f"| {k} p50/p99 | {fmt_ms(q.get('p50'))} / "
+                       f"{fmt_ms(q.get('p99'))} |")
+    out.append("")
+    axes = s.get("axes") or {}
+    if axes:
+        out.append("| engine axis | requests | hit rate |")
+        out.append("|---|---|---|")
+        for axis, row in sorted(axes.items()):
+            row = row or {}
+            n = row.get("requests", row.get("n", "—"))
+            hr = row.get("deadline_hit_rate", row.get("hit_rate"))
+            out.append(f"| {axis} | {n} | "
+                       f"{hr if hr is not None else '—'} |")
+        out.append("")
+
+
+def section_events(paths: list, out: list) -> None:
+    merged = merge_event_streams(read_jsonl(p) for p in paths)
+    out.append(f"## Events ({len(merged)} from {len(paths)} stream(s))")
+    out.append("")
+    if not merged:
+        out.append("_no events parsed_")
+        out.append("")
+        return
+    kinds = Counter(str(e.get("event", e.get("kind", "?")))
+                    for e in merged)
+    out.append("| event | count |")
+    out.append("|---|---|")
+    for k, n in kinds.most_common():
+        out.append(f"| {k} | {n} |")
+    out.append("")
+    warns = [e for e in merged
+             if "warn" in str(e.get("event", "")).lower()
+             or "drift" in str(e.get("event", "")).lower()
+             or e.get("level") in ("warning", "error")]
+    if warns:
+        out.append(f"**{len(warns)} warning-class event(s)** "
+                   "(first 5 shown):")
+        out.append("")
+        for e in warns[:5]:
+            out.append(f"- `{json.dumps(e, default=str)[:200]}`")
+        out.append("")
+    span = merged[-1].get("t", 0) - merged[0].get("t", 0) \
+        if len(merged) > 1 else 0.0
+    out.append(f"_wall span {span:.1f}s; first event t={merged[0].get('t')}_")
+    out.append("")
+
+
+def section_trace(path: str, out: list) -> None:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    out.append(f"## Trace ({len(events)} events, {os.path.basename(path)})")
+    out.append("")
+    fam = Counter()
+    pids = set()
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        pids.add(ev.get("pid"))
+        name = str(ev.get("name", "?"))
+        # span families group on the prefix before the first '#'/':'
+        # qualifier, the same grammar the inventory test checks
+        fam[name.split("#")[0].split(":")[0].strip()] += 1
+    out.append(f"_processes: {len(pids)}_")
+    out.append("")
+    out.append("| span family | events |")
+    out.append("|---|---|")
+    for k, n in fam.most_common(30):
+        out.append(f"| {k} | {n} |")
+    out.append("")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet_report",
+        description="render one markdown fleet-health page from "
+                    "metrics.json + event JSONL + merged trace")
+    ap.add_argument("--metrics", help="metrics snapshot (JSON, or a log "
+                                      "containing the JSON line)")
+    ap.add_argument("--events", nargs="*", default=[],
+                    help="EventLog JSONL stream(s)")
+    ap.add_argument("--trace", help="merged Chrome trace JSON")
+    args = ap.parse_args(argv)
+    if not (args.metrics or args.events or args.trace):
+        ap.error("give at least one of --metrics/--events/--trace")
+    out = ["# Fleet report", ""]
+    if args.metrics:
+        m = load_metrics(args.metrics)
+        section_fleet(m, out)
+        section_slo(m, out)
+    else:
+        out += ["_no metrics snapshot given_", ""]
+    if args.events:
+        section_events(args.events, out)
+    if args.trace:
+        section_trace(args.trace, out)
+    print("\n".join(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

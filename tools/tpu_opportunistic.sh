@@ -100,7 +100,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 STEPS="bench4096 resident512 carried4096 superstep2 \
 bf16-4096 bf16-carried4096 ensemble8x1024 serve8x1024 servefault8x1024 \
 obs8x1024 multichip1024 fft4096 tta4096 warmboot1024 router8x1024 \
-routerobs8x1024 fleettcp8x1024 ttafleet8x512 fftgang8x4096 session8x256 \
+routerobs8x1024 sloaudit8x1024 fleettcp8x1024 ttafleet8x512 fftgang8x4096 session8x256 \
 mesh4096 \
 autotune-2d512 autotune-2d4096 autotune-3d256 \
 table-unstructured table-elastic table-elastic-general \
@@ -286,6 +286,26 @@ run_step_cmd() {  # the queue's one name->command map
       # processes, steady_state_builds == 0, bit_identical.
       bench_nofb BENCH_ROUTER="${OPP_ROUTER_REPLICAS:-8}" \
         BENCH_TRACE_FLEET="${OPP_ROUTEROBS_TRACE_DIR:-docs/bench/fleet_trace_$ROUND}" \
+        BENCH_PLATFORM=cpu \
+        BENCH_GRID="${OPP_GRID_ROUTER:-1024}" \
+        BENCH_LADDER="${OPP_GRID_ROUTER:-1024}" BENCH_ACCURACY=0 ;;
+    sloaudit8x1024)
+      # SLO promise-audit A/B (ISSUE 20, obs/slo.py + serve/router.py
+      # router_slo_ab): the SAME mixed-bucket case set served by two
+      # 8-replica fleets over one shared AOT store — unaudited vs the
+      # full promise/outcome ledger (router + per-worker pipelines +
+      # live rate recalibration into the autotune records) — then a
+      # corrupted pass (modeled cost scaled 1000x: injected
+      # rate-record corruption) that must fire the drift warning.  A
+      # HOST measurement like router8x1024 (same BENCH_PLATFORM=cpu
+      # rationale; step() exempts the backend grep).  Gate
+      # (step_variant_ok): variant sloN, slo_overhead <=
+      # OPP_SLO_MAX_OVERHEAD (default 1.05 — the ISSUE 20 audit-cost
+      # ceiling), deadline_hit_rate == 1.0 (unloaded fleet, generous
+      # deadlines), drift fired on the corrupt pass and NOT on the
+      # clean pass, ledger balanced (open == 0, duplicate == 0),
+      # bit_identical.
+      bench_nofb BENCH_SLO="${OPP_ROUTER_REPLICAS:-8}" \
         BENCH_PLATFORM=cpu \
         BENCH_GRID="${OPP_GRID_ROUTER:-1024}" \
         BENCH_LADDER="${OPP_GRID_ROUTER:-1024}" BENCH_ACCURACY=0 ;;
@@ -600,6 +620,44 @@ for line in open(sys.argv[1]):
 sys.exit(0 if ok else 1)
 PYEOF
       ;;
+    sloaudit8x1024) python - "$2" <<'PYEOF'
+import json, os, sys
+# the ISSUE 20 gate: auditing must be free (slo_overhead <=
+# OPP_SLO_MAX_OVERHEAD, default 1.05 — a millisecond-scale CPU proxy
+# is noisy, so the smoke harness can relax it), every promise kept on
+# an unloaded fleet (deadline_hit_rate == 1.0), the drift detector
+# must fire under the injected rate-record corruption and stay quiet
+# on the clean pass, the ledger must balance (open == 0, duplicate ==
+# 0), and the arms must be bit-identical (auditing never touches the
+# numerics).
+limit = float(os.environ.get("OPP_SLO_MAX_OVERHEAD", "1.05"))
+ok = False
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    if not str(r.get("variant", "")).startswith("slo"):
+        continue
+    overhead = r.get("slo_overhead")
+    if not isinstance(overhead, (int, float)) or overhead > limit:
+        continue
+    if r.get("deadline_hit_rate") != 1.0:
+        continue
+    if r.get("drift_fired_clean") is not False \
+            or r.get("drift_fired_corrupt") is not True:
+        continue
+    ledger = r.get("slo") or {}
+    if ledger.get("open") != 0 or ledger.get("duplicate") != 0:
+        continue
+    if r.get("bit_identical") is True:
+        ok = True
+sys.exit(0 if ok else 1)
+PYEOF
+      ;;
     fleettcp8x1024) python - "$2" <<'PYEOF'
 import json, os, sys
 # the ISSUE 12 gate: the socket hop must not eat the fleet speedup
@@ -787,8 +845,8 @@ step() {  # <name>: run one queue step unless already done.
   log "step $name: start"
   local run rc backend_check=step_backend_ok
   case $name in
-    router8x1024 | routerobs8x1024 | fleettcp8x1024 | ttafleet8x512 \
-      | fftgang8x4096 | session8x256 | mesh4096)
+    router8x1024 | routerobs8x1024 | sloaudit8x1024 | fleettcp8x1024 \
+      | ttafleet8x512 | fftgang8x4096 | session8x256 | mesh4096)
       # deliberately host measurements (see run_step_cmd): the fleet
       # proxies pin BENCH_PLATFORM=cpu because N replica processes
       # cannot share the single tunneled chip — their rows are cpu-
